@@ -1,0 +1,27 @@
+"""distributed_grep_tpu — a TPU-native distributed-grep / MapReduce framework.
+
+A from-scratch rebuild of the capabilities of bgilby59/distributed-grep
+(reference: a Go MapReduce framework in the MIT 6.824 Lab-1 style, with grep
+as the pluggable application) designed TPU-first on JAX/XLA/Pallas:
+
+* ``apps``     — the pluggable Map/Reduce application boundary
+                 (reference: application/grep.go:13-40, main/worker_launch.go:21-34).
+* ``runtime``  — coordinator/worker MapReduce runtime: task scheduling,
+                 heartbeat/timeout fault tolerance, streaming shuffle,
+                 idempotent atomic commits
+                 (reference: map_reduce/coordinator.go, map_reduce/worker.go).
+* ``models``   — pattern automata ("model families"): shift-and bit-parallel
+                 masks, regex -> NFA -> DFA with byte-class compression,
+                 Aho-Corasick multi-pattern tables.
+* ``ops``      — TPU compute path: Pallas byte-scan kernels and pure-XLA
+                 fallbacks for DFA/shift-and scanning, newline indexing and
+                 line-number assignment.
+* ``parallel`` — device-mesh fan-out: shard_map data/sequence parallelism with
+                 DFA state carried across shard boundaries, ICI collectives,
+                 multi-host initialization.
+* ``utils``    — config, logging, metrics, IO, native-library bindings.
+"""
+
+from distributed_grep_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,136 @@
+"""CLI launchers — the L4 layer (reference: main/coordinator_launch.go,
+main/worker_launch.go), unified into one entry point.
+
+    python -m distributed_grep_tpu grep PATTERN FILE...        in-process grep
+    python -m distributed_grep_tpu run --config job.json       any application
+    python -m distributed_grep_tpu coordinator --config ...    distributed mode
+    python -m distributed_grep_tpu worker --addr host:port     distributed mode
+
+The reference's coordinator takes input files as argv and hardcodes
+everything else (coordinator_launch.go:11-23); the worker takes the
+application .so path (worker_launch.go:11-19).  Here both take a JobConfig
+(JSON + flag overrides) and applications are Python modules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_grep_tpu.utils.config import JobConfig
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--n-reduce", type=int, default=None)
+    p.add_argument("--workers", type=int, default=2, help="in-process worker threads")
+    p.add_argument("--work-dir", default=None)
+    p.add_argument("--backend", default=None, choices=["cpu", "tpu", "auto"])
+    p.add_argument("--metrics", action="store_true", help="print job metrics to stderr")
+
+
+def cmd_grep(args: argparse.Namespace) -> int:
+    import re
+    from pathlib import Path
+
+    from distributed_grep_tpu.runtime.job import run_job
+
+    try:
+        re.compile(args.pattern)
+    except re.error as e:
+        print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
+        return 2
+    missing = [f for f in args.files if not Path(f).exists()]
+    if missing:
+        print(f"error: no such file: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    cfg = JobConfig(
+        input_files=[str(Path(f).resolve()) for f in args.files],
+        application=(
+            "distributed_grep_tpu.apps.grep_tpu"
+            if (args.backend or "cpu") in ("tpu", "auto")
+            else "distributed_grep_tpu.apps.grep"
+        ),
+        app_options={"pattern": args.pattern, "ignore_case": args.ignore_case},
+        n_reduce=args.n_reduce or 10,
+    )
+    if args.work_dir:
+        cfg.work_dir = args.work_dir
+    else:
+        import tempfile
+
+        cfg.work_dir = tempfile.mkdtemp(prefix="dgrep-")
+    res = run_job(cfg, n_workers=args.workers)
+    for line in res.sorted_lines():
+        print(line)
+    if args.metrics:
+        print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    from distributed_grep_tpu.runtime.job import run_job
+
+    overrides = {}
+    if args.n_reduce:
+        overrides["n_reduce"] = args.n_reduce
+    if args.work_dir:
+        overrides["work_dir"] = args.work_dir
+    cfg = JobConfig.load(args.config, **overrides)
+    res = run_job(cfg, n_workers=args.workers, resume=args.resume)
+    for line in res.sorted_lines():
+        print(line)
+    if args.metrics:
+        print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def cmd_coordinator(args: argparse.Namespace) -> int:
+    from distributed_grep_tpu.runtime.http_coordinator import serve_coordinator
+
+    cfg = JobConfig.load(args.config)
+    serve_coordinator(cfg, resume=args.resume)
+    return 0
+
+
+def cmd_worker(args: argparse.Namespace) -> int:
+    from distributed_grep_tpu.runtime.http_transport import run_http_worker
+
+    run_http_worker(addr=args.addr, n_parallel=args.slots)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="distributed_grep_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("grep", help="distributed grep over input files")
+    p.add_argument("pattern")
+    p.add_argument("files", nargs="+")
+    p.add_argument("-i", "--ignore-case", action="store_true")
+    _add_common(p)
+    p.set_defaults(fn=cmd_grep)
+
+    p = sub.add_parser("run", help="run any MapReduce application from a job config")
+    p.add_argument("--config", required=True)
+    p.add_argument("--resume", action="store_true", help="replay journal, skip done tasks")
+    _add_common(p)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("coordinator", help="serve the distributed control plane")
+    p.add_argument("--config", required=True)
+    p.add_argument("--resume", action="store_true")
+    p.set_defaults(fn=cmd_coordinator)
+
+    p = sub.add_parser("worker", help="connect to a coordinator and process tasks")
+    p.add_argument("--addr", required=True, help="coordinator http address host:port")
+    p.add_argument("--slots", type=int, default=1, help="parallel task slots")
+    p.set_defaults(fn=cmd_worker)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Pluggable Map/Reduce applications.
+
+The application boundary mirrors the reference's plugin contract
+(application/grep.go:13-40): an application supplies
+
+    map(filename: str, contents: bytes) -> list[KeyValue]
+    reduce(key: str, values: list[str]) -> str
+
+and is loaded dynamically (loader.py is the equivalent of the Go
+``plugin.Open`` + symbol lookup in main/worker_launch.go:21-34).  CPU grep
+and TPU grep are drop-in interchangeable behind this interface.
+"""
+
+from distributed_grep_tpu.apps.base import Application, KeyValue
+from distributed_grep_tpu.apps.loader import load_application
+
+__all__ = ["Application", "KeyValue", "load_application"]

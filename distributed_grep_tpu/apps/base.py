@@ -1,0 +1,68 @@
+"""Core application types: KeyValue and the Application protocol.
+
+Reference contract: ``KeyValue`` (map_reduce/helper_types.go:8-11) and the
+Map/Reduce function pair (application/grep.go:13-40).  The reference loads
+applications as Go plugins exposing ``Map``/``Reduce`` symbols
+(main/worker_launch.go:21-34); here an application is any object (usually a
+module) exposing the same two callables, plus an optional ``configure`` hook
+so job-level options (e.g. the grep pattern — which the reference hardcodes
+to "" and never plumbs, application/grep.go:11) reach the application.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Protocol, runtime_checkable
+
+
+class KeyValue(NamedTuple):
+    """One intermediate record emitted by Map and consumed by Reduce.
+
+    Mirrors map_reduce/helper_types.go:8-11.  Keys are strings (they are
+    hashed for partitioning and sorted for grouping); values are strings.
+    """
+
+    key: str
+    value: str
+
+
+@runtime_checkable
+class Application(Protocol):
+    """The pluggable application boundary.
+
+    Structural protocol: a module or object with ``map_fn``/``reduce_fn``
+    (named to avoid shadowing Python builtins; the loader also accepts
+    ``Map``/``Reduce`` for reference-style modules).
+    """
+
+    def map_fn(self, filename: str, contents: bytes) -> list[KeyValue]:
+        """Process one input split; emit intermediate key/value records."""
+        ...
+
+    def reduce_fn(self, key: str, values: list[str]) -> str:
+        """Fold all values for one key into a single output string."""
+        ...
+
+
+def sort_by_key(records: Iterable[KeyValue]) -> list[KeyValue]:
+    """Stable sort by key — the grouping precursor (helper_types.go:14-19)."""
+    return sorted(records, key=lambda kv: kv.key)
+
+
+def group_reduce(records: list[KeyValue], reduce_fn) -> dict[str, str]:
+    """Sort-merge grouping: one reduce call per distinct key.
+
+    Mirrors ``reduceDistinctKeys`` (map_reduce/worker.go:22-43): sort all
+    records by key, walk runs of equal keys, call reduce once per run.
+    """
+    out: dict[str, str] = {}
+    kva = sort_by_key(records)
+    i = 0
+    n = len(kva)
+    while i < n:
+        j = i
+        while j < n and kva[j].key == kva[i].key:
+            j += 1
+        values = [kva[k].value for k in range(i, j)]
+        out[kva[i].key] = reduce_fn(kva[i].key, values)
+        i = j
+    return out

@@ -1,0 +1,53 @@
+"""CPU grep application — the reference flagship app, with the pattern plumbed.
+
+Mirrors application/grep.go: Map splits the input on newlines and emits one
+record per matching line with key ``"<filename> (line number #N)"`` and value
+= the line (grep.go:17-30); Reduce is the identity on the first value
+(grep.go:38-40) — grep needs no aggregation, reduce only collates output.
+
+Differences from the reference, on purpose:
+
+* The pattern actually works.  The reference initializes ``pattern = ""``
+  and never sets it (grep.go:11, TODO at coordinator.go:41), so every line
+  matches.  Here the job config calls ``configure(pattern=...)`` before any
+  map task runs.
+* Input is bytes, decoded permissively (grep must survive non-UTF8 corpora).
+* Line numbers are 1-based like grep -n (the reference is 0-based via
+  ``range`` index; 1-based is what users of grep expect and what our tests
+  compare against).
+"""
+
+from __future__ import annotations
+
+import re
+
+from distributed_grep_tpu.apps.base import KeyValue
+
+# Job-configured state (set via configure(); the reference's missing plumbing).
+_pattern: re.Pattern[bytes] = re.compile(b"")
+_ignore_case = False
+
+
+def configure(pattern: str | bytes = b"", ignore_case: bool = False, **_: object) -> None:
+    global _pattern, _ignore_case
+    if isinstance(pattern, str):
+        pattern = pattern.encode("utf-8")
+    _ignore_case = ignore_case
+    _pattern = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+
+
+def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
+    out: list[KeyValue] = []
+    for lineno, line in enumerate(contents.split(b"\n"), start=1):
+        if _pattern.search(line):
+            out.append(
+                KeyValue(
+                    key=f"{filename} (line number #{lineno})",
+                    value=line.decode("utf-8", errors="replace"),
+                )
+            )
+    return out
+
+
+def reduce_fn(key: str, values: list[str]) -> str:
+    return values[0]

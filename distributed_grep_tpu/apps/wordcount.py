@@ -1,0 +1,25 @@
+"""Word-count application — proves the application boundary is pluggable.
+
+The reference framework is application-agnostic (any Map/Reduce pair behind
+the plugin interface, main/worker_launch.go:21-34); word count is the
+canonical second app and, unlike grep, exercises a non-identity Reduce.
+"""
+
+from __future__ import annotations
+
+import re
+
+from distributed_grep_tpu.apps.base import KeyValue
+
+_WORD = re.compile(rb"[A-Za-z]+")
+
+
+def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
+    return [
+        KeyValue(key=m.group(0).decode("ascii").lower(), value="1")
+        for m in _WORD.finditer(contents)
+    ]
+
+
+def reduce_fn(key: str, values: list[str]) -> str:
+    return str(sum(int(v) for v in values))

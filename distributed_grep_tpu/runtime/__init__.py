@@ -1,0 +1,14 @@
+"""MapReduce runtime: coordinator scheduling, worker loop, transports.
+
+The runtime reproduces the reference's semantics (map_reduce/coordinator.go,
+map_reduce/worker.go) with TPU-era machinery: condition variables instead of
+10ms/50ms/1s busy-poll loops, an HTTP long-poll control plane instead of Go
+net/rpc, a shared-FS/HTTP data plane instead of SSH+SFTP, and a durable task
+journal so a restarted coordinator skips completed work.
+"""
+
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.worker import WorkerLoop
+from distributed_grep_tpu.runtime.job import run_job
+
+__all__ = ["Scheduler", "WorkerLoop", "run_job"]

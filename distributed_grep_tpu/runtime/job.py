@@ -1,0 +1,134 @@
+"""In-process job driver: coordinator + N worker threads, one call.
+
+The single-process equivalent of launching coordinator_launch + worker_launch
+binaries (main/coordinator_launch.go:11-23, main/worker_launch.go:11-19) —
+the correctness spine used by tests and the local CLI, and the shape the
+6.824-style integration tests run in.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from distributed_grep_tpu.apps.loader import LoadedApplication, load_application
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.transport import LocalTransport
+from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.io import WorkDir
+from distributed_grep_tpu.utils.logging import get_logger
+from distributed_grep_tpu.utils.metrics import Metrics
+
+log = get_logger("job")
+
+
+@dataclass
+class JobResult:
+    output_files: list[Path]
+    results: dict[str, str]  # merged key -> value across all mr-out-* files
+    metrics: dict = field(default_factory=dict)
+
+    def sorted_lines(self) -> list[str]:
+        """Output lines sorted naturally: grep-style keys sort by (file, line
+        number); anything else sorts lexicographically."""
+        import re
+
+        def sort_key(item):
+            m = re.match(r"^(.*) \(line number #(\d+)\)$", item[0])
+            return (m.group(1), int(m.group(2))) if m else (item[0], 0)
+
+        return [f"{k} {v}" for k, v in sorted(self.results.items(), key=sort_key)]
+
+
+def collate_outputs(workdir: WorkDir) -> dict[str, str]:
+    """Merge all mr-out-* files into one key->value dict.
+
+    Keys never span partitions (each key hashes to exactly one reduce task),
+    so the merge is a plain union.
+    """
+    results: dict[str, str] = {}
+    for path in workdir.list_outputs():
+        for line in path.read_text(encoding="utf-8").splitlines():
+            if line:
+                k, _, v = line.partition("\t")
+                results[k] = v
+    return results
+
+
+def run_job(
+    config: JobConfig,
+    n_workers: int = 2,
+    app: LoadedApplication | None = None,
+    resume: bool = False,
+    fault_hooks_per_worker: list[dict] | None = None,
+) -> JobResult:
+    workdir = WorkDir(config.work_dir)
+    if app is None:
+        app = load_application(config.application, **config.app_options)
+
+    journal = None
+    resume_entries = None
+    if config.journal:
+        jpath = workdir.journal_path()
+        if resume:
+            resume_entries = TaskJournal.replay(jpath)
+        elif jpath.exists():
+            jpath.unlink()  # fresh job: discard any stale journal
+        journal = TaskJournal(jpath)
+
+    metrics = Metrics()
+    scheduler = Scheduler(
+        files=list(config.input_files),
+        n_reduce=config.n_reduce,
+        task_timeout_s=config.task_timeout_s,
+        sweep_interval_s=config.sweep_interval_s,
+        app_options=config.app_options,
+        journal=journal,
+        resume_entries=resume_entries,
+        metrics=metrics,
+    )
+
+    def worker_main(idx: int) -> None:
+        hooks = (fault_hooks_per_worker or [{}] * n_workers)[idx]
+        loop = WorkerLoop(
+            LocalTransport(scheduler, workdir, rpc_timeout_s=config.rpc_timeout_s),
+            app,
+            metrics=metrics,
+            fault_hooks=hooks,
+        )
+        try:
+            loop.run()
+        except WorkerKilled:
+            log.info("worker thread %d killed by fault injection", idx)
+        except Exception:
+            log.exception("worker thread %d crashed", idx)
+
+    threads = [
+        threading.Thread(target=worker_main, args=(i,), name=f"worker-{i}", daemon=True)
+        for i in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    # Wait for completion — but abort instead of hanging if every worker has
+    # died (e.g. a config error raising in all of them) with work outstanding.
+    while not scheduler.wait_done(timeout=0.5):
+        if all(not t.is_alive() for t in threads):
+            scheduler.stop()
+            raise RuntimeError(
+                "job aborted: all workers exited with tasks outstanding "
+                "(see worker logs above)"
+            )
+    scheduler.stop()
+    for t in threads:
+        t.join(timeout=10.0)
+    if journal:
+        journal.close()
+
+    return JobResult(
+        output_files=workdir.list_outputs(),
+        results=collate_outputs(workdir),
+        metrics=metrics.snapshot(),
+    )

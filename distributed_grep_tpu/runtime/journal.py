@@ -1,0 +1,56 @@
+"""Durable task-commit journal — coordinator checkpoint/resume.
+
+The reference has no job-level checkpointing; its implicit checkpoint is the
+committed mr-* files on disk plus the file->task dedup map (coordinator.go:29,
+:53-58) — a coordinator crash loses the job (SURVEY.md §5).  This journal
+makes the same rename-commit philosophy durable: every task completion is
+appended as one JSON line, fsync'd, and a restarted coordinator replays it
+to skip finished work (the committed intermediate/output files are still on
+disk, so replay is sound).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+
+class TaskJournal:
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def record(self, entry: dict) -> None:
+        self._f.write(json.dumps(entry, sort_keys=True) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def map_completed(self, task_id: int, file: str, produced_parts: list[int]) -> None:
+        self.record(
+            {"kind": "map_done", "task_id": task_id, "file": file, "parts": produced_parts}
+        )
+
+    def reduce_completed(self, task_id: int) -> None:
+        self.record({"kind": "reduce_done", "task_id": task_id})
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def replay(path: str | Path) -> list[dict]:
+        p = Path(path)
+        if not p.exists():
+            return []
+        entries = []
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break  # torn tail write from a crash; ignore the rest
+        return entries

@@ -1,0 +1,96 @@
+"""Control-plane message schema — the four-verb protocol.
+
+Mirrors map_reduce/rpc.go exactly in capability:
+
+  AssignTask      (rpc.go:10-21)  worker asks for work; long-polls until a
+                                  map split or reduce partition is available.
+  MapFinished     (rpc.go:23-31)  map task commit notification.
+  ReduceFinished  (rpc.go:23-31)  reduce task commit notification.
+  ReduceNextFile  (rpc.go:33-42)  streaming shuffle feed: reducer asks for
+                                  its next intermediate file, long-polling
+                                  until one commits or the map phase ends.
+
+Additions over the reference: an explicit JOB_DONE assignment (the reference
+kills workers by closing SSH and letting call() log.Fatal,
+coordinator.go:291-296 / worker.go:223) and the grep job options rider on
+AssignTaskReply (the pattern plumbing the reference's TODO never built).
+All messages are plain dicts <-> dataclasses for JSON transport.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Verb:
+    ASSIGN_TASK = "AssignTask"
+    MAP_FINISHED = "MapFinished"
+    REDUCE_FINISHED = "ReduceFinished"
+    REDUCE_NEXT_FILE = "ReduceNextFile"
+
+
+class Assignment:
+    MAP = "map"
+    REDUCE = "reduce"
+    JOB_DONE = "job_done"  # explicit shutdown; reference has none
+
+
+@dataclass
+class AssignTaskArgs:
+    worker_id: int = -1  # -1 = not yet registered; coordinator allocates
+
+
+@dataclass
+class AssignTaskReply:
+    assignment: str = Assignment.JOB_DONE
+    filename: str = ""
+    task_id: int = -1
+    n_reduce: int = 0
+    worker_id: int = -1
+    app_options: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TaskFinishedArgs:
+    task_id: int
+    worker_id: int = -1
+    # Reduce partitions for which this map task actually produced records —
+    # the coordinator registers only files that exist (coordinator.go:139-141).
+    produced_parts: list[int] = field(default_factory=list)
+
+
+@dataclass
+class TaskFinishedReply:
+    ok: bool = True
+
+
+@dataclass
+class ReduceNextFileArgs:
+    task_id: int
+    files_processed: int  # rpc.go:35 FilesProcessed — resume-safe cursor
+
+
+@dataclass
+class ReduceNextFileReply:
+    next_file: str = ""
+    done: bool = False
+
+
+_TYPES = {
+    "AssignTaskArgs": AssignTaskArgs,
+    "AssignTaskReply": AssignTaskReply,
+    "TaskFinishedArgs": TaskFinishedArgs,
+    "TaskFinishedReply": TaskFinishedReply,
+    "ReduceNextFileArgs": ReduceNextFileArgs,
+    "ReduceNextFileReply": ReduceNextFileReply,
+}
+
+
+def to_dict(msg: Any) -> dict:
+    return dataclasses.asdict(msg)
+
+
+def from_dict(cls_name: str, payload: dict) -> Any:
+    return _TYPES[cls_name](**payload)

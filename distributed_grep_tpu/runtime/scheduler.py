@@ -1,0 +1,318 @@
+"""Coordinator task scheduler — the reference's semantics, without busy-polls.
+
+Reproduces map_reduce/coordinator.go's behavior:
+
+* one map task per input file, seeded up front (coordinator.go:329-333);
+  reduce partitions 0..n_reduce-1 seeded alongside (coordinator.go:334-337);
+* long-polling AssignTask: blocks until a map split is available; after the
+  map phase completes, hands out reduce partitions (coordinator.go:43-95);
+* file->task dedup so a re-enqueued file keeps its task id
+  (coordinator.go:53-58);
+* monotonically increasing worker ids allocated at assignment
+  (coordinator.go:68,:86);
+* streaming shuffle: ReduceNextFile blocks until the next intermediate file
+  for that partition commits, or returns done once the map phase is over and
+  the cursor is exhausted — so reducers run concurrently with maps
+  (coordinator.go:159-174);
+* heartbeats stamped at assignment and on every next-file fetch
+  (coordinator.go:62,:82,:162); a background sweeper re-enqueues any
+  in-progress task idle >= task_timeout_s (coordinator.go:97-124);
+* idempotent completion: duplicate MapFinished/ReduceFinished short-circuit
+  (coordinator.go:131-134);
+* Done() when both phases complete (coordinator.go:276-299) — without the
+  reference's side effect of tearing down connections inside the predicate.
+
+Where the reference busy-polls (10 ms in AssignTask :89,:92, 50 ms in
+ReduceNextFile :172, 1 s sweeper :122), this scheduler blocks on a single
+condition variable and notifies on every state change.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Optional
+
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.types import MapTask, ReduceTask, TaskState
+from distributed_grep_tpu.utils.logging import get_logger
+from distributed_grep_tpu.utils.metrics import Metrics
+
+log = get_logger("scheduler")
+
+
+class Scheduler:
+    """Transport-agnostic coordinator state machine (thread-safe)."""
+
+    def __init__(
+        self,
+        files: list[str],
+        n_reduce: int,
+        task_timeout_s: float = 10.0,
+        sweep_interval_s: float = 1.0,
+        app_options: Optional[dict[str, Any]] = None,
+        journal: Optional[TaskJournal] = None,
+        resume_entries: Optional[list[dict]] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.n_reduce = n_reduce
+        self.task_timeout_s = task_timeout_s
+        self.sweep_interval_s = sweep_interval_s
+        self.app_options = dict(app_options or {})
+        self.journal = journal
+        self.metrics = metrics or Metrics()
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+        # Task tables (MapData/ReduceData, helper_types.go:150-161).
+        self.map_tasks: list[MapTask] = [MapTask(i, f) for i, f in enumerate(files)]
+        self.reduce_tasks: list[ReduceTask] = [ReduceTask(i) for i in range(n_reduce)]
+        self.file_to_task: dict[str, int] = {f: i for i, f in enumerate(files)}
+
+        # Work queues (the buffered channels, coordinator.go:329-337).
+        self._map_queue: deque[int] = deque(range(len(files)))
+        self._reduce_queue: deque[int] = deque(range(n_reduce))
+
+        self._next_worker_id = 0  # safeInt.get_and_increment (helper_types.go:45-79)
+        self._stopped = False
+
+        if resume_entries:
+            self._replay(resume_entries)
+
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, name="failure-detector", daemon=True
+        )
+        self._sweeper.start()
+
+    # ------------------------------------------------------------------ replay
+    def _replay(self, entries: list[dict]) -> None:
+        """Apply journal entries so a restarted coordinator skips done work."""
+        for e in entries:
+            if e.get("kind") == "map_done":
+                tid = e["task_id"]
+                if 0 <= tid < len(self.map_tasks):
+                    t = self.map_tasks[tid]
+                    if t.state is not TaskState.COMPLETED:
+                        t.state = TaskState.COMPLETED
+                        self._register_map_outputs(tid, e.get("parts", []))
+                        if tid in self._map_queue:
+                            self._map_queue.remove(tid)
+            elif e.get("kind") == "reduce_done":
+                tid = e["task_id"]
+                if 0 <= tid < len(self.reduce_tasks):
+                    t = self.reduce_tasks[tid]
+                    t.state = TaskState.COMPLETED
+                    if tid in self._reduce_queue:
+                        self._reduce_queue.remove(tid)
+        n_map = sum(t.state is TaskState.COMPLETED for t in self.map_tasks)
+        n_red = sum(t.state is TaskState.COMPLETED for t in self.reduce_tasks)
+        log.info("journal replay: %d map + %d reduce tasks already complete", n_map, n_red)
+
+    # ----------------------------------------------------------------- assign
+    def assign_task(self, args: rpc.AssignTaskArgs, timeout: float = 30.0) -> rpc.AssignTaskReply:
+        """Long-poll for work.  Blocks until a task is available, the job is
+        done (reply JOB_DONE), or `timeout` elapses (reply JOB_DONE only if
+        actually done; otherwise an empty retry reply with task_id == -2)."""
+        deadline = _Deadline(timeout)
+        with self._cond:
+            worker_id = args.worker_id
+            if worker_id < 0:
+                worker_id = self._next_worker_id
+                self._next_worker_id += 1
+            while True:
+                if self._stopped or self._done_locked():
+                    return rpc.AssignTaskReply(
+                        assignment=rpc.Assignment.JOB_DONE, worker_id=worker_id
+                    )
+                if self._map_queue:
+                    tid = self._map_queue.popleft()
+                    task = self.map_tasks[tid]
+                    # file_to_task dedup keeps the same task id on re-issue
+                    # (coordinator.go:53-58); queue entries are task ids here
+                    # so the invariant holds by construction.
+                    task.state = TaskState.IN_PROGRESS
+                    task.heartbeat()
+                    task.attempts += 1
+                    self.metrics.inc("map_assigned")
+                    log.debug("assign map task %d (%s) -> worker %d", tid, task.file, worker_id)
+                    return rpc.AssignTaskReply(
+                        assignment=rpc.Assignment.MAP,
+                        filename=task.file,
+                        task_id=tid,
+                        n_reduce=self.n_reduce,
+                        worker_id=worker_id,
+                        app_options=self.app_options,
+                    )
+                if self._map_phase_done_locked() and self._reduce_queue:
+                    tid = self._reduce_queue.popleft()
+                    task = self.reduce_tasks[tid]
+                    task.state = TaskState.IN_PROGRESS
+                    task.heartbeat()
+                    task.attempts += 1
+                    self.metrics.inc("reduce_assigned")
+                    log.debug("assign reduce task %d -> worker %d", tid, worker_id)
+                    return rpc.AssignTaskReply(
+                        assignment=rpc.Assignment.REDUCE,
+                        task_id=tid,
+                        n_reduce=self.n_reduce,
+                        worker_id=worker_id,
+                        app_options=self.app_options,
+                    )
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    return rpc.AssignTaskReply(
+                        assignment=rpc.Assignment.JOB_DONE if self._done_locked() else "retry",
+                        task_id=-2,
+                        worker_id=worker_id,
+                    )
+                self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
+
+    # ------------------------------------------------------------- completion
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        """Idempotent map commit (coordinator.go:126-148)."""
+        with self._cond:
+            task = self.map_tasks[args.task_id]
+            if task.state is TaskState.COMPLETED:
+                return rpc.TaskFinishedReply(ok=True)  # duplicate absorbed (:131-134)
+            task.state = TaskState.COMPLETED
+            self._register_map_outputs(args.task_id, args.produced_parts)
+            self.metrics.inc("map_completed")
+            if self.journal:
+                self.journal.map_completed(args.task_id, task.file, args.produced_parts)
+            log.info(
+                "map task %d done (%d/%d)",
+                args.task_id,
+                sum(t.state is TaskState.COMPLETED for t in self.map_tasks),
+                len(self.map_tasks),
+            )
+            self._cond.notify_all()
+            return rpc.TaskFinishedReply(ok=True)
+
+    def _register_map_outputs(self, map_task_id: int, produced_parts: list[int]) -> None:
+        """Register committed intermediate files with their reduce partitions —
+        only partitions the map actually produced (coordinator.go:139-141)."""
+        for r in produced_parts:
+            if 0 <= r < self.n_reduce:
+                name = f"mr-{map_task_id}-{r}"
+                if name not in self.reduce_tasks[r].task_files:
+                    self.reduce_tasks[r].task_files.append(name)
+
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        with self._cond:
+            task = self.reduce_tasks[args.task_id]
+            if task.state is not TaskState.COMPLETED:
+                task.state = TaskState.COMPLETED
+                self.metrics.inc("reduce_completed")
+                if self.journal:
+                    self.journal.reduce_completed(args.task_id)
+                log.info(
+                    "reduce task %d done (%d/%d)",
+                    args.task_id,
+                    sum(t.state is TaskState.COMPLETED for t in self.reduce_tasks),
+                    self.n_reduce,
+                )
+            self._cond.notify_all()
+            return rpc.TaskFinishedReply(ok=True)
+
+    # ------------------------------------------------------ streaming shuffle
+    def reduce_next_file(
+        self, args: rpc.ReduceNextFileArgs, timeout: float = 30.0
+    ) -> rpc.ReduceNextFileReply:
+        """The pipelined shuffle feed (coordinator.go:159-174): block until the
+        reducer's next intermediate file exists, or the map phase is done and
+        the cursor is exhausted (done=True).  Doubles as a heartbeat (:162)."""
+        deadline = _Deadline(timeout)
+        with self._cond:
+            task = self.reduce_tasks[args.task_id]
+            while True:
+                task.heartbeat()
+                if args.files_processed < len(task.task_files):
+                    return rpc.ReduceNextFileReply(
+                        next_file=task.task_files[args.files_processed], done=False
+                    )
+                if self._map_phase_done_locked():
+                    return rpc.ReduceNextFileReply(done=True)
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    # Not done — client should re-poll (long-poll window expired).
+                    return rpc.ReduceNextFileReply(next_file="", done=False)
+                self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
+
+    # -------------------------------------------------------------- liveness
+    def heartbeat(self, task_type: str, task_id: int) -> None:
+        """UpdateTimestamp (coordinator.go:176-182)."""
+        with self._cond:
+            table = self.map_tasks if task_type == "map" else self.reduce_tasks
+            if 0 <= task_id < len(table):
+                table[task_id].heartbeat()
+
+    def _sweep_loop(self) -> None:
+        """Failure detector (coordinator.go:97-124): re-enqueue stale tasks."""
+        import time as _time
+
+        while True:
+            with self._cond:
+                if self._stopped or self._done_locked():
+                    return
+                now = _time.monotonic()
+                for task in self.map_tasks:
+                    if (
+                        task.state is TaskState.IN_PROGRESS
+                        and now - task.timestamp >= self.task_timeout_s
+                    ):
+                        log.warning("map task %d timed out; re-enqueueing", task.task_id)
+                        task.state = TaskState.UNASSIGNED
+                        self._map_queue.append(task.task_id)
+                        self.metrics.inc("map_retries")
+                        self._cond.notify_all()
+                for task in self.reduce_tasks:
+                    if (
+                        task.state is TaskState.IN_PROGRESS
+                        and now - task.timestamp >= self.task_timeout_s
+                    ):
+                        log.warning("reduce task %d timed out; re-enqueueing", task.task_id)
+                        task.state = TaskState.UNASSIGNED
+                        self._reduce_queue.append(task.task_id)
+                        self.metrics.inc("reduce_retries")
+                        self._cond.notify_all()
+            _time.sleep(self.sweep_interval_s)
+
+    # ------------------------------------------------------------- predicates
+    def _map_phase_done_locked(self) -> bool:
+        return all(t.state is TaskState.COMPLETED for t in self.map_tasks)
+
+    def map_phase_done(self) -> bool:
+        with self._lock:
+            return self._map_phase_done_locked()
+
+    def _done_locked(self) -> bool:
+        return self._map_phase_done_locked() and all(
+            t.state is TaskState.COMPLETED for t in self.reduce_tasks
+        )
+
+    def done(self) -> bool:
+        """Pure predicate — no teardown side effects (unlike coordinator.go:291-296)."""
+        with self._lock:
+            return self._done_locked()
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            return self._cond.wait_for(self._done_locked, timeout=timeout)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
+class _Deadline:
+    def __init__(self, timeout: float):
+        import time as _time
+
+        self._t = _time.monotonic
+        self._deadline = self._t() + timeout
+
+    def remaining(self) -> float:
+        return self._deadline - self._t()

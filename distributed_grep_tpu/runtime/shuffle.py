@@ -1,0 +1,43 @@
+"""Shuffle encoding: partitioning and the intermediate-file wire format.
+
+Partitioning is FNV-32a(key) % n_reduce, bit-compatible with the reference's
+ihash (map_reduce/worker.go:13-17, :89).  Intermediate files are JSON-lines
+of [key, value] records — the reference JSON-encodes a stream of KeyValue
+structs per file (worker.go:45-70, :92-101); JSON-lines keeps that
+inspectability while being trivially appendable and splittable.
+
+Unlike the reference's writeMapOutput — which does one full pass over the
+map output *per partition* (O(nReduce * |out|), worker.go:88-91) — this
+bucketizes in a single pass.
+"""
+
+from __future__ import annotations
+
+import json
+
+from distributed_grep_tpu.apps.base import KeyValue
+from distributed_grep_tpu.utils.native import partition
+
+
+def bucketize(records: list[KeyValue], n_reduce: int) -> dict[int, list[KeyValue]]:
+    """Single-pass partition of map output into reduce buckets."""
+    buckets: dict[int, list[KeyValue]] = {}
+    for kv in records:
+        r = partition(kv.key, n_reduce)
+        buckets.setdefault(r, []).append(kv)
+    return buckets
+
+
+def encode_records(records: list[KeyValue]) -> bytes:
+    return "".join(
+        json.dumps([kv.key, kv.value], ensure_ascii=False) + "\n" for kv in records
+    ).encode("utf-8")
+
+
+def decode_records(data: bytes) -> list[KeyValue]:
+    out: list[KeyValue] = []
+    for line in data.decode("utf-8").splitlines():
+        if line:
+            k, v = json.loads(line)
+            out.append(KeyValue(k, v))
+    return out

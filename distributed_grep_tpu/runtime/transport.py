@@ -1,0 +1,69 @@
+"""Transports: how a worker reaches the coordinator and the data plane.
+
+The reference splits control (Go net/rpc over HTTP, coordinator.go:184-193)
+from data (SSH/SFTP file copies through the coordinator host,
+coordinator.go:195-265).  Here the same split is a Protocol with two
+implementations: LocalTransport (in-process scheduler + shared work dir —
+the single-process spine and the shared-FS cluster mode) and HttpTransport
+(runtime/http_transport.py — long-poll control plane + HTTP data plane for
+multi-process/multi-host without a shared FS).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Protocol
+
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.utils.io import WorkDir, atomic_write
+
+
+class Transport(Protocol):
+    # --- control plane (the four verbs of rpc.go) --------------------------
+    def assign_task(self, args: rpc.AssignTaskArgs) -> rpc.AssignTaskReply: ...
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply: ...
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply: ...
+    def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply: ...
+
+    # --- data plane (what SFTP push/pull becomes) --------------------------
+    def read_input(self, filename: str) -> bytes: ...
+    def write_intermediate(self, name: str, data: bytes) -> None: ...
+    def read_intermediate(self, name: str) -> bytes: ...
+    def write_output(self, name: str, data: bytes) -> None: ...
+
+
+class LocalTransport:
+    """Direct scheduler calls + shared-filesystem data plane."""
+
+    def __init__(self, scheduler: Scheduler, workdir: WorkDir, rpc_timeout_s: float = 30.0):
+        self.scheduler = scheduler
+        self.workdir = workdir
+        self.rpc_timeout_s = rpc_timeout_s
+
+    def assign_task(self, args: rpc.AssignTaskArgs) -> rpc.AssignTaskReply:
+        return self.scheduler.assign_task(args, timeout=self.rpc_timeout_s)
+
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return self.scheduler.map_finished(args)
+
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return self.scheduler.reduce_finished(args)
+
+    def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply:
+        return self.scheduler.reduce_next_file(args, timeout=self.rpc_timeout_s)
+
+    def read_input(self, filename: str) -> bytes:
+        p = Path(filename)
+        if not p.is_absolute() and not p.exists():
+            p = self.workdir.root / "inputs" / p
+        return p.read_bytes()
+
+    def write_intermediate(self, name: str, data: bytes) -> None:
+        atomic_write(self.workdir.root / "intermediate" / name, data)
+
+    def read_intermediate(self, name: str) -> bytes:
+        return (self.workdir.root / "intermediate" / name).read_bytes()
+
+    def write_output(self, name: str, data: bytes) -> None:
+        atomic_write(self.workdir.root / "out" / name, data)

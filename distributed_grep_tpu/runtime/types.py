@@ -1,0 +1,53 @@
+"""Task state tables — the coordinator's core bookkeeping.
+
+Mirrors the reference's task model: state enum Unassigned/InProgress/
+Completed (helper_types.go:144-148), per-map-task {state, timestamp, file}
+(MapData, helper_types.go:150-154) and per-reduce-task {state, timestamp,
+registered intermediate files} (ReduceData, helper_types.go:156-161).
+Timestamps drive the 10s failure detector (coordinator.go:97-124).
+Tasks — not workers — are the tracked entities; workers join implicitly by
+asking for work (a genuine elasticity capability of the reference design).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+
+
+class TaskType(enum.Enum):
+    MAP = "map"
+    REDUCE = "reduce"
+
+
+class TaskState(enum.Enum):
+    UNASSIGNED = "unassigned"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+
+
+@dataclass
+class MapTask:
+    task_id: int
+    file: str
+    state: TaskState = TaskState.UNASSIGNED
+    timestamp: float = 0.0  # heartbeat; stamped at assignment
+    attempts: int = 0
+
+    def heartbeat(self) -> None:
+        self.timestamp = time.monotonic()
+
+
+@dataclass
+class ReduceTask:
+    task_id: int
+    state: TaskState = TaskState.UNASSIGNED
+    timestamp: float = 0.0
+    attempts: int = 0
+    # Intermediate files registered as map tasks commit; reducers stream these
+    # in arrival order (the pipelined shuffle, coordinator.go:159-174).
+    task_files: list[str] = field(default_factory=list)
+
+    def heartbeat(self) -> None:
+        self.timestamp = time.monotonic()

@@ -1,0 +1,128 @@
+"""Worker task loop — mirrors map_reduce/worker.go:126-178.
+
+Loop: ask for work (long-poll AssignTask); on a map assignment read the
+split, run the application's map, bucketize by FNV-32a partition, commit
+intermediate files atomically, notify MapFinished; on a reduce assignment
+stream intermediate files one at a time via ReduceNextFile (the pipelined
+shuffle — reduce starts while maps still run), sort-merge group, run the
+application's reduce per distinct key, commit the output atomically, notify
+ReduceFinished.
+
+Differences from the reference, on purpose:
+* clean shutdown on an explicit JOB_DONE assignment instead of dying via
+  log.Fatal when the coordinator closes connections (worker.go:223);
+* app options (grep pattern) arrive with the assignment and are applied via
+  the application's configure hook — the plumbing the reference never built;
+* a fault-injection hook table for tests (SURVEY.md §5 calls for one);
+* reduce output lines are sorted by key for deterministic output (the
+  reference iterates a Go map — nondeterministic order, worker.go:163-168).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from distributed_grep_tpu.apps.base import KeyValue, group_reduce
+from distributed_grep_tpu.apps.loader import LoadedApplication
+from distributed_grep_tpu.runtime import rpc, shuffle
+from distributed_grep_tpu.runtime.transport import Transport
+from distributed_grep_tpu.utils.logging import get_logger
+from distributed_grep_tpu.utils.metrics import Metrics
+
+log = get_logger("worker")
+
+
+class WorkerKilled(Exception):
+    """Raised by fault-injection hooks to simulate a worker crash."""
+
+
+class WorkerLoop:
+    def __init__(
+        self,
+        transport: Transport,
+        app: LoadedApplication,
+        metrics: Optional[Metrics] = None,
+        fault_hooks: Optional[dict[str, Callable[[], None]]] = None,
+    ):
+        self.transport = transport
+        self.app = app
+        self.metrics = metrics or Metrics()
+        self.fault_hooks = fault_hooks or {}
+        self.worker_id = -1
+
+    def _fault(self, point: str) -> None:
+        hook = self.fault_hooks.get(point)
+        if hook:
+            hook()
+
+    def run(self) -> None:
+        """The infinite task loop (worker.go:126-178), with a clean exit."""
+        while True:
+            reply = self.transport.assign_task(rpc.AssignTaskArgs(worker_id=self.worker_id))
+            self.worker_id = reply.worker_id
+            if reply.assignment == rpc.Assignment.JOB_DONE:
+                log.info("worker %d: job done, exiting", self.worker_id)
+                return
+            if reply.assignment == rpc.Assignment.MAP:
+                self._run_map(reply)
+            elif reply.assignment == rpc.Assignment.REDUCE:
+                self._run_reduce(reply)
+            # anything else ("retry"): long-poll window expired — loop again
+
+    # ------------------------------------------------------------------- map
+    def _run_map(self, a: rpc.AssignTaskReply) -> None:
+        t0 = time.perf_counter()
+        self.app.configure(**a.app_options)
+        contents = self.transport.read_input(a.filename)
+        self._fault("after_map_read")
+        with self.metrics.timer("map_compute"):
+            records = self.app.map_fn(a.filename, contents)
+        self.metrics.record_scan(len(contents), time.perf_counter() - t0)
+        buckets = shuffle.bucketize(records, a.n_reduce)
+        self._fault("before_map_commit")
+        produced: list[int] = []
+        for r, kvs in sorted(buckets.items()):
+            # Atomic write == the temp-file + rename commit (worker.go:103).
+            self.transport.write_intermediate(f"mr-{a.task_id}-{r}", shuffle.encode_records(kvs))
+            produced.append(r)
+        self._fault("before_map_finished")
+        self.transport.map_finished(
+            rpc.TaskFinishedArgs(
+                task_id=a.task_id, worker_id=self.worker_id, produced_parts=produced
+            )
+        )
+        self.metrics.inc("map_tasks")
+        self.metrics.observe("map_task_total", time.perf_counter() - t0)
+
+    # ---------------------------------------------------------------- reduce
+    def _run_reduce(self, a: rpc.AssignTaskReply) -> None:
+        t0 = time.perf_counter()
+        self.app.configure(**a.app_options)
+        records: list[KeyValue] = []
+        files_processed = 0
+        while True:
+            r = self.transport.reduce_next_file(
+                rpc.ReduceNextFileArgs(task_id=a.task_id, files_processed=files_processed)
+            )
+            if r.done:
+                break
+            if not r.next_file:
+                continue  # long-poll window expired; re-poll (worker.go:153-160)
+            data = self.transport.read_intermediate(r.next_file)
+            records.extend(shuffle.decode_records(data))
+            files_processed += 1
+            self._fault("after_reduce_file")
+        with self.metrics.timer("reduce_compute"):
+            reduced = group_reduce(records, self.app.reduce_fn)
+        self._fault("before_reduce_commit")
+        # One "key<TAB>value\n" line per key (the reference writes "key value",
+        # worker.go:111-124, but grep keys contain spaces — a tab keeps the
+        # k/v split unambiguous).  Sorted for determinism.
+        text = "".join(f"{k}\t{v}\n" for k, v in sorted(reduced.items()))
+        self.transport.write_output(f"mr-out-{a.task_id}", text.encode("utf-8"))
+        self.transport.reduce_finished(
+            rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
+        )
+        self.metrics.inc("reduce_tasks")
+        self.metrics.observe("reduce_task_total", time.perf_counter() - t0)

@@ -1,0 +1,6 @@
+"""Utilities: config, logging, metrics, IO, native-library bindings."""
+
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.metrics import Metrics
+
+__all__ = ["JobConfig", "Metrics"]

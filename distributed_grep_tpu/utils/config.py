@@ -1,0 +1,69 @@
+"""Job configuration — the knobs the reference hardcodes with TODOs.
+
+One dataclass covering exactly the reference's hardcoded constants:
+input list (coordinator_launch.go:12-17), grep pattern (application/grep.go:11),
+n_reduce=10 (coordinator_launch.go:17), coordinator address
+(worker.go:221, coordinator.go:184-193), data roots (coordinator.go:306-309,
+worker.go:19), task timeout 10s (coordinator.go:105), plus the TPU-native
+knobs (mesh shape, chunking) the reference has no analogue for.
+Loadable from JSON with CLI overrides (see runtime/launch.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+@dataclass
+class JobConfig:
+    # --- What to run -------------------------------------------------------
+    input_files: list[str] = field(default_factory=list)
+    application: str = "distributed_grep_tpu.apps.grep"
+    app_options: dict[str, Any] = field(default_factory=dict)  # e.g. {"pattern": "foo"}
+    n_reduce: int = 10  # coordinator_launch.go:17
+
+    # --- Where data lives (replaces /tmp/mr-data + /tmp/mr + SFTP) ---------
+    work_dir: str = "/tmp/dgrep"  # shared-FS data plane root
+
+    # --- Control plane -----------------------------------------------------
+    coordinator_host: str = "127.0.0.1"
+    coordinator_port: int = 1234  # coordinator.go:193
+    rpc_timeout_s: float = 60.0  # client-side long-poll ceiling
+
+    # --- Fault tolerance ---------------------------------------------------
+    task_timeout_s: float = 10.0  # coordinator.go:105,:114
+    sweep_interval_s: float = 1.0  # coordinator.go:122
+    journal: bool = True  # durable task-commit journal for coordinator resume
+
+    # --- TPU execution -----------------------------------------------------
+    backend: str = "auto"  # "cpu" | "tpu" | "auto" — pick the grep map engine
+    mesh_shape: tuple[int, ...] = ()  # () = all local devices on one data axis
+    mesh_axes: tuple[str, ...] = ("data",)
+    chunk_bytes: int = 8 * 1024 * 1024  # per-device scan chunk (HBM-sized shards)
+
+    def __post_init__(self) -> None:
+        if self.n_reduce <= 0:
+            raise ValueError(f"n_reduce must be positive, got {self.n_reduce}")
+        self.mesh_shape = tuple(self.mesh_shape)
+        self.mesh_axes = tuple(self.mesh_axes)
+
+    # --- (De)serialization -------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "JobConfig":
+        return cls(**json.loads(text))
+
+    @classmethod
+    def load(cls, path: str | Path, **overrides: Any) -> "JobConfig":
+        cfg = cls.from_json(Path(path).read_text())
+        return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+    @property
+    def coordinator_addr(self) -> str:
+        return f"http://{self.coordinator_host}:{self.coordinator_port}"

@@ -1,0 +1,85 @@
+"""IO helpers: atomic commits, chunked reads, work-dir layout.
+
+The reference's exactly-once story rests on write-to-temp + os.Rename as the
+atomic commit (worker.go:103, worker.go:169); re-executed tasks overwrite
+idempotently.  We keep exactly that design.  The work-dir layout replaces the
+reference's /tmp/mr-data (host) + /tmp/mr (remote) + SFTP star topology
+(coordinator.go:306-309, worker.go:19) with a single shared-FS root.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from pathlib import Path
+from typing import Iterator
+
+
+def atomic_write(path: str | Path, data: bytes) -> None:
+    """Write-to-temp-then-rename: the reference's commit protocol."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic on POSIX; duplicate executions are safe
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_chunks(path: str | Path, chunk_bytes: int, overlap: int = 0) -> Iterator[tuple[int, bytes]]:
+    """Stream a file as (offset, chunk) pairs with an overlap halo.
+
+    The reference reads whole files into memory (worker.go:72-76) and so
+    cannot handle a file bigger than worker RAM; chunked streaming with a
+    halo (>= max match length) is the long-context analogue (SURVEY.md §5).
+    """
+    if chunk_bytes <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    if overlap >= chunk_bytes:
+        raise ValueError("overlap must be smaller than chunk_bytes")
+    with open(path, "rb") as f:
+        offset = 0
+        carry = b""
+        while True:
+            block = f.read(chunk_bytes - len(carry))
+            if not block and not carry:
+                return
+            chunk = carry + block
+            yield offset, chunk
+            if not block or len(chunk) < chunk_bytes:
+                return
+            carry = chunk[-overlap:] if overlap else b""
+            offset += len(chunk) - len(carry)
+
+
+class WorkDir:
+    """Filesystem layout for one job under a shared root.
+
+    inputs/         input splits (what SFTP-push of inputs becomes)
+    intermediate/   mr-<map_task>-<r> shuffle files (coordinator.go:136-142)
+    out/            mr-out-<r> final outputs (worker.go:169, coordinator.go:152)
+    journal/        coordinator's durable task-commit journal
+    """
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        for sub in ("inputs", "intermediate", "out", "journal"):
+            (self.root / sub).mkdir(parents=True, exist_ok=True)
+
+    def intermediate_path(self, map_task: int, reduce_part: int) -> Path:
+        return self.root / "intermediate" / f"mr-{map_task}-{reduce_part}"
+
+    def output_path(self, reduce_task: int) -> Path:
+        return self.root / "out" / f"mr-out-{reduce_task}"
+
+    def journal_path(self) -> Path:
+        return self.root / "journal" / "tasks.jsonl"
+
+    def list_outputs(self) -> list[Path]:
+        return sorted((self.root / "out").glob("mr-out-*"))

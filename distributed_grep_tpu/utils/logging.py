@@ -1,0 +1,30 @@
+"""Structured logging — replaces the reference's bare fmt.Printf/log.Fatal.
+
+The reference logs progress with unstructured prints (coordinator.go:45,:79,
+:127,:288; worker.go:48,:132,:173) and kills workers with log.Fatal
+(worker.go:223).  Here: stdlib logging with a single consistent format and a
+per-component child-logger helper.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("DGREP_LOG", "INFO").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("dgrep")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(f"dgrep.{name}")

@@ -1,0 +1,73 @@
+"""Per-job metrics: task timings, retries, bytes scanned, GB/s accounting.
+
+The reference has no metrics at all (SURVEY.md §5).  The north-star target
+(>=10 GB/s/chip) makes throughput accounting first-class: every scan records
+bytes + seconds, every task records its assign->data-ready->compute->commit
+phases, and the job dumps one dict at completion.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Metrics:
+    """Thread-safe counters + timers; one instance per coordinator/worker."""
+
+    counters: dict[str, float] = field(default_factory=lambda: defaultdict(float))
+    timings: dict[str, list[float]] = field(default_factory=lambda: defaultdict(list))
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] += value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.timings[name].append(seconds)
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def record_scan(self, n_bytes: int, seconds: float) -> None:
+        """Throughput accounting for the north-star GB/s metric."""
+        with self._lock:
+            self.counters["bytes_scanned"] += n_bytes
+            self.counters["scan_seconds"] += seconds
+
+    def gbps(self) -> float:
+        secs = self.counters.get("scan_seconds", 0.0)
+        return (self.counters.get("bytes_scanned", 0.0) / 1e9 / secs) if secs else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "counters": dict(self.counters),
+                "timings": {
+                    k: {
+                        "count": len(v),
+                        "total_s": sum(v),
+                        "mean_s": sum(v) / len(v),
+                        "max_s": max(v),
+                    }
+                    for k, v in self.timings.items()
+                    if v
+                },
+            }
+        if out["counters"].get("scan_seconds"):
+            out["throughput_GBps"] = round(self.gbps(), 3)
+        return out
+
+    def dump(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, sort_keys=True)

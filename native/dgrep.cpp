@@ -1,0 +1,101 @@
+// libdgrep — native host-side hot loops for distributed_grep_tpu.
+//
+// The reference implements its runtime in compiled Go; the TPU-native build
+// keeps the runtime's hot host-side loops native too (the TPU compute path
+// is JAX/XLA/Pallas; this library covers what runs on the host):
+//
+//   * fnv32a        — FNV-32a partition hash (reference: ihash,
+//                     map_reduce/worker.go:13-17; partition = hash % nReduce,
+//                     worker.go:89).
+//   * newline_index — newline offset scan (memchr loop) used to slice match
+//                     byte-offsets into grep line numbers without Python
+//                     per-byte loops.
+//   * literal_scan  — memmem-based literal substring scan emitting match end
+//                     offsets; CPU fallback engine + oracle for kernels.
+//   * dfa_scan      — table-driven DFA byte scan emitting accept offsets;
+//                     the host-side oracle for the Pallas DFA kernel.
+//
+// Build: make -C native   (produces libdgrep.so; loaded via ctypes from
+// distributed_grep_tpu/utils/native.py, with pure-Python fallbacks).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// FNV-32a over `len` bytes, masked to non-negative int32 like the reference
+// does (worker.go:13-17 masks with 0x7fffffff).
+uint32_t dgrep_fnv32a(const uint8_t* data, size_t len) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < len; ++i) {
+        h ^= data[i];
+        h *= 16777619u;
+    }
+    return h & 0x7fffffffu;
+}
+
+// Write byte offsets of every '\n' into out (capacity max_out).
+// Returns the total number of newlines found (may exceed max_out; caller
+// re-calls with a bigger buffer in that case).
+size_t dgrep_newline_index(const uint8_t* data, size_t len,
+                           uint64_t* out, size_t max_out) {
+    size_t count = 0;
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    while (p < end) {
+        const uint8_t* nl = (const uint8_t*)memchr(p, '\n', (size_t)(end - p));
+        if (!nl) break;
+        if (count < max_out) out[count] = (uint64_t)(nl - data);
+        ++count;
+        p = nl + 1;
+    }
+    return count;
+}
+
+// Find end-offsets (offset of last byte + 1) of every occurrence of
+// `needle` in `hay` (overlapping occurrences included, matching regex
+// scan-all semantics). Returns total count; writes up to max_out offsets.
+size_t dgrep_literal_scan(const uint8_t* hay, size_t hay_len,
+                          const uint8_t* needle, size_t needle_len,
+                          uint64_t* out, size_t max_out) {
+    if (needle_len == 0 || needle_len > hay_len) return 0;
+    size_t count = 0;
+    const uint8_t* p = hay;
+    const uint8_t* end = hay + hay_len;
+    while (p + needle_len <= end) {
+        const uint8_t* hit =
+            (const uint8_t*)memmem(p, (size_t)(end - p), needle, needle_len);
+        if (!hit) break;
+        if (count < max_out)
+            out[count] = (uint64_t)(hit - hay) + needle_len;
+        ++count;
+        p = hit + 1;  // overlapping matches
+    }
+    return count;
+}
+
+// Table-driven DFA scan. `table` is row-major [n_states][256] uint16 next
+// states; `accept` is a per-state 0/1 byte map. Starts in `start_state`,
+// feeds every byte, records offset i+1 whenever the post-transition state is
+// accepting. Returns total accept count (writes up to max_out offsets) and
+// stores the final state in *final_state (for cross-chunk state carry).
+size_t dgrep_dfa_scan(const uint8_t* data, size_t len,
+                      const uint16_t* table, const uint8_t* accept,
+                      uint32_t start_state,
+                      uint64_t* out, size_t max_out,
+                      uint32_t* final_state) {
+    uint32_t s = start_state;
+    size_t count = 0;
+    for (size_t i = 0; i < len; ++i) {
+        s = table[((size_t)s << 8) | data[i]];
+        if (accept[s]) {
+            if (count < max_out) out[count] = (uint64_t)i + 1;
+            ++count;
+        }
+    }
+    if (final_state) *final_state = s;
+    return count;
+}
+
+}  // extern "C"

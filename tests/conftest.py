@@ -1,0 +1,38 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; the standard JAX trick is to
+fake an 8-device mesh on CPU via --xla_force_host_platform_device_count and
+test pjit/shard_map logic there (SURVEY.md §4).  Must run before jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    from distributed_grep_tpu.utils.io import WorkDir
+
+    return WorkDir(tmp_path / "job")
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    """A small multi-file text corpus with known grep-able content."""
+    files = {}
+    texts = {
+        "a.txt": "hello world\nthe quick brown fox\nhello again\n",
+        "b.txt": "nothing here\nfox says hello\n\ntrailing line",
+        "c.txt": "HELLO uppercase\nhellohello twice on one line\nlast hello",
+    }
+    for name, text in texts.items():
+        p = tmp_path / name
+        p.write_text(text)
+        files[name] = p
+    return files

@@ -1,0 +1,73 @@
+"""Application-boundary tests: KeyValue, grouping, loader, grep/wordcount apps."""
+
+import pytest
+
+from distributed_grep_tpu.apps import KeyValue, load_application
+from distributed_grep_tpu.apps.base import group_reduce
+
+
+def test_group_reduce_sort_merge_semantics():
+    # Mirrors reduceDistinctKeys (worker.go:22-43): one reduce call per key,
+    # values in original order within sorted key runs.
+    records = [KeyValue("b", "1"), KeyValue("a", "x"), KeyValue("b", "2"), KeyValue("a", "y")]
+    calls = []
+
+    def reducef(key, values):
+        calls.append((key, list(values)))
+        return ",".join(values)
+
+    out = group_reduce(records, reducef)
+    assert out == {"a": "x,y", "b": "1,2"}
+    assert calls == [("a", ["x", "y"]), ("b", ["1", "2"])]
+
+
+def test_load_application_by_module_name():
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="fox")
+    kvs = app.map_fn("f.txt", b"a fox\nno match\nfoxfox")
+    assert [kv.key for kv in kvs] == ["f.txt (line number #1)", "f.txt (line number #3)"]
+    assert app.reduce_fn("k", ["v1", "v2"]) == "v1"
+
+
+def test_load_application_by_path(tmp_path):
+    # Reference-style module exposing Map/Reduce names (worker_launch.go:27-31).
+    p = tmp_path / "custom_app.py"
+    p.write_text(
+        "from distributed_grep_tpu.apps.base import KeyValue\n"
+        "def Map(filename, contents):\n"
+        "    return [KeyValue('n_bytes', str(len(contents)))]\n"
+        "def Reduce(key, values):\n"
+        "    return str(sum(int(v) for v in values))\n"
+    )
+    app = load_application(str(p))
+    assert app.map_fn("x", b"abcd") == [KeyValue("n_bytes", "4")]
+    assert app.reduce_fn("n_bytes", ["4", "6"]) == "10"
+
+
+def test_load_application_rejects_incomplete_module(tmp_path):
+    p = tmp_path / "broken_app.py"
+    p.write_text("def Map(f, c): return []\n")  # no Reduce
+    with pytest.raises(TypeError):
+        load_application(str(p))
+
+
+def test_grep_app_pattern_plumbing_and_regex():
+    app = load_application("distributed_grep_tpu.apps.grep", pattern=r"h[ae]llo")
+    kvs = app.map_fn("t", b"hallo\nhello\nhullo\n")
+    assert len(kvs) == 2
+    # Reconfigure (new job, new pattern) — state must not leak.
+    app.configure(pattern="hullo")
+    assert len(app.map_fn("t", b"hallo\nhello\nhullo\n")) == 1
+
+
+def test_grep_app_case_insensitive_and_binary_safe():
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="hello", ignore_case=True)
+    kvs = app.map_fn("t", b"HELLO\nx\xff\xfehello\xff\n")
+    assert len(kvs) == 2
+    assert kvs[1].key == "t (line number #2)"
+
+
+def test_wordcount_app():
+    app = load_application("distributed_grep_tpu.apps.wordcount")
+    kvs = app.map_fn("t", b"the cat and the hat")
+    out = group_reduce(kvs, app.reduce_fn)
+    assert out == {"the": "2", "cat": "1", "and": "1", "hat": "1"}

@@ -1,0 +1,62 @@
+"""Native library (libdgrep) vs pure-Python fallback equivalence."""
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.utils import native
+
+
+def _python_fnv32a(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+@pytest.mark.parametrize("key", [b"", b"a", b"app", b"hello world", bytes(range(256))])
+def test_fnv32a_matches_reference_algorithm(key):
+    # Same algorithm as the reference's ihash (worker.go:13-17): FNV-32a
+    # masked to non-negative.
+    assert native.fnv32a(key) == _python_fnv32a(key)
+
+
+def test_partition_range():
+    for key in ["a", "b", "some key", ""]:
+        assert 0 <= native.partition(key, 10) < 10
+
+
+def test_newline_index():
+    data = b"a\nbb\n\nccc"
+    np.testing.assert_array_equal(native.newline_index(data), [1, 4, 5])
+    assert native.newline_index(b"").size == 0
+    assert native.newline_index(b"no newline").size == 0
+
+
+def test_literal_scan_overlapping():
+    # End offsets, overlapping occurrences included.
+    np.testing.assert_array_equal(native.literal_scan(b"aaaa", b"aa"), [2, 3, 4])
+    np.testing.assert_array_equal(native.literal_scan(b"abcabc", b"abc"), [3, 6])
+    assert native.literal_scan(b"abc", b"xyz").size == 0
+    assert native.literal_scan(b"abc", b"").size == 0
+
+
+def test_dfa_scan_and_state_carry():
+    # DFA for literal "ab": 0 -(a)-> 1 -(b)-> 2(accept); 2 -(a)-> 1.
+    tbl = np.zeros((3, 256), dtype=np.uint16)
+    tbl[:, ord("a")] = 1
+    tbl[1, ord("b")] = 2
+    acc = np.array([0, 0, 1], dtype=np.uint8)
+    offsets, final = native.dfa_scan(b"xabxab", tbl, acc)
+    np.testing.assert_array_equal(offsets, [3, 6])
+    assert final == 2
+    # State carry across a chunk boundary: split "ab" across chunks.
+    off1, s1 = native.dfa_scan(b"xa", tbl, acc, start_state=0)
+    off2, s2 = native.dfa_scan(b"bxab", tbl, acc, start_state=s1)
+    assert off1.size == 0
+    np.testing.assert_array_equal(off2, [1, 4])  # offsets relative to chunk 2
+
+
+def test_native_lib_actually_loaded():
+    # The toolchain is baked into the image; the native path must be active.
+    assert native.native_available()

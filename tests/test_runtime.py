@@ -1,0 +1,215 @@
+"""Runtime spine tests: scheduler semantics, full jobs, fault tolerance.
+
+These test the capabilities the reference exhibits (SURVEY.md §4): per-file
+map tasks, streaming shuffle, heartbeat-timeout re-execution, idempotent
+completion, atomic commits — exactly-once output despite at-least-once
+execution.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_grep_tpu.apps.loader import load_application
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.worker import WorkerKilled
+from distributed_grep_tpu.utils.config import JobConfig
+
+
+def make_config(tmp_path, corpus, pattern="hello", **kw):
+    defaults = dict(
+        input_files=[str(p) for p in corpus.values()],
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": pattern},
+        n_reduce=4,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=2.0,
+        sweep_interval_s=0.1,
+    )
+    defaults.update(kw)
+    return JobConfig(**defaults)
+
+
+# --------------------------------------------------------------- scheduler
+
+def test_scheduler_map_before_reduce():
+    s = Scheduler(files=["f1", "f2"], n_reduce=2, sweep_interval_s=0.05)
+    r1 = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    r2 = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    assert {r1.assignment, r2.assignment} == {rpc.Assignment.MAP}
+    assert {r1.filename, r2.filename} == {"f1", "f2"}
+    assert r1.worker_id != r2.worker_id  # monotonically allocated ids
+    # No reduce assignment until the map phase completes (coordinator.go:75).
+    r3 = s.assign_task(rpc.AssignTaskArgs(worker_id=r1.worker_id), timeout=0.2)
+    assert r3.assignment == "retry"
+    s.map_finished(rpc.TaskFinishedArgs(task_id=r1.task_id, produced_parts=[0]))
+    s.map_finished(rpc.TaskFinishedArgs(task_id=r2.task_id, produced_parts=[1]))
+    r4 = s.assign_task(rpc.AssignTaskArgs(worker_id=r1.worker_id), timeout=1.0)
+    assert r4.assignment == rpc.Assignment.REDUCE
+    s.stop()
+
+
+def test_scheduler_idempotent_map_finished():
+    s = Scheduler(files=["f1"], n_reduce=2, sweep_interval_s=0.05)
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    s.map_finished(rpc.TaskFinishedArgs(task_id=a.task_id, produced_parts=[0]))
+    # Duplicate completion (a timed-out clone finishing late) is absorbed
+    # (coordinator.go:131-134): partition list must not double-register.
+    s.map_finished(rpc.TaskFinishedArgs(task_id=a.task_id, produced_parts=[0]))
+    assert s.reduce_tasks[0].task_files == ["mr-0-0"]
+    s.stop()
+
+
+def test_scheduler_timeout_reenqueues_same_task_id():
+    s = Scheduler(files=["f1"], n_reduce=1, task_timeout_s=0.3, sweep_interval_s=0.05)
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    assert a.assignment == rpc.Assignment.MAP
+    # Don't complete it; the failure detector must re-enqueue within ~0.5s.
+    b = s.assign_task(rpc.AssignTaskArgs(), timeout=3.0)
+    assert b.assignment == rpc.Assignment.MAP
+    assert b.task_id == a.task_id  # file->task dedup keeps the id (coordinator.go:53-58)
+    assert s.map_tasks[a.task_id].attempts == 2
+    s.stop()
+
+
+def test_scheduler_streaming_shuffle_before_map_phase_end():
+    """Reducers stream files while maps still run (coordinator.go:159-174)."""
+    s = Scheduler(files=["f1", "f2"], n_reduce=1, sweep_interval_s=0.05)
+    a1 = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    s.map_finished(rpc.TaskFinishedArgs(task_id=a1.task_id, produced_parts=[0]))
+    # Map phase NOT done (f2 outstanding), but partition 0 already has a file.
+    r = s.reduce_next_file(rpc.ReduceNextFileArgs(task_id=0, files_processed=0), timeout=1.0)
+    assert r.next_file == f"mr-{a1.task_id}-0" and not r.done
+    # Next fetch blocks (long-poll) until the second map commits.
+    result = {}
+
+    def fetch():
+        result["r"] = s.reduce_next_file(
+            rpc.ReduceNextFileArgs(task_id=0, files_processed=1), timeout=5.0
+        )
+
+    t = threading.Thread(target=fetch)
+    t.start()
+    time.sleep(0.2)
+    a2 = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    s.map_finished(rpc.TaskFinishedArgs(task_id=a2.task_id, produced_parts=[0]))
+    t.join(timeout=5.0)
+    assert result["r"].next_file == f"mr-{a2.task_id}-0"
+    # Cursor exhausted + map phase done -> done=True.
+    r3 = s.reduce_next_file(rpc.ReduceNextFileArgs(task_id=0, files_processed=2), timeout=1.0)
+    assert r3.done
+    s.stop()
+
+
+def test_scheduler_done_predicate_is_pure():
+    s = Scheduler(files=[], n_reduce=1, sweep_interval_s=0.05)
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    assert a.assignment == rpc.Assignment.REDUCE  # zero map tasks: phase trivially done
+    s.reduce_finished(rpc.TaskFinishedArgs(task_id=a.task_id))
+    assert s.done() and s.done()  # callable repeatedly, no side effects
+    s.stop()
+
+
+# -------------------------------------------------------------- end-to-end
+
+def test_grep_job_end_to_end(tmp_path, corpus):
+    cfg = make_config(tmp_path, corpus, pattern="hello")
+    res = run_job(cfg, n_workers=3)
+    # Oracle: Python re over the same files, reference key format.
+    expected = {}
+    for name, path in corpus.items():
+        for i, line in enumerate(path.read_bytes().split(b"\n"), start=1):
+            if b"hello" in line:
+                expected[f"{path} (line number #{i})"] = line.decode()
+    # Keys contain spaces, so compare whole output lines rather than the
+    # first-space-split collate view.
+    lines = set()
+    for f in res.output_files:
+        lines.update(l for l in f.read_text().splitlines() if l)
+    expected_lines = {f"{k}\t{v}" for k, v in expected.items()}
+    assert lines == expected_lines
+    assert res.metrics["counters"]["map_completed"] == 3
+    assert res.metrics["counters"]["reduce_completed"] == 4
+
+
+def test_wordcount_job_end_to_end(tmp_path, corpus):
+    cfg = make_config(
+        tmp_path, corpus, application="distributed_grep_tpu.apps.wordcount", app_options={}
+    )
+    res = run_job(cfg, n_workers=2)
+    all_text = b" ".join(p.read_bytes() for p in corpus.values())
+    import re as _re
+
+    words = [w.lower() for w in _re.findall(r"[A-Za-z]+", all_text.decode())]
+    assert res.results["hello"] == str(words.count("hello"))
+    assert res.results["fox"] == str(words.count("fox"))
+
+
+def test_job_fault_injection_worker_death_recovers(tmp_path, corpus):
+    """Kill worker 0 mid-map; the job must still finish with correct output
+    (at-least-once execution, exactly-once output)."""
+    killed = {"n": 0}
+
+    def die_once():
+        if killed["n"] == 0:
+            killed["n"] += 1
+            raise WorkerKilled()
+
+    cfg = make_config(tmp_path, corpus, task_timeout_s=1.0)
+    res = run_job(
+        cfg,
+        n_workers=2,
+        fault_hooks_per_worker=[{"before_map_commit": die_once}, {}],
+    )
+    assert killed["n"] == 1
+    assert res.metrics["counters"]["map_completed"] == 3
+    # Retry happened for the killed task.
+    assert res.metrics["counters"].get("map_retries", 0) >= 1
+    lines = set()
+    for f in res.output_files:
+        lines.update(l for l in f.read_text().splitlines())
+    assert any("hello" in l for l in lines)
+
+
+def test_job_journal_resume_skips_completed_work(tmp_path, corpus):
+    """Coordinator crash + restart: journal replay skips finished tasks."""
+    cfg = make_config(tmp_path, corpus)
+    res1 = run_job(cfg, n_workers=2)
+    n_outputs = len(res1.output_files)
+    # "Restart": run again with resume=True — journal says everything is done,
+    # so no tasks are re-assigned (metrics show zero assignments).
+    res2 = run_job(cfg, n_workers=2, resume=True)
+    assert res2.metrics["counters"].get("map_assigned", 0) == 0
+    assert res2.metrics["counters"].get("reduce_assigned", 0) == 0
+    assert len(res2.output_files) == n_outputs
+    assert res2.results == res1.results
+
+
+def test_duplicate_execution_is_idempotent(tmp_path, corpus):
+    """Two workers racing the same re-issued task produce identical committed
+    files (rename-commit makes duplicate executions safe, worker.go:103)."""
+    slow_once = {"done": False}
+
+    def stall():
+        if not slow_once["done"]:
+            slow_once["done"] = True
+            time.sleep(2.5)  # > task_timeout_s: task gets re-issued meanwhile
+
+    cfg = make_config(tmp_path, corpus, task_timeout_s=1.0)
+    res = run_job(
+        cfg,
+        n_workers=2,
+        fault_hooks_per_worker=[{"before_map_commit": stall}, {}],
+    )
+    lines = set()
+    for f in res.output_files:
+        lines.update(l for l in f.read_text().splitlines() if l)
+    expected_lines = set()
+    for name, path in corpus.items():
+        for i, line in enumerate(path.read_bytes().split(b"\n"), start=1):
+            if b"hello" in line:
+                expected_lines.add(f"{path} (line number #{i})\t{line.decode()}")
+    assert lines == expected_lines
