@@ -24,16 +24,21 @@ import re
 from distributed_grep_tpu.apps.base import KeyValue
 
 # Job-configured state (set via configure(); the reference's missing plumbing).
+# The loader gives every job its own module instance, so this is per-job, not
+# per-process, state.
 _pattern: re.Pattern[bytes] = re.compile(b"")
-_ignore_case = False
+_configured_with: tuple | None = None
 
 
 def configure(pattern: str | bytes = b"", ignore_case: bool = False, **_: object) -> None:
-    global _pattern, _ignore_case
+    global _pattern, _configured_with
     if isinstance(pattern, str):
         pattern = pattern.encode("utf-8")
-    _ignore_case = ignore_case
+    key = (pattern, ignore_case)
+    if key == _configured_with:
+        return  # configure runs per task assignment; skip the recompile
     _pattern = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+    _configured_with = key
 
 
 def map_fn(filename: str, contents: bytes) -> list[KeyValue]:

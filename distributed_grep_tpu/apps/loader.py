@@ -16,8 +16,8 @@ The loader fixes the reference's ``LoadMR`` return-type bug
 
 from __future__ import annotations
 
-import importlib
 import importlib.util
+import itertools
 import sys
 from dataclasses import dataclass
 from pathlib import Path
@@ -41,15 +41,37 @@ class LoadedApplication:
             hook(**options)
 
 
+_instance_counter = itertools.count()
+
+
+def _fresh_instance_name(stem: str) -> str:
+    # Every load gets its own module instance (unique sys.modules key) so two
+    # concurrent jobs never share application state — module-level config like
+    # the grep pattern stays per-job, not per-process.
+    return f"_dgrep_app_{stem}_{next(_instance_counter)}"
+
+
 def _import_by_path(path: str) -> Any:
     p = Path(path)
-    mod_name = f"_dgrep_app_{p.stem}"
-    spec = importlib.util.spec_from_file_location(mod_name, p)
+    spec = importlib.util.spec_from_file_location(_fresh_instance_name(p.stem), p)
     if spec is None or spec.loader is None:
         raise ImportError(f"cannot load application from path: {path}")
     module = importlib.util.module_from_spec(spec)
-    sys.modules[mod_name] = module
+    sys.modules[spec.name] = module
     spec.loader.exec_module(module)
+    return module
+
+
+def _import_fresh_by_name(dotted: str) -> Any:
+    spec = importlib.util.find_spec(dotted)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"no module named {dotted!r}")
+    fresh = importlib.util.spec_from_file_location(
+        _fresh_instance_name(dotted.rsplit(".", 1)[-1]), spec.origin
+    )
+    module = importlib.util.module_from_spec(fresh)
+    sys.modules[fresh.name] = module
+    fresh.loader.exec_module(module)
     return module
 
 
@@ -62,7 +84,7 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
     if spec.endswith(".py") or "/" in spec:
         module = _import_by_path(spec)
     else:
-        module = importlib.import_module(spec)
+        module = _import_fresh_by_name(spec)
 
     map_fn = getattr(module, "map_fn", None) or getattr(module, "Map", None)
     reduce_fn = getattr(module, "reduce_fn", None) or getattr(module, "Reduce", None)

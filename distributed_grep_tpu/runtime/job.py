@@ -71,13 +71,17 @@ def run_job(
 
     journal = None
     resume_entries = None
+    if resume:
+        if config.journal:
+            resume_entries = TaskJournal.replay(workdir.journal_path())
+    else:
+        # Fresh job: a reused work_dir must not leak a previous job's journal,
+        # intermediate files, or outputs into this one (a smaller n_reduce
+        # would otherwise leave stale mr-out-* files that collate_outputs
+        # would silently merge in).
+        workdir.clear()
     if config.journal:
-        jpath = workdir.journal_path()
-        if resume:
-            resume_entries = TaskJournal.replay(jpath)
-        elif jpath.exists():
-            jpath.unlink()  # fresh job: discard any stale journal
-        journal = TaskJournal(jpath)
+        journal = TaskJournal(workdir.journal_path())
 
     metrics = Metrics()
     scheduler = Scheduler(

@@ -94,6 +94,18 @@ class Scheduler:
                 tid = e["task_id"]
                 if 0 <= tid < len(self.map_tasks):
                     t = self.map_tasks[tid]
+                    if t.file != e.get("file"):
+                        # Input list changed/reordered since the journal was
+                        # written: this entry describes a different file, so
+                        # the task must run again.
+                        log.warning(
+                            "journal entry for map task %d names %r but task file "
+                            "is %r; ignoring entry",
+                            tid,
+                            e.get("file"),
+                            t.file,
+                        )
+                        continue
                     if t.state is not TaskState.COMPLETED:
                         t.state = TaskState.COMPLETED
                         self._register_map_outputs(tid, e.get("parts", []))
@@ -126,6 +138,13 @@ class Scheduler:
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.JOB_DONE, worker_id=worker_id
                     )
+                while self._map_queue and (
+                    self.map_tasks[self._map_queue[0]].state is not TaskState.UNASSIGNED
+                ):
+                    # Stale entry: the task timed out, was re-enqueued, and the
+                    # original worker then completed it — never re-issue a
+                    # COMPLETED (or already re-assigned) task.
+                    self._map_queue.popleft()
                 if self._map_queue:
                     tid = self._map_queue.popleft()
                     task = self.map_tasks[tid]
@@ -145,6 +164,10 @@ class Scheduler:
                         worker_id=worker_id,
                         app_options=self.app_options,
                     )
+                while self._reduce_queue and (
+                    self.reduce_tasks[self._reduce_queue[0]].state is not TaskState.UNASSIGNED
+                ):
+                    self._reduce_queue.popleft()  # stale entry (see map queue above)
                 if self._map_phase_done_locked() and self._reduce_queue:
                     tid = self._reduce_queue.popleft()
                     task = self.reduce_tasks[tid]

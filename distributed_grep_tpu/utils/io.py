@@ -48,11 +48,13 @@ def read_chunks(path: str | Path, chunk_bytes: int, overlap: int = 0) -> Iterato
         carry = b""
         while True:
             block = f.read(chunk_bytes - len(carry))
-            if not block and not carry:
+            if not block:
+                # EOF: any carried halo bytes were already yielded as part of
+                # the previous chunk — never emit a carry-only chunk.
                 return
             chunk = carry + block
             yield offset, chunk
-            if not block or len(chunk) < chunk_bytes:
+            if len(chunk) < chunk_bytes:
                 return
             carry = chunk[-overlap:] if overlap else b""
             offset += len(chunk) - len(carry)
@@ -80,6 +82,13 @@ class WorkDir:
 
     def journal_path(self) -> Path:
         return self.root / "journal" / "tasks.jsonl"
+
+    def clear(self) -> None:
+        """Remove all job state (fresh-job reset of a reused work dir)."""
+        for sub in ("inputs", "intermediate", "out", "journal"):
+            for p in (self.root / sub).iterdir():
+                if p.is_file():
+                    p.unlink()
 
     def list_outputs(self) -> list[Path]:
         return sorted((self.root / "out").glob("mr-out-*"))

@@ -1,0 +1,95 @@
+"""Regression tests for review findings: workdir staleness, journal/file
+mismatch, stale queue entries, chunk-halo duplication, app state isolation."""
+
+import threading
+import time
+
+from distributed_grep_tpu.apps.loader import load_application
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.types import TaskState
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.io import read_chunks
+
+
+def test_fresh_job_clears_stale_outputs(tmp_path, corpus):
+    """A reused work_dir with smaller n_reduce must not leak old mr-out-*."""
+    wd = str(tmp_path / "job")
+    files = [str(p) for p in corpus.values()]
+    cfg1 = JobConfig(input_files=files, app_options={"pattern": "hello"}, n_reduce=8, work_dir=wd)
+    res1 = run_job(cfg1, n_workers=2)
+    cfg2 = JobConfig(input_files=files, app_options={"pattern": "zzz_nomatch"}, n_reduce=2, work_dir=wd)
+    res2 = run_job(cfg2, n_workers=2)
+    assert res2.results == {}  # nothing matches; stale job-1 outputs must be gone
+    assert len(res2.output_files) == 2
+    assert res1.results  # job 1 did find matches
+
+
+def test_journal_replay_rejects_changed_file(tmp_path):
+    entries = [{"kind": "map_done", "task_id": 0, "file": "old.txt", "parts": [0]}]
+    s = Scheduler(files=["new.txt"], n_reduce=1, sweep_interval_s=0.05, resume_entries=entries)
+    # Entry names a different file -> task must still be runnable.
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    assert a.assignment == rpc.Assignment.MAP and a.filename == "new.txt"
+    s.stop()
+
+
+def test_stale_queue_entry_not_reissued_after_completion():
+    """Timeout re-enqueues a task; the original worker then completes it.
+    The stale queue entry must not regress the task to IN_PROGRESS."""
+    s = Scheduler(files=["f1"], n_reduce=1, task_timeout_s=0.2, sweep_interval_s=0.05)
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    time.sleep(0.5)  # let the sweeper re-enqueue it
+    s.map_finished(rpc.TaskFinishedArgs(task_id=a.task_id, produced_parts=[0]))
+    assert s.map_tasks[a.task_id].state is TaskState.COMPLETED
+    # Next assignment must be the reduce task, not the stale map entry.
+    b = s.assign_task(rpc.AssignTaskArgs(), timeout=2.0)
+    assert b.assignment == rpc.Assignment.REDUCE
+    assert s.map_tasks[a.task_id].state is TaskState.COMPLETED
+    s.stop()
+
+
+def test_read_chunks_no_carry_only_tail(tmp_path):
+    p = tmp_path / "f"
+    p.write_bytes(b"abcd")
+    # File length == chunk size: exactly one chunk, no halo-only tail.
+    chunks = list(read_chunks(p, chunk_bytes=4, overlap=2))
+    assert chunks == [(0, b"abcd")]
+    # Two chunks with halo; second begins at offset 2 (halo overlaps).
+    p.write_bytes(b"abcdef")
+    chunks = list(read_chunks(p, chunk_bytes=4, overlap=2))
+    assert chunks == [(0, b"abcd"), (2, b"cdef")]
+    # Empty file: nothing.
+    p.write_bytes(b"")
+    assert list(read_chunks(p, chunk_bytes=4, overlap=2)) == []
+
+
+def test_app_instances_are_isolated():
+    """Two loads of the same app module must not share pattern state."""
+    a = load_application("distributed_grep_tpu.apps.grep", pattern="aaa")
+    b = load_application("distributed_grep_tpu.apps.grep", pattern="bbb")
+    assert len(a.map_fn("f", b"aaa\nbbb\n")) == 1
+    assert a.map_fn("f", b"aaa\nbbb\n")[0].key.endswith("#1)")
+    assert b.map_fn("f", b"aaa\nbbb\n")[0].key.endswith("#2)")
+
+
+def test_concurrent_jobs_different_patterns(tmp_path, corpus):
+    """Two jobs running simultaneously in one process, different patterns."""
+    files = [str(p) for p in corpus.values()]
+    results = {}
+
+    def job(name, pattern, wd):
+        cfg = JobConfig(
+            input_files=files, app_options={"pattern": pattern}, n_reduce=2, work_dir=wd
+        )
+        results[name] = run_job(cfg, n_workers=2)
+
+    t1 = threading.Thread(target=job, args=("fox", "fox", str(tmp_path / "j1")))
+    t2 = threading.Thread(target=job, args=("quick", "quick", str(tmp_path / "j2")))
+    t1.start(), t2.start()
+    t1.join(30), t2.join(30)
+    fox_lines = "\n".join(results["fox"].sorted_lines())
+    quick_lines = "\n".join(results["quick"].sorted_lines())
+    assert "fox" in fox_lines and "quick" not in fox_lines.replace("quick brown", "")
+    assert all("quick" in l for l in results["quick"].sorted_lines())
