@@ -1,0 +1,275 @@
+"""Distributed control plane: the coordinator as an HTTP server.
+
+The reference serves Go net/rpc over HTTP on :1234 (coordinator.go:184-193)
+and moves all bytes through the coordinator host via SSH/SFTP
+(coordinator.go:195-265) — a star topology where the coordinator is also the
+data hub.  This module keeps that architecture with TPU-era plumbing:
+
+* control plane: the four verbs of rpc.go as JSON-over-HTTP long-poll
+  endpoints (POST /rpc/<verb>) — long-polling happens server-side in the
+  scheduler's condition variables, not in 10/50 ms sleep loops;
+* data plane: plain HTTP GET/PUT of input splits, intermediate files, and
+  final outputs (GET/PUT /data/...), replacing SFTP push/pull — workers
+  need no shared filesystem and no SSH credentials (the reference uses
+  password-auth-equals-username + InsecureIgnoreHostKey,
+  coordinator.go:196-202);
+* bootstrap: GET /config hands workers the full JobConfig (application spec
+  + options), replacing the reference's hand-copied .so files and hardcoded
+  constants;
+* observability: GET /status returns task states + metrics.
+
+Workers join implicitly by calling AssignTask — no registry, exactly like
+the reference (elasticity by protocol shape, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.types import TaskState
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.io import WorkDir, atomic_write
+from distributed_grep_tpu.utils.logging import get_logger
+from distributed_grep_tpu.utils.metrics import Metrics
+
+log = get_logger("http_coordinator")
+
+# Server-side long-poll window: shorter than any sane client timeout, long
+# enough that re-polls are rare.
+LONG_POLL_WINDOW_S = 20.0
+
+
+class CoordinatorServer:
+    def __init__(self, config: JobConfig, resume: bool = False):
+        self.config = config
+        self.workdir = WorkDir(config.work_dir)
+        resume_entries = None
+        if resume:
+            if config.journal:
+                resume_entries = TaskJournal.replay(self.workdir.journal_path())
+        else:
+            self.workdir.clear()
+        journal = TaskJournal(self.workdir.journal_path()) if config.journal else None
+        self.metrics = Metrics()
+        self.scheduler = Scheduler(
+            files=list(config.input_files),
+            n_reduce=config.n_reduce,
+            task_timeout_s=config.task_timeout_s,
+            sweep_interval_s=config.sweep_interval_s,
+            app_options=config.app_options,
+            journal=journal,
+            resume_entries=resume_entries,
+            metrics=self.metrics,
+        )
+        self._httpd = ThreadingHTTPServer(
+            (config.coordinator_host, config.coordinator_port), _make_handler(self)
+        )
+        self._httpd.daemon_threads = True
+        self._serve_thread: threading.Thread | None = None
+
+    # --- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-coordinator", daemon=True
+        )
+        self._serve_thread.start()
+        log.info(
+            "coordinator serving on %s:%d (%d map tasks, %d reduce tasks)",
+            self.config.coordinator_host,
+            self.config.coordinator_port,
+            len(self.scheduler.map_tasks),
+            self.config.n_reduce,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def wait_done(self, timeout: float | None = None) -> bool:
+        return self.scheduler.wait_done(timeout=timeout)
+
+    def shutdown(self, linger_s: float = 2.0) -> None:
+        """Give long-polling workers a moment to receive JOB_DONE, then stop."""
+        self.scheduler.stop()
+        time.sleep(linger_s)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # --- RPC dispatch ------------------------------------------------------
+    def handle_rpc(self, verb: str, payload: dict) -> dict:
+        if verb == rpc.Verb.ASSIGN_TASK:
+            reply = self.scheduler.assign_task(
+                rpc.AssignTaskArgs(**payload), timeout=LONG_POLL_WINDOW_S
+            )
+        elif verb == rpc.Verb.MAP_FINISHED:
+            reply = self.scheduler.map_finished(rpc.TaskFinishedArgs(**payload))
+        elif verb == rpc.Verb.REDUCE_FINISHED:
+            reply = self.scheduler.reduce_finished(rpc.TaskFinishedArgs(**payload))
+        elif verb == rpc.Verb.REDUCE_NEXT_FILE:
+            reply = self.scheduler.reduce_next_file(
+                rpc.ReduceNextFileArgs(**payload), timeout=LONG_POLL_WINDOW_S
+            )
+        else:
+            raise KeyError(f"unknown RPC verb: {verb}")
+        return asdict(reply)
+
+    def status(self) -> dict:
+        s = self.scheduler
+        return {
+            "done": s.done(),
+            "map": {
+                "total": len(s.map_tasks),
+                "completed": sum(t.state is TaskState.COMPLETED for t in s.map_tasks),
+            },
+            "reduce": {
+                "total": len(s.reduce_tasks),
+                "completed": sum(t.state is TaskState.COMPLETED for t in s.reduce_tasks),
+            },
+            "metrics": self.metrics.snapshot(),
+        }
+
+
+def _make_handler(server: CoordinatorServer):
+    workdir = server.workdir
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through our logger, DEBUG only
+            log.debug("http: " + fmt, *args)
+
+        def _send_json(self, obj: dict, code: int = 200) -> None:
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_bytes(self, data: bytes, code: int = 200) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _read_body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length) if length else b""
+
+        # --- POST /rpc/<verb> ---------------------------------------------
+        def do_POST(self):
+            try:
+                if self.path.startswith("/rpc/"):
+                    verb = self.path[len("/rpc/") :]
+                    payload = json.loads(self._read_body() or b"{}")
+                    self._send_json(server.handle_rpc(verb, payload))
+                else:
+                    self._send_json({"error": "not found"}, 404)
+            except BrokenPipeError:
+                pass  # client gave up on a long-poll; scheduler state is safe
+            except Exception as e:  # noqa: BLE001 — report, don't kill the server
+                log.exception("rpc error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        # --- GET /config /status /data/... --------------------------------
+        def do_GET(self):
+            try:
+                if self.path == "/config":
+                    self._send_json(json.loads(server.config.to_json()))
+                elif self.path == "/status":
+                    self._send_json(server.status())
+                elif self.path.startswith("/data/input/"):
+                    fname = urllib.parse.unquote(self.path[len("/data/input/") :])
+                    try:
+                        data = LocalInputReader(workdir).read(fname)
+                    except FileNotFoundError:
+                        self._send_json({"error": f"no such input: {fname}"}, 404)
+                        return
+                    self._send_bytes(data)
+                elif self.path.startswith("/data/intermediate/"):
+                    name = _safe_name(self.path[len("/data/intermediate/") :])
+                    p = workdir.root / "intermediate" / name
+                    if not p.exists():
+                        self._send_json({"error": f"no such file: {name}"}, 404)
+                        return
+                    self._send_bytes(p.read_bytes())
+                else:
+                    self._send_json({"error": "not found"}, 404)
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001
+                log.exception("get error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        # --- PUT /data/intermediate/<name>, /data/out/<name> --------------
+        def do_PUT(self):
+            try:
+                data = self._read_body()
+                if self.path.startswith("/data/intermediate/"):
+                    name = _safe_name(self.path[len("/data/intermediate/") :])
+                    atomic_write(workdir.root / "intermediate" / name, data)
+                    self._send_json({"ok": True})
+                elif self.path.startswith("/data/out/"):
+                    name = _safe_name(self.path[len("/data/out/") :])
+                    atomic_write(workdir.root / "out" / name, data)
+                    self._send_json({"ok": True})
+                else:
+                    self._send_json({"error": "not found"}, 404)
+            except Exception as e:  # noqa: BLE001
+                log.exception("put error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+    return Handler
+
+
+def _safe_name(name: str) -> str:
+    name = urllib.parse.unquote(name)
+    if "/" in name or name.startswith("."):
+        raise ValueError(f"invalid data-plane file name: {name!r}")
+    return name
+
+
+class LocalInputReader:
+    """Reads input splits from the coordinator's filesystem (the data hub)."""
+
+    def __init__(self, workdir: WorkDir):
+        self.workdir = workdir
+
+    def read(self, filename: str) -> bytes:
+        from pathlib import Path
+
+        p = Path(filename)
+        if not p.is_absolute() and not p.exists():
+            p = self.workdir.root / "inputs" / p
+        return p.read_bytes()
+
+
+def serve_coordinator(config: JobConfig, resume: bool = False) -> dict:
+    """Blocking entry point for the CLI: serve until the job completes,
+    print output file list + metrics, then shut down."""
+    server = CoordinatorServer(config, resume=resume)
+    server.start()
+    server.wait_done()
+    status = server.status()
+    log.info("job complete: %s", json.dumps(status["metrics"].get("counters", {})))
+    server.shutdown()
+    print(json.dumps({"outputs": [str(p) for p in server.workdir.list_outputs()]}))
+    return status

@@ -1,0 +1,143 @@
+"""Worker-side HTTP transport: long-poll control plane + HTTP data plane.
+
+The client half of http_coordinator.py — implements the Transport protocol
+(runtime/transport.py) over urllib, replacing the reference's per-call TCP
+dials to a hardcoded coordinator IP (worker.go:220-233) and its SFTP file
+pushes.  Unlike the reference worker, which dies via log.Fatal when the
+coordinator disappears (worker.go:223), this transport retries transient
+errors with backoff and raises CoordinatorGone only after the retry budget,
+letting the worker loop exit cleanly (the coordinator vanishing after job
+completion is the normal shutdown signal, as in the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("http_transport")
+
+# Client timeout must exceed the server's long-poll window (20s).
+CLIENT_TIMEOUT_S = 40.0
+RETRY_BUDGET_S = 15.0
+RETRY_DELAY_S = 0.5
+
+
+class CoordinatorGone(Exception):
+    """The coordinator stopped answering — treat as job over (worker exits)."""
+
+
+class HttpTransport:
+    def __init__(self, addr: str):
+        # addr: "host:port" or full "http://host:port"
+        if not addr.startswith("http"):
+            addr = f"http://{addr}"
+        self.base = addr.rstrip("/")
+
+    # ------------------------------------------------------------- plumbing
+    def _request(
+        self, method: str, path: str, body: bytes | None = None, timeout: float = CLIENT_TIMEOUT_S
+    ) -> bytes:
+        url = f"{self.base}{path}"
+        deadline = time.monotonic() + RETRY_BUDGET_S
+        while True:
+            req = urllib.request.Request(url, data=body, method=method)
+            if body is not None:
+                req.add_header("Content-Type", "application/json")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                # Server answered: 4xx/5xx are not liveness failures.
+                raise RuntimeError(f"{method} {path} -> {e.code}: {e.read()[:200]!r}") from e
+            except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+                if time.monotonic() >= deadline:
+                    raise CoordinatorGone(f"{method} {path}: {e}") from e
+                time.sleep(RETRY_DELAY_S)
+
+    def _rpc(self, verb: str, payload: dict) -> dict:
+        data = self._request("POST", f"/rpc/{verb}", json.dumps(payload).encode("utf-8"))
+        return json.loads(data)
+
+    # ------------------------------------------------------- control plane
+    def assign_task(self, args: rpc.AssignTaskArgs) -> rpc.AssignTaskReply:
+        return rpc.AssignTaskReply(**self._rpc(rpc.Verb.ASSIGN_TASK, rpc.to_dict(args)))
+
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return rpc.TaskFinishedReply(**self._rpc(rpc.Verb.MAP_FINISHED, rpc.to_dict(args)))
+
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return rpc.TaskFinishedReply(**self._rpc(rpc.Verb.REDUCE_FINISHED, rpc.to_dict(args)))
+
+    def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply:
+        return rpc.ReduceNextFileReply(
+            **self._rpc(rpc.Verb.REDUCE_NEXT_FILE, rpc.to_dict(args))
+        )
+
+    # ---------------------------------------------------------- data plane
+    def read_input(self, filename: str) -> bytes:
+        return self._request("GET", f"/data/input/{urllib.parse.quote(filename, safe='')}")
+
+    def write_intermediate(self, name: str, data: bytes) -> None:
+        self._request("PUT", f"/data/intermediate/{urllib.parse.quote(name)}", data)
+
+    def read_intermediate(self, name: str) -> bytes:
+        return self._request("GET", f"/data/intermediate/{urllib.parse.quote(name)}")
+
+    def write_output(self, name: str, data: bytes) -> None:
+        self._request("PUT", f"/data/out/{urllib.parse.quote(name)}", data)
+
+    # ------------------------------------------------------------ bootstrap
+    def fetch_config(self) -> JobConfig:
+        return JobConfig(**json.loads(self._request("GET", "/config")))
+
+    def fetch_status(self) -> dict:
+        return json.loads(self._request("GET", "/status"))
+
+
+def run_http_worker(addr: str, n_parallel: int = 1) -> None:
+    """CLI worker entry: fetch config, load the application, run task loops.
+
+    The reference worker gets its application as a .so path on argv
+    (worker_launch.go:11-19) and everything else from hardcoded constants;
+    here the coordinator's /config endpoint supplies both the application
+    module spec and the job options.  n_parallel > 1 runs several task loops
+    sharing one process — the slot analogue of multiple chips per host.
+    """
+    import threading
+
+    from distributed_grep_tpu.apps.loader import load_application
+    from distributed_grep_tpu.runtime.worker import WorkerLoop
+
+    transport = HttpTransport(addr)
+    try:
+        config = transport.fetch_config()
+    except CoordinatorGone:
+        log.error("no coordinator at %s", addr)
+        raise SystemExit(1)
+    app = load_application(config.application, **config.app_options)
+
+    def run_loop(slot: int) -> None:
+        loop = WorkerLoop(HttpTransport(addr), app)
+        try:
+            loop.run()
+        except CoordinatorGone:
+            # Coordinator exited (job presumably done) — clean worker exit,
+            # unlike the reference's log.Fatal (worker.go:223).
+            log.info("slot %d: coordinator gone, exiting", slot)
+
+    threads = [
+        threading.Thread(target=run_loop, args=(i,), name=f"slot-{i}") for i in range(n_parallel)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

@@ -1,0 +1,194 @@
+"""Distributed control/data plane tests: HTTP long-poll protocol, star-topology
+data plane, multi-process localhost jobs, worker-death recovery over HTTP.
+
+The reference's own topology (coordinator + workers over RPC/SFTP,
+SURVEY.md §4) degenerates to localhost multi-process — that's what these run.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.apps.loader import load_application
+from distributed_grep_tpu.runtime.http_coordinator import CoordinatorServer
+from distributed_grep_tpu.runtime.http_transport import CoordinatorGone, HttpTransport
+from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
+from distributed_grep_tpu.utils.config import JobConfig
+
+
+def make_server(tmp_path, corpus, pattern="hello", **kw):
+    defaults = dict(
+        input_files=[str(p) for p in corpus.values()],
+        app_options={"pattern": pattern},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job"),
+        coordinator_port=0,  # ephemeral
+        task_timeout_s=2.0,
+        sweep_interval_s=0.1,
+    )
+    defaults.update(kw)
+    server = CoordinatorServer(JobConfig(**defaults))
+    server.start()
+    return server
+
+
+def expected_grep_lines(corpus, pattern=b"hello"):
+    out = set()
+    for path in corpus.values():
+        for i, line in enumerate(path.read_bytes().split(b"\n"), start=1):
+            if pattern in line:
+                out.add(f"{path} (line number #{i})\t{line.decode()}")
+    return out
+
+
+def output_lines(workdir_root):
+    lines = set()
+    for f in sorted(Path(workdir_root).glob("out/mr-out-*")):
+        lines.update(l for l in f.read_text().splitlines() if l)
+    return lines
+
+
+def test_http_end_to_end(tmp_path, corpus):
+    server = make_server(tmp_path, corpus)
+    addr = f"127.0.0.1:{server.port}"
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="hello")
+
+    def worker():
+        WorkerLoop(HttpTransport(addr), app).run()
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    assert server.wait_done(timeout=30.0)
+    for t in threads:
+        t.join(timeout=10.0)
+    assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+    status = server.status()
+    assert status["done"] and status["map"]["completed"] == 3
+    server.shutdown(linger_s=0.1)
+
+
+def test_http_worker_death_recovery(tmp_path, corpus):
+    """Worker dies after reading its input; a second worker finishes the job
+    after the task timeout re-enqueue — over the real HTTP protocol."""
+    server = make_server(tmp_path, corpus, task_timeout_s=1.0)
+    addr = f"127.0.0.1:{server.port}"
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="hello")
+
+    def dying_worker():
+        loop = WorkerLoop(
+            HttpTransport(addr),
+            app,
+            fault_hooks={"after_map_read": _raise_killed},
+        )
+        try:
+            loop.run()
+        except WorkerKilled:
+            pass
+
+    t1 = threading.Thread(target=dying_worker)
+    t1.start()
+    t1.join(timeout=10.0)
+    # Job not done; the healthy worker arrives late (elastic join) and finishes.
+    assert not server.scheduler.done()
+    t2 = threading.Thread(target=lambda: WorkerLoop(HttpTransport(addr), app).run())
+    t2.start()
+    assert server.wait_done(timeout=30.0)
+    t2.join(timeout=10.0)
+    assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+    assert server.metrics.counters.get("map_retries", 0) >= 1
+    server.shutdown(linger_s=0.1)
+
+
+def _raise_killed():
+    raise WorkerKilled()
+
+
+def test_http_data_plane_rejects_traversal(tmp_path, corpus):
+    server = make_server(tmp_path, corpus)
+    t = HttpTransport(f"127.0.0.1:{server.port}")
+    with pytest.raises(RuntimeError):
+        t.write_intermediate("../escape", b"x")
+    with pytest.raises(RuntimeError):
+        t.read_intermediate("..%2F..%2Fetc%2Fpasswd")
+    server.shutdown(linger_s=0.1)
+
+
+def test_http_config_bootstrap(tmp_path, corpus):
+    server = make_server(tmp_path, corpus, pattern="fox")
+    t = HttpTransport(f"127.0.0.1:{server.port}")
+    cfg = t.fetch_config()
+    assert cfg.app_options["pattern"] == "fox"
+    assert cfg.n_reduce == 3
+    server.shutdown(linger_s=0.1)
+
+
+def test_coordinator_gone_raises_after_budget(monkeypatch):
+    from distributed_grep_tpu.runtime import http_transport as ht
+
+    monkeypatch.setattr(ht, "RETRY_BUDGET_S", 0.5)
+    monkeypatch.setattr(ht, "RETRY_DELAY_S", 0.05)
+    # Nothing listens on this port.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    t = HttpTransport(f"127.0.0.1:{dead_port}")
+    with pytest.raises(CoordinatorGone):
+        t.fetch_status()
+
+
+@pytest.mark.slow
+def test_multiprocess_cli_job(tmp_path, corpus):
+    """Real processes: coordinator + 2 workers via the CLI, localhost."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        app_options={"pattern": "hello"},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job"),
+        coordinator_port=port,
+        task_timeout_s=3.0,
+    )
+    cfg_path = tmp_path / "job.json"
+    cfg_path.write_text(cfg.to_json())
+    repo = str(Path(__file__).resolve().parents[1])
+    env = {"PYTHONPATH": repo, "PATH": "/usr/bin:/bin", "DGREP_LOG": "WARNING",
+           "JAX_PLATFORMS": "cpu"}
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "distributed_grep_tpu", "coordinator", "--config", str(cfg_path)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+    workers = []
+    try:
+        for _ in range(2):
+            workers.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "distributed_grep_tpu", "worker",
+                     "--addr", f"127.0.0.1:{port}"],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    env=env,
+                )
+            )
+        out, err = coord.communicate(timeout=90)
+        assert coord.returncode == 0, f"coordinator failed: {err[-2000:]}"
+        outputs = json.loads(out.strip().splitlines()[-1])["outputs"]
+        assert len(outputs) == 3
+        assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+        for w in workers:
+            w.wait(timeout=30)
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
