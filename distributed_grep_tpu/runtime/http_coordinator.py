@@ -36,15 +36,17 @@ from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils.config import JobConfig
-from distributed_grep_tpu.utils.io import WorkDir, atomic_write
+from distributed_grep_tpu.utils.io import WorkDir, atomic_write, resolve_input_path
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 
 log = get_logger("http_coordinator")
 
-# Server-side long-poll window: shorter than any sane client timeout, long
-# enough that re-polls are rare.
-LONG_POLL_WINDOW_S = 20.0
+def long_poll_window_s(config: JobConfig) -> float:
+    """Server-side long-poll window, derived from the single rpc_timeout_s
+    knob so the client socket timeout (== rpc_timeout_s, http_transport.py)
+    always exceeds it: half the client ceiling, bounded to [5s, 30s]."""
+    return min(30.0, max(5.0, config.rpc_timeout_s / 2.0))
 
 
 class CoordinatorServer:
@@ -58,6 +60,9 @@ class CoordinatorServer:
         else:
             self.workdir.clear()
         journal = TaskJournal(self.workdir.journal_path()) if config.journal else None
+        # GET /data/input/ may serve exactly the job's input splits — nothing
+        # else on the coordinator's filesystem.
+        self.input_allowlist = frozenset(config.input_files)
         self.metrics = Metrics()
         self.scheduler = Scheduler(
             files=list(config.input_files),
@@ -105,17 +110,16 @@ class CoordinatorServer:
 
     # --- RPC dispatch ------------------------------------------------------
     def handle_rpc(self, verb: str, payload: dict) -> dict:
+        window = long_poll_window_s(self.config)
         if verb == rpc.Verb.ASSIGN_TASK:
-            reply = self.scheduler.assign_task(
-                rpc.AssignTaskArgs(**payload), timeout=LONG_POLL_WINDOW_S
-            )
+            reply = self.scheduler.assign_task(rpc.AssignTaskArgs(**payload), timeout=window)
         elif verb == rpc.Verb.MAP_FINISHED:
             reply = self.scheduler.map_finished(rpc.TaskFinishedArgs(**payload))
         elif verb == rpc.Verb.REDUCE_FINISHED:
             reply = self.scheduler.reduce_finished(rpc.TaskFinishedArgs(**payload))
         elif verb == rpc.Verb.REDUCE_NEXT_FILE:
             reply = self.scheduler.reduce_next_file(
-                rpc.ReduceNextFileArgs(**payload), timeout=LONG_POLL_WINDOW_S
+                rpc.ReduceNextFileArgs(**payload), timeout=window
             )
         else:
             raise KeyError(f"unknown RPC verb: {verb}")
@@ -192,8 +196,13 @@ def _make_handler(server: CoordinatorServer):
                     self._send_json(server.status())
                 elif self.path.startswith("/data/input/"):
                     fname = urllib.parse.unquote(self.path[len("/data/input/") :])
+                    if fname not in server.input_allowlist:
+                        # Never serve arbitrary coordinator-host files — only
+                        # the job's own input splits.
+                        self._send_json({"error": f"not an input split: {fname}"}, 403)
+                        return
                     try:
-                        data = LocalInputReader(workdir).read(fname)
+                        data = resolve_input_path(fname, workdir).read_bytes()
                     except FileNotFoundError:
                         self._send_json({"error": f"no such input: {fname}"}, 404)
                         return
@@ -245,21 +254,6 @@ def _safe_name(name: str) -> str:
     if "/" in name or name.startswith("."):
         raise ValueError(f"invalid data-plane file name: {name!r}")
     return name
-
-
-class LocalInputReader:
-    """Reads input splits from the coordinator's filesystem (the data hub)."""
-
-    def __init__(self, workdir: WorkDir):
-        self.workdir = workdir
-
-    def read(self, filename: str) -> bytes:
-        from pathlib import Path
-
-        p = Path(filename)
-        if not p.is_absolute() and not p.exists():
-            p = self.workdir.root / "inputs" / p
-        return p.read_bytes()
 
 
 def serve_coordinator(config: JobConfig, resume: bool = False) -> dict:

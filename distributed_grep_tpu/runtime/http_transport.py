@@ -25,8 +25,6 @@ from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("http_transport")
 
-# Client timeout must exceed the server's long-poll window (20s).
-CLIENT_TIMEOUT_S = 40.0
 RETRY_BUDGET_S = 15.0
 RETRY_DELAY_S = 0.5
 
@@ -36,30 +34,36 @@ class CoordinatorGone(Exception):
 
 
 class HttpTransport:
-    def __init__(self, addr: str):
-        # addr: "host:port" or full "http://host:port"
+    def __init__(self, addr: str, rpc_timeout_s: float = 60.0):
+        # addr: "host:port" or full "http://host:port".  rpc_timeout_s is the
+        # client socket timeout; the coordinator derives its long-poll window
+        # as half of this (bounded to 30s, http_coordinator.long_poll_window_s)
+        # so a healthy idle long-poll always returns before the socket times
+        # out.  Pass the job's JobConfig.rpc_timeout_s.
         if not addr.startswith("http"):
             addr = f"http://{addr}"
         self.base = addr.rstrip("/")
+        self.rpc_timeout_s = rpc_timeout_s
 
     # ------------------------------------------------------------- plumbing
-    def _request(
-        self, method: str, path: str, body: bytes | None = None, timeout: float = CLIENT_TIMEOUT_S
-    ) -> bytes:
+    def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
         url = f"{self.base}{path}"
-        deadline = time.monotonic() + RETRY_BUDGET_S
+        deadline: float | None = None  # anchored at the FIRST failure
         while True:
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
                 req.add_header("Content-Type", "application/json")
             try:
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                with urllib.request.urlopen(req, timeout=self.rpc_timeout_s) as resp:
                     return resp.read()
             except urllib.error.HTTPError as e:
                 # Server answered: 4xx/5xx are not liveness failures.
                 raise RuntimeError(f"{method} {path} -> {e.code}: {e.read()[:200]!r}") from e
             except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
-                if time.monotonic() >= deadline:
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + RETRY_BUDGET_S
+                if now >= deadline:
                     raise CoordinatorGone(f"{method} {path}: {e}") from e
                 time.sleep(RETRY_DELAY_S)
 
@@ -126,7 +130,7 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     app = load_application(config.application, **config.app_options)
 
     def run_loop(slot: int) -> None:
-        loop = WorkerLoop(HttpTransport(addr), app)
+        loop = WorkerLoop(HttpTransport(addr, rpc_timeout_s=config.rpc_timeout_s), app)
         try:
             loop.run()
         except CoordinatorGone:

@@ -11,12 +11,11 @@ multi-process/multi-host without a shared FS).
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import Protocol
 
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.scheduler import Scheduler
-from distributed_grep_tpu.utils.io import WorkDir, atomic_write
+from distributed_grep_tpu.utils.io import WorkDir, atomic_write, resolve_input_path
 
 
 class Transport(Protocol):
@@ -54,10 +53,7 @@ class LocalTransport:
         return self.scheduler.reduce_next_file(args, timeout=self.rpc_timeout_s)
 
     def read_input(self, filename: str) -> bytes:
-        p = Path(filename)
-        if not p.is_absolute() and not p.exists():
-            p = self.workdir.root / "inputs" / p
-        return p.read_bytes()
+        return resolve_input_path(filename, self.workdir).read_bytes()
 
     def write_intermediate(self, name: str, data: bytes) -> None:
         atomic_write(self.workdir.root / "intermediate" / name, data)

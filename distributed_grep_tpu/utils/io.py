@@ -60,6 +60,16 @@ def read_chunks(path: str | Path, chunk_bytes: int, overlap: int = 0) -> Iterato
             offset += len(chunk) - len(carry)
 
 
+def resolve_input_path(filename: str, workdir: "WorkDir") -> Path:
+    """Input-split path resolution, shared by every data plane: absolute paths
+    and existing cwd-relative paths are used as-is; bare names fall back to
+    the work dir's inputs/ directory."""
+    p = Path(filename)
+    if not p.is_absolute() and not p.exists():
+        p = workdir.root / "inputs" / p
+    return p
+
+
 class WorkDir:
     """Filesystem layout for one job under a shared root.
 

@@ -120,6 +120,19 @@ def test_http_data_plane_rejects_traversal(tmp_path, corpus):
     server.shutdown(linger_s=0.1)
 
 
+def test_http_input_endpoint_allowlist(tmp_path, corpus):
+    """GET /data/input/ serves only the job's input splits — never arbitrary
+    coordinator-host files like /etc/passwd."""
+    server = make_server(tmp_path, corpus)
+    t = HttpTransport(f"127.0.0.1:{server.port}")
+    legit = server.config.input_files[0]
+    assert t.read_input(legit) == Path(legit).read_bytes()
+    with pytest.raises(RuntimeError) as e:
+        t.read_input("/etc/passwd")
+    assert "403" in str(e.value)
+    server.shutdown(linger_s=0.1)
+
+
 def test_http_config_bootstrap(tmp_path, corpus):
     server = make_server(tmp_path, corpus, pattern="fox")
     t = HttpTransport(f"127.0.0.1:{server.port}")
