@@ -1,0 +1,37 @@
+"""Pattern automata — the "model families" of a grep framework.
+
+A pattern compiles to one of three automaton models, in order of preference:
+
+* ``shift_and``  — bit-parallel Shift-And masks for literals and short
+                   class sequences (<= 32 symbols): the fastest TPU path,
+                   pure VPU integer ops, no table gathers.
+* ``dfa``        — regex subset -> Thompson NFA -> subset-construction DFA
+                   with byte-class compression: the general engine.
+* ``aho``        — Aho-Corasick automaton for multi-literal pattern sets,
+                   emitted in the same DFA table format.
+
+All models share the *newline-reset* property: the scan state after a '\\n'
+byte is a fixed state independent of prior state.  That property is what
+makes the TPU scan embarrassingly lane-parallel (state at any byte depends
+only on bytes since line start), with exact host-side stitching of lines
+that span lane boundaries (ops/ and SURVEY.md §5 long-context analogue).
+"""
+
+from distributed_grep_tpu.models.dfa import (
+    DfaTable,
+    RegexError,
+    TooManyStates,
+    compile_dfa,
+)
+from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
+from distributed_grep_tpu.models.aho import compile_aho_corasick
+
+__all__ = [
+    "DfaTable",
+    "RegexError",
+    "TooManyStates",
+    "compile_dfa",
+    "ShiftAndModel",
+    "try_compile_shift_and",
+    "compile_aho_corasick",
+]

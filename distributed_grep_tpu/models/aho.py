@@ -1,0 +1,119 @@
+"""Aho-Corasick multi-pattern automaton, emitted as a DFA scan table.
+
+Multi-literal pattern sets (grep -f / Hyperscan-style rule sets,
+BASELINE.json configs 3 and 5) compile to a trie with failure links,
+resolved into the same dense ``DfaTable`` the single-pattern engine uses —
+so the TPU byte-scan kernel is identical; only the host-side compiler
+differs.  Accept states answer "some pattern ends at this byte", which is
+exactly grep's per-line match semantics.
+
+Construction is the textbook algorithm: build the trie, BFS to compute
+failure links, then densify goto+failure into full transitions; finally
+compress byte columns into equivalence classes and force the newline-reset
+column like compile_dfa does.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from distributed_grep_tpu.models.dfa import NL, DfaTable, RegexError, TooManyStates
+
+
+def compile_aho_corasick(
+    patterns: list[str | bytes],
+    ignore_case: bool = False,
+    max_states: int = 1 << 16,
+) -> DfaTable:
+    """Compile a literal pattern set into a newline-reset DfaTable."""
+    if not patterns:
+        raise RegexError("empty pattern set")
+    needles: list[bytes] = []
+    for p in patterns:
+        b = p.encode("utf-8") if isinstance(p, str) else bytes(p)
+        if not b:
+            raise RegexError("empty literal in pattern set")
+        if NL in b:
+            raise RegexError("literal contains '\\n' — not representable per-line")
+        needles.append(b.lower() if ignore_case else b)
+
+    # --- trie --------------------------------------------------------------
+    goto: list[dict[int, int]] = [{}]
+    accept_sets: list[bool] = [False]
+
+    def add(word: bytes) -> None:
+        s = 0
+        for byte in word:
+            if byte not in goto[s]:
+                if len(goto) >= max_states:
+                    raise TooManyStates(f"pattern set needs >{max_states} trie states")
+                goto[s][byte] = len(goto)
+                goto.append({})
+                accept_sets.append(False)
+            s = goto[s][byte]
+        accept_sets[s] = True
+
+    for w in needles:
+        add(w)
+    n = len(goto)
+
+    # --- failure links (BFS) ----------------------------------------------
+    fail = [0] * n
+    q: deque[int] = deque()
+    for byte, s in goto[0].items():
+        q.append(s)
+    while q:
+        u = q.popleft()
+        accept_sets[u] = accept_sets[u] or accept_sets[fail[u]]
+        for byte, v in goto[u].items():
+            q.append(v)
+            f = fail[u]
+            while f and byte not in goto[f]:
+                f = fail[f]
+            fail[v] = goto[f].get(byte, 0) if goto[f].get(byte, 0) != v else 0
+
+    # --- densify to full transitions --------------------------------------
+    # delta[s][b] = goto with failure resolution; column '\n' forced to 0.
+    full = np.zeros((n, 256), dtype=np.uint16)
+    order = list(range(n))  # BFS order from construction: parents precede children
+    # Recompute in BFS order so delta[fail[u]] is ready before delta[u].
+    bfs = [0]
+    q = deque(goto[0].values())
+    while q:
+        u = q.popleft()
+        bfs.append(u)
+        q.extend(goto[u].values())
+    for s in bfs:
+        for b in range(256):
+            if b == NL:
+                full[s, b] = 0
+                continue
+            if ignore_case and ord("A") <= b <= ord("Z"):
+                lookup = b + 32
+            else:
+                lookup = b
+            if lookup in goto[s]:
+                full[s, b] = goto[s][lookup]
+            else:
+                full[s, b] = 0 if s == 0 else full[fail[s], b]
+
+    # --- byte-class compression -------------------------------------------
+    cols, byte_to_cls = np.unique(full, axis=1, return_inverse=True)
+    # keep '\n' in its own class even if its column collides with another
+    nl_cls = int(byte_to_cls[NL])
+    if int(np.sum(byte_to_cls == nl_cls)) > 1:
+        byte_to_cls = byte_to_cls.copy()
+        byte_to_cls[NL] = cols.shape[1]
+        cols = np.concatenate([cols, np.zeros((n, 1), dtype=cols.dtype)], axis=1)
+    trans = np.ascontiguousarray(cols, dtype=np.uint16)
+
+    return DfaTable(
+        trans=trans,
+        byte_to_cls=byte_to_cls.astype(np.uint8),
+        accept=np.asarray(accept_sets, dtype=bool),
+        accept_eol=np.zeros(n, dtype=bool),
+        start=0,
+        pattern=f"<aho-corasick {len(needles)} literals>",
+    )

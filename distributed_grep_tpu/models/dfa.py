@@ -1,0 +1,592 @@
+"""Regex subset -> NFA -> DFA compiler with byte-class compression.
+
+The reference greps with Go's regexp, one line at a time on the host
+(application/grep.go:20-30).  The TPU path instead compiles the pattern
+*once* on the host into a dense DFA transition table that a byte-scan kernel
+executes over the whole corpus (SURVEY.md §7 step 4).  Supported syntax —
+the grep -E working set:
+
+    literals (UTF-8 as raw byte sequences), '.', escapes (\\n \\t \\r \\\\
+    \\xHH \\d \\D \\w \\W \\s \\S and escaped metachars), character classes
+    [a-z] / [^...], alternation '|', groups '(...)', repeats '* + ?' and
+    bounded '{m} {m,n} {m,}', anchors '^' and '$', case-insensitive flag.
+
+Semantics baked into the table (all chosen for the TPU scan):
+
+* **Unanchored search**: the DFA recognizes Sigma*·pattern — an accepting
+  state means "a match ends at this byte".
+* **Newline reset**: every state's transition on '\\n' is forced to the
+  line-start state.  Patterns are rejected (NewlineInPattern) if they would
+  consume '\\n', so the forcing is semantics-preserving.  This gives the
+  scan its lane-parallel decomposition: state at byte i depends only on
+  bytes since the start of i's line.
+* **Non-consuming anchors**: '^' branches are reachable only at line start
+  (initial state / after the reset); '$' is a second accept set
+  ``accept_at_eol`` — a match iff the *next* byte is '\\n' (scans pad a
+  trailing '\\n', so end-of-input behaves as end-of-line).
+* **Byte classes**: bytes are partitioned into equivalence classes so the
+  device table is [n_states, n_classes] rather than [n_states, 256].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class RegexError(ValueError):
+    """Malformed pattern."""
+
+
+class TooManyStates(RegexError):
+    """DFA exceeded the state cap — caller should fall back to the CPU engine."""
+
+
+class NewlineInPattern(RegexError):
+    """Pattern would consume '\\n'; the newline-reset table cannot express it."""
+
+
+NL = 0x0A
+_ALL = (1 << 256) - 1
+_ANY_NO_NL = _ALL & ~(1 << NL)  # '.' — any byte except newline
+
+
+def _mask_of(byte: int) -> int:
+    return 1 << byte
+
+
+def _class_mask(chars: str) -> int:
+    m = 0
+    for c in chars:
+        m |= 1 << ord(c)
+    return m
+
+
+_DIGIT = _class_mask("0123456789")
+_WORD = _DIGIT | _class_mask("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_")
+# \s normally includes '\n', but the scan is strictly per-line (lines never
+# contain '\n'), so excluding it here is semantics-preserving — and keeps \s
+# usable under the newline-reset table.
+_SPACE = _class_mask(" \t\r\x0b\x0c")
+
+
+# --------------------------------------------------------------------- AST
+
+@dataclass
+class Char:
+    mask: int  # 256-bit membership bitmask
+
+
+@dataclass
+class Concat:
+    parts: list
+
+
+@dataclass
+class Alt:
+    options: list
+
+
+@dataclass
+class Repeat:
+    node: object
+    min: int
+    max: int | None  # None = unbounded
+
+
+@dataclass
+class Anchor:
+    kind: str  # "^" or "$"
+
+
+_REPEAT_EXPANSION_CAP = 512  # total copies a bounded repeat may expand to
+
+
+class _Parser:
+    """Recursive-descent parser for the grep -E subset."""
+
+    def __init__(self, pattern: str, ignore_case: bool):
+        self.src = pattern.encode("utf-8") if isinstance(pattern, str) else bytes(pattern)
+        self.pos = 0
+        self.ignore_case = ignore_case
+
+    def parse(self):
+        node = self._alt()
+        if self.pos != len(self.src):
+            raise RegexError(f"unexpected {chr(self.src[self.pos])!r} at {self.pos}")
+        return node
+
+    # alt := concat ('|' concat)*
+    def _alt(self):
+        options = [self._concat()]
+        while self._peek() == ord("|"):
+            self.pos += 1
+            options.append(self._concat())
+        return options[0] if len(options) == 1 else Alt(options)
+
+    # concat := repeat*
+    def _concat(self):
+        parts = []
+        while True:
+            c = self._peek()
+            if c is None or c in (ord("|"), ord(")")):
+                break
+            parts.append(self._repeat())
+        if not parts:
+            return Concat([])
+        return parts[0] if len(parts) == 1 else Concat(parts)
+
+    # repeat := atom ('*'|'+'|'?'|'{m,n}')?
+    def _repeat(self):
+        atom = self._atom()
+        c = self._peek()
+        if c == ord("*"):
+            self.pos += 1
+            node = Repeat(atom, 0, None)
+        elif c == ord("+"):
+            self.pos += 1
+            node = Repeat(atom, 1, None)
+        elif c == ord("?"):
+            self.pos += 1
+            node = Repeat(atom, 0, 1)
+        elif c == ord("{"):
+            node = Repeat(atom, *self._bounds())
+        else:
+            return atom
+        if isinstance(atom, Anchor):
+            raise RegexError("cannot repeat an anchor")
+        if self._peek() == ord("?"):  # lazy marker — match-detection is identical
+            self.pos += 1
+        return node
+
+    def _bounds(self) -> tuple[int, int | None]:
+        start = self.pos
+        assert self.src[self.pos] == ord("{")
+        self.pos += 1
+        end = self.src.find(b"}", self.pos)
+        if end < 0:
+            raise RegexError(f"unterminated {{...}} at {start}")
+        body = self.src[self.pos : end].decode("ascii", "replace")
+        self.pos = end + 1
+        try:
+            if "," not in body:
+                m = int(body)
+                return m, m
+            lo, hi = body.split(",", 1)
+            m = int(lo) if lo else 0
+            n = int(hi) if hi else None
+        except ValueError as e:
+            raise RegexError(f"bad repeat bounds {{{body}}}") from e
+        if n is not None and n < m:
+            raise RegexError(f"bad repeat bounds {{{body}}}: max < min")
+        return m, n
+
+    def _atom(self):
+        c = self._peek()
+        if c is None:
+            raise RegexError("unexpected end of pattern")
+        if c == ord("("):
+            self.pos += 1
+            if self.src[self.pos : self.pos + 2] == b"?:":  # non-capturing group
+                self.pos += 2
+            node = self._alt()
+            if self._peek() != ord(")"):
+                raise RegexError(f"unbalanced '(' at {self.pos}")
+            self.pos += 1
+            return node
+        if c == ord("["):
+            return Char(self._char_class())
+        if c == ord("."):
+            self.pos += 1
+            return Char(_ANY_NO_NL)
+        if c == ord("^"):
+            self.pos += 1
+            return Anchor("^")
+        if c == ord("$"):
+            self.pos += 1
+            return Anchor("$")
+        if c == ord("\\"):
+            return Char(self._fold(self._escape()))
+        if c in (ord("*"), ord("+"), ord("?"), ord("{"), ord("}")):
+            # '{' not opening a valid bound is literal, like grep
+            if c == ord("{"):
+                save = self.pos
+                try:
+                    self.pos += 0
+                    self._bounds()
+                    raise RegexError("repeat with nothing to repeat")
+                except RegexError as e:
+                    if "nothing to repeat" in str(e):
+                        raise
+                    self.pos = save
+            else:
+                raise RegexError(f"nothing to repeat before {chr(c)!r} at {self.pos}")
+        self.pos += 1
+        return Char(self._fold(_mask_of(c)))
+
+    def _escape(self) -> int:
+        self.pos += 1  # consume backslash
+        if self.pos >= len(self.src):
+            raise RegexError("trailing backslash")
+        c = self.src[self.pos]
+        self.pos += 1
+        simple = {
+            ord("n"): _mask_of(NL),
+            ord("t"): _mask_of(9),
+            ord("r"): _mask_of(13),
+            ord("f"): _mask_of(12),
+            ord("v"): _mask_of(11),
+            ord("0"): _mask_of(0),
+            ord("d"): _DIGIT,
+            ord("D"): _ALL & ~_DIGIT & ~_mask_of(NL),
+            ord("w"): _WORD,
+            ord("W"): _ALL & ~_WORD & ~_mask_of(NL),
+            ord("s"): _SPACE,
+            ord("S"): _ALL & ~_SPACE,
+        }
+        if c in simple:
+            return simple[c]
+        if c == ord("x"):
+            hexs = self.src[self.pos : self.pos + 2]
+            if len(hexs) != 2:
+                raise RegexError("bad \\x escape")
+            self.pos += 2
+            return _mask_of(int(hexs, 16))
+        return _mask_of(c)  # escaped literal (metachars, punctuation, ...)
+
+    def _char_class(self) -> int:
+        start = self.pos
+        assert self.src[self.pos] == ord("[")
+        self.pos += 1
+        negate = False
+        if self._peek() == ord("^"):
+            negate = True
+            self.pos += 1
+        mask = 0
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                raise RegexError(f"unterminated '[' at {start}")
+            if c == ord("]") and not first:
+                self.pos += 1
+                break
+            first = False
+            if c == ord("\\"):
+                m = self._escape()
+            else:
+                self.pos += 1
+                m = _mask_of(c)
+            # range a-z: single char followed by '-' and another single char
+            if (
+                m.bit_count() == 1
+                and self._peek() == ord("-")
+                and self.pos + 1 < len(self.src)
+                and self.src[self.pos + 1] != ord("]")
+            ):
+                self.pos += 1
+                hi_c = self._peek()
+                if hi_c == ord("\\"):
+                    hi_m = self._escape()
+                else:
+                    self.pos += 1
+                    hi_m = _mask_of(hi_c)
+                if hi_m.bit_count() != 1:
+                    raise RegexError("bad class range endpoint")
+                lo_b = m.bit_length() - 1
+                hi_b = hi_m.bit_length() - 1
+                if hi_b < lo_b:
+                    raise RegexError(f"reversed class range at {start}")
+                for b in range(lo_b, hi_b + 1):
+                    mask |= 1 << b
+            else:
+                mask |= m
+        if negate:
+            mask = _ALL & ~mask & ~_mask_of(NL)  # grep: negated classes skip \n
+        return self._fold(mask)
+
+    def _fold(self, mask: int) -> int:
+        if not self.ignore_case:
+            return mask
+        folded = mask
+        for lo, up in zip(range(ord("a"), ord("z") + 1), range(ord("A"), ord("Z") + 1)):
+            if mask >> lo & 1:
+                folded |= 1 << up
+            if mask >> up & 1:
+                folded |= 1 << lo
+        return folded
+
+    def _peek(self) -> int | None:
+        return self.src[self.pos] if self.pos < len(self.src) else None
+
+
+# --------------------------------------------------------------------- NFA
+
+@dataclass
+class _NfaState:
+    # char transitions: list of (mask, target); eps: list of targets
+    chars: list = field(default_factory=list)
+    eps: list = field(default_factory=list)
+
+
+class _Nfa:
+    """Thompson construction.  Fragments are (start, accept) state-id pairs."""
+
+    def __init__(self):
+        self.states: list[_NfaState] = []
+
+    def new_state(self) -> int:
+        self.states.append(_NfaState())
+        return len(self.states) - 1
+
+    def build(self, node) -> tuple[int, int]:
+        if isinstance(node, Char):
+            if node.mask >> NL & 1:
+                raise NewlineInPattern(
+                    "pattern consumes '\\n' — not representable with line semantics"
+                )
+            if node.mask == 0:
+                raise RegexError("empty character class matches nothing")
+            s, a = self.new_state(), self.new_state()
+            self.states[s].chars.append((node.mask, a))
+            return s, a
+        if isinstance(node, Concat):
+            s = a = self.new_state()
+            for part in node.parts:
+                ps, pa = self.build(part)
+                self.states[a].eps.append(ps)
+                a = pa
+            return s, a
+        if isinstance(node, Alt):
+            s, a = self.new_state(), self.new_state()
+            for opt in node.options:
+                os_, oa = self.build(opt)
+                self.states[s].eps.append(os_)
+                self.states[oa].eps.append(a)
+            return s, a
+        if isinstance(node, Repeat):
+            return self._build_repeat(node)
+        if isinstance(node, Anchor):
+            raise RegexError(
+                f"'{node.kind}' anchor only supported at the {'start' if node.kind == '^' else 'end'}"
+                " of the pattern or an alternation branch"
+            )
+        raise AssertionError(f"unknown node {node!r}")
+
+    def _build_repeat(self, node: Repeat) -> tuple[int, int]:
+        m, n = node.min, node.max
+        if n is not None and n > _REPEAT_EXPANSION_CAP:
+            raise TooManyStates(f"repeat bound {n} exceeds expansion cap")
+        if m > _REPEAT_EXPANSION_CAP:
+            raise TooManyStates(f"repeat bound {m} exceeds expansion cap")
+        s = a = self.new_state()
+        for _ in range(m):  # required copies
+            ps, pa = self.build(node.node)
+            self.states[a].eps.append(ps)
+            a = pa
+        if n is None:  # star over one more copy
+            ps, pa = self.build(node.node)
+            self.states[a].eps.append(ps)
+            self.states[pa].eps.append(ps)
+            end = self.new_state()
+            self.states[a].eps.append(end)
+            self.states[pa].eps.append(end)
+            return s, end
+        for _ in range(n - m):  # optional copies: a -> ps..pa -> end, skip a -> end
+            ps, pa = self.build(node.node)
+            end = self.new_state()
+            self.states[a].eps.append(ps)
+            self.states[a].eps.append(end)
+            self.states[pa].eps.append(end)
+            a = end
+        return s, a
+
+
+# --------------------------------------------------------------------- DFA
+
+@dataclass
+class DfaTable:
+    """Dense scan tables, device- and host-ready.
+
+    trans        [n_states, n_classes] uint16 — next state per byte class
+    byte_to_cls  [256] uint8
+    accept       [n_states] bool — a match ends at this byte
+    accept_eol   [n_states] bool — a match ends here iff next byte is '\\n'
+                 (the '$' accept set; scans pad a trailing '\\n')
+    start        line-start state (also every state's target on '\\n')
+    """
+
+    trans: np.ndarray
+    byte_to_cls: np.ndarray
+    accept: np.ndarray
+    accept_eol: np.ndarray
+    start: int
+    pattern: str
+
+    @property
+    def n_states(self) -> int:
+        return self.trans.shape[0]
+
+    @property
+    def n_classes(self) -> int:
+        return self.trans.shape[1]
+
+    def full_table(self) -> np.ndarray:
+        """[n_states, 256] uint16 — for the native/C++ scanner oracle."""
+        return np.ascontiguousarray(self.trans[:, self.byte_to_cls])
+
+
+def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
+    """Host-side oracle: end offsets (index+1) of every match in `data`.
+
+    Uses the native C scanner (utils/native.py) for the plain accept set and
+    handles the '$' accept set (accept_eol: match iff next byte is '\\n' or
+    end-of-input) in numpy on top of the same state sequence.
+    """
+    from distributed_grep_tpu.utils import native
+
+    full = table.full_table()
+    offsets, _ = native.dfa_scan(data, full, table.accept.astype(np.uint8), table.start)
+    if not table.accept_eol.any():
+        return offsets
+    # Recompute the state sequence to evaluate accept_eol positions.
+    s = table.start
+    eol_hits = []
+    n = len(data)
+    for i, b in enumerate(data):
+        s = int(full[s, b])
+        if table.accept_eol[s] and (i + 1 == n or data[i + 1] == NL):
+            eol_hits.append(i + 1)
+    if not eol_hits:
+        return offsets
+    return np.unique(np.concatenate([offsets, np.asarray(eol_hits, dtype=np.uint64)]))
+
+
+def matched_lines(table: DfaTable, data: bytes) -> set[int]:
+    """1-based line numbers containing at least one match — grep's contract."""
+    offsets = reference_scan(table, data)
+    if offsets.size == 0:
+        return set()
+    nl = np.flatnonzero(np.frombuffer(data, dtype=np.uint8) == NL)
+    # line number of byte position p (0-based p) = count of newlines before p, +1
+    return set((np.searchsorted(nl, offsets - 1, side="right") + 1).tolist())
+
+
+def _split_anchors(node):
+    """Pull top-level '^'/'$' anchors out of each alternation branch.
+
+    Returns list of (anchored_start, body, anchored_end) triples.
+    """
+    branches = node.options if isinstance(node, Alt) else [node]
+    out = []
+    for b in branches:
+        parts = list(b.parts) if isinstance(b, Concat) else [b]
+        a_start = a_end = False
+        while parts and isinstance(parts[0], Anchor) and parts[0].kind == "^":
+            a_start = True
+            parts.pop(0)
+        while parts and isinstance(parts[-1], Anchor) and parts[-1].kind == "$":
+            a_end = True
+            parts.pop()
+        body = Concat(parts) if len(parts) != 1 else parts[0]
+        out.append((a_start, body, a_end))
+    return out
+
+
+def compile_dfa(
+    pattern: str,
+    ignore_case: bool = False,
+    max_states: int = 4096,
+) -> DfaTable:
+    """Compile a grep -E subset pattern into newline-reset scan tables."""
+    ast = _Parser(pattern, ignore_case).parse()
+    branches = _split_anchors(ast)
+
+    nfa = _Nfa()
+    root = nfa.new_state()  # line-start entry: active at line starts only
+    floating = nfa.new_state()  # Sigma* self-loop: unanchored search restarts
+    nfa.states[root].eps.append(floating)
+    nfa.states[floating].chars.append((_ANY_NO_NL, floating))
+
+    accepts_now: set[int] = set()
+    accepts_eol: set[int] = set()
+    for a_start, body, a_end in branches:
+        s, a = nfa.build(body)
+        (nfa.states[root] if a_start else nfa.states[floating]).eps.append(s)
+        (accepts_eol if a_end else accepts_now).add(a)
+
+    # --- eps closures -----------------------------------------------------
+    n = len(nfa.states)
+    closures: list[frozenset[int]] = [frozenset()] * n
+
+    def closure(seed: frozenset[int]) -> frozenset[int]:
+        stack, seen = list(seed), set(seed)
+        while stack:
+            s = stack.pop()
+            for t in nfa.states[s].eps:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+    # --- byte classes -----------------------------------------------------
+    # Two bytes are equivalent iff they belong to exactly the same set of
+    # transition masks; '\n' is always its own class (the reset column).
+    masks = sorted({m for st in nfa.states for (m, _) in st.chars})
+    sig_to_cls: dict[tuple, int] = {}
+    byte_to_cls = np.zeros(256, dtype=np.uint8)
+    cls_repr: list[int] = []
+    for b in range(256):
+        s = ("NL",) if b == NL else tuple((m >> b) & 1 for m in masks)
+        if s not in sig_to_cls:
+            sig_to_cls[s] = len(sig_to_cls)
+            cls_repr.append(b)
+        byte_to_cls[b] = sig_to_cls[s]
+    n_classes = len(sig_to_cls)
+    nl_cls = int(byte_to_cls[NL])
+
+    # --- subset construction ---------------------------------------------
+    start_set = closure(frozenset({root}))
+    dfa_index: dict[frozenset[int], int] = {start_set: 0}
+    order: list[frozenset[int]] = [start_set]
+    rows: list[list[int]] = []
+
+    i = 0
+    while i < len(order):
+        S = order[i]
+        i += 1
+        row = [0] * n_classes
+        for c in range(n_classes):
+            if c == nl_cls:
+                row[c] = 0  # newline reset: every state -> line start
+                continue
+            b = cls_repr[c]
+            moved = set()
+            for s in S:
+                for mask, t in nfa.states[s].chars:
+                    if mask >> b & 1:
+                        moved.add(t)
+            T = closure(frozenset(moved)) if moved else frozenset()
+            if T not in dfa_index:
+                if len(order) >= max_states:
+                    raise TooManyStates(
+                        f"pattern {pattern!r} needs >{max_states} DFA states"
+                    )
+                dfa_index[T] = len(order)
+                order.append(T)
+            row[c] = dfa_index[T]
+        rows.append(row)
+
+    n_states = len(order)
+    trans = np.asarray(rows, dtype=np.uint16)
+    accept = np.array([bool(S & accepts_now) for S in order], dtype=bool)
+    accept_eol = np.array([bool(S & accepts_eol) for S in order], dtype=bool)
+    return DfaTable(
+        trans=trans,
+        byte_to_cls=byte_to_cls,
+        accept=accept,
+        accept_eol=accept_eol,
+        start=0,
+        pattern=pattern if isinstance(pattern, str) else repr(pattern),
+    )

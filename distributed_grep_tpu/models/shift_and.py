@@ -1,0 +1,87 @@
+"""Shift-And bit-parallel model for literals and short class sequences.
+
+The fastest TPU scan path: the automaton state is one uint32 per lane, and a
+byte step is ``s = ((s << 1) | 1) & B[byte]`` — pure VPU integer ops, no
+table gathers (Pallas TPU has no vector gather; B[byte] is computed with
+per-symbol compare/or, ops/shift_and_scan.py).  Bit j of ``s`` means "the
+first j+1 symbols of the pattern match ending at this byte"; a match ends
+where bit m-1 is set.
+
+Eligible patterns: a plain concatenation of single-byte chars / classes
+(after case folding), length <= 32, no anchors/alternation/repeats — i.e.
+what a literal grep or a character-class literal like 'h[ae]llo' compiles
+to.  Everything else uses the DFA model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.models import dfa as _dfa
+from distributed_grep_tpu.models.dfa import NL, Char, Concat, RegexError
+
+MAX_SYMBOLS = 32  # state fits a uint32 lane
+
+
+@dataclass
+class ShiftAndModel:
+    """B-masks for the Shift-And scan.
+
+    b_table  [256] uint32 — B[byte]: bit j set iff byte matches symbol j
+    sym_masks list of 256-bit Python ints (one per symbol) for introspection
+    length   number of symbols (match bit = length - 1)
+    """
+
+    b_table: np.ndarray
+    length: int
+    pattern: str
+
+    @property
+    def match_bit(self) -> np.uint32:
+        return np.uint32(1 << (self.length - 1))
+
+
+def try_compile_shift_and(
+    pattern: str, ignore_case: bool = False
+) -> ShiftAndModel | None:
+    """Compile if the pattern is a Shift-And-eligible symbol sequence, else None."""
+    try:
+        ast = _dfa._Parser(pattern, ignore_case).parse()
+    except RegexError:
+        return None  # let compile_dfa surface the syntax error
+
+    parts = ast.parts if isinstance(ast, Concat) else [ast]
+    if not parts:
+        return None
+    sym_masks: list[int] = []
+    for p in parts:
+        if not isinstance(p, Char):
+            return None  # repeats/alts/anchors -> DFA model
+        if p.mask >> NL & 1:
+            return None  # newline-consuming -> CPU fallback path decides
+        sym_masks.append(p.mask)
+    if len(sym_masks) > MAX_SYMBOLS:
+        return None
+
+    b = np.zeros(256, dtype=np.uint32)
+    for j, mask in enumerate(sym_masks):
+        bit = np.uint32(1 << j)
+        for byte in range(256):
+            if mask >> byte & 1:
+                b[byte] |= bit
+    return ShiftAndModel(b_table=b, length=len(sym_masks), pattern=pattern)
+
+
+def scan_reference(model: ShiftAndModel, data: bytes) -> np.ndarray:
+    """Host-side oracle: end offsets (index+1) of every match."""
+    s = np.uint32(0)
+    hits = []
+    b = model.b_table
+    mb = model.match_bit
+    for i, byte in enumerate(data):
+        s = np.uint32(((np.uint32(s) << np.uint32(1)) | np.uint32(1)) & b[byte])
+        if s & mb:
+            hits.append(i + 1)
+    return np.asarray(hits, dtype=np.uint64)

@@ -1,0 +1,196 @@
+"""Pattern-model tests: DFA compiler vs Python re, shift-and, Aho-Corasick.
+
+The contract under test is grep's: for every line, "does any match occur in
+this line" must agree with Python re.search on that line (SURVEY.md §4:
+regex kernel vs a reference oracle on adversarial inputs).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models.aho import compile_aho_corasick
+from distributed_grep_tpu.models.dfa import (
+    NewlineInPattern,
+    RegexError,
+    TooManyStates,
+    compile_dfa,
+    matched_lines,
+    reference_scan,
+)
+from distributed_grep_tpu.models.shift_and import scan_reference, try_compile_shift_and
+
+TEXT = (
+    b"hello world\n"
+    b"the quick brown fox jumps\n"
+    b"HELLO SHOUTING\n"
+    b"hallo hullo hella\n"
+    b"abc123 def456\n"
+    b"  indented line\n"
+    b"\n"
+    b"x" * 300 + b"needle" + b"y" * 50 + b"\n"
+    b"ends with dollar\n"
+    b"no trailing newline"
+)
+
+
+def oracle_lines(pattern: str, data: bytes, flags=0) -> set[int]:
+    out = set()
+    for i, line in enumerate(data.split(b"\n"), start=1):
+        if re.search(pattern.encode(), line, flags):
+            out.add(i)
+    return out
+
+
+PATTERNS = [
+    "hello",
+    "h[ae]llo",
+    "h.llo",
+    "hel+o",
+    "hel*o",
+    "hells?",
+    "(hello|fox|needle)",
+    "[0-9]+",
+    r"\d{3}",
+    r"[a-z]{2}\d",
+    "qu..k",
+    "^hello",
+    "^the",
+    "dollar$",
+    "^HELLO.*$",
+    "x{10,20}needle",
+    r"\w+\s\w+",
+    "h(el){2}a",
+    "nee(dle|ble)",
+    "[^a-z ]+",
+]
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_dfa_matches_re_oracle_per_line(pattern):
+    table = compile_dfa(pattern)
+    assert matched_lines(table, TEXT) == oracle_lines(pattern, TEXT)
+
+
+@pytest.mark.parametrize("pattern", ["hello", "h[ae]llo", "[0-9]+", "^the"])
+def test_dfa_case_insensitive(pattern):
+    table = compile_dfa(pattern, ignore_case=True)
+    assert matched_lines(table, TEXT) == oracle_lines(pattern, TEXT, re.IGNORECASE)
+
+
+def test_dfa_random_fuzz_vs_re():
+    rng = np.random.default_rng(42)
+    alphabet = b"abcdef\n \t"
+    data = bytes(rng.choice(list(alphabet), size=4096).tolist())
+    for pattern in ["ab", "a[bc]d", "a.*f", "(ab|cd)+", "a{2,4}b", "^a", "f$", r"\w\s\w"]:
+        table = compile_dfa(pattern)
+        assert matched_lines(table, data) == oracle_lines(pattern, data), pattern
+
+
+def test_dfa_binary_bytes():
+    data = b"\x00\x01hello\xff\xfe\nz\x80hello\n"
+    table = compile_dfa("hello")
+    assert matched_lines(table, data) == {1, 2}
+    table = compile_dfa(r"[\x00-\x08]")
+    assert matched_lines(table, data) == {1}
+
+
+def test_dfa_match_offsets_exact():
+    table = compile_dfa("ab")
+    offsets = reference_scan(table, b"xabxxab")
+    np.testing.assert_array_equal(offsets, [3, 7])
+
+
+def test_dfa_rejects_newline_patterns():
+    with pytest.raises(NewlineInPattern):
+        compile_dfa(r"a\nb")
+
+
+def test_dfa_syntax_errors():
+    for bad in ["h[", "(a", "a)", "*a", "a{3,1}", "a\\"]:
+        with pytest.raises(RegexError):
+            compile_dfa(bad)
+
+
+def test_dfa_state_cap():
+    with pytest.raises(TooManyStates):
+        compile_dfa("a{400}b{400}", max_states=16)
+
+
+def test_dfa_byte_classes_are_compressed():
+    table = compile_dfa("hello")
+    # distinct symbols: h e l o + newline + everything-else = 6 classes
+    assert table.n_classes <= 8
+    assert table.trans.shape == (table.n_states, table.n_classes)
+    # newline column resets every state to start
+    nl_cls = table.byte_to_cls[0x0A]
+    assert (table.trans[:, nl_cls] == table.start).all()
+
+
+# ----------------------------------------------------------------- shift-and
+
+def test_shift_and_eligibility():
+    assert try_compile_shift_and("hello") is not None
+    assert try_compile_shift_and("h[ae]llo") is not None
+    assert try_compile_shift_and("h.llo") is not None
+    assert try_compile_shift_and("hel+o") is None  # repeat -> DFA
+    assert try_compile_shift_and("(a|b)") is None  # alternation -> DFA
+    assert try_compile_shift_and("^x") is None  # anchor -> DFA
+    assert try_compile_shift_and("a" * 33) is None  # too long
+    assert try_compile_shift_and("h[") is None  # syntax error -> let DFA raise
+
+
+def test_shift_and_scan_matches_dfa():
+    for pattern in ["hello", "h[ae]llo", "qu..k", "needle"]:
+        model = try_compile_shift_and(pattern)
+        table = compile_dfa(pattern)
+        np.testing.assert_array_equal(
+            scan_reference(model, TEXT), reference_scan(table, TEXT), err_msg=pattern
+        )
+
+
+def test_shift_and_case_insensitive():
+    model = try_compile_shift_and("hello", ignore_case=True)
+    hits = scan_reference(model, b"HELLO hello HeLLo")
+    assert len(hits) == 3
+
+
+# -------------------------------------------------------------- aho-corasick
+
+def test_aho_basic_multi_pattern():
+    table = compile_aho_corasick(["he", "she", "his", "hers"])
+    data = b"ushers\nhis house\nnothing\n"
+    assert matched_lines(table, data) == {1, 2}
+    offsets = reference_scan(table, b"ushers")
+    # matches: she@4, he@4, hers@6 -> end offsets {4, 6}
+    np.testing.assert_array_equal(offsets, [4, 6])
+
+
+def test_aho_overlapping_and_substring_patterns():
+    table = compile_aho_corasick(["ab", "abc", "bc"])
+    offsets = reference_scan(table, b"zabcz")
+    np.testing.assert_array_equal(offsets, [3, 4])
+
+
+def test_aho_vs_re_oracle_on_text():
+    pats = ["hello", "fox", "needle", "456", "SHOUT"]
+    table = compile_aho_corasick(pats)
+    expected = set()
+    for p in pats:
+        expected |= oracle_lines(re.escape(p), TEXT)
+    assert matched_lines(table, TEXT) == expected
+
+
+def test_aho_ignore_case():
+    table = compile_aho_corasick(["hello"], ignore_case=True)
+    assert matched_lines(table, TEXT) == oracle_lines("hello", TEXT, re.IGNORECASE)
+
+
+def test_aho_scales_to_1k_literals():
+    rng = np.random.default_rng(7)
+    pats = ["".join(chr(c) for c in rng.integers(97, 123, size=8)) for _ in range(1000)]
+    table = compile_aho_corasick(pats)
+    assert table.n_states > 1000
+    data = ("xx" + pats[17] + "yy\n" + "zz\n" + pats[999]).encode()
+    assert matched_lines(table, data) == {1, 3}
