@@ -1,0 +1,70 @@
+"""TPU grep application — drop-in interchangeable with apps/grep.py.
+
+Same Map/Reduce contract and same output records as the CPU app
+(application/grep.go:13-40 semantics: key "<filename> (line number #N)",
+value = the line; identity Reduce), but the per-line host regexp loop is
+replaced by the ops.GrepEngine device scan: compile the pattern once to a
+shift-and/DFA model, scan the whole split on the TPU, then slice only the
+matched lines out of the buffer using the native newline index.
+
+The ``backend`` option ("device" | "cpu") and every engine knob arrive via
+configure() — the plumbing the reference's TODO (coordinator.go:41) never
+built.  Patterns outside the device subset transparently fall back to the
+host re engine inside GrepEngine, so this app never refuses a pattern the
+CPU app would accept.
+"""
+
+from __future__ import annotations
+
+from distributed_grep_tpu.apps.base import KeyValue
+from distributed_grep_tpu.ops.engine import GrepEngine
+from distributed_grep_tpu.ops.lines import line_span, newline_index
+
+_engine: GrepEngine | None = None
+_configured_with: tuple | None = None
+
+
+def configure(
+    pattern: str | bytes = "",
+    ignore_case: bool = False,
+    backend: str = "device",
+    patterns: list[str] | None = None,
+    **engine_opts: object,
+) -> None:
+    global _engine, _configured_with
+    if isinstance(pattern, bytes):
+        pattern = pattern.decode("utf-8", "surrogateescape")
+    key = (pattern, ignore_case, backend, tuple(patterns or ()), tuple(sorted(engine_opts.items())))
+    if key == _configured_with:
+        return
+    _engine = GrepEngine(
+        pattern if patterns is None else None,
+        patterns=patterns,
+        ignore_case=ignore_case,
+        backend=backend,
+        **engine_opts,  # type: ignore[arg-type]
+    )
+    _configured_with = key
+
+
+def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
+    if _engine is None:
+        raise RuntimeError("grep_tpu used before configure() — no pattern set")
+    result = _engine.scan(contents)
+    if result.matched_lines.size == 0:
+        return []
+    nl = newline_index(contents)
+    out: list[KeyValue] = []
+    for line_no in result.matched_lines.tolist():
+        start, end = line_span(nl, line_no, len(contents))
+        out.append(
+            KeyValue(
+                key=f"{filename} (line number #{line_no})",
+                value=contents[start:end].decode("utf-8", errors="replace"),
+            )
+        )
+    return out
+
+
+def reduce_fn(key: str, values: list[str]) -> str:
+    return values[0]

@@ -29,18 +29,25 @@ MAX_SYMBOLS = 32  # state fits a uint32 lane
 class ShiftAndModel:
     """B-masks for the Shift-And scan.
 
-    b_table  [256] uint32 — B[byte]: bit j set iff byte matches symbol j
-    sym_masks list of 256-bit Python ints (one per symbol) for introspection
-    length   number of symbols (match bit = length - 1)
+    b_table    [256] uint32 — B[byte]: bit j set iff byte matches symbol j
+    sym_ranges per symbol, the byte set as sorted disjoint (lo, hi) ranges —
+               lets the Pallas kernel compute B[byte] with range compares
+               instead of a table gather (Pallas TPU has no vector gather)
+    length     number of symbols (match bit = length - 1)
     """
 
     b_table: np.ndarray
+    sym_ranges: list[list[tuple[int, int]]]
     length: int
     pattern: str
 
     @property
     def match_bit(self) -> np.uint32:
         return np.uint32(1 << (self.length - 1))
+
+    @property
+    def total_ranges(self) -> int:
+        return sum(len(r) for r in self.sym_ranges)
 
 
 def try_compile_shift_and(
@@ -71,7 +78,27 @@ def try_compile_shift_and(
         for byte in range(256):
             if mask >> byte & 1:
                 b[byte] |= bit
-    return ShiftAndModel(b_table=b, length=len(sym_masks), pattern=pattern)
+    return ShiftAndModel(
+        b_table=b,
+        sym_ranges=[_mask_to_ranges(m) for m in sym_masks],
+        length=len(sym_masks),
+        pattern=pattern,
+    )
+
+
+def _mask_to_ranges(mask: int) -> list[tuple[int, int]]:
+    """256-bit membership mask -> sorted disjoint inclusive (lo, hi) ranges."""
+    ranges: list[tuple[int, int]] = []
+    b = 0
+    while b < 256:
+        if mask >> b & 1:
+            lo = b
+            while b < 256 and mask >> b & 1:
+                b += 1
+            ranges.append((lo, b - 1))
+        else:
+            b += 1
+    return ranges
 
 
 def scan_reference(model: ShiftAndModel, data: bytes) -> np.ndarray:

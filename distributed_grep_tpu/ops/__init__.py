@@ -1,0 +1,21 @@
+"""TPU compute path: byte-scan engines and line machinery.
+
+The reference's compute hot loop is a per-line regexp.Match on the host
+(application/grep.go:20-30).  Here the whole corpus is scanned on device:
+
+* ``layout``      — bytes -> (lanes, chunk) stripe layout with '\\n' padding;
+* ``scan_jnp``    — XLA engines: vectorized DFA table scan and bit-parallel
+                    Shift-And scan, lane-parallel with per-lane sequential
+                    chunks (lax.scan over byte columns);
+* ``pallas_scan`` — Pallas TPU kernel for the Shift-And fast path;
+* ``lines``       — host-side: packed match bits -> byte offsets -> line
+                    numbers, plus exact stitching of lines that span lane
+                    boundaries (the long-context correctness story,
+                    SURVEY.md §5);
+* ``engine``      — ties a compiled pattern model + engine + stitching into
+                    one ``scan(data) -> matched lines`` object.
+"""
+
+from distributed_grep_tpu.ops.engine import GrepEngine, make_engine
+
+__all__ = ["GrepEngine", "make_engine"]

@@ -1,0 +1,191 @@
+"""GrepEngine: compiled pattern + device scan + host stitching, one object.
+
+Engine selection, fastest first (the pluggable-backend story the north star
+pins — CPU grep and TPU grep are interchangeable behind the application
+interface):
+
+1. ``shift_and`` — literal/class sequences <= 32 symbols: bit-parallel VPU
+   scan (Pallas kernel on TPU, XLA scan elsewhere);
+2. ``dfa``       — anything the subset compiler handles within the state
+   cap: vectorized DFA table scan;
+3. ``re``        — host fallback (Python re per line) for patterns outside
+   the subset (e.g. newline-consuming) — the reference's own strategy
+   (application/grep.go:20-30), kept as the escape hatch.
+
+Large documents are scanned in segments (bounded device memory — the
+reference instead reads whole files and cannot handle files larger than
+RAM, worker.go:72-76); segment starts and stripe starts are boundary
+positions whose lines get exact host re-scans (ops/lines.py).
+"""
+
+from __future__ import annotations
+
+import re as _re
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.models.aho import compile_aho_corasick
+from distributed_grep_tpu.models.dfa import (
+    DfaTable,
+    RegexError,
+    compile_dfa,
+    reference_scan,
+)
+from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import lines as lines_mod
+from distributed_grep_tpu.ops import scan_jnp
+from distributed_grep_tpu.ops import sparse as sparse_mod
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("engine")
+
+
+@dataclass
+class ScanResult:
+    matched_lines: np.ndarray  # sorted 1-based line numbers
+    n_matches: int  # match end-offset count (>= matched lines)
+    bytes_scanned: int
+
+
+class GrepEngine:
+    """Scan documents for one compiled pattern (or literal pattern set)."""
+
+    def __init__(
+        self,
+        pattern: str | None = None,
+        *,
+        patterns: list[str] | None = None,  # multi-literal set -> Aho-Corasick
+        ignore_case: bool = False,
+        backend: str = "device",  # "device" (jnp/pallas) | "cpu" (host re/native)
+        target_lanes: int = 1024,
+        segment_bytes: int = 64 * 1024 * 1024,
+        max_states: int = 4096,
+    ):
+        if (pattern is None) == (patterns is None):
+            raise ValueError("exactly one of pattern / patterns is required")
+        self.backend = backend
+        self.target_lanes = target_lanes
+        self.segment_bytes = segment_bytes
+        self.ignore_case = ignore_case
+
+        self.shift_and: ShiftAndModel | None = None
+        self.table: DfaTable | None = None
+        self._re_fallback: _re.Pattern[bytes] | None = None
+
+        if patterns is not None:
+            self.pattern = f"<set of {len(patterns)}>"
+            self.table = compile_aho_corasick(patterns, ignore_case=ignore_case)
+            self.mode = "dfa"
+        else:
+            self.pattern = pattern
+            try:
+                self.table = compile_dfa(pattern, ignore_case=ignore_case, max_states=max_states)
+                self.shift_and = try_compile_shift_and(pattern, ignore_case=ignore_case)
+                self.mode = "shift_and" if self.shift_and is not None else "dfa"
+            except RegexError as e:
+                # Outside the device subset (newline-consuming, state blowup,
+                # unsupported syntax): host re fallback, like the reference.
+                log.info("pattern %r -> host re fallback (%s)", pattern, e)
+                flags = _re.IGNORECASE if ignore_case else 0
+                self._re_fallback = _re.compile(
+                    pattern.encode("utf-8") if isinstance(pattern, str) else pattern, flags
+                )
+                self.mode = "re"
+        if backend == "cpu" and self.mode != "re":
+            self.mode = "native"  # host C scanner, same tables
+
+    # ------------------------------------------------------------------ scan
+    def scan(self, data: bytes) -> ScanResult:
+        if self.mode == "re":
+            return self._scan_re(data)
+        if self.table is not None and self.table.accept[self.table.start]:
+            # Pattern matches the empty string -> every line matches (grep
+            # semantics); also sidesteps empty-match bookkeeping on device.
+            n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
+            return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
+        if self.mode == "native":
+            return self._scan_native(data)
+        return self._scan_device(data)
+
+    # ---------------------------------------------------------- host engines
+    def _scan_re(self, data: bytes) -> ScanResult:
+        matched = []
+        for i, line in enumerate(data.split(b"\n"), start=1):
+            if self._re_fallback.search(line):
+                matched.append(i)
+        return ScanResult(np.asarray(matched, dtype=np.int64), len(matched), len(data))
+
+    def _scan_native(self, data: bytes) -> ScanResult:
+        offsets = reference_scan(self.table, data)
+        nl = lines_mod.newline_index(data)
+        lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
+            np.zeros(0, dtype=np.int64)
+        return ScanResult(lns.astype(np.int64), int(offsets.size), len(data))
+
+    def _host_line_matcher(self, line: bytes) -> bool:
+        return reference_scan(self.table, line).size > 0
+
+    # --------------------------------------------------------- device engine
+    def _scan_device(self, data: bytes) -> ScanResult:
+        nl = lines_mod.newline_index(data)
+        device_lines: set[int] = set()
+        boundaries: list[int] = []
+        n_matches = 0
+        seg = self.segment_bytes
+        from distributed_grep_tpu.ops import pallas_scan
+
+        use_pallas = (
+            self.mode == "shift_and"
+            and pallas_scan.available()
+            and pallas_scan.eligible(self.shift_and)
+        )
+        for seg_start in range(0, max(len(data), 1), seg):
+            seg_bytes = data[seg_start : seg_start + seg]
+            if seg_start > 0:
+                boundaries.append(seg_start)
+            if use_pallas:
+                lay = layout_mod.choose_layout(
+                    len(seg_bytes),
+                    target_lanes=max(self.target_lanes, pallas_scan.LANES_PER_BLOCK),
+                    min_chunk=512,
+                    lane_multiple=pallas_scan.LANES_PER_BLOCK,
+                    chunk_multiple=512,
+                )
+            else:
+                lay = layout_mod.choose_layout(len(seg_bytes), target_lanes=self.target_lanes)
+            arr = layout_mod.to_device_array(seg_bytes, lay)
+            # Device scan, then sparse fetch: a 4-byte count round-trip plus
+            # O(matches) coordinates — never the dense packed plane.
+            if use_pallas:
+                words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                idx, vals = scan_jnp.sparse_nonzero(words)
+                offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
+            else:
+                packed = (
+                    scan_jnp.shift_and_scan(arr, self.shift_and)
+                    if self.mode == "shift_and"
+                    else scan_jnp.dfa_scan(arr, self.table)
+                )
+                idx, vals = scan_jnp.sparse_nonzero(packed)
+                offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+            n_matches += int(offsets.size)
+            if offsets.size:
+                seg_nl = lines_mod.newline_index(seg_bytes)
+                seg_lines = np.unique(lines_mod.line_of_offsets(offsets, seg_nl))
+                base = int(np.searchsorted(nl, seg_start))  # lines before segment
+                device_lines.update((seg_lines + base).tolist())
+            boundaries.extend((seg_start + lay.stripe_starts()).tolist())
+
+        stitched = lines_mod.stitch_lines(
+            device_lines, data, nl, boundaries, self._host_line_matcher
+        )
+        return ScanResult(
+            np.asarray(sorted(stitched), dtype=np.int64), n_matches, len(data)
+        )
+
+def make_engine(
+    pattern: str | None = None, patterns: list[str] | None = None, **kw
+) -> GrepEngine:
+    return GrepEngine(pattern, patterns=patterns, **kw)

@@ -1,0 +1,71 @@
+"""Stripe layout: document bytes -> (chunk, lanes) device array.
+
+The scan is lane-parallel: the document is cut into ``lanes`` contiguous
+stripes, each lane scans its stripe sequentially (lax.scan over the chunk
+axis), and all lanes run as one vector op per byte step.  Because the DFA
+resets to line-start on '\\n', every lane can start from the start state;
+the only error is each stripe's first partial line, which lines.py
+re-scans exactly on the host.
+
+Padding uses '\\n' bytes: the pattern can never consume '\\n', so padding
+can't create matches inside real lines; phantom empty padding lines are
+clamped away by lines.py (they sit past the real data's last offset).
+
+Layout is column-major for the scan: array[c, l] = byte c of stripe l, so
+lax.scan iterates the leading axis with unit-stride vectors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+NL = 0x0A
+
+
+@dataclass(frozen=True)
+class Layout:
+    lanes: int
+    chunk: int  # bytes per lane
+    n_real: int  # real (unpadded) document length
+
+    @property
+    def padded(self) -> int:
+        return self.lanes * self.chunk
+
+    def offset_of(self, c: int, l: int) -> int:
+        """Absolute byte offset of array position (chunk c, lane l)."""
+        return l * self.chunk + c
+
+    def stripe_starts(self) -> np.ndarray:
+        """Absolute offsets where a lane's stripe begins (boundary fix-ups)."""
+        return np.arange(1, self.lanes, dtype=np.int64) * self.chunk
+
+
+def choose_layout(
+    n_bytes: int,
+    target_lanes: int = 1024,
+    min_chunk: int = 256,
+    lane_multiple: int = 8,
+    chunk_multiple: int = 8,
+) -> Layout:
+    """Pick (lanes, chunk) for a document: enough lanes to fill the VPU,
+    chunks long enough that the sequential scan amortizes its step cost.
+    lane_multiple/chunk_multiple let kernels impose tile shapes (the Pallas
+    path needs lanes % 4096 == 0 and chunk % 512 == 0)."""
+    if n_bytes <= 0:
+        return Layout(lanes=lane_multiple, chunk=chunk_multiple, n_real=max(0, n_bytes))
+    lanes = max(lane_multiple, target_lanes // lane_multiple * lane_multiple)
+    while lanes > lane_multiple and (n_bytes + lanes - 1) // lanes < min_chunk:
+        lanes = max(lane_multiple, lanes // 2 // lane_multiple * lane_multiple)
+    chunk = (n_bytes + lanes - 1) // lanes
+    chunk = (chunk + chunk_multiple - 1) // chunk_multiple * chunk_multiple
+    return Layout(lanes=lanes, chunk=chunk, n_real=n_bytes)
+
+
+def to_device_array(data: bytes, layout: Layout) -> np.ndarray:
+    """Pad with '\\n' and reshape column-major: result[c, l] = data[l*chunk+c]."""
+    buf = np.full(layout.padded, NL, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return np.ascontiguousarray(buf.reshape(layout.lanes, layout.chunk).T)

@@ -1,0 +1,86 @@
+"""Host-side line machinery: packed match bits -> line numbers, plus exact
+stitching of lines that span stripe/segment boundaries.
+
+The device scan starts every stripe from the start state.  By the
+newline-reset property that is exact for every byte *after* the stripe's
+first newline; the stripe's head partial line may have false negatives
+(matches spanning the boundary) and — for '^'/'$' patterns — false
+positives (the device treats the stripe start as a line start and the
+stripe tail as a line end).  The fix is exact and local: every line that
+contains a stripe boundary is re-scanned on the host with the native DFA
+scanner, and the host verdict *replaces* the device verdict for that line.
+This is the long-context analogue of carrying block state in ring
+attention (SURVEY.md §5): instead of carrying, we re-derive the tiny
+boundary-dependent region from its true line start.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import numpy as np
+
+from distributed_grep_tpu.ops.layout import Layout
+from distributed_grep_tpu.utils import native
+
+NL = 0x0A
+
+
+def match_offsets_from_packed(packed: np.ndarray, layout: Layout) -> np.ndarray:
+    """(chunk, lanes//8) packed bits -> sorted absolute end offsets (i+1),
+    clamped to the real document length."""
+    bits = np.unpackbits(packed, axis=1, bitorder="little")  # (chunk, lanes)
+    c_idx, l_idx = np.nonzero(bits)
+    offsets = l_idx.astype(np.int64) * layout.chunk + c_idx + 1
+    offsets = offsets[offsets <= layout.n_real]
+    offsets.sort()
+    return offsets
+
+
+def line_of_offsets(offsets: np.ndarray, nl_index: np.ndarray) -> np.ndarray:
+    """1-based line number containing each match end offset (i+1 convention):
+    the match's last byte is at offset-1."""
+    return np.searchsorted(nl_index, offsets - 1, side="right") + 1
+
+
+def line_span(nl_index: np.ndarray, line_no: int, n_bytes: int) -> tuple[int, int]:
+    """[start, end) byte range of 1-based line line_no (end excludes '\\n')."""
+    start = 0 if line_no == 1 else int(nl_index[line_no - 2]) + 1
+    end = int(nl_index[line_no - 1]) if line_no - 1 < len(nl_index) else n_bytes
+    return start, end
+
+
+def boundary_lines(
+    boundaries: Iterable[int], nl_index: np.ndarray, n_bytes: int
+) -> set[int]:
+    """1-based line numbers containing any of the given byte positions."""
+    out = set()
+    for p in boundaries:
+        if 0 < p < n_bytes:
+            out.add(int(np.searchsorted(nl_index, p, side="right")) + 1)
+    return out
+
+
+def stitch_lines(
+    device_lines: set[int],
+    data: bytes,
+    nl_index: np.ndarray,
+    boundaries: Iterable[int],
+    host_line_matcher: Callable[[bytes], bool],
+) -> set[int]:
+    """Replace the device verdict with the host verdict on every line that
+    contains a stripe/segment boundary."""
+    suspects = boundary_lines(boundaries, nl_index, len(data))
+    if not suspects:
+        return device_lines
+    result = set(device_lines) - suspects
+    for line_no in suspects:
+        start, end = line_span(nl_index, line_no, len(data))
+        if host_line_matcher(data[start:end]):
+            result.add(line_no)
+    return result
+
+
+def newline_index(data: bytes) -> np.ndarray:
+    """Byte offsets of every '\\n' (native fast path)."""
+    return native.newline_index(data).astype(np.int64)

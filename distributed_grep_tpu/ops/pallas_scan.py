@@ -1,0 +1,186 @@
+"""Pallas TPU kernel: Shift-And byte scan, gather-free, bit-packed output.
+
+The hot loop the reference runs per line on a Raspberry Pi CPU
+(application/grep.go:20-30) becomes a VPU-resident bit-parallel scan:
+
+* Input bytes live in HBM as (chunk, 32, 128) uint8 — 4096 lanes per grid
+  block, each lane a contiguous document stripe; blocks of rows are DMA'd
+  to VMEM by pallas_call's grid machinery (double-buffered by the
+  compiler).
+* Per byte step the kernel computes the Shift-And B-mask from the byte
+  value with **range compares** (the pattern's per-symbol byte sets as
+  (lo, hi) ranges, baked into the kernel as compile-time constants) — no
+  table gather, which Pallas TPU does not have — then performs
+  ``s = ((s << 1) | 1) & B`` on a (32, 128) uint32 state tile.
+* Match bits are packed on the fly, 32 byte-steps per uint32 word, so the
+  HBM write traffic is input/32 and the host transfer is tiny.
+* The lane state persists in VMEM scratch across sequential grid steps
+  along the chunk axis (TPU grids execute sequentially, innermost last),
+  so a stripe longer than one block carries its automaton state exactly.
+
+Grid: (lane_blocks, chunk_blocks); chunk innermost.  The engine sizes the
+layout so lanes % 4096 == 0 and chunk % (32 * CHUNK_BLOCK_WORDS) == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.shift_and import ShiftAndModel
+
+SUBLANES = 32  # uint8 tile sublanes; 32*128 = 4096 lanes per grid block
+LANE_COLS = 128
+LANES_PER_BLOCK = SUBLANES * LANE_COLS
+CHUNK_BLOCK_WORDS = 16  # byte-steps per grid block = 32 * this
+MAX_TOTAL_RANGES = 48  # compare budget per byte step
+
+
+def available() -> bool:
+    """True when a real TPU backend is present (tests use interpret=True).
+
+    Checks JAX_PLATFORMS before touching jax so that a CPU-pinned test
+    environment never triggers initialization of a TPU/axon backend (which
+    can block indefinitely if the device tunnel is unavailable)."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and "tpu" not in platforms and "axon" not in platforms:
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def eligible(model: ShiftAndModel) -> bool:
+    return model.total_ranges <= MAX_TOTAL_RANGES
+
+
+def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, steps):
+    """One grid step: scan `steps` bytes for 4096 lanes, packing match bits."""
+    from jax.experimental import pallas as pl  # deferred: import cost
+
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        state_ref[:] = jnp.zeros_like(state_ref)
+
+    def word_body(w, s):
+        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        for t in range(32):
+            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
+            bmask = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+            for j, ranges in enumerate(sym_ranges):
+                bit = jnp.uint32(1 << j)
+                hit = None
+                for lo, hi in ranges:
+                    r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                    hit = r if hit is None else (hit | r)
+                bmask = bmask | jnp.where(hit, bit, jnp.uint32(0))
+            s = ((s << jnp.uint32(1)) | jnp.uint32(1)) & bmask
+            m = (s & jnp.uint32(match_bit)) != 0
+            word = word | jnp.where(m, jnp.uint32(1 << t), jnp.uint32(0))
+        out_ref[w] = word
+        return s
+
+    final = jax.lax.fori_loop(0, steps // 32, word_body, state_ref[:])
+    state_ref[:] = final
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sym_ranges", "match_bit", "chunk", "lane_blocks", "interpret"),
+)
+def _shift_and_pallas(data, *, sym_ranges, match_bit, chunk, lane_blocks, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = 32 * CHUNK_BLOCK_WORDS
+    chunk_blocks = chunk // steps
+    kernel = functools.partial(
+        _kernel, sym_ranges=sym_ranges, match_bit=match_bit, steps=steps
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (steps, SUBLANES, LANE_COLS),
+                lambda li, ci: (ci, li, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), jnp.uint32
+        ),
+        scratch_shapes=[pltpu.VMEM((SUBLANES, LANE_COLS), jnp.uint32)],
+        interpret=interpret,
+    )(data)
+    return out
+
+
+def shift_and_scan_words(
+    arr_cl: np.ndarray, model: ShiftAndModel, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Run the kernel; returns the time-packed match words as a DEVICE array
+    (chunk//32, lane_blocks*32, 128) uint32 — decode sparsely via
+    ops/sparse.offsets_from_sparse_words.
+
+    Requires lanes % 4096 == 0 and chunk % 512 == 0 (the engine's layout
+    guarantees this on the pallas path).
+    """
+    chunk, lanes = arr_cl.shape
+    steps = 32 * CHUNK_BLOCK_WORDS
+    if lanes % LANES_PER_BLOCK or chunk % steps:
+        raise ValueError(f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0")
+    if not eligible(model):
+        raise ValueError("pattern exceeds the pallas compare budget")
+    lane_blocks = lanes // LANES_PER_BLOCK
+    data = np.ascontiguousarray(arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS))
+    if interpret is None:
+        interpret = not available()
+    return _shift_and_pallas(
+        jnp.asarray(data),
+        sym_ranges=tuple(tuple(r) for r in model.sym_ranges),
+        match_bit=int(model.match_bit),
+        chunk=chunk,
+        lane_blocks=lane_blocks,
+        interpret=interpret,
+    )
+
+
+def shift_and_scan(
+    arr_cl: np.ndarray, model: ShiftAndModel, interpret: bool | None = None
+) -> np.ndarray:
+    """Dense-output wrapper (tests): packed bits in the scan_jnp convention."""
+    chunk, lanes = arr_cl.shape
+    words = shift_and_scan_words(arr_cl, model, interpret)
+    return _unpack_words_to_lane_bits(np.asarray(words), chunk, lanes)
+
+
+def _unpack_words_to_lane_bits(words: np.ndarray, chunk: int, lanes: int) -> np.ndarray:
+    """Convert time-packed kernel words to the (chunk, lanes//8) lane-packed
+    convention shared with scan_jnp (bit t of words[w, s, l] = match at
+    chunk position w*32+t for lane (s // 32)*4096? — see reshape below)."""
+    # words: (chunk//32, lane_blocks*32, 128) uint32; lane id of (S, l):
+    # block = S // 32, sublane = S % 32 -> lane = block*4096 + sublane*128 + l
+    n_words, S, L = words.shape
+    lane_blocks = S // SUBLANES
+    # bits along time: expand to (chunk, S, L) bool
+    t = np.arange(32, dtype=np.uint32)
+    bits = (words[:, None, :, :] >> t[None, :, None, None]) & 1  # (w, t, S, L)
+    match = bits.reshape(chunk, S, L).astype(bool)
+    # lane index mapping
+    match = match.reshape(chunk, lane_blocks, SUBLANES, LANE_COLS)
+    match = match.reshape(chunk, lanes)
+    return np.packbits(match, axis=1, bitorder="little")

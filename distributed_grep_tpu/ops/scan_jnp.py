@@ -1,0 +1,151 @@
+"""XLA scan engines: DFA table scan and Shift-And, lane-parallel.
+
+Both engines take the column-major stripe array (chunk, lanes) uint8 from
+ops/layout.py and return packed match bits (chunk, lanes/8) uint8 — bit k of
+out[c, g] is "a match ends at byte (c, lane g*8+k)".  Device->host transfer
+is input/8; offset decoding happens on the host (ops/lines.py).
+
+Design notes (TPU-first):
+
+* The per-byte recurrence is sequential along a stripe but vectorized over
+  lanes: one lax.scan over the chunk axis, each step doing O(lanes) VPU work.
+* The byte->class and byte->B-mask table lookups are hoisted out of the scan
+  as ONE whole-array gather (XLA lowers a 256-entry table gather fine on
+  TPU); the in-loop DFA gather indexes the [n_states*n_classes] flat table.
+* '$' accepts (accept_eol) are evaluated against a pre-shifted
+  next-byte-is-newline plane, so anchors cost nothing in the loop.
+* Everything is shapes-static, branch-free, jit-compiled once per
+  (layout, model) signature.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.dfa import DfaTable
+from distributed_grep_tpu.models.shift_and import ShiftAndModel
+
+NL = 0x0A
+
+
+def _pack_lane_bits(match: jnp.ndarray) -> jnp.ndarray:
+    """(chunk, lanes) bool -> (chunk, lanes//8) uint8, bit k = lane g*8+k."""
+    c, l = match.shape
+    assert l % 8 == 0, "lanes must be a multiple of 8 for bit packing"
+    bits = match.reshape(c, l // 8, 8).astype(jnp.uint8)
+    powers = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+    return (bits * powers).sum(axis=-1, dtype=jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def _dfa_scan_core(
+    data_cl: jnp.ndarray,  # (chunk, lanes) uint8
+    trans_flat: jnp.ndarray,  # (n_states * n_classes,) int32
+    byte_to_cls: jnp.ndarray,  # (256,) int32
+    accept: jnp.ndarray,  # (n_states,) bool
+    accept_eol: jnp.ndarray,  # (n_states,) bool
+    start: jnp.ndarray,  # () int32
+    n_classes: int,
+) -> jnp.ndarray:
+    chunk, lanes = data_cl.shape
+    # Hoisted table lookups: one gather for the whole array.
+    cls = byte_to_cls[data_cl.astype(jnp.int32)]  # (chunk, lanes) int32
+    # next byte within the same stripe is the next row; the final row's
+    # successor is the next stripe's first byte — treat it as '\n' (stripe
+    # tails are re-checked by the host stitcher anyway, and real documents
+    # are padded with '\n').
+    nl_next = jnp.concatenate(
+        [data_cl[1:] == NL, jnp.ones((1, lanes), dtype=bool)], axis=0
+    )
+
+    init = jnp.full((lanes,), start, dtype=jnp.int32)
+
+    def step(states, inputs):
+        cls_row, nl_row = inputs
+        nxt = trans_flat[states * n_classes + cls_row]
+        match = accept[nxt] | (accept_eol[nxt] & nl_row)
+        return nxt, match
+
+    _, match = jax.lax.scan(step, init, (cls, nl_next))
+    return _pack_lane_bits(match)
+
+
+def dfa_scan(data_cl: np.ndarray, table: DfaTable) -> jnp.ndarray:
+    """Run the DFA engine; returns packed match bits as a device array
+    (decode sparsely via sparse_nonzero + ops/sparse, or np.asarray for
+    the dense path)."""
+    return _dfa_scan_core(
+        jnp.asarray(data_cl),
+        jnp.asarray(table.trans.astype(np.int32).reshape(-1)),
+        jnp.asarray(table.byte_to_cls.astype(np.int32)),
+        jnp.asarray(table.accept),
+        jnp.asarray(table.accept_eol),
+        jnp.int32(table.start),
+        table.n_classes,
+    )
+
+
+@jax.jit
+def _shift_and_core(
+    data_cl: jnp.ndarray,  # (chunk, lanes) uint8
+    b_table: jnp.ndarray,  # (256,) uint32
+    match_bit: jnp.ndarray,  # () uint32
+) -> jnp.ndarray:
+    # One whole-array gather for B[byte]; the scan is then pure VPU
+    # shift/and/or — no gathers in the loop at all.
+    b_all = b_table[data_cl.astype(jnp.int32)]  # (chunk, lanes) uint32
+    lanes = data_cl.shape[1]
+    init = jnp.zeros((lanes,), dtype=jnp.uint32)
+
+    def step(s, b_row):
+        s = ((s << jnp.uint32(1)) | jnp.uint32(1)) & b_row
+        return s, (s & match_bit) != 0
+
+    _, match = jax.lax.scan(step, init, b_all)
+    return _pack_lane_bits(match)
+
+
+def shift_and_scan(data_cl: np.ndarray, model: ShiftAndModel) -> jnp.ndarray:
+    """Packed match bits as a device array (see dfa_scan)."""
+    return _shift_and_core(
+        jnp.asarray(data_cl),
+        jnp.asarray(model.b_table),
+        jnp.uint32(model.match_bit),
+    )
+
+
+# ----------------------------------------------------- sparse result fetch
+# grep matches are sparse; host<->device links may be slow (PCIe, or the
+# axon tunnel in this environment at ~MB/s).  Instead of downloading the
+# dense packed-bit plane (input/8 bytes), count the nonzero packed bytes on
+# device (4-byte transfer), then gather exactly those bytes + their indices
+# (a few KB for realistic match densities).
+
+
+@jax.jit
+def count_nonzero_bytes(packed: jnp.ndarray) -> jnp.ndarray:
+    return jnp.count_nonzero(packed)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def gather_nonzero_bytes(packed: jnp.ndarray, k: int):
+    flat = packed.reshape(-1)
+    idx = jnp.nonzero(flat, size=k, fill_value=0)[0]
+    return idx, flat[idx]
+
+
+def sparse_nonzero(packed_dev) -> tuple[np.ndarray, np.ndarray]:
+    """(indices, values) of nonzero bytes in a device packed-bit array."""
+    nnz = int(count_nonzero_bytes(packed_dev))
+    if nnz == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.uint8)
+    # Round k up to limit jit specializations.
+    k = 1 << max(6, (nnz - 1).bit_length())
+    idx, vals = gather_nonzero_bytes(packed_dev, k)
+    idx = np.asarray(idx)[:nnz].astype(np.int64)
+    vals = np.asarray(vals)[:nnz]
+    return idx, vals

@@ -12,6 +12,19 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Deregister the axon tunnel plugin entirely: its client init can block
+# indefinitely when the device tunnel is wedged, hanging every test.  CI is
+# CPU-only by design (SURVEY.md §4).  The plain "tpu" factory stays — it is
+# never initialized under jax_platforms=cpu, and removing it breaks MLIR
+# platform registration (pallas registers tpu lowering rules).
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+# sitecustomize may have imported jax before this file ran, freezing
+# JAX_PLATFORMS at its boot-time value — override through the config API.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

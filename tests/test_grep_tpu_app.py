@@ -1,0 +1,59 @@
+"""CPU grep vs TPU grep: drop-in interchangeability behind the app boundary.
+
+The north star pins this: both apps produce identical records for identical
+jobs (BASELINE.json north_star; SURVEY.md §1 plugin boundary).
+"""
+
+import pytest
+
+from distributed_grep_tpu.apps.loader import load_application
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.utils.config import JobConfig
+
+
+@pytest.mark.parametrize("pattern", ["hello", "h[ae]llo", "(fox|hello)", "^the", r"a\nb"])
+def test_cpu_and_tpu_apps_emit_identical_records(pattern):
+    cpu = load_application("distributed_grep_tpu.apps.grep", pattern=pattern)
+    tpu = load_application("distributed_grep_tpu.apps.grep_tpu", pattern=pattern)
+    data = (
+        b"hello world\nthe quick brown fox\nhallo again\nHELLO up\n"
+        b"the end\nno match here\nfox hello the"
+    )
+    assert cpu.map_fn("f.txt", data) == tpu.map_fn("f.txt", data)
+
+
+def test_tpu_app_case_insensitive():
+    cpu = load_application("distributed_grep_tpu.apps.grep", pattern="hello", ignore_case=True)
+    tpu = load_application("distributed_grep_tpu.apps.grep_tpu", pattern="hello", ignore_case=True)
+    data = b"HELLO\nx\nHeLLo there\n"
+    assert cpu.map_fn("f", data) == tpu.map_fn("f", data)
+
+
+def test_tpu_app_multi_pattern_set():
+    tpu = load_application(
+        "distributed_grep_tpu.apps.grep_tpu", patterns=["fox", "hello"]
+    )
+    data = b"a fox\nnothing\nhello\n"
+    keys = [kv.key for kv in tpu.map_fn("f", data)]
+    assert keys == ["f (line number #1)", "f (line number #3)"]
+
+
+def test_full_job_with_tpu_app(tmp_path, corpus):
+    cfg = JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello"},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job"),
+    )
+    res_tpu = run_job(cfg, n_workers=2)
+    cfg2 = JobConfig(
+        input_files=cfg.input_files,
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": "hello"},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job2"),
+    )
+    res_cpu = run_job(cfg2, n_workers=2)
+    assert res_tpu.results == res_cpu.results
+    assert res_tpu.results  # non-empty

@@ -1,0 +1,199 @@
+"""Ops tests: layout, XLA engines, Pallas kernel (interpret mode), stitching,
+and the full GrepEngine vs the re oracle — including boundary-spanning
+matches and anchored patterns across stripe boundaries (SURVEY.md §4)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models.dfa import compile_dfa
+from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import lines as lines_mod
+from distributed_grep_tpu.ops import pallas_scan, scan_jnp
+from distributed_grep_tpu.ops.engine import GrepEngine
+
+
+def oracle_lines(pattern: str, data: bytes, flags=0) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(data.split(b"\n"), start=1)
+        if re.search(pattern.encode(), line, flags)
+    }
+
+
+def make_text(n_lines=200, seed=3, inject=()):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n_lines):
+        n = int(rng.integers(0, 80))
+        lines.append(bytes(rng.choice(list(b"abcdefgh XYZ.,"), size=n).tolist()))
+    for pos, text in inject:
+        lines[pos] = text
+    return b"\n".join(lines) + b"\n"
+
+
+# ------------------------------------------------------------------- layout
+
+def test_layout_roundtrip():
+    data = bytes(range(256)) * 10
+    lay = layout_mod.choose_layout(len(data), target_lanes=16, min_chunk=8)
+    arr = layout_mod.to_device_array(data, lay)
+    assert arr.shape == (lay.chunk, lay.lanes)
+    # arr[c, l] = data[l*chunk + c] for real offsets, NL padding beyond
+    for l in (0, lay.lanes - 1):
+        for c in (0, lay.chunk - 1):
+            off = lay.offset_of(c, l)
+            expect = data[off] if off < len(data) else 0x0A
+            assert arr[c, l] == expect
+
+
+def test_layout_multiples():
+    lay = layout_mod.choose_layout(10_000, lane_multiple=4096, chunk_multiple=512, min_chunk=512)
+    assert lay.lanes % 4096 == 0 and lay.chunk % 512 == 0
+    assert lay.padded >= 10_000
+
+
+# -------------------------------------------------------------- XLA engines
+
+def scan_to_lines(packed, lay, data):
+    offsets = lines_mod.match_offsets_from_packed(packed, lay)
+    nl = lines_mod.newline_index(data)
+    return set(np.unique(lines_mod.line_of_offsets(offsets, nl)).tolist()), offsets
+
+
+@pytest.mark.parametrize("pattern", ["hello", "h[ae]llo", "[0-9]+", "qu..k"])
+def test_dfa_scan_single_lane_exact(pattern):
+    """One lane = no boundaries: device offsets must equal the host oracle."""
+    data = make_text(50, inject=[(5, b"say hello world"), (9, b"hallo 123 hello")])
+    table = compile_dfa(pattern)
+    lay = layout_mod.choose_layout(len(data), target_lanes=8, min_chunk=len(data) + 8)
+    arr = layout_mod.to_device_array(data, lay)
+    packed = scan_jnp.dfa_scan(arr, table)
+    from distributed_grep_tpu.models.dfa import reference_scan
+
+    got_lines, offsets = scan_to_lines(packed, lay, data)
+    np.testing.assert_array_equal(offsets, reference_scan(table, data))
+    assert got_lines == oracle_lines(pattern, data)
+
+
+def test_shift_and_scan_matches_dfa_scan():
+    data = make_text(100, inject=[(3, b"needle in haystack"), (97, b"a needle again")])
+    model = try_compile_shift_and("needle")
+    table = compile_dfa("needle")
+    lay = layout_mod.choose_layout(len(data), target_lanes=8, min_chunk=len(data) + 8)
+    arr = layout_mod.to_device_array(data, lay)
+    np.testing.assert_array_equal(
+        scan_jnp.shift_and_scan(arr, model), scan_jnp.dfa_scan(arr, table)
+    )
+
+
+# ---------------------------------------------------------------- stitching
+
+def test_boundary_spanning_match_is_stitched():
+    """Place a match exactly across a stripe boundary; the engine must find it."""
+    # lanes=2: boundary at chunk. Build data so 'needle' straddles it.
+    filler = b"x" * 95 + b"\n"
+    data = filler * 10 + b"nee" + b"dle" + b"y" * 90 + b"\n" + filler * 9
+    eng = GrepEngine("needle", target_lanes=2, segment_bytes=1 << 20)
+    # force a layout where the boundary falls inside 'needle'
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == oracle_lines("needle", data)
+
+
+@pytest.mark.parametrize("pattern", ["^hello", "world$", "^only.*line$"])
+def test_anchored_patterns_across_boundaries(pattern):
+    data = make_text(
+        300,
+        inject=[
+            (0, b"hello starts the file"),
+            (150, b"hello mid file"),
+            (151, b"ends with world"),
+            (152, b"only one matching line"),
+            (299, b"hello at end or world"),
+        ],
+    )
+    eng = GrepEngine(pattern, target_lanes=16)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == oracle_lines(pattern, data), pattern
+
+
+def test_multi_segment_document():
+    data = make_text(500, inject=[(250, b"the needle spans segments maybe")])
+    eng = GrepEngine("needle", target_lanes=8, segment_bytes=4096)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == oracle_lines("needle", data)
+
+
+# ------------------------------------------------------------------- engine
+
+@pytest.mark.parametrize(
+    "pattern", ["hello", "h[ae]llo", "(fox|needle)", "[0-9]{2,4}", "^XYZ", r"\w+$"]
+)
+def test_engine_vs_oracle(pattern):
+    data = make_text(
+        400,
+        inject=[
+            (10, b"hello world"),
+            (20, b"hallo 1234"),
+            (30, b"the fox and the needle"),
+            (40, b"XYZ leads here"),
+        ],
+    )
+    eng = GrepEngine(pattern, target_lanes=32)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == oracle_lines(pattern, data), pattern
+
+
+def test_engine_empty_matching_pattern_matches_all_lines():
+    data = b"a\n\nbb\n"
+    eng = GrepEngine("x*")
+    got = eng.scan(data).matched_lines.tolist()
+    assert got == [1, 2, 3]
+
+
+def test_engine_cpu_backend_and_re_fallback():
+    data = b"aaa\nbbb\nccc"
+    cpu = GrepEngine("b+", backend="cpu")
+    assert cpu.mode == "native"
+    assert cpu.scan(data).matched_lines.tolist() == [2]
+    # newline-consuming pattern -> host re fallback
+    fb = GrepEngine(r"a\nb")
+    assert fb.mode == "re"
+    assert fb.scan(data).matched_lines.tolist() == []
+
+
+def test_engine_empty_document():
+    eng = GrepEngine("x")
+    res = eng.scan(b"")
+    assert res.matched_lines.size == 0 and res.n_matches == 0
+
+
+# ----------------------------------------------------------- pallas kernel
+
+def test_pallas_shift_and_interpret_matches_jnp():
+    data = make_text(
+        2000, inject=[(5, b"needle one"), (1500, b"and a needle late in the doc")]
+    )
+    model = try_compile_shift_and("needle")
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512, lane_multiple=4096, chunk_multiple=512
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    got = pallas_scan.shift_and_scan(arr, model, interpret=True)
+    want = scan_jnp.shift_and_scan(arr, model)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_class_pattern_interpret():
+    data = make_text(1200, inject=[(7, b"say hallo please"), (900, b"or hello there")])
+    model = try_compile_shift_and("h[ae]llo")
+    assert pallas_scan.eligible(model)
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512, lane_multiple=4096, chunk_multiple=512
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    got = pallas_scan.shift_and_scan(arr, model, interpret=True)
+    want = scan_jnp.shift_and_scan(arr, model)
+    np.testing.assert_array_equal(got, want)
