@@ -1,0 +1,26 @@
+"""Device-mesh fan-out: the TPU analogue of the reference's 2-Pi cluster.
+
+The reference scales by fanning file-grained tasks over worker processes on
+separate hosts (SURVEY.md §2 parallelism checklist).  Here the same data
+parallelism rides a jax.sharding.Mesh:
+
+* ``mesh``         — mesh construction over local/global devices; the
+                     ("data", "seq") axes: documents across `data`,
+                     a document's stripes across `seq` (the sequence-
+                     parallel axis — a file larger than one chip's HBM
+                     spans the `seq` axis).
+* ``sharded_scan`` — shard_map'd scan step: each device scans its stripe
+                     block locally; counts/results combine with psum /
+                     all_gather over ICI.  Exactness across device
+                     boundaries uses the same newline-reset + host
+                     stitching story as single-device stripes.
+* ``multihost``    — jax.distributed.initialize glue: each host's worker
+                     process drives its local chips, while the
+                     coordinator's four-verb protocol (runtime/) remains
+                     the cross-host control plane over DCN.
+"""
+
+from distributed_grep_tpu.parallel.mesh import make_mesh
+from distributed_grep_tpu.parallel.sharded_scan import sharded_grep_step
+
+__all__ = ["make_mesh", "sharded_grep_step"]

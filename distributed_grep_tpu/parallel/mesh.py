@@ -1,0 +1,49 @@
+"""Mesh construction helpers.
+
+A grep job's mesh has up to two axes:
+
+* ``data`` — independent document shards (the reference's one-task-per-file
+  axis, coordinator.go:329-333, generalized to many chips);
+* ``seq``  — stripes *within* one document: the sequence-parallel axis for
+  documents bigger than a chip (the long-context axis, SURVEY.md §5).
+
+Both axes are interchangeable for throughput (the scan is lane-parallel
+either way); they differ in how results recombine — `data` concatenates,
+`seq` needs boundary-line stitching, which ops/lines.py handles uniformly
+because device boundaries are just stripe boundaries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: tuple[int, ...] = (),
+    axes: tuple[str, ...] = ("data",),
+    devices: list | None = None,
+) -> Mesh:
+    """Build a Mesh; shape () means all devices on the first axis."""
+    devs = devices if devices is not None else jax.devices()
+    if not shape:
+        shape = (len(devs),) + (1,) * (len(axes) - 1)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def lane_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for the (chunk, lanes) stripe array: lanes split across the
+    given mesh axis — each device owns a contiguous block of document
+    stripes, so cross-device boundaries are ordinary stripe boundaries."""
+    spec = [None, axis]
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,62 @@
+"""Multi-host glue: jax.distributed + the coordinator protocol over DCN.
+
+Topology (mirrors the reference's shape — a coordinator host + worker
+hosts, SURVEY.md §5 distributed-backend mapping):
+
+* Control plane: the four-verb HTTP protocol (runtime/http_coordinator.py)
+  runs over DCN exactly as the reference's net/rpc ran over the LAN.  One
+  worker process per host asks for splits and commits results.
+* Compute plane: each worker process drives all chips local to its host
+  through parallel/sharded_scan over a mesh of its local devices.
+* For jobs that want one global mesh spanning hosts (a full pod slice),
+  `init_distributed` wires jax.distributed so jax.devices() is global and
+  meshes may span hosts; collectives then ride ICI within a slice and DCN
+  across slices — standard JAX SPMD.  The MapReduce layer is agnostic:
+  a "worker" is whoever called AssignTask, whether it owns 1 chip or a
+  4x4 slice.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("multihost")
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize jax.distributed from args or standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    Returns True if distributed mode was initialized, False for
+    single-process operation (the common single-host case)."""
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if addr is None:
+        return False
+    kwargs = {}
+    n = num_processes if num_processes is not None else os.environ.get("JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID")
+    if n is not None:
+        kwargs["num_processes"] = int(n)
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(coordinator_address=addr, **kwargs)
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+    return True
+
+
+def local_mesh_devices() -> list:
+    """Devices this process should put in its worker-local mesh."""
+    return jax.local_devices()

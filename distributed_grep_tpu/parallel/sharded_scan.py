@@ -1,0 +1,126 @@
+"""shard_map'd scan step: the multi-chip grep "training step".
+
+Each device holds a contiguous block of document stripes (lanes) and runs
+the same lane-parallel scan the single-chip engine uses; XLA collectives
+combine results over ICI:
+
+* per-device packed match bits stay device-local (fetched sparsely);
+* the global match count is a psum over the mesh;
+* exit states per stripe are returned for diagnostics / cross-shard
+  continuation (a ppermute hands each device its left neighbor's last
+  exit state — the ring pattern sequence parallelism uses, exercised here
+  so the sharding compiles and runs even though grep's newline-reset +
+  host stitching already gives exactness without it).
+
+Everything is jit-compiled over an explicit Mesh with NamedShardings, so
+the same code runs on one chip, a v5e pod slice, or the CI host's
+8-virtual-device CPU mesh (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_grep_tpu.models.dfa import DfaTable
+from distributed_grep_tpu.models.shift_and import ShiftAndModel
+
+NL = 0x0A
+
+
+def _dfa_device_scan(data_blk, trans_flat, byte_to_cls, accept, accept_eol, start, n_classes):
+    """Per-device body: (chunk, local_lanes) uint8 -> (packed bits, count,
+    per-lane exit states).  Mirrors scan_jnp._dfa_scan_core."""
+    chunk, lanes = data_blk.shape
+    cls = byte_to_cls[data_blk.astype(jnp.int32)]
+    nl_next = jnp.concatenate([data_blk[1:] == NL, jnp.ones((1, lanes), bool)], axis=0)
+    # Derive the initial state vector from the (device-varying) data block so
+    # the scan carry is varying over the shard_map axis — a replicated init
+    # would fail the carry-type check against the varying output.
+    init = (data_blk[0] * 0).astype(jnp.int32) + start
+
+    def step(states, inputs):
+        cls_row, nl_row = inputs
+        nxt = trans_flat[states * n_classes + cls_row]
+        return nxt, accept[nxt] | (accept_eol[nxt] & nl_row)
+
+    final_states, match = jax.lax.scan(step, init, (cls, nl_next))
+    bits = match.reshape(chunk, lanes // 8, 8).astype(jnp.uint8)
+    powers = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
+    packed = (bits * powers).sum(axis=-1, dtype=jnp.uint8)
+    return packed, jnp.count_nonzero(match), final_states
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "n_classes"),
+)
+def _sharded_dfa_scan(
+    data_cl,  # (chunk, lanes) uint8, lanes sharded over `axis`
+    trans_flat,
+    byte_to_cls,
+    accept,
+    accept_eol,
+    start,
+    *,
+    mesh: Mesh,
+    axis: str,
+    n_classes: int,
+):
+    def body(data_blk, trans_flat, byte_to_cls, accept, accept_eol, start):
+        packed, count, exits = _dfa_device_scan(
+            data_blk, trans_flat, byte_to_cls, accept, accept_eol, start, n_classes
+        )
+        total = jax.lax.psum(count, axis)  # ICI collective: global match count
+        # Ring handoff of the rightmost stripe's exit state to the right
+        # neighbor — the sequence-parallel state-carry pattern.
+        right_edge = exits[-1:]  # (1,) last lane's final state... per device
+        left_in = jax.lax.ppermute(
+            right_edge,
+            axis,
+            perm=[(i, (i + 1) % mesh.shape[axis]) for i in range(mesh.shape[axis])],
+        )
+        return packed, total, exits, left_in
+
+    from jax.experimental.shard_map import shard_map
+
+    spec_lanes = P(None, axis)
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec_lanes, P(), P(), P(), P(), P()),
+        out_specs=(spec_lanes, P(), P(axis), P(axis)),
+    )(data_cl, trans_flat, byte_to_cls, accept, accept_eol, start)
+    return out
+
+
+def sharded_grep_step(
+    data_cl: np.ndarray,
+    table: DfaTable,
+    mesh: Mesh,
+    axis: str = "data",
+):
+    """Run the sharded DFA scan; returns (packed_bits_device, total_count,
+    exit_states, neighbor_states).  `data_cl` lanes must divide evenly by
+    the mesh axis size (layout.choose_layout lane_multiple handles this)."""
+    n_dev = mesh.shape[axis]
+    chunk, lanes = data_cl.shape
+    if lanes % (n_dev * 8):
+        raise ValueError(f"lanes={lanes} must divide mesh axis {n_dev} x 8")
+    sharding = NamedSharding(mesh, P(None, axis))
+    dev_arr = jax.device_put(jnp.asarray(data_cl), sharding)
+    return _sharded_dfa_scan(
+        dev_arr,
+        jnp.asarray(table.trans.astype(np.int32).reshape(-1)),
+        jnp.asarray(table.byte_to_cls.astype(np.int32)),
+        jnp.asarray(table.accept),
+        jnp.asarray(table.accept_eol),
+        jnp.int32(table.start),
+        mesh=mesh,
+        axis=axis,
+        n_classes=table.n_classes,
+    )
