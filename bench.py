@@ -60,15 +60,22 @@ def bench_tpu(data: bytes) -> float:
         chunk_multiple=512,
     )
     arr = layout_mod.to_device_array(data, lay)
-    dev = jax.device_put(jnp.asarray(arr.reshape(lay.chunk, -1, 128)))
+    arr3 = arr.reshape(lay.chunk, -1, 128)
+    # 512 extra '\n' pad rows: each loop iteration scans a window starting at
+    # a DIFFERENT row offset (i-dependent dynamic_slice), so XLA cannot hoist
+    # the scan out of the fori_loop as loop-invariant — which it provably did
+    # before (5 chained passes timed identical to 1).
+    pad = np.full((512,) + arr3.shape[1:], 0x0A, dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(np.concatenate([arr3, pad], axis=0)))
     sym_ranges = tuple(tuple(r) for r in model.sym_ranges)
     lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
 
     @functools.partial(jax.jit, static_argnames=("reps",))
     def chained(d, reps):
         def body(i, acc):
+            window = jax.lax.dynamic_slice_in_dim(d, (i % 2) * 512, lay.chunk, axis=0)
             words = pallas_scan._shift_and_pallas(
-                d,
+                window,
                 sym_ranges=sym_ranges,
                 match_bit=int(model.match_bit),
                 chunk=lay.chunk,
@@ -78,10 +85,15 @@ def bench_tpu(data: bytes) -> float:
             return acc + jnp.count_nonzero(words)
         return jax.lax.fori_loop(0, reps, body, jnp.int32(0))
 
-    r1, r2 = 1, 5
+    r1, r2 = 2, 10
     c1 = int(chained(dev, r1))  # compile + warm
     c2 = int(chained(dev, r2))
-    assert c2 == r2 * c1 // r1 and c1 >= 1000, f"match counts wrong: {c1}, {c2}"
+    # Odd iterations drop each stripe's first 512 bytes (the shifted window),
+    # losing ~512/chunk of the 1000 planted needles — counts are near, not
+    # exactly, 1000/pass.  Both runs see the same 1:1 full/shifted window mix,
+    # so per-pass counts must still agree exactly across rep counts.
+    assert c2 * r1 == c1 * r2, f"per-pass count drift: {c1}/{r1} vs {c2}/{r2}"
+    assert 900 * r1 <= c1 <= 1100 * r1, f"match count off: {c1} for {r1} passes"
 
     def timed(reps, iters=3):
         t0 = time.perf_counter()
@@ -91,6 +103,8 @@ def bench_tpu(data: bytes) -> float:
 
     d1, d2 = timed(r1), timed(r2)
     per_pass = (d2 - d1) / (r2 - r1)
+    print(f"bench: slope timings {d1=:.4f}s ({r1} passes) {d2=:.4f}s ({r2} passes)",
+          file=sys.stderr)
     if per_pass <= 0:
         raise RuntimeError(f"non-positive slope: {d1=:.4f} {d2=:.4f}")
     print(f"bench: tpu pallas shift-and {len(data)/1e9/per_pass:.2f} GB/s "

@@ -109,11 +109,52 @@ def compile_aho_corasick(
         cols = np.concatenate([cols, np.zeros((n, 1), dtype=cols.dtype)], axis=1)
     trans = np.ascontiguousarray(cols, dtype=np.uint16)
 
+    # Full-alphabet binary rulesets reach 256 classes (the forced-NL column
+    # only ever replaces a shared one, so 257 is unreachable); uint16 keeps
+    # headroom anyway and matches the int32 cast the device scan applies.
     return DfaTable(
         trans=trans,
-        byte_to_cls=byte_to_cls.astype(np.uint8),
+        byte_to_cls=byte_to_cls.astype(np.uint16),
         accept=np.asarray(accept_sets, dtype=bool),
         accept_eol=np.zeros(n, dtype=bool),
         start=0,
         pattern=f"<aho-corasick {len(needles)} literals>",
     )
+
+
+def compile_aho_corasick_banks(
+    patterns: list[str | bytes],
+    ignore_case: bool = False,
+    max_states_per_bank: int = 1 << 16,
+) -> list[DfaTable]:
+    """Compile an arbitrarily large literal set into one or more DfaTables.
+
+    Hyperscan-scale rulesets (10k+ patterns, BASELINE.json config 5) exceed
+    the uint16 state space of a single automaton; the Hyperscan-style answer
+    is to shard the ruleset into independent banks and scan each — on TPU the
+    banks are extra lane-parallel passes over the same device-resident bytes,
+    and grep's per-line semantics make the union of per-bank matched lines
+    exact.  Patterns are greedily packed by worst-case trie size (one state
+    per byte) so each bank compiles within its state budget.
+    """
+    norm: list[bytes] = [
+        p.encode("utf-8") if isinstance(p, str) else bytes(p) for p in patterns
+    ]
+    if not norm:
+        raise RegexError("empty pattern set")
+    banks: list[list[bytes]] = []
+    cur: list[bytes] = []
+    cur_states = 1  # root
+    for p in norm:
+        cost = len(p)
+        if cur and cur_states + cost > max_states_per_bank - 1:
+            banks.append(cur)
+            cur, cur_states = [], 1
+        cur.append(p)
+        cur_states += cost
+    if cur:
+        banks.append(cur)
+    return [
+        compile_aho_corasick(b, ignore_case=ignore_case, max_states=max_states_per_bank)
+        for b in banks
+    ]

@@ -410,7 +410,8 @@ class DfaTable:
     """Dense scan tables, device- and host-ready.
 
     trans        [n_states, n_classes] uint16 — next state per byte class
-    byte_to_cls  [256] uint8
+    byte_to_cls  [256] unsigned int (uint8 from compile_dfa, uint16 from
+                 aho — full-alphabet rulesets reach 256 classes)
     accept       [n_states] bool — a match ends at this byte
     accept_eol   [n_states] bool — a match ends here iff next byte is '\\n'
                  (the '$' accept set; scans pad a trailing '\\n')

@@ -25,7 +25,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from distributed_grep_tpu.models.aho import compile_aho_corasick
+from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
 from distributed_grep_tpu.models.dfa import (
     DfaTable,
     RegexError,
@@ -62,6 +62,7 @@ class GrepEngine:
         target_lanes: int = 1024,
         segment_bytes: int = 64 * 1024 * 1024,
         max_states: int = 4096,
+        max_states_per_bank: int = 1 << 16,
     ):
         if (pattern is None) == (patterns is None):
             raise ValueError("exactly one of pattern / patterns is required")
@@ -72,16 +73,26 @@ class GrepEngine:
 
         self.shift_and: ShiftAndModel | None = None
         self.table: DfaTable | None = None
+        # Pattern sets beyond one automaton's uint16 state space compile to
+        # several independent banks (Hyperscan-style ruleset sharding); each
+        # bank is one extra device pass and matched lines are unioned.
+        self.tables: list[DfaTable] = []
+        self._dev_tables: list[tuple] | None = None
         self._re_fallback: _re.Pattern[bytes] | None = None
 
         if patterns is not None:
             self.pattern = f"<set of {len(patterns)}>"
-            self.table = compile_aho_corasick(patterns, ignore_case=ignore_case)
+            self.tables = compile_aho_corasick_banks(
+                patterns, ignore_case=ignore_case,
+                max_states_per_bank=max_states_per_bank,
+            )
+            self.table = self.tables[0]
             self.mode = "dfa"
         else:
             self.pattern = pattern
             try:
                 self.table = compile_dfa(pattern, ignore_case=ignore_case, max_states=max_states)
+                self.tables = [self.table]
                 self.shift_and = try_compile_shift_and(pattern, ignore_case=ignore_case)
                 self.mode = "shift_and" if self.shift_and is not None else "dfa"
             except RegexError as e:
@@ -100,7 +111,7 @@ class GrepEngine:
     def scan(self, data: bytes) -> ScanResult:
         if self.mode == "re":
             return self._scan_re(data)
-        if self.table is not None and self.table.accept[self.table.start]:
+        if self.tables and any(t.accept[t.start] for t in self.tables):
             # Pattern matches the empty string -> every line matches (grep
             # semantics); also sidesteps empty-match bookkeeping on device.
             n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
@@ -118,14 +129,34 @@ class GrepEngine:
         return ScanResult(np.asarray(matched, dtype=np.int64), len(matched), len(data))
 
     def _scan_native(self, data: bytes) -> ScanResult:
-        offsets = reference_scan(self.table, data)
+        offsets = np.unique(np.concatenate(
+            [reference_scan(t, data) for t in self.tables]
+        )) if self.tables else np.zeros(0, dtype=np.int64)
         nl = lines_mod.newline_index(data)
         lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
             np.zeros(0, dtype=np.int64)
         return ScanResult(lns.astype(np.int64), int(offsets.size), len(data))
 
     def _host_line_matcher(self, line: bytes) -> bool:
-        return reference_scan(self.table, line).size > 0
+        return any(reference_scan(t, line).size > 0 for t in self.tables)
+
+    def _device_tables(self) -> list[tuple]:
+        """Per-bank device-resident scan tables, uploaded once per engine."""
+        if self._dev_tables is None:
+            import jax.numpy as jnp
+
+            self._dev_tables = [
+                (
+                    jnp.asarray(t.trans.astype(np.int32).reshape(-1)),
+                    jnp.asarray(t.byte_to_cls.astype(np.int32)),
+                    jnp.asarray(t.accept),
+                    jnp.asarray(t.accept_eol),
+                    jnp.int32(t.start),
+                    t.n_classes,
+                )
+                for t in self.tables
+            ]
+        return self._dev_tables
 
     # --------------------------------------------------------- device engine
     def _scan_device(self, data: bytes) -> ScanResult:
@@ -162,14 +193,27 @@ class GrepEngine:
                 words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
                 idx, vals = scan_jnp.sparse_nonzero(words)
                 offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-            else:
-                packed = (
-                    scan_jnp.shift_and_scan(arr, self.shift_and)
-                    if self.mode == "shift_and"
-                    else scan_jnp.dfa_scan(arr, self.table)
-                )
+            elif self.mode == "shift_and":
+                packed = scan_jnp.shift_and_scan(arr, self.shift_and)
                 idx, vals = scan_jnp.sparse_nonzero(packed)
                 offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+            else:
+                # One device pass per automaton bank; bytes AND bank tables
+                # are uploaded once (tables are cached on the engine — a
+                # near-full bank's table is ~67 MB, re-uploading it per
+                # segment would swamp the link the sparse fetch protects).
+                import jax.numpy as jnp
+
+                arr_dev = jnp.asarray(arr)
+                per_bank = []
+                for bank in self._device_tables():
+                    packed = scan_jnp._dfa_scan_core(arr_dev, *bank)
+                    idx, vals = scan_jnp.sparse_nonzero(packed)
+                    per_bank.append(
+                        sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+                    )
+                offsets = np.unique(np.concatenate(per_bank)) if per_bank else \
+                    np.zeros(0, dtype=np.int64)
             n_matches += int(offsets.size)
             if offsets.size:
                 seg_nl = lines_mod.newline_index(seg_bytes)

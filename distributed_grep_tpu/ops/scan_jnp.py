@@ -41,16 +41,22 @@ def _pack_lane_bits(match: jnp.ndarray) -> jnp.ndarray:
     return (bits * powers).sum(axis=-1, dtype=jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("n_classes",))
-def _dfa_scan_core(
+def dfa_scan_body(
     data_cl: jnp.ndarray,  # (chunk, lanes) uint8
     trans_flat: jnp.ndarray,  # (n_states * n_classes,) int32
     byte_to_cls: jnp.ndarray,  # (256,) int32
     accept: jnp.ndarray,  # (n_states,) bool
     accept_eol: jnp.ndarray,  # (n_states,) bool
-    start: jnp.ndarray,  # () int32
+    init: jnp.ndarray,  # (lanes,) int32 initial state per lane
     n_classes: int,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared DFA scan recurrence -> (final_states, match bool (chunk, lanes)).
+
+    The single source of truth for scan semantics (the end-of-stripe
+    next-byte-is-'\\n' rule, '$' accepts, the transition step) — both the
+    single-chip core below and parallel/sharded_scan's shard_map body call
+    this, so the two paths cannot drift.
+    """
     chunk, lanes = data_cl.shape
     # Hoisted table lookups: one gather for the whole array.
     cls = byte_to_cls[data_cl.astype(jnp.int32)]  # (chunk, lanes) int32
@@ -62,15 +68,30 @@ def _dfa_scan_core(
         [data_cl[1:] == NL, jnp.ones((1, lanes), dtype=bool)], axis=0
     )
 
-    init = jnp.full((lanes,), start, dtype=jnp.int32)
-
     def step(states, inputs):
         cls_row, nl_row = inputs
         nxt = trans_flat[states * n_classes + cls_row]
         match = accept[nxt] | (accept_eol[nxt] & nl_row)
         return nxt, match
 
-    _, match = jax.lax.scan(step, init, (cls, nl_next))
+    return jax.lax.scan(step, init, (cls, nl_next))
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def _dfa_scan_core(
+    data_cl: jnp.ndarray,
+    trans_flat: jnp.ndarray,
+    byte_to_cls: jnp.ndarray,
+    accept: jnp.ndarray,
+    accept_eol: jnp.ndarray,
+    start: jnp.ndarray,  # () int32
+    n_classes: int,
+) -> jnp.ndarray:
+    lanes = data_cl.shape[1]
+    init = jnp.full((lanes,), start, dtype=jnp.int32)
+    _, match = dfa_scan_body(
+        data_cl, trans_flat, byte_to_cls, accept, accept_eol, init, n_classes
+    )
     return _pack_lane_bits(match)
 
 

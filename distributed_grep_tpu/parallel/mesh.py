@@ -37,13 +37,9 @@ def make_mesh(
     return Mesh(arr, axes)
 
 
-def lane_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+def lane_sharding(mesh: Mesh, axis: str | tuple[str, ...] = "data") -> NamedSharding:
     """Sharding for the (chunk, lanes) stripe array: lanes split across the
-    given mesh axis — each device owns a contiguous block of document
-    stripes, so cross-device boundaries are ordinary stripe boundaries."""
-    spec = [None, axis]
-    return NamedSharding(mesh, P(*spec))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    given mesh axis (or axis tuple — lanes shard over the product) — each
+    device owns a contiguous block of document stripes, so cross-device
+    boundaries are ordinary stripe boundaries."""
+    return NamedSharding(mesh, P(None, axis))

@@ -28,31 +28,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_grep_tpu.models.dfa import DfaTable
 from distributed_grep_tpu.models.shift_and import ShiftAndModel
+from distributed_grep_tpu.ops import scan_jnp
+from distributed_grep_tpu.parallel.mesh import lane_sharding
 
 NL = 0x0A
 
 
 def _dfa_device_scan(data_blk, trans_flat, byte_to_cls, accept, accept_eol, start, n_classes):
     """Per-device body: (chunk, local_lanes) uint8 -> (packed bits, count,
-    per-lane exit states).  Mirrors scan_jnp._dfa_scan_core."""
-    chunk, lanes = data_blk.shape
-    cls = byte_to_cls[data_blk.astype(jnp.int32)]
-    nl_next = jnp.concatenate([data_blk[1:] == NL, jnp.ones((1, lanes), bool)], axis=0)
+    per-lane exit states).  Delegates the recurrence to scan_jnp.dfa_scan_body
+    (single source of truth for scan semantics)."""
     # Derive the initial state vector from the (device-varying) data block so
     # the scan carry is varying over the shard_map axis — a replicated init
     # would fail the carry-type check against the varying output.
     init = (data_blk[0] * 0).astype(jnp.int32) + start
-
-    def step(states, inputs):
-        cls_row, nl_row = inputs
-        nxt = trans_flat[states * n_classes + cls_row]
-        return nxt, accept[nxt] | (accept_eol[nxt] & nl_row)
-
-    final_states, match = jax.lax.scan(step, init, (cls, nl_next))
-    bits = match.reshape(chunk, lanes // 8, 8).astype(jnp.uint8)
-    powers = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint8)
-    packed = (bits * powers).sum(axis=-1, dtype=jnp.uint8)
-    return packed, jnp.count_nonzero(match), final_states
+    final_states, match = scan_jnp.dfa_scan_body(
+        data_blk, trans_flat, byte_to_cls, accept, accept_eol, init, n_classes
+    )
+    return scan_jnp._pack_lane_bits(match), jnp.count_nonzero(match), final_states
 
 
 @partial(
@@ -71,29 +64,37 @@ def _sharded_dfa_scan(
     axis: str,
     n_classes: int,
 ):
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    ring_axis = axes[-1]  # stripes within a document run along the innermost axis
+
     def body(data_blk, trans_flat, byte_to_cls, accept, accept_eol, start):
         packed, count, exits = _dfa_device_scan(
             data_blk, trans_flat, byte_to_cls, accept, accept_eol, start, n_classes
         )
-        total = jax.lax.psum(count, axis)  # ICI collective: global match count
+        total = jax.lax.psum(count, axes)  # ICI collective: global match count
         # Ring handoff of the rightmost stripe's exit state to the right
-        # neighbor — the sequence-parallel state-carry pattern.
-        right_edge = exits[-1:]  # (1,) last lane's final state... per device
+        # neighbor along the sequence axis — the sequence-parallel
+        # state-carry pattern (the data axis holds independent documents and
+        # needs no handoff).
+        right_edge = exits[-1:]  # (1,) last lane's final state per device
         left_in = jax.lax.ppermute(
             right_edge,
-            axis,
-            perm=[(i, (i + 1) % mesh.shape[axis]) for i in range(mesh.shape[axis])],
+            ring_axis,
+            perm=[
+                (i, (i + 1) % mesh.shape[ring_axis])
+                for i in range(mesh.shape[ring_axis])
+            ],
         )
         return packed, total, exits, left_in
 
     from jax.experimental.shard_map import shard_map
 
-    spec_lanes = P(None, axis)
+    spec_lanes = P(None, axes)
     out = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec_lanes, P(), P(), P(), P(), P()),
-        out_specs=(spec_lanes, P(), P(axis), P(axis)),
+        out_specs=(spec_lanes, P(), P(axes), P(axes)),
     )(data_cl, trans_flat, byte_to_cls, accept, accept_eol, start)
     return out
 
@@ -102,17 +103,19 @@ def sharded_grep_step(
     data_cl: np.ndarray,
     table: DfaTable,
     mesh: Mesh,
-    axis: str = "data",
+    axis: str | tuple[str, ...] = "data",
 ):
     """Run the sharded DFA scan; returns (packed_bits_device, total_count,
-    exit_states, neighbor_states).  `data_cl` lanes must divide evenly by
-    the mesh axis size (layout.choose_layout lane_multiple handles this)."""
-    n_dev = mesh.shape[axis]
+    exit_states, neighbor_states).  `axis` may be one mesh axis name or a
+    tuple (e.g. ("data", "seq")) — lanes shard over the product.  Lanes must
+    divide evenly by the sharded device count (layout.choose_layout
+    lane_multiple handles this)."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
     chunk, lanes = data_cl.shape
     if lanes % (n_dev * 8):
-        raise ValueError(f"lanes={lanes} must divide mesh axis {n_dev} x 8")
-    sharding = NamedSharding(mesh, P(None, axis))
-    dev_arr = jax.device_put(jnp.asarray(data_cl), sharding)
+        raise ValueError(f"lanes={lanes} must divide mesh axes {axes} ({n_dev}) x 8")
+    dev_arr = jax.device_put(jnp.asarray(data_cl), lane_sharding(mesh, axes))
     return _sharded_dfa_scan(
         dev_arr,
         jnp.asarray(table.trans.astype(np.int32).reshape(-1)),

@@ -194,3 +194,34 @@ def test_aho_scales_to_1k_literals():
     assert table.n_states > 1000
     data = ("xx" + pats[17] + "yy\n" + "zz\n" + pats[999]).encode()
     assert matched_lines(table, data) == {1, 3}
+
+
+def test_aho_full_alphabet_binary_patterns():
+    # Full-alphabet binary ruleset: 256 byte classes, every class index must
+    # survive the table dtypes end to end (config-5 shape at toy size).
+    pats = [bytes([b]) for b in range(256) if b != 0x0A]
+    table = compile_aho_corasick(pats)
+    assert table.n_classes >= 256
+    assert int(table.byte_to_cls.max()) < table.n_classes
+    data = bytes([0, 65, 0x0A, 255, 254, 0x0A])
+    assert matched_lines(table, data) == {1, 2}
+
+
+def test_aho_banks_split_and_union():
+    from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
+
+    pats = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot"]
+    banks = compile_aho_corasick_banks(pats, max_states_per_bank=16)
+    assert len(banks) >= 2  # forced split
+    data = b"xx alpha\nnothing\nfoxtrot here\ndelta\n"
+    got = set()
+    for t in banks:
+        got |= matched_lines(t, data)
+    assert got == {1, 3, 4}
+
+
+def test_aho_bank_single_when_capacity_allows():
+    from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
+
+    banks = compile_aho_corasick_banks(["he", "she"], max_states_per_bank=1 << 16)
+    assert len(banks) == 1

@@ -170,6 +170,25 @@ def test_engine_empty_document():
     assert res.matched_lines.size == 0 and res.n_matches == 0
 
 
+def test_engine_pattern_set_banked_device_scan():
+    # Force the pattern set across several automaton banks and check the
+    # device path unions per-bank matches exactly (config-5 shape at toy size).
+    pats = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf"]
+    data = make_text(
+        120,
+        inject=[(5, b"xx alpha yy"), (30, b"golf and echo"), (77, b"charlie!")],
+    )
+    eng = GrepEngine(patterns=pats, target_lanes=16, max_states_per_bank=16)
+    assert len(eng.tables) >= 2
+    expected = set()
+    for p in pats:
+        expected |= oracle_lines(p, data)
+    assert set(eng.scan(data).matched_lines.tolist()) == expected
+    # native backend takes the same banked union path
+    cpu = GrepEngine(patterns=pats, backend="cpu", max_states_per_bank=16)
+    assert set(cpu.scan(data).matched_lines.tolist()) == expected
+
+
 # ----------------------------------------------------------- pallas kernel
 
 def test_pallas_shift_and_interpret_matches_jnp():

@@ -104,3 +104,31 @@ def test_two_axis_mesh_scan():
         i for i, line in enumerate(data.split(b"\n"), start=1) if b"needle" in line
     }
     assert stitched == expected
+
+
+def test_product_axis_sharding_uses_all_devices():
+    """axis=("data","seq"): lanes shard over the 4x2 product — all 8 devices
+    hold distinct stripes, psum spans both axes, ring rides 'seq'."""
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    data = make_text(300, inject=[(13, b"needle one"), (250, b"two needle")])
+    table = compile_dfa("needle")
+    lay = layout_mod.choose_layout(len(data), target_lanes=64, min_chunk=8)
+    arr = layout_mod.to_device_array(data, lay)
+    packed, total, exits, neigh = sharded_grep_step(
+        arr, table, mesh, axis=("data", "seq")
+    )
+    shard_shapes = {s.data.shape for s in packed.addressable_shards}
+    assert shard_shapes == {(lay.chunk, lay.lanes // 8 // 8)}  # 8-way lane split
+    assert np.asarray(neigh).shape == (8,)
+    offsets = lines_mod.match_offsets_from_packed(np.asarray(packed), lay)
+    nl = lines_mod.newline_index(data)
+    device_lines = set(np.unique(lines_mod.line_of_offsets(offsets, nl)).tolist())
+    stitched = lines_mod.stitch_lines(
+        device_lines, data, nl, lay.stripe_starts().tolist(),
+        lambda line: reference_scan(table, line).size > 0,
+    )
+    expected = {
+        i for i, line in enumerate(data.split(b"\n"), start=1) if b"needle" in line
+    }
+    assert stitched == expected
+    assert int(total) == offsets.size
