@@ -61,54 +61,36 @@ def bench_tpu(data: bytes) -> float:
     )
     arr = layout_mod.to_device_array(data, lay)
     arr3 = arr.reshape(lay.chunk, -1, 128)
-    # 512 extra '\n' pad rows: each loop iteration scans a window starting at
-    # a DIFFERENT row offset (i-dependent dynamic_slice), so XLA cannot hoist
-    # the scan out of the fori_loop as loop-invariant — which it provably did
-    # before (5 chained passes timed identical to 1).
+    # 512 '\n' pad rows let each chained pass scan an i-dependent window —
+    # required by the slope harness's anti-hoisting scheme (utils/slope.py).
+    # Odd windows drop each stripe's first 512 bytes, losing ~512/chunk of
+    # the 1000 planted needles, hence the count band below.
     pad = np.full((512,) + arr3.shape[1:], 0x0A, dtype=np.uint8)
     dev = jax.device_put(jnp.asarray(np.concatenate([arr3, pad], axis=0)))
     sym_ranges = tuple(tuple(r) for r in model.sym_ranges)
     lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
 
-    @functools.partial(jax.jit, static_argnames=("reps",))
-    def chained(d, reps):
-        def body(i, acc):
-            window = jax.lax.dynamic_slice_in_dim(d, (i % 2) * 512, lay.chunk, axis=0)
-            words = pallas_scan._shift_and_pallas(
-                window,
-                sym_ranges=sym_ranges,
-                match_bit=int(model.match_bit),
-                chunk=lay.chunk,
-                lane_blocks=lane_blocks,
-                interpret=False,
-            )
-            return acc + jnp.count_nonzero(words)
-        return jax.lax.fori_loop(0, reps, body, jnp.int32(0))
+    def scan_count(window):
+        import jax.numpy as jnp
 
-    r1, r2 = 2, 10
-    c1 = int(chained(dev, r1))  # compile + warm
-    c2 = int(chained(dev, r2))
-    # Odd iterations drop each stripe's first 512 bytes (the shifted window),
-    # losing ~512/chunk of the 1000 planted needles — counts are near, not
-    # exactly, 1000/pass.  Both runs see the same 1:1 full/shifted window mix,
-    # so per-pass counts must still agree exactly across rep counts.
-    assert c2 * r1 == c1 * r2, f"per-pass count drift: {c1}/{r1} vs {c2}/{r2}"
-    assert 900 * r1 <= c1 <= 1100 * r1, f"match count off: {c1} for {r1} passes"
+        words = pallas_scan._shift_and_pallas(
+            window,
+            sym_ranges=sym_ranges,
+            match_bit=int(model.match_bit),
+            chunk=lay.chunk,
+            lane_blocks=lane_blocks,
+            interpret=False,
+        )
+        return jnp.count_nonzero(words)
 
-    def timed(reps, iters=3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            int(chained(dev, reps))
-        return (time.perf_counter() - t0) / iters
+    from distributed_grep_tpu.utils.slope import slope_per_pass
 
-    d1, d2 = timed(r1), timed(r2)
-    per_pass = (d2 - d1) / (r2 - r1)
-    print(f"bench: slope timings {d1=:.4f}s ({r1} passes) {d2=:.4f}s ({r2} passes)",
-          file=sys.stderr)
-    if per_pass <= 0:
-        raise RuntimeError(f"non-positive slope: {d1=:.4f} {d2=:.4f}")
+    per_pass, per_count = slope_per_pass(
+        dev, lay.chunk, 512, scan_count, r1=2, r2=10, count_range=(900, 1100)
+    )
     print(f"bench: tpu pallas shift-and {len(data)/1e9/per_pass:.2f} GB/s "
-          f"({per_pass*1e3:.1f} ms/pass, {c1} matches)", file=sys.stderr)
+          f"({per_pass*1e3:.1f} ms/pass, {per_count:.0f} matches/pass)",
+          file=sys.stderr)
     return len(data) / 1e9 / per_pass
 
 

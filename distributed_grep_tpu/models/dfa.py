@@ -438,6 +438,84 @@ class DfaTable:
         return np.ascontiguousarray(self.trans[:, self.byte_to_cls])
 
 
+@dataclass
+class StrideTable:
+    """k-byte-stride composition of a DfaTable for the device scan.
+
+    The per-byte DFA recurrence costs one table gather per scanned byte; on
+    TPU the gather (and the lax.scan step overhead around it) dominates.
+    Composing transitions over k bytes turns the scan into chunk/k steps of
+    ONE gather from a [n_states, n_classes**k] table whose int32 entries pack
+    the landing state with a k-bit accept bitmap:
+
+        entry = (state_after_k_bytes << k) | accept_bitmap
+        bit t of accept_bitmap = accept[state after consuming byte t]
+
+    The bitmap preserves exact per-byte match offsets (a match ending
+    mid-stride keeps its true position, so line attribution across a '\\n'
+    inside the stride stays correct), and newline-reset transitions compose
+    through the table like any other byte.  '$' accepts (accept_eol) need
+    next-byte context, so patterns using them keep stride 1.
+    """
+
+    trans_k: np.ndarray  # [n_states, n_classes**k] int32 packed entries
+    byte_to_cls: np.ndarray  # [256] (shared with the base table)
+    k: int
+    n_classes: int  # base (1-byte) class count
+    start: int
+
+    @property
+    def n_states(self) -> int:
+        return self.trans_k.shape[0]
+
+
+def choose_stride(
+    table: DfaTable, max_entries: int = 1 << 23, max_cols: int = 1 << 13
+) -> int:
+    """Largest k in {4,2,1} whose composed table fits the budget (entries
+    cap bounds HBM/upload cost; column cap bounds the combined-class index
+    range).  Powers of two only: scan layouts pad chunk to a multiple of 8,
+    which k must divide."""
+    if table.accept_eol.any():
+        return 1
+    for k in (4, 2):
+        cols = table.n_classes**k
+        if cols <= max_cols and table.n_states * cols <= max_entries:
+            return k
+    return 1
+
+
+def build_stride_table(table: DfaTable, k: int) -> StrideTable:
+    """Compose the DFA over k-byte strides (vectorized over states)."""
+    if k < 1:
+        raise ValueError(f"stride must be >= 1, got {k}")
+    if k > 1 and table.accept_eol.any():
+        raise ValueError("'$' accepts need next-byte context; stride must be 1")
+    S, C = table.n_states, table.n_classes
+    trans = table.trans.astype(np.int64)  # [S, C]
+    accept = table.accept
+
+    # states[s, j] = state after consuming the byte sequence j (base-C digits,
+    # most significant = first byte), starting from s.  bitmap accumulates
+    # accept bits at each step.
+    states = np.arange(S, dtype=np.int64)[:, None]  # [S, 1] identity column
+    bitmap = np.zeros((S, 1), dtype=np.int64)
+    for t in range(k):
+        # extend each sequence by one byte class: [S, C**t] -> [S, C**(t+1)]
+        states = trans[states]  # [S, cols, C]
+        states = states.reshape(S, -1)
+        bitmap = (np.repeat(bitmap[:, :, None], C, axis=2).reshape(S, -1)
+                  | (accept[states].astype(np.int64) << t))
+    packed = (states << k) | bitmap
+    return StrideTable(
+        trans_k=np.ascontiguousarray(packed.astype(np.int32)),
+        byte_to_cls=table.byte_to_cls,
+        k=k,
+        n_classes=C,
+        start=table.start,
+    )
+
+
 def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
     """Host-side oracle: end offsets (index+1) of every match in `data`.
 

@@ -29,6 +29,8 @@ from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
 from distributed_grep_tpu.models.dfa import (
     DfaTable,
     RegexError,
+    build_stride_table,
+    choose_stride,
     compile_dfa,
     reference_scan,
 )
@@ -141,21 +143,36 @@ class GrepEngine:
         return any(reference_scan(t, line).size > 0 for t in self.tables)
 
     def _device_tables(self) -> list[tuple]:
-        """Per-bank device-resident scan tables, uploaded once per engine."""
+        """Per-bank device-resident scan tables, uploaded once per engine.
+
+        Each entry is ("stride", args) when the k-byte-stride composition
+        pays (chunk/k scan steps, one gather each — see models/dfa
+        StrideTable) or ("plain", args) for the per-byte core ('$' accepts,
+        or class counts whose composed table would blow the budget)."""
         if self._dev_tables is None:
             import jax.numpy as jnp
 
-            self._dev_tables = [
-                (
-                    jnp.asarray(t.trans.astype(np.int32).reshape(-1)),
-                    jnp.asarray(t.byte_to_cls.astype(np.int32)),
-                    jnp.asarray(t.accept),
-                    jnp.asarray(t.accept_eol),
-                    jnp.int32(t.start),
-                    t.n_classes,
-                )
-                for t in self.tables
-            ]
+            self._dev_tables = []
+            for t in self.tables:
+                k = choose_stride(t)
+                if k > 1:
+                    st = build_stride_table(t, k)
+                    self._dev_tables.append(("stride", (
+                        jnp.asarray(st.trans_k.reshape(-1)),
+                        jnp.asarray(st.byte_to_cls.astype(np.int32)),
+                        jnp.int32(st.start),
+                        st.k,
+                        st.n_classes,
+                    )))
+                else:
+                    self._dev_tables.append(("plain", (
+                        jnp.asarray(t.trans.astype(np.int32).reshape(-1)),
+                        jnp.asarray(t.byte_to_cls.astype(np.int32)),
+                        jnp.asarray(t.accept),
+                        jnp.asarray(t.accept_eol),
+                        jnp.int32(t.start),
+                        t.n_classes,
+                    )))
         return self._dev_tables
 
     # --------------------------------------------------------- device engine
@@ -206,8 +223,11 @@ class GrepEngine:
 
                 arr_dev = jnp.asarray(arr)
                 per_bank = []
-                for bank in self._device_tables():
-                    packed = scan_jnp._dfa_scan_core(arr_dev, *bank)
+                for kind, bank in self._device_tables():
+                    if kind == "stride":
+                        packed = scan_jnp._dfa_stride_core(arr_dev, *bank)
+                    else:
+                        packed = scan_jnp._dfa_scan_core(arr_dev, *bank)
                     idx, vals = scan_jnp.sparse_nonzero(packed)
                     per_bank.append(
                         sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)

@@ -95,6 +95,51 @@ def _dfa_scan_core(
     return _pack_lane_bits(match)
 
 
+@partial(jax.jit, static_argnames=("k", "n_classes"))
+def _dfa_stride_core(
+    data_cl: jnp.ndarray,  # (chunk, lanes) uint8, chunk % k == 0
+    trans_k_flat: jnp.ndarray,  # (n_states * n_classes**k,) int32 packed
+    byte_to_cls: jnp.ndarray,  # (256,) int32
+    start: jnp.ndarray,  # () int32
+    k: int,
+    n_classes: int,
+) -> jnp.ndarray:
+    """k-byte-stride DFA scan (models/dfa.StrideTable): chunk/k lax.scan
+    steps of one gather each; per-byte match positions recovered exactly
+    from the packed accept bitmaps."""
+    chunk, lanes = data_cl.shape
+    cols = n_classes**k
+    cls = byte_to_cls[data_cl.astype(jnp.int32)]  # (chunk, lanes)
+    cls_k = cls.reshape(chunk // k, k, lanes)
+    idx = cls_k[:, 0, :]
+    for t in range(1, k):  # first byte of the stride is the most significant
+        idx = idx * n_classes + cls_k[:, t, :]
+
+    init = jnp.full((lanes,), start, dtype=jnp.int32)
+
+    def step(states, idx_row):
+        entry = trans_k_flat[states * cols + idx_row]
+        return entry >> k, entry & ((1 << k) - 1)
+
+    _, bitmaps = jax.lax.scan(step, init, idx)  # (chunk//k, lanes) int32
+    t = jnp.arange(k, dtype=bitmaps.dtype)
+    match = ((bitmaps[:, None, :] >> t[None, :, None]) & 1).astype(bool)
+    return _pack_lane_bits(match.reshape(chunk, lanes))
+
+
+def dfa_scan_stride(data_cl, stride_table) -> jnp.ndarray:
+    """Run the stride engine; same packed-bit output convention as dfa_scan."""
+    assert data_cl.shape[0] % stride_table.k == 0, "chunk must divide stride"
+    return _dfa_stride_core(
+        jnp.asarray(data_cl),
+        jnp.asarray(stride_table.trans_k.reshape(-1)),
+        jnp.asarray(stride_table.byte_to_cls.astype(np.int32)),
+        jnp.int32(stride_table.start),
+        stride_table.k,
+        stride_table.n_classes,
+    )
+
+
 def dfa_scan(data_cl: np.ndarray, table: DfaTable) -> jnp.ndarray:
     """Run the DFA engine; returns packed match bits as a device array
     (decode sparsely via sparse_nonzero + ops/sparse, or np.asarray for

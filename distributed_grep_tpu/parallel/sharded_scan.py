@@ -65,7 +65,7 @@ def _sharded_dfa_scan(
     n_classes: int,
 ):
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
-    ring_axis = axes[-1]  # stripes within a document run along the innermost axis
+    n_total = int(np.prod([mesh.shape[a] for a in axes]))
 
     def body(data_blk, trans_flat, byte_to_cls, accept, accept_eol, start):
         packed, count, exits = _dfa_device_scan(
@@ -73,17 +73,16 @@ def _sharded_dfa_scan(
         )
         total = jax.lax.psum(count, axes)  # ICI collective: global match count
         # Ring handoff of the rightmost stripe's exit state to the right
-        # neighbor along the sequence axis — the sequence-parallel
-        # state-carry pattern (the data axis holds independent documents and
-        # needs no handoff).
+        # neighbor — the sequence-parallel state-carry pattern.  Lanes are
+        # sharded over the linearized product of `axes` (lane_sharding is
+        # axes-major in the given order), so the ring must wrap over that
+        # same linear order: passing the axes tuple to ppermute flattens
+        # them, making perm indices the linearized device positions.
         right_edge = exits[-1:]  # (1,) last lane's final state per device
         left_in = jax.lax.ppermute(
             right_edge,
-            ring_axis,
-            perm=[
-                (i, (i + 1) % mesh.shape[ring_axis])
-                for i in range(mesh.shape[ring_axis])
-            ],
+            axes if len(axes) > 1 else axes[0],
+            perm=[(i, (i + 1) % n_total) for i in range(n_total)],
         )
         return packed, total, exits, left_in
 

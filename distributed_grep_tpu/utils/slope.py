@@ -1,0 +1,68 @@
+"""Slope-method device timing, shared by bench.py and benchmarks/.
+
+Measuring a single device pass through a slow host link (PCIe, or the axon
+tunnel here) mixes dispatch/fetch latency into the kernel time.  The slope
+method runs r chained passes inside ONE jit and takes the per-pass time from
+the difference between two rep counts — constants cancel.
+
+The one subtlety (learned the hard way — see bench.py history): the chained
+loop body MUST depend on the loop index, or XLA's loop-invariant code motion
+hoists the scan out of the fori_loop and N passes time exactly like one.
+Callers therefore pad the chunk axis with `pad_rows` of '\\n' bytes and each
+iteration scans a window at an i-dependent row offset.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+
+def slope_per_pass(
+    dev,
+    chunk: int,
+    pad_rows: int,
+    scan_count_fn,
+    r1: int = 2,
+    r2: int = 6,
+    iters: int = 3,
+    count_range: tuple[int, int] | None = None,
+):
+    """Per-pass seconds for scan_count_fn over `dev`'s leading-axis windows.
+
+    dev            device array, leading axis of size chunk + pad_rows
+    scan_count_fn  window -> scalar match count (or an array; nonzero bytes
+                   are counted) — jit-traceable, tables closed over
+    count_range    optional (lo, hi) per-pass count sanity band
+    Returns (per_pass_seconds, per_pass_count_avg).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("reps",))
+    def chained(d, reps):
+        def body(i, acc):
+            win = jax.lax.dynamic_slice_in_dim(d, (i % 2) * pad_rows, chunk, axis=0)
+            out = scan_count_fn(win)
+            return acc + (out if getattr(out, "ndim", 0) == 0 else jnp.count_nonzero(out))
+        return jax.lax.fori_loop(0, reps, body, jnp.int32(0))
+
+    c1, c2 = int(chained(dev, r1)), int(chained(dev, r2))
+    # Both rep counts see the same even/odd window mix, so per-pass counts
+    # must agree exactly — catches any miscounting regression for free.
+    assert c2 * r1 == c1 * r2, f"per-pass count drift: {c1}/{r1} vs {c2}/{r2}"
+    if count_range is not None:
+        lo, hi = count_range
+        assert lo * r1 <= c1 <= hi * r1, f"match count off: {c1} for {r1} passes"
+
+    def timed(r):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            int(chained(dev, r))
+        return (time.perf_counter() - t0) / iters
+
+    d1, d2 = timed(r1), timed(r2)
+    per_pass = (d2 - d1) / (r2 - r1)
+    if per_pass <= 0:
+        raise RuntimeError(f"non-positive slope: {d1=:.4f}s ({r1}) {d2=:.4f}s ({r2})")
+    return per_pass, c1 / r1

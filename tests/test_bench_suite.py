@@ -1,0 +1,28 @@
+"""The BASELINE.json config suite must run and agree with the re oracle at
+toy size on every config (CPU; the numbers only matter on hardware)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
+
+import baseline_configs as bc  # noqa: E402
+
+
+@pytest.mark.parametrize("num", [1, 2, 3, 4])
+def test_config_runs_and_checks(num):
+    out = bc.run_config(num, size=200_000, backend="device", check=True)
+    assert out["check"] == "ok", out
+    assert out["matched_lines"] > 0 or num == 3  # sparse injected sets may be small
+
+
+def test_config_5_banked_ruleset():
+    out = bc.run_config(5, size=200_000, backend="device", check=True, n_patterns=300)
+    assert out["check"] == "ok", out
+
+
+def test_config_3_cpu_backend_parity():
+    out = bc.run_config(3, size=150_000, backend="cpu", check=True)
+    assert out["check"] == "ok", out

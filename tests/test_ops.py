@@ -189,6 +189,65 @@ def test_engine_pattern_set_banked_device_scan():
     assert set(cpu.scan(data).matched_lines.tolist()) == expected
 
 
+# --------------------------------------------------------------- stride DFA
+
+@pytest.mark.parametrize("pattern", ["hello", "h[ae]llo", "(fox|needle)", "ab+a"])
+@pytest.mark.parametrize("k", [2, 4])
+def test_stride_scan_matches_per_byte_scan(pattern, k):
+    from distributed_grep_tpu.models.dfa import build_stride_table
+
+    data = make_text(
+        150, inject=[(3, b"hello fox"), (80, b"needle hallo abba abbba")]
+    )
+    table = compile_dfa(pattern)
+    lay = layout_mod.choose_layout(len(data), target_lanes=32, min_chunk=16)
+    assert lay.chunk % k == 0
+    arr = layout_mod.to_device_array(data, lay)
+    st = build_stride_table(table, k)
+    got = np.asarray(scan_jnp.dfa_scan_stride(arr, st))
+    want = np.asarray(scan_jnp.dfa_scan(arr, table))
+    np.testing.assert_array_equal(got, want, err_msg=f"{pattern} k={k}")
+
+
+def test_stride_preserves_midstride_newline_attribution():
+    # A match ending immediately before a '\n' that sits INSIDE a stride must
+    # keep its exact offset (line attribution depends on it).
+    from distributed_grep_tpu.models.dfa import build_stride_table
+
+    data = b"xxab\nyyyy\nzzab\nqqqq\n" * 8
+    table = compile_dfa("ab")
+    lay = layout_mod.choose_layout(len(data), target_lanes=8, min_chunk=8)
+    arr = layout_mod.to_device_array(data, lay)
+    st = build_stride_table(table, 4)
+    packed = np.asarray(scan_jnp.dfa_scan_stride(arr, st))
+    offsets = lines_mod.match_offsets_from_packed(packed, lay)
+    ref = np.asarray(scan_jnp.dfa_scan(arr, table))
+    ref_offsets = lines_mod.match_offsets_from_packed(ref, lay)
+    np.testing.assert_array_equal(offsets, ref_offsets)
+
+
+def test_choose_stride_rules():
+    from distributed_grep_tpu.models.dfa import choose_stride
+
+    assert choose_stride(compile_dfa("hello")) in (2, 4)
+    assert choose_stride(compile_dfa("hel+o$")) == 1  # '$' needs next-byte
+    # huge class count (full alphabet AC bank) -> budget forces stride 1
+    from distributed_grep_tpu.models.aho import compile_aho_corasick
+
+    pats = [bytes([b, b]) for b in range(1, 256) if b != 0x0A]
+    assert choose_stride(compile_aho_corasick(pats), max_cols=1 << 6) == 1
+
+
+def test_engine_uses_stride_and_matches_oracle():
+    data = make_text(300, inject=[(20, b"the fox ran"), (222, b"a needle!")])
+    eng = GrepEngine("(fox|needle)", target_lanes=32)
+    kinds = [kind for kind, _ in eng._device_tables()]
+    assert kinds == ["stride"]
+    assert set(eng.scan(data).matched_lines.tolist()) == oracle_lines(
+        "(fox|needle)", data
+    )
+
+
 # ----------------------------------------------------------- pallas kernel
 
 def test_pallas_shift_and_interpret_matches_jnp():

@@ -119,7 +119,13 @@ def test_product_axis_sharding_uses_all_devices():
     )
     shard_shapes = {s.data.shape for s in packed.addressable_shards}
     assert shard_shapes == {(lay.chunk, lay.lanes // 8 // 8)}  # 8-way lane split
-    assert np.asarray(neigh).shape == (8,)
+    # The ring must wrap over the LINEARIZED product order (data-major),
+    # not within each seq group: device d receives device (d-1)%8's last
+    # lane's exit state.
+    exits_np = np.asarray(exits)
+    local = lay.lanes // 8
+    last_exit_per_dev = exits_np[local - 1 :: local]
+    np.testing.assert_array_equal(np.asarray(neigh), np.roll(last_exit_per_dev, 1))
     offsets = lines_mod.match_offsets_from_packed(np.asarray(packed), lay)
     nl = lines_mod.newline_index(data)
     device_lines = set(np.unique(lines_mod.line_of_offsets(offsets, nl)).tolist())
