@@ -20,7 +20,6 @@ bench always emits its line.
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
@@ -44,49 +43,17 @@ def make_corpus(n: int) -> bytes:
 
 
 def bench_tpu(data: bytes) -> float:
-    import jax
-    import jax.numpy as jnp
-
     from distributed_grep_tpu.models.shift_and import try_compile_shift_and
-    from distributed_grep_tpu.ops import layout as layout_mod
-    from distributed_grep_tpu.ops import pallas_scan
+    from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
 
     model = try_compile_shift_and(PATTERN)
-    lay = layout_mod.choose_layout(
-        len(data),
-        target_lanes=8192,
-        min_chunk=512,
-        lane_multiple=pallas_scan.LANES_PER_BLOCK,
-        chunk_multiple=512,
-    )
-    arr = layout_mod.to_device_array(data, lay)
-    arr3 = arr.reshape(lay.chunk, -1, 128)
-    # 512 '\n' pad rows let each chained pass scan an i-dependent window —
+    # The 512 '\n' pad rows let each chained pass scan an i-dependent window —
     # required by the slope harness's anti-hoisting scheme (utils/slope.py).
     # Odd windows drop each stripe's first 512 bytes, losing ~512/chunk of
     # the 1000 planted needles, hence the count band below.
-    pad = np.full((512,) + arr3.shape[1:], 0x0A, dtype=np.uint8)
-    dev = jax.device_put(jnp.asarray(np.concatenate([arr3, pad], axis=0)))
-    sym_ranges = tuple(tuple(r) for r in model.sym_ranges)
-    lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
-
-    def scan_count(window):
-        import jax.numpy as jnp
-
-        words = pallas_scan._shift_and_pallas(
-            window,
-            sym_ranges=sym_ranges,
-            match_bit=int(model.match_bit),
-            chunk=lay.chunk,
-            lane_blocks=lane_blocks,
-            interpret=False,
-        )
-        return jnp.count_nonzero(words)
-
-    from distributed_grep_tpu.utils.slope import slope_per_pass
-
+    dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, model)
     per_pass, per_count = slope_per_pass(
-        dev, lay.chunk, 512, scan_count, r1=2, r2=10, count_range=(900, 1100)
+        dev, chunk, pad_rows, scan, r1=2, r2=10, count_range=(900, 1100)
     )
     print(f"bench: tpu pallas shift-and {len(data)/1e9/per_pass:.2f} GB/s "
           f"({per_pass*1e3:.1f} ms/pass, {per_count:.0f} matches/pass)",

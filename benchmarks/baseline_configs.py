@@ -173,7 +173,7 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
 
     from distributed_grep_tpu.ops import layout as layout_mod
     from distributed_grep_tpu.ops import pallas_scan, scan_jnp
-    from distributed_grep_tpu.utils.slope import slope_per_pass
+    from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
 
     if eng.mode not in ("shift_and", "dfa"):
         return None
@@ -184,25 +184,13 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
         and pallas_scan.eligible(eng.shift_and)
     )
     if use_pallas:
-        lay = layout_mod.choose_layout(
-            len(data), target_lanes=8192, min_chunk=512,
-            lane_multiple=pallas_scan.LANES_PER_BLOCK, chunk_multiple=512,
-        )
-        arr = layout_mod.to_device_array(data, lay).reshape(lay.chunk, -1, 128)
-        pad_rows = 512
         label = "pallas_shift_and"
-        sym_ranges = tuple(tuple(r) for r in eng.shift_and.sym_ranges)
-        lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
-
-        def scan(win):
-            return pallas_scan._shift_and_pallas(
-                win, sym_ranges=sym_ranges, match_bit=int(eng.shift_and.match_bit),
-                chunk=lay.chunk, lane_blocks=lane_blocks, interpret=False,
-            )
+        dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, eng.shift_and)
     else:
         lay = layout_mod.choose_layout(len(data), target_lanes=4096, min_chunk=64)
         arr = layout_mod.to_device_array(data, lay)
         pad_rows = 8
+        chunk = lay.chunk
         if eng.mode == "shift_and":
             label = "xla_shift_and"
             b_table = jnp.asarray(eng.shift_and.b_table)
@@ -222,12 +210,12 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
                     total = total + jnp.count_nonzero(core(win, *bank))
                 return total
 
-    pad = np.full((pad_rows,) + arr.shape[1:], 0x0A, dtype=np.uint8)
-    dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
-    try:
-        per_pass, _ = slope_per_pass(dev, lay.chunk, pad_rows, scan)
-    except RuntimeError:
-        return None
+        pad = np.full((pad_rows,) + arr.shape[1:], 0x0A, dtype=np.uint8)
+        dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
+    # A timing failure (e.g. non-positive slope from noise) propagates as a
+    # RuntimeError — main() reports it as an error rather than mislabeling
+    # it "no device path".
+    per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan)
     return len(data) / 1e9 / per_pass, label
 
 

@@ -129,7 +129,7 @@ def _dfa_stride_core(
 
 def dfa_scan_stride(data_cl, stride_table) -> jnp.ndarray:
     """Run the stride engine; same packed-bit output convention as dfa_scan."""
-    assert data_cl.shape[0] % stride_table.k == 0, "chunk must divide stride"
+    assert data_cl.shape[0] % stride_table.k == 0, "stride k must divide chunk"
     return _dfa_stride_core(
         jnp.asarray(data_cl),
         jnp.asarray(stride_table.trans_k.reshape(-1)),

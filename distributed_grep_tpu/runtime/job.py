@@ -17,6 +17,7 @@ from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.transport import LocalTransport
 from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
+from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.config import JobConfig
 from distributed_grep_tpu.utils.io import WorkDir
 from distributed_grep_tpu.utils.logging import get_logger
@@ -114,20 +115,22 @@ def run_job(
         threading.Thread(target=worker_main, args=(i,), name=f"worker-{i}", daemon=True)
         for i in range(n_workers)
     ]
-    for t in threads:
-        t.start()
-    # Wait for completion — but abort instead of hanging if every worker has
-    # died (e.g. a config error raising in all of them) with work outstanding.
-    while not scheduler.wait_done(timeout=0.5):
-        if all(not t.is_alive() for t in threads):
-            scheduler.stop()
-            raise RuntimeError(
-                "job aborted: all workers exited with tasks outstanding "
-                "(see worker logs above)"
-            )
-    scheduler.stop()
-    for t in threads:
-        t.join(timeout=10.0)
+    with trace.job_trace():
+        for t in threads:
+            t.start()
+        # Wait for completion — but abort instead of hanging if every worker
+        # has died (e.g. a config error raising in all of them) with work
+        # outstanding.
+        while not scheduler.wait_done(timeout=0.5):
+            if all(not t.is_alive() for t in threads):
+                scheduler.stop()
+                raise RuntimeError(
+                    "job aborted: all workers exited with tasks outstanding "
+                    "(see worker logs above)"
+                )
+        scheduler.stop()
+        for t in threads:
+            t.join(timeout=10.0)
     if journal:
         journal.close()
 

@@ -27,6 +27,7 @@ from distributed_grep_tpu.apps.base import KeyValue, group_reduce
 from distributed_grep_tpu.apps.loader import LoadedApplication
 from distributed_grep_tpu.runtime import rpc, shuffle
 from distributed_grep_tpu.runtime.transport import Transport
+from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 
@@ -74,9 +75,10 @@ class WorkerLoop:
     def _run_map(self, a: rpc.AssignTaskReply) -> None:
         t0 = time.perf_counter()
         self.app.configure(**a.app_options)
-        contents = self.transport.read_input(a.filename)
+        with trace.annotate(f"map_read:{a.task_id}"):
+            contents = self.transport.read_input(a.filename)
         self._fault("after_map_read")
-        with self.metrics.timer("map_compute"):
+        with self.metrics.timer("map_compute"), trace.annotate(f"map_compute:{a.task_id}"):
             records = self.app.map_fn(a.filename, contents)
         self.metrics.record_scan(len(contents), time.perf_counter() - t0)
         buckets = shuffle.bucketize(records, a.n_reduce)
@@ -113,7 +115,7 @@ class WorkerLoop:
             records.extend(shuffle.decode_records(data))
             files_processed += 1
             self._fault("after_reduce_file")
-        with self.metrics.timer("reduce_compute"):
+        with self.metrics.timer("reduce_compute"), trace.annotate(f"reduce_compute:{a.task_id}"):
             reduced = group_reduce(records, self.app.reduce_fn)
         self._fault("before_reduce_commit")
         # One "key<TAB>value\n" line per key (the reference writes "key value",

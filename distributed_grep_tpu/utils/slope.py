@@ -34,10 +34,16 @@ def slope_per_pass(
     scan_count_fn  window -> scalar match count (or an array; nonzero bytes
                    are counted) — jit-traceable, tables closed over
     count_range    optional (lo, hi) per-pass count sanity band
+    r1, r2         rep counts; both must be even so the two runs see the
+                   same even/odd window mix (the count-drift check below
+                   compares per-pass counts exactly)
     Returns (per_pass_seconds, per_pass_count_avg).
     """
     import jax
     import jax.numpy as jnp
+
+    if r1 % 2 or r2 % 2:
+        raise ValueError(f"r1/r2 must be even (same window mix per run): {r1=} {r2=}")
 
     @functools.partial(jax.jit, static_argnames=("reps",))
     def chained(d, reps):
@@ -66,3 +72,45 @@ def slope_per_pass(
     if per_pass <= 0:
         raise RuntimeError(f"non-positive slope: {d1=:.4f}s ({r1}) {d2=:.4f}s ({r2})")
     return per_pass, c1 / r1
+
+
+def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
+    """Device array + scan closure for slope-timing the Pallas shift-and
+    kernel.  The one copy of this setup (layout choice, 512 '\\n' pad rows,
+    kernel closure) shared by bench.py and benchmarks/baseline_configs.py so
+    the two benches measure the identical configuration.
+
+    Returns (dev_array, chunk, pad_rows, scan_fn) ready for slope_per_pass.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_grep_tpu.ops import layout as layout_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    lay = layout_mod.choose_layout(
+        len(data),
+        target_lanes=target_lanes,
+        min_chunk=512,
+        lane_multiple=pallas_scan.LANES_PER_BLOCK,
+        chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data, lay).reshape(lay.chunk, -1, 128)
+    pad_rows = 512
+    pad = np.full((pad_rows,) + arr.shape[1:], 0x0A, dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
+    sym_ranges = tuple(tuple(r) for r in model.sym_ranges)
+    lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
+
+    def scan(win):
+        return pallas_scan._shift_and_pallas(
+            win,
+            sym_ranges=sym_ranges,
+            match_bit=int(model.match_bit),
+            chunk=lay.chunk,
+            lane_blocks=lane_blocks,
+            interpret=False,
+        )
+
+    return dev, lay.chunk, pad_rows, scan

@@ -1,0 +1,54 @@
+"""utils/trace: jax.profiler integration (SURVEY.md §5 tracing subsystem)."""
+
+import contextlib
+
+from distributed_grep_tpu.utils import trace
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DGREP_TRACE_DIR", raising=False)
+    assert not trace.enabled()
+    # annotate must be a cheap nullcontext when off
+    assert isinstance(trace.annotate("x"), contextlib.nullcontext)
+    with trace.job_trace():
+        pass
+    with trace.step_trace("scan", 0):
+        pass
+
+
+def test_job_trace_writes_profile(tmp_path, monkeypatch):
+    d = tmp_path / "trace"
+    monkeypatch.setenv("DGREP_TRACE_DIR", str(d))
+    assert trace.enabled() and trace.trace_dir() == str(d)
+
+    import jax.numpy as jnp
+
+    with trace.job_trace():
+        with trace.annotate("compute"):
+            jnp.arange(8).sum().block_until_ready()
+        with trace.step_trace("scan", 1):
+            jnp.arange(8).prod().block_until_ready()
+
+    # jax.profiler.trace writes plugins/profile/<run>/... under the dir
+    assert d.exists() and any(d.rglob("*.xplane.pb"))
+
+
+def test_job_runs_traced(tmp_path, monkeypatch):
+    """End-to-end: a tiny job under tracing produces identical output."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    (tmp_path / "in.txt").write_bytes(b"needle one\nhay\nneedle two\n")
+    cfg = dict(
+        input_files=[str(tmp_path / "in.txt")],
+        n_reduce=2,
+        work_dir=str(tmp_path / "work"),
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": "needle"},
+    )
+    plain = run_job(JobConfig(**cfg), n_workers=2)
+
+    monkeypatch.setenv("DGREP_TRACE_DIR", str(tmp_path / "trace"))
+    traced = run_job(JobConfig(**cfg), n_workers=2)
+    assert traced.results == plain.results
+    assert (tmp_path / "trace").exists()
