@@ -172,20 +172,32 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
     import jax.numpy as jnp
 
     from distributed_grep_tpu.ops import layout as layout_mod
-    from distributed_grep_tpu.ops import pallas_scan, scan_jnp
-    from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
+    from distributed_grep_tpu.ops import pallas_nfa, pallas_scan, scan_jnp
+    from distributed_grep_tpu.utils.slope import (
+        pallas_nfa_setup,
+        pallas_shift_and_setup,
+        slope_per_pass,
+    )
 
-    if eng.mode not in ("shift_and", "dfa"):
+    if eng.mode not in ("shift_and", "nfa", "dfa"):
         return None
 
-    use_pallas = (
+    use_pallas_sa = (
         eng.mode == "shift_and"
         and pallas_scan.available()
         and pallas_scan.eligible(eng.shift_and)
     )
-    if use_pallas:
+    use_pallas_nfa = (
+        eng.mode == "nfa"
+        and pallas_scan.available()
+        and pallas_nfa.eligible(eng.glushkov)
+    )
+    if use_pallas_sa:
         label = "pallas_shift_and"
         dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, eng.shift_and)
+    elif use_pallas_nfa:
+        label = "pallas_nfa"
+        dev, chunk, pad_rows, scan = pallas_nfa_setup(data, eng.glushkov)
     else:
         lay = layout_mod.choose_layout(len(data), target_lanes=4096, min_chunk=64)
         arr = layout_mod.to_device_array(data, lay)
@@ -214,8 +226,18 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
         dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
     # A timing failure (e.g. non-positive slope from noise) propagates as a
     # RuntimeError — main() reports it as an error rather than mislabeling
-    # it "no device path".
-    per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan)
+    # it "no device path".  Pallas passes are fast enough that low rep
+    # counts drown in tunnel noise — give them a longer chain.
+    if label.startswith("pallas"):
+        # Scale the chain so it covers >~1.5 GB regardless of split size —
+        # an 8 MB split (config 2) needs ~200 reps before the slope rises
+        # above the tunnel's run-to-run noise.
+        r2 = min(256, max(40, int(1.5e9 / max(len(data), 1))))
+        r2 += r2 % 2
+        r1 = max(8, r2 // 5 + (r2 // 5) % 2)
+        per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan, r1=r1, r2=r2)
+    else:
+        per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan)
     return len(data) / 1e9 / per_pass, label
 
 

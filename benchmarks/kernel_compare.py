@@ -1,0 +1,198 @@
+"""On-device kernel comparison: GB/s per engine mode via the slope harness.
+
+Times each scan engine (pallas shift-and, XLA shift-and, XLA DFA, k-stride
+DFA, Aho-Corasick banks) on the same synthetic corpus, printing one JSON
+line per engine.  Used to direct kernel optimisation work — the e2e config
+suite mixes in host-link costs that a tunneled device distorts.
+
+    python benchmarks/kernel_compare.py [--size-mb 64] [--engines dfa,stride4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def make_corpus(n: int) -> bytes:
+    rng = np.random.default_rng(0)
+    data = rng.integers(32, 127, size=n, dtype=np.uint8)
+    data[rng.integers(0, n, size=n // 80)] = 0x0A
+    needle = np.frombuffer(b"needle", np.uint8)
+    for p in rng.integers(0, n - 16, size=1000):
+        data[p : p + len(needle)] = needle
+    return data.tobytes()
+
+
+def _layout(data: bytes, *, lane_multiple=8, chunk_multiple=512, target_lanes=8192):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import layout as layout_mod
+
+    lay = layout_mod.choose_layout(
+        len(data),
+        target_lanes=target_lanes,
+        min_chunk=512,
+        lane_multiple=lane_multiple,
+        chunk_multiple=chunk_multiple,
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    pad_rows = 512
+    pad = np.full((pad_rows, arr.shape[1]), 0x0A, dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
+    return dev, lay, pad_rows
+
+
+def bench_pallas(data):
+    from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+    from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
+
+    model = try_compile_shift_and("needle")
+    dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, model)
+    per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan, r1=2, r2=10)
+    return len(data) / 1e9 / per_pass
+
+
+def bench_nfa(data, pattern="nee(dle|t)"):
+    from distributed_grep_tpu.models.nfa import try_compile_glushkov
+    from distributed_grep_tpu.ops import pallas_nfa
+    from distributed_grep_tpu.utils.slope import pallas_nfa_setup, slope_per_pass
+
+    model = try_compile_glushkov(pattern)
+    assert model is not None and pallas_nfa.eligible(model)
+    dev, chunk, pad_rows, scan = pallas_nfa_setup(data, model)
+    per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan, r1=8, r2=64)
+    return len(data) / 1e9 / per_pass
+
+
+def bench_xla_shift_and(data):
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+    from distributed_grep_tpu.ops import scan_jnp
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    model = try_compile_shift_and("needle")
+    dev, lay, pad_rows = _layout(data)
+    b_table = jnp.asarray(model.b_table)
+    mb = jnp.uint32(model.match_bit)
+
+    def scan(win):
+        return scan_jnp._shift_and_core(win, b_table, mb)
+
+    per_pass, _ = slope_per_pass(dev, lay.chunk, pad_rows, scan, r1=2, r2=6)
+    return len(data) / 1e9 / per_pass
+
+
+def _dfa_closure(table):
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import scan_jnp
+
+    trans_flat = jnp.asarray(table.trans.astype(np.int32).reshape(-1))
+    byte_cls = jnp.asarray(table.byte_to_cls.astype(np.int32))
+    accept = jnp.asarray(table.accept)
+    accept_eol = jnp.asarray(table.accept_eol)
+
+    def scan(win):
+        return scan_jnp._dfa_scan_core(
+            win, trans_flat, byte_cls, accept, accept_eol,
+            jnp.int32(table.start), table.n_classes,
+        )
+
+    return scan
+
+
+def bench_dfa(data, pattern="nee(dle|t)"):
+    from distributed_grep_tpu.models.dfa import compile_dfa
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    table = compile_dfa(pattern)
+    dev, lay, pad_rows = _layout(data)
+    per_pass, _ = slope_per_pass(dev, lay.chunk, pad_rows, _dfa_closure(table), r1=2, r2=6)
+    return len(data) / 1e9 / per_pass
+
+
+def bench_stride(data, k, pattern="nee(dle|t)"):
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.models.dfa import build_stride_table, compile_dfa
+    from distributed_grep_tpu.ops import scan_jnp
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    st = build_stride_table(compile_dfa(pattern), k)
+    dev, lay, pad_rows = _layout(data, chunk_multiple=512)
+    trans = jnp.asarray(st.trans_k.reshape(-1))
+    byte_cls = jnp.asarray(st.byte_to_cls.astype(np.int32))
+
+    def scan(win):
+        return scan_jnp._dfa_stride_core(
+            win, trans, byte_cls, jnp.int32(st.start), st.k, st.n_classes
+        )
+
+    per_pass, _ = slope_per_pass(dev, lay.chunk, pad_rows, scan, r1=2, r2=6)
+    return len(data) / 1e9 / per_pass
+
+
+def bench_aho(data, n_patterns=256):
+    from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    rng = np.random.default_rng(1)
+    pats = ["needle"] + [
+        "".join(chr(c) for c in rng.integers(97, 123, size=int(rng.integers(5, 12))))
+        for _ in range(n_patterns - 1)
+    ]
+    banks = compile_aho_corasick_banks(pats)
+    dev, lay, pad_rows = _layout(data)
+    total = 0.0
+    for table in banks:
+        scan = _dfa_closure(table)
+        per_pass, _ = slope_per_pass(dev, lay.chunk, pad_rows, scan, r1=2, r2=6)
+        total += per_pass
+    return len(data) / 1e9 / total, len(banks)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=64)
+    ap.add_argument("--engines", default="pallas,xla_sa,dfa,stride2,stride4,aho256")
+    args = ap.parse_args()
+    data = make_corpus(args.size_mb * 1024 * 1024)
+    engines = args.engines.split(",")
+    import jax
+
+    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    for eng in engines:
+        try:
+            extra = {}
+            if eng == "pallas":
+                v = bench_pallas(data)
+            elif eng == "nfa":
+                v = bench_nfa(data)
+            elif eng == "nfa_alt8":
+                v = bench_nfa(data, "(volcano|anarchy|physics|quantum|needle|breadth|journal|mineral)")
+            elif eng == "xla_sa":
+                v = bench_xla_shift_and(data)
+            elif eng == "dfa":
+                v = bench_dfa(data)
+            elif eng.startswith("stride"):
+                v = bench_stride(data, int(eng[len("stride"):]))
+            elif eng.startswith("aho"):
+                v, nb = bench_aho(data, int(eng[len("aho"):]))
+                extra["banks"] = nb
+            else:
+                raise ValueError(f"unknown engine {eng}")
+            print(json.dumps({"engine": eng, "value": round(v, 3), "unit": "GB/s", **extra}))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"engine": eng, "error": f"{type(e).__name__}: {e}"}))
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

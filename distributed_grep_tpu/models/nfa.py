@@ -1,0 +1,268 @@
+"""Glushkov position automaton -> bit-parallel NFA model for the Pallas path.
+
+The DFA engine (models/dfa.py) is exact for the whole grep -E subset but its
+device scan needs one table gather per byte — and TPU has no vector gather,
+so the XLA fallback runs the gather through lax.scan at ~0.1 GB/s (measured,
+benchmarks/kernel_compare.py).  The shift-and kernel avoids gathers entirely
+(state = bits, B[byte] = range compares) but only covers plain symbol
+sequences <= 32 symbols.
+
+This model closes the gap for general regex: the Glushkov (position)
+automaton of the pattern, simulated bit-parallel.  One bit per *position*
+(= char edge of the Thompson NFA, models/dfa._Nfa); a byte step is
+
+    D' = (follow(D) | init) & B[byte]
+
+where follow(D) = OR of follow[p] over set bits p, init re-activates the
+pattern starts (the unanchored Sigma* restart, plus '^' starts only after a
+newline), and B[byte] has bit p set iff the byte is in position p's class.
+All of it is VPU bit-ops + compares — gather-free, so it runs on the same
+286 GB/s Pallas path as shift-and (ops/pallas_nfa.py).
+
+The kernel plan exploits that most positions in real patterns sit in plain
+concatenation runs where follow[p] == {p+1}: all such "chain" bits advance
+with ONE masked shift per state word, exactly like shift-and.  Only branch
+points (alternation heads/tails, repeat back-edges, word-boundary bits) pay
+an individual select.  An 8-word alternation therefore costs barely more
+than a literal scan.
+
+Eligibility (try_compile_glushkov returns None otherwise; caller falls back
+to the DFA/XLA path): <= MAX_POSITIONS positions after bounded-repeat
+expansion, no '$' accepts (they need next-byte lookahead, which would
+misattribute the match to the newline's line in the packed-bit convention —
+dfa.py's accept_eol plane handles them), pattern not nullable (empty-match
+patterns match every line; the engine short-circuits those before any scan).
+
+Reference behaviour cross-check: compile_dfa on the same pattern is the
+oracle (tests/test_nfa.py) — the two compilers share the parser and the
+Thompson construction (dfa.py:106-403), so semantic drift is structural,
+not incidental.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.models import dfa as _dfa
+from distributed_grep_tpu.models.dfa import NL, RegexError
+
+MAX_POSITIONS = 64  # state spans <= 2 uint32 words per lane
+WORD_BITS = 32
+
+
+@dataclass
+class GlushkovModel:
+    """Bit-parallel position-automaton tables + the Pallas kernel plan.
+
+    n_pos       number of Glushkov positions (char edges)
+    sym_masks   per position, 256-bit byte-membership mask
+    follow      per position, n_pos-bit mask of successor positions
+    init_float  positions active at every byte (unanchored restart)
+    init_anchor positions active only at line starts ('^' branches),
+                *minus* init_float
+    final       positions whose activation means "a match ends here"
+    """
+
+    n_pos: int
+    sym_masks: list[int]
+    follow: list[int]
+    init_float: int
+    init_anchor: int
+    final: int
+    pattern: str
+
+    # ---- kernel plan (derived in __post_init__) --------------------------
+    # classes: positions grouped by identical byte set; per class the byte
+    # set as (lo, hi) ranges and the per-word position masks it contributes
+    # to B.  chain_src: per word, bits p with follow[p] == {p+1} in-word.
+    # specials: (word, bit, ((word, mask), ...)) per remaining position.
+    def __post_init__(self) -> None:
+        self.n_words = (self.n_pos + WORD_BITS - 1) // WORD_BITS
+        cls_of: dict[int, list[int]] = {}
+        for p, m in enumerate(self.sym_masks):
+            cls_of.setdefault(m, []).append(p)
+        self.cls_ranges: list[tuple[tuple[int, int], ...]] = []
+        self.cls_pos_words: list[tuple[tuple[int, int], ...]] = []
+        for mask, ps in cls_of.items():
+            self.cls_ranges.append(tuple(_mask_to_ranges(mask)))
+            self.cls_pos_words.append(tuple(_bits_to_words(ps, self.n_words)))
+        chain = [0] * self.n_words
+        specials: list[tuple[int, int, tuple[tuple[int, int], ...]]] = []
+        for p, f in enumerate(self.follow):
+            if f == 0:
+                continue
+            if f == (1 << (p + 1)) and (p % WORD_BITS) != WORD_BITS - 1:
+                chain[p // WORD_BITS] |= 1 << (p % WORD_BITS)
+            else:
+                words = _int_to_words(f, self.n_words)
+                specials.append(
+                    (p // WORD_BITS, p % WORD_BITS,
+                     tuple((w, m) for w, m in enumerate(words) if m))
+                )
+        self.chain_src = tuple(chain)
+        self.specials = tuple(specials)
+        self.init_float_words = tuple(_int_to_words(self.init_float, self.n_words))
+        self.init_anchor_words = tuple(_int_to_words(self.init_anchor, self.n_words))
+        self.final_words = tuple(_int_to_words(self.final, self.n_words))
+
+    @property
+    def total_ranges(self) -> int:
+        return sum(len(r) for r in self.cls_ranges)
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.cls_ranges)
+
+    @property
+    def n_specials(self) -> int:
+        return len(self.specials)
+
+    def kernel_plan(self) -> tuple:
+        """Hashable plan consumed by ops/pallas_nfa (static jit arg)."""
+        return (
+            self.n_words,
+            tuple(zip(self.cls_ranges, self.cls_pos_words)),
+            self.chain_src,
+            self.specials,
+            self.init_float_words,
+            self.init_anchor_words,
+            self.final_words,
+            bool(self.init_anchor),
+        )
+
+
+def _mask_to_ranges(mask: int) -> list[tuple[int, int]]:
+    ranges: list[tuple[int, int]] = []
+    b = 0
+    while b < 256:
+        if mask >> b & 1:
+            lo = b
+            while b < 256 and mask >> b & 1:
+                b += 1
+            ranges.append((lo, b - 1))
+        else:
+            b += 1
+    return ranges
+
+
+def _int_to_words(v: int, n_words: int) -> list[int]:
+    return [(v >> (WORD_BITS * w)) & 0xFFFFFFFF for w in range(n_words)]
+
+
+def _bits_to_words(bits: list[int], n_words: int) -> list[tuple[int, int]]:
+    words = [0] * n_words
+    for p in bits:
+        words[p // WORD_BITS] |= 1 << (p % WORD_BITS)
+    return [(w, m) for w, m in enumerate(words) if m]
+
+
+def try_compile_glushkov(
+    pattern: str, ignore_case: bool = False, max_positions: int = MAX_POSITIONS
+) -> GlushkovModel | None:
+    """Compile to a bit-parallel position automaton, or None if ineligible.
+
+    Reuses dfa.py's parser, anchor splitting, and Thompson construction so
+    the supported syntax and line semantics are identical to compile_dfa;
+    RegexError propagates (the caller's compile_dfa will surface it)."""
+    ast = _dfa._Parser(pattern, ignore_case).parse()
+    branches = _dfa._split_anchors(ast)
+    if any(a_end for _, _, a_end in branches):
+        return None  # '$' needs next-byte lookahead — DFA path handles it
+
+    nfa = _dfa._Nfa()
+    root = nfa.new_state()  # line-start entry
+    floating = nfa.new_state()  # unanchored restart entry (no self-loop edge:
+    nfa.states[root].eps.append(floating)  # the kernel re-injects init_float
+    accepts: set[int] = set()  # at every byte instead)
+    try:
+        for a_start, body, _ in branches:
+            s, a = nfa.build(body)
+            (nfa.states[root] if a_start else nfa.states[floating]).eps.append(s)
+            accepts.add(a)
+    except _dfa.TooManyStates:
+        return None  # bounded-repeat expansion blew the cap -> DFA/host path
+
+    # positions = char edges, in (state, edge) order
+    positions: list[tuple[int, int, int]] = []  # (source, mask, target)
+    for sid, st in enumerate(nfa.states):
+        for mask, tgt in st.chars:
+            positions.append((sid, mask, tgt))
+    n_pos = len(positions)
+    if n_pos == 0 or n_pos > max_positions:
+        return None
+
+    def closure(seed: frozenset[int]) -> frozenset[int]:
+        stack, seen = list(seed), set(seed)
+        while stack:
+            s = stack.pop()
+            for t in nfa.states[s].eps:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return frozenset(seen)
+
+    pos_of_source: dict[int, int] = {}
+    for i, (src, _, _) in enumerate(positions):
+        pos_of_source.setdefault(src, 0)
+        pos_of_source[src] |= 1 << i
+
+    def pos_from(states: frozenset[int]) -> int:
+        m = 0
+        for s in states:
+            m |= pos_of_source.get(s, 0)
+        return m
+
+    root_cl = closure(frozenset({root}))
+    if root_cl & accepts:
+        return None  # nullable: empty match — engine short-circuits pre-scan
+    float_cl = closure(frozenset({floating}))
+    init_line = pos_from(root_cl)
+    init_float = pos_from(float_cl)
+
+    follow: list[int] = []
+    final = 0
+    for i, (_, _, tgt) in enumerate(positions):
+        tcl = closure(frozenset({tgt}))
+        follow.append(pos_from(tcl))
+        if tcl & accepts:
+            final |= 1 << i
+
+    return GlushkovModel(
+        n_pos=n_pos,
+        sym_masks=[m for _, m, _ in positions],
+        follow=follow,
+        init_float=init_float,
+        init_anchor=init_line & ~init_float,
+        final=final,
+        pattern=pattern,
+    )
+
+
+def scan_reference(model: GlushkovModel, data: bytes) -> np.ndarray:
+    """Host-side oracle: end offsets (index+1) of every match (line-start
+    state at offset 0, newline resets — the device scan's exact semantics)."""
+    b_table = [0] * 256
+    for cls_ranges, pos_words in zip(model.cls_ranges, model.cls_pos_words):
+        mask = 0
+        for w, m in pos_words:
+            mask |= m << (WORD_BITS * w)
+        for lo, hi in cls_ranges:
+            for byte in range(lo, hi + 1):
+                b_table[byte] |= mask
+    d = 0
+    prev_nl = True
+    hits = []
+    for i, byte in enumerate(data):
+        reached = model.init_float | (model.init_anchor if prev_nl else 0)
+        dd = d
+        while dd:
+            p = (dd & -dd).bit_length() - 1
+            reached |= model.follow[p]
+            dd &= dd - 1
+        d = reached & b_table[byte]
+        if d & model.final:
+            hits.append(i + 1)
+        prev_nl = byte == NL
+    return np.asarray(hits, dtype=np.uint64)

@@ -6,9 +6,14 @@ interface):
 
 1. ``shift_and`` — literal/class sequences <= 32 symbols: bit-parallel VPU
    scan (Pallas kernel on TPU, XLA scan elsewhere);
-2. ``dfa``       — anything the subset compiler handles within the state
-   cap: vectorized DFA table scan;
-3. ``re``        — host fallback (Python re per line) for patterns outside
+2. ``nfa``       — general regex (alternations, repeats, '^') <= 64
+   Glushkov positions: bit-parallel position-automaton Pallas kernel
+   (models/nfa.py, ops/pallas_nfa.py) — gather-free, so it keeps Pallas
+   throughput where the DFA's table gather would fall off the cliff;
+3. ``dfa``       — anything the subset compiler handles within the state
+   cap ('$' accepts, big patterns, pattern-set banks): vectorized DFA
+   table scan (XLA);
+4. ``re``        — host fallback (Python re per line) for patterns outside
    the subset (e.g. newline-consuming) — the reference's own strategy
    (application/grep.go:20-30), kept as the escape hatch.
 
@@ -34,6 +39,7 @@ from distributed_grep_tpu.models.dfa import (
     compile_dfa,
     reference_scan,
 )
+from distributed_grep_tpu.models.nfa import GlushkovModel, try_compile_glushkov
 from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
 from distributed_grep_tpu.ops import layout as layout_mod
 from distributed_grep_tpu.ops import lines as lines_mod
@@ -74,6 +80,7 @@ class GrepEngine:
         self.ignore_case = ignore_case
 
         self.shift_and: ShiftAndModel | None = None
+        self.glushkov: GlushkovModel | None = None
         self.table: DfaTable | None = None
         # Pattern sets beyond one automaton's uint16 state space compile to
         # several independent banks (Hyperscan-style ruleset sharding); each
@@ -96,7 +103,11 @@ class GrepEngine:
                 self.table = compile_dfa(pattern, ignore_case=ignore_case, max_states=max_states)
                 self.tables = [self.table]
                 self.shift_and = try_compile_shift_and(pattern, ignore_case=ignore_case)
-                self.mode = "shift_and" if self.shift_and is not None else "dfa"
+                if self.shift_and is not None:
+                    self.mode = "shift_and"
+                else:
+                    self.glushkov = try_compile_glushkov(pattern, ignore_case=ignore_case)
+                    self.mode = "nfa" if self.glushkov is not None else "dfa"
             except RegexError as e:
                 # Outside the device subset (newline-consuming, state blowup,
                 # unsupported syntax): host re fallback, like the reference.
@@ -182,13 +193,21 @@ class GrepEngine:
         boundaries: list[int] = []
         n_matches = 0
         seg = self.segment_bytes
-        from distributed_grep_tpu.ops import pallas_scan
+        from distributed_grep_tpu.ops import pallas_nfa, pallas_scan
 
-        use_pallas = (
+        use_pallas_sa = (
             self.mode == "shift_and"
             and pallas_scan.available()
             and pallas_scan.eligible(self.shift_and)
         )
+        # NFA mode without a real TPU (or over budget) falls back to the XLA
+        # DFA path — same tables, interpreter-free.
+        use_pallas_nfa = (
+            self.mode == "nfa"
+            and pallas_scan.available()
+            and pallas_nfa.eligible(self.glushkov)
+        )
+        use_pallas = use_pallas_sa or use_pallas_nfa
         for seg_start in range(0, max(len(data), 1), seg):
             seg_bytes = data[seg_start : seg_start + seg]
             if seg_start > 0:
@@ -207,7 +226,10 @@ class GrepEngine:
             # Device scan, then sparse fetch: a 4-byte count round-trip plus
             # O(matches) coordinates — never the dense packed plane.
             if use_pallas:
-                words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                if use_pallas_sa:
+                    words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                else:
+                    words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
                 idx, vals = scan_jnp.sparse_nonzero(words)
                 offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
             elif self.mode == "shift_and":

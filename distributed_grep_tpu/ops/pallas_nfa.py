@@ -1,0 +1,198 @@
+"""Pallas TPU kernel: bit-parallel Glushkov NFA scan (general regex).
+
+Same shell as ops/pallas_scan.py (layout, grid, time-packed uint32 match
+words, VMEM state scratch carried across chunk blocks) but the per-byte
+recurrence is the position-automaton step from models/nfa.py:
+
+    reached = init_float                       (unanchored Sigma* restart)
+            | (prev_nl ? init_anchor : 0)      ('^' starts, line-start only)
+            | ((D & chain_src) << 1)           (concat runs — one shift/word)
+            | OR_specials (D[p] ? follow[p] : 0)
+    D       = reached & B[byte]                (B via per-class range compares)
+    match   = (D & final) != 0
+
+Everything is uint32 tile bit-ops and compares — no gathers, so general
+regex (alternations, classes, bounded repeats, '^') runs at Pallas speeds
+instead of the XLA lax.scan DFA path's ~0.1 GB/s (the gap that motivated
+this kernel; benchmarks/kernel_compare.py).
+
+The select trick: a per-position select is (0 - ((D >> j) & 1)) & mask —
+an all-ones/all-zero uint32 mask from one bit, avoiding jnp.where's
+bool plumbing in the hot loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.nfa import GlushkovModel
+from distributed_grep_tpu.ops.pallas_scan import (
+    CHUNK_BLOCK_WORDS,
+    LANE_COLS,
+    LANES_PER_BLOCK,
+    SUBLANES,
+    available,
+)
+
+NL = 0x0A
+# Compare/select budget per byte step; beyond this the unrolled kernel body
+# compiles slowly and the XLA DFA path (or host) is the better engine.
+MAX_COST = 160
+
+
+def kernel_cost(model: GlushkovModel) -> int:
+    """Rough per-byte op count — eligibility metric."""
+    b_cost = model.total_ranges + sum(len(pw) for pw in model.cls_pos_words)
+    special_cost = sum(2 + len(f) for _, _, f in model.specials)
+    return b_cost + special_cost + 4 * model.n_words
+
+
+def eligible(model: GlushkovModel) -> bool:
+    return kernel_cost(model) <= MAX_COST
+
+
+def _kernel(data_ref, out_ref, d_ref, nl_ref, *, plan, steps):
+    from jax.experimental import pallas as pl  # deferred: import cost
+
+    (n_words, classes, chain_src, specials, init_float, init_anchor,
+     final_words, anchored) = plan
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        d_ref[...] = jnp.zeros_like(d_ref)
+        nl_ref[...] = jnp.ones_like(nl_ref)  # stripe start = line start
+
+    zero = jnp.uint32(0)
+
+    def word_body(w, carry):
+        *d, prev_nl = carry
+        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        for t in range(32):
+            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
+            # ---- B[byte] per state word, via per-class range compares
+            bmask = [zero] * n_words
+            for ranges, pos_words in classes:
+                hit = None
+                for lo, hi in ranges:
+                    r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                    hit = r if hit is None else (hit | r)
+                hit_m = zero - hit.astype(jnp.uint32)  # all-ones where hit
+                for wi, m in pos_words:
+                    bmask[wi] = bmask[wi] | (hit_m & jnp.uint32(m))
+            # ---- reached = init | chains | specials
+            reached = [jnp.full((SUBLANES, LANE_COLS), f, dtype=jnp.uint32)
+                       for f in init_float]
+            if anchored:
+                nl_m = zero - prev_nl  # all-ones after a newline
+                for wi in range(n_words):
+                    if init_anchor[wi]:
+                        reached[wi] = reached[wi] | (nl_m & jnp.uint32(init_anchor[wi]))
+            for wi in range(n_words):
+                if chain_src[wi]:
+                    reached[wi] = reached[wi] | (
+                        (d[wi] & jnp.uint32(chain_src[wi])) << jnp.uint32(1)
+                    )
+            for wp, jp, flist in specials:
+                bit = (d[wp] >> jnp.uint32(jp)) & jnp.uint32(1)
+                sel = zero - bit
+                for wi, m in flist:
+                    reached[wi] = reached[wi] | (sel & jnp.uint32(m))
+            # ---- step + match
+            d = [reached[wi] & bmask[wi] for wi in range(n_words)]
+            acc = d[0] & jnp.uint32(final_words[0])
+            for wi in range(1, n_words):
+                acc = acc | (d[wi] & jnp.uint32(final_words[wi]))
+            word = word | jnp.where(acc != 0, jnp.uint32(1 << t), zero)
+            if anchored:
+                prev_nl = (b == NL).astype(jnp.uint32)
+        out_ref[w] = word
+        return (*d, prev_nl)
+
+    carry0 = tuple(d_ref[wi] for wi in range(n_words)) + (nl_ref[...],)
+    final_carry = jax.lax.fori_loop(0, steps // 32, word_body, carry0)
+    for wi in range(n_words):
+        d_ref[wi] = final_carry[wi]
+    nl_ref[...] = final_carry[-1]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("plan", "chunk", "lane_blocks", "interpret")
+)
+def _nfa_pallas(data, *, plan, chunk, lane_blocks, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = 32 * CHUNK_BLOCK_WORDS
+    chunk_blocks = chunk // steps
+    n_words = plan[0]
+    kernel = functools.partial(_kernel, plan=plan, steps=steps)
+    return pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (steps, SUBLANES, LANE_COLS),
+                lambda li, ci: (ci, li, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), jnp.uint32
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_words, SUBLANES, LANE_COLS), jnp.uint32),
+            pltpu.VMEM((SUBLANES, LANE_COLS), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(data)
+
+
+def nfa_scan_words(
+    arr_cl: np.ndarray, model: GlushkovModel, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Run the kernel; returns time-packed match words as a DEVICE array
+    (chunk//32, lane_blocks*32, 128) uint32 — the exact convention of
+    pallas_scan.shift_and_scan_words, so sparse decode
+    (ops/sparse.offsets_from_sparse_words) is shared."""
+    chunk, lanes = arr_cl.shape
+    steps = 32 * CHUNK_BLOCK_WORDS
+    if lanes % LANES_PER_BLOCK or chunk % steps:
+        raise ValueError(
+            f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0"
+        )
+    if not eligible(model):
+        raise ValueError("pattern exceeds the pallas NFA cost budget")
+    lane_blocks = lanes // LANES_PER_BLOCK
+    data = np.ascontiguousarray(
+        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
+    )
+    if interpret is None:
+        interpret = not available()
+    return _nfa_pallas(
+        jnp.asarray(data),
+        plan=model.kernel_plan(),
+        chunk=chunk,
+        lane_blocks=lane_blocks,
+        interpret=interpret,
+    )
+
+
+def nfa_scan(
+    arr_cl: np.ndarray, model: GlushkovModel, interpret: bool | None = None
+) -> np.ndarray:
+    """Dense-output wrapper (tests): packed bits in the scan_jnp convention."""
+    from distributed_grep_tpu.ops.pallas_scan import _unpack_words_to_lane_bits
+
+    chunk, lanes = arr_cl.shape
+    words = nfa_scan_words(arr_cl, model, interpret)
+    return _unpack_words_to_lane_bits(np.asarray(words), chunk, lanes)

@@ -74,14 +74,11 @@ def slope_per_pass(
     return per_pass, c1 / r1
 
 
-def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
-    """Device array + scan closure for slope-timing the Pallas shift-and
-    kernel.  The one copy of this setup (layout choice, 512 '\\n' pad rows,
-    kernel closure) shared by bench.py and benchmarks/baseline_configs.py so
-    the two benches measure the identical configuration.
-
-    Returns (dev_array, chunk, pad_rows, scan_fn) ready for slope_per_pass.
-    """
+def _pallas_device_setup(data: bytes, target_lanes: int):
+    """Shared layout/pad/upload for slope-timing the Pallas kernels: choose
+    the pallas-tile layout, append 512 '\\n' pad rows (the anti-hoisting
+    window scheme above), put on device.  Returns (dev, layout, lane_blocks,
+    pad_rows)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -100,8 +97,21 @@ def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
     pad_rows = 512
     pad = np.full((pad_rows,) + arr.shape[1:], 0x0A, dtype=np.uint8)
     dev = jax.device_put(jnp.asarray(np.concatenate([arr, pad], axis=0)))
+    return dev, lay, lay.lanes // pallas_scan.LANES_PER_BLOCK, pad_rows
+
+
+def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
+    """Device array + scan closure for slope-timing the Pallas shift-and
+    kernel.  The one copy of this setup (layout choice, 512 '\\n' pad rows,
+    kernel closure) shared by bench.py and benchmarks/baseline_configs.py so
+    the two benches measure the identical configuration.
+
+    Returns (dev_array, chunk, pad_rows, scan_fn) ready for slope_per_pass.
+    """
+    from distributed_grep_tpu.ops import pallas_scan
+
+    dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
     sym_ranges = tuple(tuple(r) for r in model.sym_ranges)
-    lane_blocks = lay.lanes // pallas_scan.LANES_PER_BLOCK
 
     def scan(win):
         return pallas_scan._shift_and_pallas(
@@ -111,6 +121,23 @@ def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
             chunk=lay.chunk,
             lane_blocks=lane_blocks,
             interpret=False,
+        )
+
+    return dev, lay.chunk, pad_rows, scan
+
+
+def pallas_nfa_setup(data: bytes, model, *, target_lanes: int = 8192):
+    """Device array + scan closure for slope-timing the Pallas Glushkov NFA
+    kernel (ops/pallas_nfa.py) — same layout contract as the shift-and
+    setup, shared by benchmarks/."""
+    from distributed_grep_tpu.ops import pallas_nfa
+
+    dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
+    plan = model.kernel_plan()
+
+    def scan(win):
+        return pallas_nfa._nfa_pallas(
+            win, plan=plan, chunk=lay.chunk, lane_blocks=lane_blocks, interpret=False
         )
 
     return dev, lay.chunk, pad_rows, scan

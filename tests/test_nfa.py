@@ -1,0 +1,169 @@
+"""Glushkov bit-parallel NFA model + Pallas kernel vs the DFA oracle.
+
+The DFA compiler (models/dfa.py) shares the parser and Thompson
+construction with the Glushkov compiler, so compile_dfa's reference_scan is
+the semantic oracle: for every eligible pattern the two engines must agree
+on exact match end-offsets, on adversarial texts (stripe boundaries,
+anchors, matches at offset 0 / EOF, overlapping matches).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models import dfa as dfa_mod
+from distributed_grep_tpu.models import nfa as nfa_mod
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import pallas_nfa, scan_jnp
+
+from tests.test_ops import make_text  # shared corpus builder
+
+
+PATTERNS = [
+    "needle",
+    "nee(dle|t)",
+    "(cat|dog|bird)",
+    "colou?r",
+    "a[bc]*d",
+    "(foo|bar)+baz",
+    "x.y",
+    "[0-9]{2,4}x",
+    "^anchor",
+    "^(GET|POST) /cgi",
+    "wiki(pedia|media)?",
+    "[a-f]{3}",
+]
+
+TEXT = (
+    b"needle at start\n"
+    b"the cat sat on the dog\n"
+    b"colour and color and colr\n"
+    b"abd abcd abccd abcbcd ad\n"
+    b"foobaz barbaz foobarbaz bazfoo\n"
+    b"x.y xay xzy x\ny\n"
+    b"12x 123x 12345x 1x\n"
+    b"anchor here\nnot ^anchor\n"
+    b"GET /cgi-bin/query POST /cgi\n"
+    b"wiki wikipedia wikimedia wikip\n"
+    b"abcdef fade bead\n"
+    b"neet needle neets\n"
+) * 3
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_reference_scan_matches_dfa_oracle(pattern):
+    model = nfa_mod.try_compile_glushkov(pattern)
+    assert model is not None, pattern
+    table = dfa_mod.compile_dfa(pattern)
+    got = nfa_mod.scan_reference(model, TEXT)
+    want = dfa_mod.reference_scan(table, TEXT)
+    np.testing.assert_array_equal(got, want, err_msg=pattern)
+
+
+@pytest.mark.parametrize("pattern", ["NeEdLe", "[A-F]{3}", "^GeT"])
+def test_ignore_case(pattern):
+    model = nfa_mod.try_compile_glushkov(pattern, ignore_case=True)
+    assert model is not None
+    table = dfa_mod.compile_dfa(pattern, ignore_case=True)
+    np.testing.assert_array_equal(
+        nfa_mod.scan_reference(model, TEXT),
+        dfa_mod.reference_scan(table, TEXT),
+    )
+
+
+def test_ineligible_patterns():
+    assert nfa_mod.try_compile_glushkov("foo$") is None  # '$' needs lookahead
+    assert nfa_mod.try_compile_glushkov("a*") is None  # nullable
+    assert nfa_mod.try_compile_glushkov("x|") is None  # nullable branch
+    assert nfa_mod.try_compile_glushkov("a{1,200}") is None  # position blowup
+    with pytest.raises(dfa_mod.RegexError):
+        nfa_mod.try_compile_glushkov("(unbalanced")
+
+
+def test_chain_specials_split():
+    # Pure literal: every position but the last is a chain bit; no specials.
+    m = nfa_mod.try_compile_glushkov("needle")
+    assert m.n_specials == 0
+    assert bin(m.chain_src[0]).count("1") == len("needle") - 1
+    # Star introduces a back-edge special.
+    m2 = nfa_mod.try_compile_glushkov("ab*c")
+    assert m2.n_specials >= 1
+
+
+def test_long_alternation_spans_two_words():
+    words = ["volcano", "anarchy", "physics", "quantum", "needle", "breadth"]
+    pattern = "(" + "|".join(words) + ")"
+    model = nfa_mod.try_compile_glushkov(pattern)
+    assert model is not None and model.n_words == 2
+    table = dfa_mod.compile_dfa(pattern)
+    data = make_text(4000, inject=[(100, b"a volcano erupts"), (2000, b"quantum needle")])
+    np.testing.assert_array_equal(
+        nfa_mod.scan_reference(model, data),
+        dfa_mod.reference_scan(table, data),
+    )
+
+
+# ----------------------------------------------------------- pallas kernel
+
+def _kernel_vs_dfa(pattern, data, ignore_case=False):
+    model = nfa_mod.try_compile_glushkov(pattern, ignore_case=ignore_case)
+    assert model is not None and pallas_nfa.eligible(model), pattern
+    table = dfa_mod.compile_dfa(pattern, ignore_case=ignore_case)
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512,
+        lane_multiple=4096, chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    got = pallas_nfa.nfa_scan(arr, model, interpret=True)
+    want = np.asarray(scan_jnp.dfa_scan(arr, table))
+    np.testing.assert_array_equal(got, want, err_msg=pattern)
+
+
+@pytest.mark.parametrize(
+    "pattern",
+    ["nee(dle|t)", "colou?r", "a[bc]*d", "^anchor", "[0-9]{2,4}x", "(foo|bar)+baz"],
+)
+def test_pallas_nfa_interpret_matches_dfa_scan(pattern):
+    data = make_text(
+        3000,
+        inject=[
+            (5, b"needle neet colour anchor"),
+            (1500, b"abccd 1234x foobarbaz"),
+            (2900, b"neet at the end 99x"),
+        ],
+    )
+    _kernel_vs_dfa(pattern, data)
+
+
+def test_pallas_nfa_two_word_state_interpret():
+    words = ["volcano", "anarchy", "physics", "quantum", "needle", "breadth"]
+    pattern = "(" + "|".join(words) + ")"
+    data = make_text(3000, inject=[(40, b"volcano"), (2000, b"breadth quantum")])
+    _kernel_vs_dfa(pattern, data)
+
+
+def test_pallas_nfa_anchor_at_stripe_boundary():
+    # '^foo' where a stripe starts mid-line: the kernel treats stripe start
+    # as line start (the host stitcher re-checks those lines); the DFA scan
+    # does the same (state 0 at stripe start), so packed bits still agree.
+    data = make_text(3000, inject=[(0, b"anchor first"), (1700, b"anchor mid")])
+    _kernel_vs_dfa("^anchor", data)
+
+
+def test_pallas_nfa_ignore_case_interpret():
+    data = make_text(2000, inject=[(10, b"NEEDLE NeEt"), (1200, b"needle")])
+    _kernel_vs_dfa("nee(dle|t)", data, ignore_case=True)
+
+
+def test_kernel_cost_and_eligibility():
+    m = nfa_mod.try_compile_glushkov("nee(dle|t)")
+    assert pallas_nfa.kernel_cost(m) < pallas_nfa.MAX_COST
+    # 60 positions with 60 distinct 2-range classes compiles (<= 64
+    # positions) but blows the per-byte compare budget -> XLA DFA path.
+    import string
+
+    chars = string.ascii_letters + "!#%&,;:@"
+    big = nfa_mod.try_compile_glushkov("".join(f"[{c}0-9]" for c in chars[:60]))
+    assert big is not None
+    assert not pallas_nfa.eligible(big)
