@@ -35,10 +35,40 @@ def cmd_grep(args: argparse.Namespace) -> int:
 
     from distributed_grep_tpu.runtime.job import run_job
 
-    try:
-        re.compile(args.pattern)
-    except re.error as e:
-        print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
+    patterns: list[str] | None = None
+    if args.patterns_file:
+        if args.pattern is not None:
+            # like grep: -f replaces the positional pattern, which then
+            # parses as the first input file
+            args.files.insert(0, args.pattern)
+            args.pattern = None
+        pf = Path(args.patterns_file)
+        if not pf.exists():
+            print(f"error: no such file: {args.patterns_file}", file=sys.stderr)
+            return 2
+        # bytes + surrogateescape: pattern files need not be UTF-8 (the apps
+        # re-encode with surrogateescape, so arbitrary bytes round-trip)
+        raw = pf.read_bytes().splitlines()
+        if not raw:
+            print(f"error: empty pattern file: {args.patterns_file}", file=sys.stderr)
+            return 2
+        if any(not ln for ln in raw):
+            # grep -F -f: an empty pattern line matches every line
+            patterns = None
+            args.pattern = ""
+        else:
+            patterns = [ln.decode("utf-8", "surrogateescape") for ln in raw]
+    if args.pattern is None and patterns is None:
+        print("error: need a PATTERN or -f FILE", file=sys.stderr)
+        return 2
+    if patterns is None and not args.patterns_file:
+        try:
+            re.compile(args.pattern)
+        except re.error as e:
+            print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
+            return 2
+    if not args.files:
+        print("error: no input files", file=sys.stderr)
         return 2
     missing = [f for f in args.files if not Path(f).exists()]
     if missing:
@@ -52,7 +82,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
             if (args.backend or "cpu") in ("tpu", "auto")
             else "distributed_grep_tpu.apps.grep"
         ),
-        app_options={"pattern": args.pattern, "ignore_case": args.ignore_case},
+        app_options={
+            "ignore_case": args.ignore_case,
+            **({"patterns": patterns} if patterns else {"pattern": args.pattern}),
+        },
         n_reduce=args.n_reduce or 10,
     )
     if args.work_dir:
@@ -106,9 +139,14 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("grep", help="distributed grep over input files")
-    p.add_argument("pattern")
-    p.add_argument("files", nargs="+")
+    p.add_argument("pattern", nargs="?", default=None)
+    p.add_argument("files", nargs="*")
     p.add_argument("-i", "--ignore-case", action="store_true")
+    p.add_argument(
+        "-f", "--patterns-file", default=None,
+        help="literal pattern set, one per line (grep -F -f semantics; "
+             "device scan uses Aho-Corasick/FDR pattern-set engines)",
+    )
     _add_common(p)
     p.set_defaults(fn=cmd_grep)
 

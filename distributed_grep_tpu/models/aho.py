@@ -32,7 +32,7 @@ def compile_aho_corasick(
         raise RegexError("empty pattern set")
     needles: list[bytes] = []
     for p in patterns:
-        b = p.encode("utf-8") if isinstance(p, str) else bytes(p)
+        b = p.encode("utf-8", "surrogateescape") if isinstance(p, str) else bytes(p)
         if not b:
             raise RegexError("empty literal in pattern set")
         if NL in b:
@@ -138,7 +138,7 @@ def compile_aho_corasick_banks(
     per byte) so each bank compiles within its state budget.
     """
     norm: list[bytes] = [
-        p.encode("utf-8") if isinstance(p, str) else bytes(p) for p in patterns
+        p.encode("utf-8", "surrogateescape") if isinstance(p, str) else bytes(p) for p in patterns
     ]
     if not norm:
         raise RegexError("empty pattern set")

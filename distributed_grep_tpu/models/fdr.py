@@ -1,0 +1,275 @@
+"""FDR-style bucketed literal-set filter model (Hyperscan's large-set idea,
+re-derived for the TPU VPU's lane-gather primitive).
+
+Large literal sets (BASELINE.json configs 3 and 5 — grep -f / Snort-style
+rulesets) are the one workload where the reference's per-line regex loop
+(/root/reference/application/grep.go:20-30) has no small automaton: an
+Aho-Corasick DFA over 10k patterns has ~60k states, and a per-byte table
+gather at that size is the XLA scan path's ~0.1 GB/s cliff.  Hyperscan's
+answer is FDR: superimpose the set into a few *buckets*, filter the stream
+with shift-AND over per-position reach tables, and confirm rare candidates
+exactly.  This module is that idea rebuilt around what the TPU can do fast:
+
+* 32 buckets — one uint32 per lane, the same tile shape every other kernel
+  here uses;
+* reach tables indexed by a *pair-domain hash* ``h = ((b0*37) ^ (b1*101))
+  & (D-1)`` of two consecutive bytes — single-byte reach saturates at these
+  set sizes, a pair domain of 128..512 entries keeps per-bucket densities
+  in the few-percent range;
+* D <= 512 because the kernel's lane-gather (``take_along_axis`` over a
+  128-lane vreg) covers 128 entries per op — D/128 gathers + selects per
+  lookup (ops/pallas_fdr.py);
+* the filter checks the last ``m+1`` bytes of every position (m pair
+  checks, m <= 5); a candidate only says "some bucket's superimposition
+  matched here" — the engine re-checks the candidate's *line* on the host
+  with the exact Aho-Corasick tables (ops/engine.py), so end-to-end output
+  is exact, mirroring how boundary lines are already stitched.
+* sets whose densities are still too high shard into independent *banks*
+  (extra device passes over the same bytes), length-stratified so short
+  patterns don't drag the window down for everyone.
+
+The expected false-positive rate is computed exactly from the built tables
+(``FdrBank.fp_per_byte``), and bank/domain choice is a small cost search
+over that estimate — not a heuristic guess.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+NL = 0x0A
+N_BUCKETS = 32
+MAX_M = 5  # pair checks per position; window = MAX_M + 1 bytes
+DOMAINS = (128, 256, 512)  # kernel gathers per lookup = D / 128
+HASH_A, HASH_B = 37, 101
+# Sets whose best achievable candidate rate is still above this are not
+# worth filtering (the host confirm would dominate): compile_fdr raises and
+# the engine keeps the exact DFA banks instead.
+FP_CEILING_PER_BYTE = 1e-2
+
+
+def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int):
+    """The kernel's pair-domain hash — shared host/device definition."""
+    return ((b0 * HASH_A) ^ (b1 * HASH_B)) & (domain - 1)
+
+
+class FdrError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class FdrBank:
+    """One filter pass: m pair-position reach tables over a D-entry domain."""
+
+    m: int  # pair checks (window = m+1 bytes)
+    domain: int  # table entries; D/128 lane-gathers per lookup
+    tables: np.ndarray  # (m, domain) uint32 bucket masks
+    patterns: list[bytes]  # normalized members (for debugging/repr)
+    fp_per_byte: float  # expected candidate rate on uniform bytes
+
+    @property
+    def n_subtables(self) -> int:
+        return self.domain // 128
+
+    def scan_cost(self) -> int:
+        """Relative per-byte device cost (gathers dominate)."""
+        return self.m * (2 * self.n_subtables + 2)
+
+
+@dataclass(frozen=True)
+class FdrModel:
+    banks: list[FdrBank]
+    ignore_case: bool
+    n_patterns: int
+
+    @property
+    def fp_per_byte(self) -> float:
+        return float(sum(b.fp_per_byte for b in self.banks))
+
+    def scan_cost(self) -> int:
+        return sum(b.scan_cost() for b in self.banks)
+
+    @property
+    def window(self) -> int:
+        """Max filter window — candidate misses are confined to the first
+        window-1 bytes of a stripe (the engine's boundary stitching)."""
+        return max(b.m for b in self.banks) + 1
+
+
+def _normalize(patterns: list[str | bytes], ignore_case: bool) -> list[bytes]:
+    out: list[bytes] = []
+    for p in patterns:
+        b = p.encode("utf-8", "surrogateescape") if isinstance(p, str) else bytes(p)
+        if not b:
+            raise FdrError("empty literal in pattern set")
+        if NL in b:
+            raise FdrError("literal contains '\\n' — not representable per-line")
+        out.append(b.lower() if ignore_case else b)
+    return out
+
+
+def _bank_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
+    """Build (m, domain) uint32 reach tables for one bank.
+
+    Bucket assignment sorts patterns by their final-pair hash so literals
+    sharing a tail land in the same bucket — distinct hashes per (bucket,
+    position) is what sets the density, so clustering identical tails is
+    free selectivity.
+    """
+    order = sorted(
+        range(len(group)),
+        key=lambda i: int(pair_hash(group[i][-2], group[i][-1], domain)),
+    )
+    tables = np.zeros((m, domain), dtype=np.uint32)
+    n = len(group)
+    for rank, i in enumerate(order):
+        p = group[i]
+        bucket = rank * N_BUCKETS // n
+        bit = np.uint32(1 << bucket)
+        for k in range(m):
+            # Pipeline slot k is applied k steps after the oldest check, so
+            # tables[k] holds the pair at depth m-1-k from the pattern end:
+            # candidate(t) = AND_k tables[k][h_{t-(m-1-k)}], and the pair at
+            # depth d ends exactly at byte t-d.
+            d = m - 1 - k
+            b0, b1 = p[len(p) - 2 - d], p[len(p) - 1 - d]
+            tables[k, int(pair_hash(b0, b1, domain))] |= bit
+    return tables
+
+
+def _fp_estimate(tables: np.ndarray) -> float:
+    """Expected candidate probability per byte on uniform random pairs:
+    sum over buckets of prod over positions of that bucket's density."""
+    m, domain = tables.shape
+    bits = (tables[:, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
+    dens = bits.sum(axis=1) / domain  # (m, N_BUCKETS)
+    return float(np.prod(dens, axis=0).sum())
+
+
+def _compile_group(
+    group: list[bytes], m: int, fp_budget: float, max_banks: int
+) -> list[FdrBank]:
+    """Pick (domain, n_banks) for one length-stratified group: the cheapest
+    configuration whose exact FP estimate meets the budget, else min-FP."""
+    candidates = []
+    for domain in DOMAINS:
+        for n_banks in (1, 2, 4, 8, 16, 32):
+            if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * 4):
+                continue
+            cost = n_banks * m * (2 * (domain // 128) + 2)
+            candidates.append((cost, domain, n_banks))
+    candidates.sort()
+    best: tuple[float, list[FdrBank]] | None = None
+    for _cost, domain, n_banks in candidates:
+        shards = [group[i::n_banks] for i in range(n_banks)]
+        banks = []
+        for shard in shards:
+            tables = _bank_tables(shard, m, domain)
+            banks.append(
+                FdrBank(
+                    m=m,
+                    domain=domain,
+                    tables=tables,
+                    patterns=shard,
+                    fp_per_byte=_fp_estimate(tables),
+                )
+            )
+        fp = sum(b.fp_per_byte for b in banks)
+        if fp <= fp_budget:
+            return banks
+        if best is None or fp < best[0]:
+            best = (fp, banks)
+    assert best is not None
+    return best[1]
+
+
+def compile_fdr(
+    patterns: list[str | bytes],
+    *,
+    ignore_case: bool = False,
+    fp_budget_per_byte: float = 2e-4,
+    max_banks: int = 32,
+) -> FdrModel:
+    """Compile a literal set (every literal >= 2 bytes) into filter banks.
+
+    Patterns are stratified by length class so each group's window is as
+    long as its shortest member allows (m = min(len)-1, capped at MAX_M);
+    groups too small to be worth a device pass merge into the next shorter
+    window.  Raises FdrError for sets this filter cannot host (the engine
+    routes those members to the exact DFA-bank path instead).
+    """
+    norm = _normalize(patterns, ignore_case)
+    if not norm:
+        raise FdrError("empty pattern set")
+    if any(len(p) < 2 for p in norm):
+        raise FdrError("FDR needs literals >= 2 bytes")
+
+    groups: dict[int, list[bytes]] = {}
+    for p in norm:
+        groups.setdefault(min(MAX_M, len(p) - 1), []).append(p)
+    # merge small groups downward (their patterns still satisfy smaller m)
+    for m in sorted(groups.keys(), reverse=True):
+        if len(groups) > 1 and len(groups[m]) < 32:
+            smaller = [k for k in groups if k < m]
+            if smaller:
+                groups[max(smaller)].extend(groups.pop(m))
+
+    budget_each = fp_budget_per_byte / len(groups)
+    banks: list[FdrBank] = []
+    for m in sorted(groups.keys(), reverse=True):
+        banks.extend(_compile_group(groups[m], m, budget_each, max_banks))
+    model = FdrModel(banks=banks, ignore_case=ignore_case, n_patterns=len(norm))
+    if model.fp_per_byte > FP_CEILING_PER_BYTE:
+        raise FdrError(
+            f"set too dense to filter: best candidate rate "
+            f"{model.fp_per_byte:.3g}/byte > {FP_CEILING_PER_BYTE:g}"
+        )
+    return model
+
+
+# ------------------------------------------------------------------ reference
+
+def reference_candidates(bank: FdrBank, data: bytes) -> np.ndarray:
+    """NumPy oracle of the device filter for one bank: candidate end offsets
+    (i+1 convention, like models/dfa.reference_scan) over a single stripe.
+
+    Mirrors the kernel exactly, including the all-ones pipeline seed at the
+    stripe start (conservative: early positions over-report rather than
+    miss, and the engine host-confirms candidates anyway).
+    """
+    arr = np.frombuffer(data, dtype=np.uint8).astype(np.int64)
+    n = arr.size
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    prev = np.concatenate([[0], arr[:-1]])
+    h = pair_hash(prev, arr, bank.domain)
+    masks = bank.tables[:, h]  # (m, n) uint32
+    ones = np.uint32(0xFFFFFFFF)
+    # pipeline: V_0(t) = masks[0, t]; V_k(t) = V_{k-1}(t-1) & masks[k, t]
+    Vs = np.empty((bank.m, n), dtype=np.uint32)
+    Vs[0] = masks[0]
+    for k in range(1, bank.m):
+        shifted = np.concatenate([[ones], Vs[k - 1][:-1]])
+        Vs[k] = shifted & masks[k]
+    return np.nonzero(Vs[bank.m - 1] != 0)[0].astype(np.int64) + 1
+
+
+def reference_candidates_model(model: FdrModel, data: bytes) -> np.ndarray:
+    """Union of per-bank candidate end offsets."""
+    if model.ignore_case:
+        data = bytes(data).lower()
+    outs = [reference_candidates(b, data) for b in model.banks]
+    return np.unique(np.concatenate(outs)) if outs else np.zeros(0, dtype=np.int64)
+
+
+def exact_match_lines(patterns: list[bytes], data: bytes, ignore_case: bool) -> set[int]:
+    """Simple oracle for tests: 1-based lines containing any literal."""
+    hay = data.lower() if ignore_case else data
+    needles = [p.lower() if ignore_case else p for p in patterns]
+    out = set()
+    for i, line in enumerate(hay.split(b"\n"), 1):
+        if any(nd in line for nd in needles):
+            out.add(i)
+    return out

@@ -31,6 +31,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
+from distributed_grep_tpu.models.fdr import FdrError, FdrModel, compile_fdr
 from distributed_grep_tpu.models.dfa import (
     DfaTable,
     RegexError,
@@ -52,8 +53,10 @@ log = get_logger("engine")
 
 @dataclass
 class ScanResult:
-    matched_lines: np.ndarray  # sorted 1-based line numbers
-    n_matches: int  # match end-offset count (>= matched lines)
+    matched_lines: np.ndarray  # sorted 1-based line numbers (always exact)
+    # device end-offset count (>= matched lines; for the FDR filter mode
+    # these are pre-confirmation candidates — matched_lines is post-confirm)
+    n_matches: int
     bytes_scanned: int
 
 
@@ -88,15 +91,44 @@ class GrepEngine:
         self.tables: list[DfaTable] = []
         self._dev_tables: list[tuple] | None = None
         self._re_fallback: _re.Pattern[bytes] | None = None
+        self.fdr: FdrModel | None = None
+        self._fdr_short: list[DfaTable] = []
+        self._fdr_dev_tables: list | None = None
+        self._fdr_broken = False
 
         if patterns is not None:
             self.pattern = f"<set of {len(patterns)}>"
+            # Exact AC banks always exist: they are the CPU/native engine,
+            # the DFA-bank device fallback, AND the host confirm oracle for
+            # the FDR filter path.
             self.tables = compile_aho_corasick_banks(
                 patterns, ignore_case=ignore_case,
                 max_states_per_bank=max_states_per_bank,
             )
             self.table = self.tables[0]
             self.mode = "dfa"
+            # Large literal sets: FDR bucketed filter (models/fdr.py) on the
+            # Pallas path + exact per-line host confirm — the Hyperscan-style
+            # architecture that keeps 1k..10k-pattern sets off the per-byte
+            # table-gather cliff.  Literals shorter than 2 bytes can't form a
+            # pair check and stay on the exact DFA banks (run additionally).
+            if backend == "device":
+                def _blen(p):
+                    return len(p.encode("utf-8", "surrogateescape") if isinstance(p, str) else p)
+
+                long_pats = [p for p in patterns if _blen(p) >= 2]
+                short_pats = [p for p in patterns if _blen(p) < 2]
+                if long_pats:
+                    try:
+                        self.fdr = compile_fdr(long_pats, ignore_case=ignore_case)
+                        if short_pats:
+                            self._fdr_short = compile_aho_corasick_banks(
+                                short_pats, ignore_case=ignore_case,
+                                max_states_per_bank=max_states_per_bank,
+                            )
+                        self.mode = "fdr"
+                    except FdrError as e:
+                        log.info("pattern set -> DFA banks (FDR: %s)", e)
         else:
             self.pattern = pattern
             try:
@@ -186,6 +218,18 @@ class GrepEngine:
                     )))
         return self._dev_tables
 
+    def _fdr_device_tables(self) -> list:
+        """Per-bank FDR reach tables on device, uploaded once per engine."""
+        if self._fdr_dev_tables is None:
+            import jax.numpy as jnp
+
+            from distributed_grep_tpu.ops import pallas_fdr
+
+            self._fdr_dev_tables = [
+                jnp.asarray(pallas_fdr.bank_device_tables(b)) for b in self.fdr.banks
+            ]
+        return self._fdr_dev_tables
+
     # --------------------------------------------------------- device engine
     def _scan_device(self, data: bytes) -> ScanResult:
         nl = lines_mod.newline_index(data)
@@ -193,7 +237,7 @@ class GrepEngine:
         boundaries: list[int] = []
         n_matches = 0
         seg = self.segment_bytes
-        from distributed_grep_tpu.ops import pallas_nfa, pallas_scan
+        from distributed_grep_tpu.ops import pallas_fdr, pallas_nfa, pallas_scan
 
         use_pallas_sa = (
             self.mode == "shift_and"
@@ -207,9 +251,19 @@ class GrepEngine:
             and pallas_scan.available()
             and pallas_nfa.eligible(self.glushkov)
         )
-        use_pallas = use_pallas_sa or use_pallas_nfa
+        # FDR filter path: candidates on device, exact confirm per line on
+        # host; without a TPU (or after a kernel failure) the same engine
+        # falls back to the exact DFA banks below.
+        use_fdr = (
+            self.mode == "fdr" and not self._fdr_broken and pallas_scan.available()
+        )
+        use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr
         for seg_start in range(0, max(len(data), 1), seg):
             seg_bytes = data[seg_start : seg_start + seg]
+            if use_fdr and self.ignore_case:
+                # FDR hashes raw bytes; fold the haystack like the patterns
+                # were folded (the exact DFA confirm is case-aware either way)
+                seg_bytes = seg_bytes.lower()
             if seg_start > 0:
                 boundaries.append(seg_start)
             if use_pallas:
@@ -225,7 +279,25 @@ class GrepEngine:
             arr = layout_mod.to_device_array(seg_bytes, lay)
             # Device scan, then sparse fetch: a 4-byte count round-trip plus
             # O(matches) coordinates — never the dense packed plane.
-            if use_pallas:
+            if use_fdr:
+                try:
+                    words = None
+                    for bank, dev_tab in zip(self.fdr.banks, self._fdr_device_tables()):
+                        w = pallas_fdr.fdr_scan_words(arr, bank, dev_tables=dev_tab)
+                        words = w if words is None else words | w
+                    idx, vals = scan_jnp.sparse_nonzero(words)
+                except Exception as e:  # Mosaic limits are empirical; stay exact
+                    log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
+                    self._fdr_broken = True
+                    return self._scan_device(data)
+                offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
+                if self._fdr_short:
+                    # len<2 literals: exact host scan (native DFA, tiny sets)
+                    short = np.unique(np.concatenate(
+                        [reference_scan(t, seg_bytes) for t in self._fdr_short]
+                    ))
+                    offsets = np.union1d(offsets, short.astype(np.int64))
+            elif use_pallas:
                 if use_pallas_sa:
                     words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
                 else:
@@ -264,6 +336,15 @@ class GrepEngine:
                 device_lines.update((seg_lines + base).tolist())
             boundaries.extend((seg_start + lay.stripe_starts()).tolist())
 
+        if use_fdr and device_lines:
+            # FDR lines are *candidates* (bucket superimposition + domain
+            # hashing over-report); confirm each against the exact AC banks.
+            confirmed = set()
+            for ln in device_lines:
+                start, end = lines_mod.line_span(nl, ln, len(data))
+                if self._host_line_matcher(data[start:end]):
+                    confirmed.add(ln)
+            device_lines = confirmed
         stitched = lines_mod.stitch_lines(
             device_lines, data, nl, boundaries, self._host_line_matcher
         )

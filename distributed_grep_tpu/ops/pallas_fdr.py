@@ -1,0 +1,199 @@
+"""Pallas TPU kernel: FDR bucketed literal-set filter (models/fdr.py).
+
+Same shell as ops/pallas_scan.py / ops/pallas_nfa.py (lanes x chunk tiles,
+time-packed uint32 candidate words, VMEM scratch carried across chunk
+blocks), but the per-byte step is the bucketed pair-hash filter:
+
+    h      = ((prev*37) ^ (b*101)) & (D-1)       pair-domain hash
+    R_j    = tables[j][h]                        m reach lookups
+    V_0    = R_0 ;  V_k = V_k-1(prev byte) & R_k pipeline over pair checks
+    cand   = V_{m-1} != 0                        some bucket passed all m
+
+The reach lookup is the part the VPU had no primitive for until lane
+gathers: ``jnp.take_along_axis(table_tile, idx, axis=1)`` gathers within a
+128-lane vreg row, so a D-entry table is D/128 broadcast tiles selected by
+the hash's high bits (the ``hi == j`` selects are shared across all m
+position tables — one compare set per byte, not per lookup).
+
+Probed on TPU v5e (2026-07-30): m=4/D=256 ~22 GB/s, m=5/D=512 ~11.5 GB/s;
+D=1024 crashes the Mosaic compiler, hence models/fdr.DOMAINS caps at 512.
+
+The V pipeline is seeded ALL-ONES at each stripe start: the first m
+positions of a stripe then over-report candidates instead of missing
+matches whose window spans the stripe head, and the engine's host
+confirmation (exact Aho-Corasick on the candidate's line) keeps the final
+output exact either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.fdr import HASH_A, HASH_B, FdrBank
+from distributed_grep_tpu.ops.pallas_scan import (
+    CHUNK_BLOCK_WORDS,
+    LANE_COLS,
+    LANES_PER_BLOCK,
+    SUBLANES,
+    available,
+)
+
+
+def eligible(bank: FdrBank) -> bool:
+    """models/fdr only emits kernel-sized banks; guard anyway."""
+    return bank.m <= 6 and bank.domain <= 512 and bank.domain % 128 == 0
+
+
+def bank_device_tables(bank: FdrBank) -> np.ndarray:
+    """(m * n_subtables, SUBLANES, LANE_COLS) uint32 — each 128-entry
+    subtable broadcast across sublanes, ready to pass to the kernel.
+    Upload once per engine; ~16 KB per subtable."""
+    m, d = bank.tables.shape
+    g = d // LANE_COLS
+    sub = bank.tables.reshape(m, g, LANE_COLS)  # (m, G, 128)
+    tiles = np.broadcast_to(
+        sub[:, :, None, :], (m, g, SUBLANES, LANE_COLS)
+    ).reshape(m * g, SUBLANES, LANE_COLS)
+    return np.ascontiguousarray(tiles)
+
+
+def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, steps):
+    from jax.experimental import pallas as pl  # deferred: import cost
+
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        # all-ones: stripe heads over-report (host confirm), never miss
+        v_ref[...] = jnp.full_like(v_ref, jnp.uint32(0xFFFFFFFF))
+        prev_ref[...] = jnp.zeros_like(prev_ref)
+
+    zero = jnp.uint32(0)
+
+    def word_body(w, carry):
+        prev_b, *V = carry
+        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        for t in range(32):
+            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
+            h = ((prev_b * HASH_A) ^ (b * HASH_B)) & (n_sub * LANE_COLS - 1)
+            prev_b = b
+            lo = h & (LANE_COLS - 1)
+            if n_sub > 1:
+                hi = h >> 7
+                # all-ones/all-zero uint32 select masks, shared by all m lookups
+                sels = [zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)]
+            masks = []
+            for p in range(m):
+                acc = None
+                for j in range(n_sub):
+                    g = jnp.take_along_axis(tabs_ref[p * n_sub + j], lo, axis=1)
+                    if n_sub > 1:
+                        g = g & sels[j]
+                    acc = g if acc is None else (acc | g)
+                masks.append(acc)
+            V = [masks[0]] + [V[k - 1] & masks[k] for k in range(1, m)]
+            word = word | jnp.where(V[m - 1] != 0, jnp.uint32(1 << t), zero)
+        out_ref[w] = word
+        return (prev_b, *V)
+
+    carry0 = (prev_ref[...].astype(jnp.int32),) + tuple(v_ref[k] for k in range(m))
+    final = jax.lax.fori_loop(0, steps // 32, word_body, carry0)
+    prev_ref[...] = final[0].astype(jnp.uint32)
+    for k in range(m):
+        v_ref[k] = final[1 + k]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "n_sub", "chunk", "lane_blocks", "interpret")
+)
+def _fdr_pallas(data, tabs, *, m, n_sub, chunk, lane_blocks, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = 32 * CHUNK_BLOCK_WORDS
+    chunk_blocks = chunk // steps
+    kernel = functools.partial(_kernel, m=m, n_sub=n_sub, steps=steps)
+    return pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (steps, SUBLANES, LANE_COLS),
+                lambda li, ci: (ci, li, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (m * n_sub, SUBLANES, LANE_COLS),
+                lambda li, ci: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), jnp.uint32
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((m, SUBLANES, LANE_COLS), jnp.uint32),
+            pltpu.VMEM((SUBLANES, LANE_COLS), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(data, tabs)
+
+
+def fdr_scan_words(
+    arr_cl: np.ndarray,
+    bank: FdrBank,
+    dev_tables=None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Run one bank's filter; returns time-packed candidate words as a
+    DEVICE array in the shared Pallas convention ((chunk//32, S, 128)
+    uint32 — decode via ops/sparse.offsets_from_sparse_words).  Candidates
+    from several banks OR together on device before the sparse fetch.
+
+    ``dev_tables`` lets the engine upload ``bank_device_tables`` once and
+    reuse across segments."""
+    chunk, lanes = arr_cl.shape
+    steps = 32 * CHUNK_BLOCK_WORDS
+    if lanes % LANES_PER_BLOCK or chunk % steps:
+        raise ValueError(
+            f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0"
+        )
+    if not eligible(bank):
+        raise ValueError("bank outside the kernel's m/domain budget")
+    lane_blocks = lanes // LANES_PER_BLOCK
+    data = np.ascontiguousarray(
+        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
+    )
+    if dev_tables is None:
+        dev_tables = jnp.asarray(bank_device_tables(bank))
+    if interpret is None:
+        interpret = not available()
+    return _fdr_pallas(
+        jnp.asarray(data),
+        dev_tables,
+        m=bank.m,
+        n_sub=bank.domain // LANE_COLS,
+        chunk=chunk,
+        lane_blocks=lane_blocks,
+        interpret=interpret,
+    )
+
+
+def fdr_scan(
+    arr_cl: np.ndarray, bank: FdrBank, interpret: bool | None = None
+) -> np.ndarray:
+    """Dense-output wrapper (tests): packed bits in the scan_jnp convention."""
+    from distributed_grep_tpu.ops.pallas_scan import _unpack_words_to_lane_bits
+
+    chunk, lanes = arr_cl.shape
+    words = fdr_scan_words(arr_cl, bank, interpret=interpret)
+    return _unpack_words_to_lane_bits(np.asarray(words), chunk, lanes)

@@ -71,3 +71,25 @@ def test_wordcount_app():
     kvs = app.map_fn("t", b"the cat and the hat")
     out = group_reduce(kvs, app.reduce_fn)
     assert out == {"the": "2", "cat": "1", "and": "1", "hat": "1"}
+
+
+def test_grep_cpu_no_phantom_trailing_line():
+    # 'grep -n ""' on a trailing-newline file matches every real line, not a
+    # phantom empty line after the final '\n'
+    from distributed_grep_tpu.apps import grep as grep_app
+
+    grep_app.configure(pattern="")
+    out = grep_app.map_fn("f", b"one\ntwo\n")
+    assert [kv.key for kv in out] == [
+        "f (line number #1)", "f (line number #2)"
+    ]
+
+
+def test_grep_cpu_pattern_set_uses_ac():
+    from distributed_grep_tpu.apps import grep as grep_app
+
+    grep_app.configure(patterns=["needle", "vol.cano"])  # literals, not regex
+    out = grep_app.map_fn("f", b"a needle\nvolXcano\nvol.cano literal\nnone\n")
+    assert [kv.key for kv in out] == [
+        "f (line number #1)", "f (line number #3)"
+    ]

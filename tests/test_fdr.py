@@ -1,0 +1,263 @@
+"""FDR bucketed literal-set filter: model oracle, Pallas kernel (interpret
+mode), auto-tuning, and end-to-end exactness through the engine confirm
+path.  The filter itself may over-report (bucket superimposition, all-ones
+stripe seeds); exactness is asserted where the system promises it — at the
+line level after host confirmation — while the model-level tests assert
+the filter's contract: candidates are a SUPERSET of true match ends, with
+a measured false-positive rate close to the model's prediction."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models import fdr as fdr_mod
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import pallas_fdr
+
+from tests.test_ops import make_text
+
+
+def _rand_literals(n, lo, hi, seed, alphabet=b"abcdefghijklmnopqrstuvwxyz"):
+    rng = np.random.default_rng(seed)
+    pats = set()
+    while len(pats) < n:
+        k = int(rng.integers(lo, hi + 1))
+        pats.add(bytes(rng.choice(list(alphabet), size=k).tolist()))
+    return sorted(pats)
+
+
+def _true_ends(patterns, data: bytes, ignore_case=False) -> set[int]:
+    hay = data.lower() if ignore_case else data
+    ends = set()
+    for p in patterns:
+        nd = p.lower() if ignore_case else p
+        start = 0
+        while True:
+            i = hay.find(nd, start)
+            if i < 0:
+                break
+            ends.add(i + len(nd))  # i+1 convention: offset of last byte + 1
+            start = i + 1
+    return ends
+
+
+# ------------------------------------------------------------------- model
+
+def test_candidates_superset_of_matches():
+    pats = _rand_literals(200, 4, 10, seed=1)
+    model = fdr_mod.compile_fdr(pats)
+    data = make_text(400, inject=[(7, b"xx " + pats[0] + b" yy"),
+                                  (200, pats[10] + pats[50]),
+                                  (399, b"ends with " + pats[99])])
+    cands = set(fdr_mod.reference_candidates_model(model, data).tolist())
+    assert _true_ends(pats, data) <= cands
+
+
+def test_fp_rate_close_to_estimate():
+    pats = _rand_literals(300, 5, 9, seed=2)
+    model = fdr_mod.compile_fdr(pats, fp_budget_per_byte=1e-3)
+    rng = np.random.default_rng(3)
+    data = rng.integers(97, 123, size=1 << 20, dtype=np.uint8).tobytes()
+    cands = fdr_mod.reference_candidates_model(model, data)
+    true = _true_ends(pats, data)
+    fp = len(set(cands.tolist()) - true) / len(data)
+    # estimate assumes uniform pairs; lowercase text is close enough that
+    # the empirical rate should be within ~30x (and under budget x30)
+    assert fp <= max(model.fp_per_byte * 30, 3e-3), (fp, model.fp_per_byte)
+
+
+def test_ignore_case_folding():
+    pats = [b"NeedLe", b"VOLCANO"]
+    model = fdr_mod.compile_fdr(pats, ignore_case=True)
+    data = b"a nEEdle here\nand a volCANO there\n"
+    cands = set(fdr_mod.reference_candidates_model(model, data).tolist())
+    assert _true_ends(pats, data, ignore_case=True) <= cands
+
+
+def test_length_stratification_and_short_patterns():
+    pats = [b"ab", b"cd", b"needle", b"volcano", b"xy"] + _rand_literals(40, 6, 8, seed=4)
+    model = fdr_mod.compile_fdr(pats)
+    ms = sorted({b.m for b in model.banks})
+    assert ms[0] == 1  # len-2 group got its own window
+    data = b"ab here\nneedle there\nxy\n" + make_text(50)
+    cands = set(fdr_mod.reference_candidates_model(model, data).tolist())
+    assert _true_ends(pats, data) <= cands
+
+
+def test_rejects_unusable_literals():
+    with pytest.raises(fdr_mod.FdrError):
+        fdr_mod.compile_fdr([b"a"])  # too short for a pair
+    with pytest.raises(fdr_mod.FdrError):
+        fdr_mod.compile_fdr([b"has\nnewline"])
+    with pytest.raises(fdr_mod.FdrError):
+        fdr_mod.compile_fdr([])
+
+
+def test_big_set_banks_within_budget():
+    pats = _rand_literals(2000, 5, 9, seed=5)
+    model = fdr_mod.compile_fdr(pats, fp_budget_per_byte=2e-4)
+    assert model.n_patterns == 2000
+    for b in model.banks:
+        assert b.domain in fdr_mod.DOMAINS and 1 <= b.m <= fdr_mod.MAX_M
+    # cost search should prefer meeting the budget when feasible
+    assert model.fp_per_byte <= 2e-3
+
+
+# ------------------------------------------------------------------ kernel
+
+def _kernel_vs_reference(pats, data, **compile_kw):
+    model = fdr_mod.compile_fdr(pats, **compile_kw)
+    if model.ignore_case:
+        data_f = bytes(data).lower()
+    else:
+        data_f = data
+    lay = layout_mod.choose_layout(
+        len(data_f), target_lanes=4096, min_chunk=512,
+        lane_multiple=4096, chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data_f, lay)
+    for bank in model.banks:
+        got = pallas_fdr.fdr_scan(arr, bank, interpret=True)
+        # expected: reference per lane-stripe (each lane is its own stripe)
+        want = np.zeros((lay.chunk, lay.lanes), dtype=bool)
+        for lane in range(lay.lanes):
+            stripe = bytes(arr[:, lane])
+            ends = fdr_mod.reference_candidates(bank, stripe)
+            want[(ends - 1).astype(np.int64), lane] = True
+        np.testing.assert_array_equal(
+            got, np.packbits(want, axis=1, bitorder="little")
+        )
+
+
+def test_pallas_fdr_interpret_matches_reference():
+    pats = _rand_literals(60, 4, 9, seed=6)
+    data = make_text(
+        120,
+        inject=[(3, b"xx " + pats[0] + b" yy"), (60, pats[1] + b" " + pats[2])],
+    )
+    _kernel_vs_reference(pats, data)
+
+
+def test_pallas_fdr_interpret_multi_subtable():
+    # force domain 512 (n_sub=4) via a big enough set
+    pats = _rand_literals(600, 5, 9, seed=7)
+    model = fdr_mod.compile_fdr(pats)
+    assert any(b.domain >= 256 for b in model.banks)
+    data = make_text(60, inject=[(5, pats[3] + b" mid " + pats[4])])
+    _kernel_vs_reference(pats, data)
+
+
+def test_pallas_fdr_short_window_bank():
+    _kernel_vs_reference([b"ab", b"zq", b"needle"], make_text(60, inject=[(2, b"zq ab")]))
+
+
+def test_device_tables_layout():
+    pats = _rand_literals(100, 4, 8, seed=8)
+    model = fdr_mod.compile_fdr(pats)
+    bank = model.banks[0]
+    tiles = pallas_fdr.bank_device_tables(bank)
+    g = bank.domain // 128
+    assert tiles.shape == (bank.m * g, 32, 128)
+    # row p*g+j, any sublane s, lane l == tables[p, j*128 + l]
+    for p in range(bank.m):
+        for j in range(g):
+            np.testing.assert_array_equal(
+                tiles[p * g + j, 5], bank.tables[p, j * 128 : (j + 1) * 128]
+            )
+
+
+# ----------------------------------------------------- engine (device path)
+
+def test_engine_fdr_end_to_end_interpret(monkeypatch):
+    """Full engine path: FDR candidates on the (interpreted) kernel, host
+    confirm, boundary stitching — output must equal the oracle exactly."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    pats = _rand_literals(150, 4, 9, seed=9)
+    data = make_text(
+        150,
+        inject=[(2, b"xx " + pats[0] + b" yy"),
+                (75, pats[1] + b" and " + pats[2]),
+                (149, b"tail " + pats[3])],
+    )
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    orig = pallas_fdr.fdr_scan_words
+    monkeypatch.setattr(
+        pallas_fdr, "fdr_scan_words",
+        lambda arr, bank, dev_tables=None, interpret=None:
+            orig(arr, bank, dev_tables=dev_tables, interpret=True),
+    )
+    eng = engine_mod.GrepEngine(patterns=[p.decode("latin-1") for p in pats])
+    assert eng.mode == "fdr"
+    res = eng.scan(data)
+    want = fdr_mod.exact_match_lines(pats, data, ignore_case=False)
+    assert set(res.matched_lines.tolist()) == want
+
+
+def test_engine_fdr_ignore_case_interpret(monkeypatch):
+    from distributed_grep_tpu.ops import engine as engine_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    pats = [b"NEEDLE", b"VolCano", b"qq"]
+    data = make_text(60, inject=[(5, b"a needle B"), (30, b"VOLCANO qQ")])
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    orig = pallas_fdr.fdr_scan_words
+    monkeypatch.setattr(
+        pallas_fdr, "fdr_scan_words",
+        lambda arr, bank, dev_tables=None, interpret=None:
+            orig(arr, bank, dev_tables=dev_tables, interpret=True),
+    )
+    eng = engine_mod.GrepEngine(
+        patterns=[p.decode() for p in pats], ignore_case=True
+    )
+    assert eng.mode == "fdr"
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=True
+    )
+
+
+def test_engine_fdr_kernel_failure_falls_back(monkeypatch):
+    from distributed_grep_tpu.ops import engine as engine_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    pats = _rand_literals(50, 4, 8, seed=10)
+    data = make_text(60, inject=[(7, pats[0] + b" here")])
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(pallas_fdr, "fdr_scan_words", boom)
+    eng = engine_mod.GrepEngine(patterns=[p.decode("latin-1") for p in pats])
+    assert eng.mode == "fdr"
+    res = eng.scan(data)  # must fall back to exact DFA banks, not raise
+    assert eng._fdr_broken
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )
+
+
+def test_engine_cpu_backend_ignores_fdr():
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    pats = _rand_literals(20, 4, 8, seed=11)
+    data = make_text(40, inject=[(3, pats[0])])
+    eng = engine_mod.GrepEngine(
+        patterns=[p.decode("latin-1") for p in pats], backend="cpu"
+    )
+    assert eng.mode == "native" and eng.fdr is None
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )
+
+
+def test_too_dense_set_raises():
+    # thousands of distinct 2-byte literals saturate every table: the model
+    # must refuse (engine then keeps the exact DFA banks)
+    pats = [bytes([a, b]) for a in range(97, 123) for b in range(97, 123)]
+    with pytest.raises(fdr_mod.FdrError):
+        fdr_mod.compile_fdr(pats * 2)
