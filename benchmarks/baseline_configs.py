@@ -174,12 +174,13 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
     from distributed_grep_tpu.ops import layout as layout_mod
     from distributed_grep_tpu.ops import pallas_nfa, pallas_scan, scan_jnp
     from distributed_grep_tpu.utils.slope import (
+        pallas_fdr_setup,
         pallas_nfa_setup,
         pallas_shift_and_setup,
         slope_per_pass,
     )
 
-    if eng.mode not in ("shift_and", "nfa", "dfa"):
+    if eng.mode not in ("shift_and", "nfa", "dfa", "fdr"):
         return None
 
     use_pallas_sa = (
@@ -192,12 +193,18 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
         and pallas_scan.available()
         and pallas_nfa.eligible(eng.glushkov)
     )
+    use_pallas_fdr = eng.mode == "fdr" and pallas_scan.available() and eng.fdr
     if use_pallas_sa:
         label = "pallas_shift_and"
         dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, eng.shift_and)
     elif use_pallas_nfa:
         label = "pallas_nfa"
         dev, chunk, pad_rows, scan = pallas_nfa_setup(data, eng.glushkov)
+    elif use_pallas_fdr:
+        label = f"pallas_fdr_x{len(eng.fdr.banks)}"
+        if eng.ignore_case:
+            data = bytes(data).lower()
+        dev, chunk, pad_rows, scan = pallas_fdr_setup(data, eng.fdr)
     else:
         lay = layout_mod.choose_layout(len(data), target_lanes=4096, min_chunk=64)
         arr = layout_mod.to_device_array(data, lay)

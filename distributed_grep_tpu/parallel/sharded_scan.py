@@ -98,6 +98,104 @@ def _sharded_dfa_scan(
     return out
 
 
+def stack_bank_tables(tables: list[DfaTable], n_shards: int):
+    """Pad + stack per-bank DFA tables for the pattern-parallel step.
+
+    Banks get padded to a common (n_states, n_classes) shape (padding rows
+    are a dead state-0 loop with accept=False, so they can never match) and
+    the bank count is padded to a multiple of n_shards.  Returns
+    (trans_flat (B, S*C) int32, byte_to_cls (B, 256) int32,
+    accept (B, S) bool, starts (B,) int32, n_classes_max)."""
+    s_max = max(t.trans.shape[0] for t in tables)
+    c_max = max(t.n_classes for t in tables)
+    b_pad = -len(tables) % n_shards
+    B = len(tables) + b_pad
+    trans = np.zeros((B, s_max, c_max), dtype=np.int32)
+    b2c = np.zeros((B, 256), dtype=np.int32)
+    accept = np.zeros((B, s_max), dtype=bool)
+    starts = np.zeros(B, dtype=np.int32)
+    for i, t in enumerate(tables):
+        s, c = t.trans.shape
+        trans[i, :s, :c] = t.trans.astype(np.int32)
+        b2c[i] = t.byte_to_cls.astype(np.int32)
+        accept[i, :s] = t.accept
+        starts[i] = t.start
+        if t.accept_eol.any():
+            raise ValueError("pattern-set banks never use accept_eol")
+    return trans.reshape(B, -1), b2c, accept, starts, c_max
+
+
+@partial(jax.jit, static_argnames=("mesh", "data_axis", "pattern_axis", "n_classes"))
+def _sharded_pattern_set_scan(
+    data_cl, trans_flat, b2c, accept, starts, *, mesh, data_axis, pattern_axis, n_classes
+):
+    def body(data_blk, trans_b, b2c_b, accept_b, starts_b):
+        # Each device: its lane block vs its local pattern banks (unrolled —
+        # bank count per device is static).
+        local = trans_b.shape[0]
+        hit = None
+        for i in range(local):
+            init = (data_blk[0] * 0).astype(jnp.int32) + starts_b[i]
+            _, match = scan_jnp.dfa_scan_body(
+                data_blk, trans_b[i], b2c_b[i], accept_b[i],
+                jnp.zeros_like(accept_b[i]), init, n_classes,
+            )
+            hit = match if hit is None else (hit | match)
+        # OR across the pattern axis: psum of the 0/1 plane, then > 0.  This
+        # is the EP-analogue combine — each chip saw only its bank shard.
+        any_hit = jax.lax.psum(hit.astype(jnp.int32), pattern_axis) > 0
+        # any_hit is now invariant over the pattern axis; the global count
+        # only needs the data-axis reduction.
+        count = jax.lax.psum(jnp.count_nonzero(any_hit), data_axis)
+        return scan_jnp._pack_lane_bits(any_hit), count
+
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(None, data_axis),  # lanes sharded over data, replicated over pattern
+            P(pattern_axis), P(pattern_axis), P(pattern_axis), P(pattern_axis),
+        ),
+        out_specs=(P(None, data_axis), P()),
+    )(data_cl, trans_flat, b2c, accept, starts)
+
+
+def sharded_pattern_set_step(
+    data_cl: np.ndarray,
+    tables: list[DfaTable],
+    mesh: Mesh,
+    data_axis: str = "data",
+    pattern_axis: str = "seq",
+):
+    """Pattern-parallel multi-chip scan — the expert-parallel analogue
+    (SURVEY.md §2 parallelism checklist): Hyperscan-style ruleset banks
+    shard across ``pattern_axis`` while document lanes shard across
+    ``data_axis``; each chip scans its lane block against only its banks
+    and the per-position OR rides ICI (psum over the pattern axis).
+
+    Returns (packed_bits (chunk, lanes//8) — the OR over all banks — and
+    the global matched-position count).  Output is exact away from stripe
+    boundaries; boundary lines get the usual host stitching."""
+    n_pat = mesh.shape[pattern_axis]
+    n_dat = mesh.shape[data_axis]
+    chunk, lanes = data_cl.shape
+    if lanes % (n_dat * 8):
+        raise ValueError(f"lanes={lanes} must divide {data_axis}={n_dat} x 8")
+    trans_flat, b2c, accept, starts, c_max = stack_bank_tables(tables, n_pat)
+    dev_arr = jax.device_put(
+        jnp.asarray(data_cl), NamedSharding(mesh, P(None, data_axis))
+    )
+    return _sharded_pattern_set_scan(
+        dev_arr,
+        jnp.asarray(trans_flat), jnp.asarray(b2c),
+        jnp.asarray(accept), jnp.asarray(starts),
+        mesh=mesh, data_axis=data_axis, pattern_axis=pattern_axis,
+        n_classes=c_max,
+    )
+
+
 def sharded_grep_step(
     data_cl: np.ndarray,
     table: DfaTable,

@@ -126,6 +126,34 @@ def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
     return dev, lay.chunk, pad_rows, scan
 
 
+def pallas_fdr_setup(data: bytes, model, *, target_lanes: int = 8192):
+    """Device array + scan closure for slope-timing the Pallas FDR filter
+    banks (ops/pallas_fdr.py) — all banks run per pass and their candidate
+    words OR together, matching what the engine executes per segment."""
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import pallas_fdr
+
+    dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
+    banks = [
+        (b.m, b.domain // pallas_fdr.LANE_COLS,
+         jnp.asarray(pallas_fdr.bank_device_tables(b)))
+        for b in model.banks
+    ]
+
+    def scan(win):
+        words = None
+        for m, n_sub, tabs in banks:
+            w = pallas_fdr._fdr_pallas(
+                win, tabs, m=m, n_sub=n_sub, chunk=lay.chunk,
+                lane_blocks=lane_blocks, interpret=False,
+            )
+            words = w if words is None else words | w
+        return words
+
+    return dev, lay.chunk, pad_rows, scan
+
+
 def pallas_nfa_setup(data: bytes, model, *, target_lanes: int = 8192):
     """Device array + scan closure for slope-timing the Pallas Glushkov NFA
     kernel (ops/pallas_nfa.py) — same layout contract as the shift-and

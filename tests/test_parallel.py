@@ -138,3 +138,72 @@ def test_product_axis_sharding_uses_all_devices():
     }
     assert stitched == expected
     assert int(total) == offsets.size
+
+
+# ------------------------------------------------- pattern-parallel (EP) step
+
+def test_pattern_set_step_matches_oracle():
+    """Banks shard over 'seq' (pattern axis), lanes over 'data': the OR over
+    the pattern axis must equal a single-automaton scan of the whole set."""
+    from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
+    from distributed_grep_tpu.parallel.sharded_scan import sharded_pattern_set_step
+
+    rng = np.random.default_rng(5)
+    pats = sorted(
+        {bytes(rng.choice(list(b"abcdefgh"), size=int(rng.integers(3, 7))).tolist())
+         for _ in range(40)}
+    )
+    # one tiny bank per ~8 patterns -> several banks to shard
+    tables = []
+    for i in range(0, len(pats), 8):
+        tables.extend(compile_aho_corasick_banks(pats[i : i + 8]))
+    assert len(tables) >= 3
+    data = make_text(300, inject=[(5, b"xx " + pats[0] + b" yy"), (250, pats[1])])
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    lay = layout_mod.choose_layout(len(data), target_lanes=64, min_chunk=8)
+    arr = layout_mod.to_device_array(data, lay)
+    packed, total = sharded_pattern_set_step(arr, tables, mesh)
+    offsets = lines_mod.match_offsets_from_packed(np.asarray(packed), lay)
+    assert int(total) >= offsets.size  # total counts padded tail positions too
+    nl = lines_mod.newline_index(data)
+    device_lines = set(np.unique(lines_mod.line_of_offsets(offsets, nl)).tolist())
+
+    def any_bank(line):
+        return any(reference_scan(t, line).size > 0 for t in tables)
+
+    stitched = lines_mod.stitch_lines(
+        device_lines, data, nl, lay.stripe_starts().tolist(), any_bank
+    )
+    expected = {
+        i for i, line in enumerate(data.split(b"\n"), 1)
+        if any(p in line for p in pats)
+    }
+    assert stitched == expected
+
+
+def test_pattern_set_step_bank_padding():
+    """Bank count not divisible by the pattern axis: dead padding banks must
+    contribute nothing."""
+    from distributed_grep_tpu.models.aho import compile_aho_corasick
+    from distributed_grep_tpu.parallel.sharded_scan import sharded_pattern_set_step
+
+    tables = [compile_aho_corasick([b"needle"]), compile_aho_corasick([b"volcano"]),
+              compile_aho_corasick([b"quartz"])]  # 3 banks over a 2-wide axis
+    data = make_text(100, inject=[(3, b"a needle"), (50, b"quartz volcano")])
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    lay = layout_mod.choose_layout(len(data), target_lanes=64, min_chunk=8)
+    arr = layout_mod.to_device_array(data, lay)
+    packed, total = sharded_pattern_set_step(arr, tables, mesh)
+    offsets = lines_mod.match_offsets_from_packed(np.asarray(packed), lay)
+    nl = lines_mod.newline_index(data)
+    got = set(np.unique(lines_mod.line_of_offsets(offsets, nl)).tolist())
+
+    def any_bank(line):
+        return any(reference_scan(t, line).size > 0 for t in tables)
+
+    got = lines_mod.stitch_lines(got, data, nl, lay.stripe_starts().tolist(), any_bank)
+    expected = {
+        i for i, line in enumerate(data.split(b"\n"), 1)
+        if any(p in line for p in (b"needle", b"volcano", b"quartz"))
+    }
+    assert got == expected
