@@ -75,15 +75,44 @@ def cmd_grep(args: argparse.Namespace) -> int:
         print(f"error: no such file: {', '.join(missing)}", file=sys.stderr)
         return 2
 
+    if args.max_errors:
+        if patterns:
+            print("error: --max-errors applies to a single pattern, not -f",
+                  file=sys.stderr)
+            return 2
+        from distributed_grep_tpu.models.approx import MAX_ERRORS
+        from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+
+        if not 1 <= args.max_errors <= MAX_ERRORS:
+            print(f"error: --max-errors must be 1..{MAX_ERRORS}", file=sys.stderr)
+            return 2
+        if try_compile_shift_and(args.pattern, ignore_case=args.ignore_case) is None:
+            print("error: --max-errors needs a literal/class-sequence pattern "
+                  "of <= 32 symbols", file=sys.stderr)
+            return 2
+    use_engine_app = (args.backend or "cpu") in ("tpu", "auto") or args.max_errors
     cfg = JobConfig(
         input_files=[str(Path(f).resolve()) for f in args.files],
+        # --max-errors needs the engine app (approx is an engine mode);
+        # with --backend cpu the engine still runs its host path
         application=(
             "distributed_grep_tpu.apps.grep_tpu"
-            if (args.backend or "cpu") in ("tpu", "auto")
+            if use_engine_app
             else "distributed_grep_tpu.apps.grep"
         ),
         app_options={
             "ignore_case": args.ignore_case,
+            "invert": args.invert,
+            **({"max_errors": args.max_errors} if args.max_errors else {}),
+            # --max-errors with no explicit backend still uses the engine's
+            # device path: without a TPU it runs the XLA approx core on the
+            # CPU jax backend, orders of magnitude faster than the host
+            # oracle loop the engine's "cpu" backend would use.
+            **(
+                {"backend": "cpu"}
+                if use_engine_app and args.backend == "cpu"
+                else {}
+            ),
             **({"patterns": patterns} if patterns else {"pattern": args.pattern}),
         },
         n_reduce=args.n_reduce or 10,
@@ -95,8 +124,22 @@ def cmd_grep(args: argparse.Namespace) -> int:
 
         cfg.work_dir = tempfile.mkdtemp(prefix="dgrep-")
     res = run_job(cfg, n_workers=args.workers)
-    for line in res.sorted_lines():
-        print(line)
+    if args.count:
+        # grep -c: one "<file>:<count>" line per input, in argv order.
+        # Parse the result KEYS with the end-anchored grep-key shape (the
+        # value may itself contain " (line number #"), not the joined lines.
+        counts = {f: 0 for f in cfg.input_files}
+        key_re = re.compile(r"^(.*) \(line number #\d+\)$")
+        for key in res.results:
+            m = key_re.match(key)
+            if m:
+                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+        for f in cfg.input_files:
+            prefix = f"{f}:" if len(cfg.input_files) > 1 else ""
+            print(f"{prefix}{counts[f]}")
+    else:
+        for line in res.sorted_lines():
+            print(line)
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
     return 0
@@ -142,6 +185,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("pattern", nargs="?", default=None)
     p.add_argument("files", nargs="*")
     p.add_argument("-i", "--ignore-case", action="store_true")
+    p.add_argument("-v", "--invert", action="store_true",
+                   help="emit non-matching lines (grep -v)")
+    p.add_argument("--max-errors", type=int, default=0, metavar="K",
+                   help="agrep: match within K edit errors (literal/class "
+                        "patterns, K=1..3)")
+    p.add_argument("-c", "--count", action="store_true",
+                   help="print match counts per file instead of lines (grep -c)")
     p.add_argument(
         "-f", "--patterns-file", default=None,
         help="literal pattern set, one per line (grep -F -f semantics; "

@@ -28,6 +28,7 @@ from distributed_grep_tpu.apps.base import KeyValue
 # per-process, state.
 _pattern: re.Pattern[bytes] | None = re.compile(b"")
 _ac_tables: list | None = None  # Aho-Corasick banks when configured with a set
+_invert: bool = False  # grep -v
 _configured_with: tuple | None = None
 
 
@@ -35,16 +36,19 @@ def configure(
     pattern: str | bytes = b"",
     ignore_case: bool = False,
     patterns: list[str | bytes] | None = None,
+    invert: bool = False,
     **_: object,
 ) -> None:
     """``pattern`` is a regex; ``patterns`` is a literal set (grep -F -f).
     Sets compile to Aho-Corasick banks scanned by the native C DFA scanner
     (a 10k-literal alternation through Python re would be O(set) per byte),
-    keeping the CPU app interchangeable with the TPU app on big rulesets."""
-    global _pattern, _ac_tables, _configured_with
+    keeping the CPU app interchangeable with the TPU app on big rulesets.
+    ``invert`` = grep -v: emit the lines that do NOT match."""
+    global _pattern, _ac_tables, _invert, _configured_with
     if isinstance(pattern, str):
         pattern = pattern.encode("utf-8", "surrogateescape")
-    key = (pattern, ignore_case, tuple(patterns) if patterns else None)
+    _invert = bool(invert)
+    key = (pattern, ignore_case, tuple(patterns) if patterns else None, _invert)
     if key == _configured_with:
         return  # configure runs per task assignment; skip the recompile
     if patterns:
@@ -73,7 +77,7 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     out: list[KeyValue] = []
     for lineno, line in enumerate(lines, start=1):
         hit = (lineno in matched) if matched is not None else _pattern.search(line)
-        if hit:
+        if bool(hit) != _invert:
             out.append(
                 KeyValue(
                     key=f"{filename} (line number #{lineno})",

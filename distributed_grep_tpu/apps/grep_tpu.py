@@ -18,9 +18,10 @@ from __future__ import annotations
 
 from distributed_grep_tpu.apps.base import KeyValue
 from distributed_grep_tpu.ops.engine import GrepEngine
-from distributed_grep_tpu.ops.lines import line_span, newline_index
+from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
 
 _engine: GrepEngine | None = None
+_invert: bool = False  # grep -v
 _configured_with: tuple | None = None
 
 
@@ -29,12 +30,15 @@ def configure(
     ignore_case: bool = False,
     backend: str = "device",
     patterns: list[str] | None = None,
+    invert: bool = False,
     **engine_opts: object,
 ) -> None:
-    global _engine, _configured_with
+    global _engine, _invert, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
-    key = (pattern, ignore_case, backend, tuple(patterns or ()), tuple(sorted(engine_opts.items())))
+    _invert = bool(invert)
+    key = (pattern, ignore_case, backend, tuple(patterns or ()), _invert,
+           tuple(sorted(engine_opts.items())))
     if key == _configured_with:
         return
     _engine = GrepEngine(
@@ -51,11 +55,14 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
     result = _engine.scan(contents)
-    if result.matched_lines.size == 0:
+    emit = result.matched_lines.tolist()
+    if _invert:
+        emit = sorted(set(range(1, count_lines(contents) + 1)) - set(emit))
+    if not emit:
         return []
     nl = newline_index(contents)
     out: list[KeyValue] = []
-    for line_no in result.matched_lines.tolist():
+    for line_no in emit:
         start, end = line_span(nl, line_no, len(contents))
         out.append(
             KeyValue(

@@ -1,0 +1,135 @@
+"""Approximate (edit-distance <= k) matching — the agrep model family.
+
+The reference's grep (application/grep.go) is exact-only; approximate
+matching is the classic extension (agrep / Wu-Manber, "Fast text searching
+allowing errors", CACM 1992) and its bit-parallel formulation is a natural
+fit for the same TPU VPU scan the shift-and engine uses: the automaton
+state becomes k+1 uint32 rows per lane, one per error budget, and a byte
+step is pure shift/and/or arithmetic on those rows — no gathers.
+
+Recurrence (per byte c, rows R_0..R_k, B from the shift-and model):
+
+    R_0' = ((R_0 << 1) | 1) & B[c]
+    R_j' = (((R_j << 1) | 1) & B[c])      exact extension
+         | R_{j-1}                        insertion  (text char inserted)
+         | (R_{j-1} << 1)                 substitution
+         | (R'_{j-1} << 1)                deletion   (pattern char skipped)
+         | ((1 << j) - 1)                 seed: bits < j are always live
+                                          (prefix p[0..i] reaches any text
+                                          position within i+1 <= j edits)
+
+Bit i of R_j = "pattern prefix p[0..i] matches a suffix of the text read
+so far with <= j errors"; a match ends wherever bit m-1 of R_k is set.
+
+Line semantics: grep matches within lines, so every '\n' resets the rows
+to their line-start seeds R_j = (1<<j)-1 *before* the match check — an
+errorful match can never span or consume a newline.  Patterns with length <= k degenerate to "every line matches"
+(delete the whole pattern); the engine short-circuits that case exactly
+like an empty-regex pattern.
+
+Eligibility: any shift-and-eligible pattern (literal / class sequence,
+<= 32 symbols) with 1 <= k < length, k <= MAX_ERRORS.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
+
+NL = 0x0A
+MAX_ERRORS = 3  # k+1 state rows per lane; beyond this the DFA product blows up
+
+
+@dataclass
+class ApproxModel:
+    """Shift-and B-masks plus an error budget."""
+
+    base: ShiftAndModel
+    k: int
+
+    @property
+    def length(self) -> int:
+        return self.base.length
+
+    @property
+    def match_bit(self) -> np.uint32:
+        return self.base.match_bit
+
+    @property
+    def seeds(self) -> list[int]:
+        """Line-start row seeds: R_j starts with j leading deletions."""
+        return [(1 << j) - 1 for j in range(self.k + 1)]
+
+
+def try_compile_approx(
+    pattern: str, k: int, ignore_case: bool = False
+) -> ApproxModel | None:
+    """Compile if `pattern` is shift-and-eligible and 1 <= k < length."""
+    if not 1 <= k <= MAX_ERRORS:
+        return None
+    base = try_compile_shift_and(pattern, ignore_case=ignore_case)
+    if base is None or base.length <= k:
+        return None
+    return ApproxModel(base=base, k=k)
+
+
+def scan_reference(model: ApproxModel, data: bytes) -> np.ndarray:
+    """Host oracle: match end offsets (i+1 convention), one stripe.
+
+    Python-int implementation of the exact kernel recurrence — used for
+    boundary-line re-scans and as the test reference.
+    """
+    b_table = model.base.b_table
+    mb = int(model.match_bit)
+    k = model.k
+    seeds = model.seeds
+    R = list(seeds)
+    out = []
+    for i, c in enumerate(data):
+        if c == NL:
+            R = list(seeds)
+        else:
+            b = int(b_table[c])
+            prev = R
+            new = [((prev[0] << 1) | 1) & b]
+            for j in range(1, k + 1):
+                new.append(
+                    ((((prev[j] << 1) | 1) & b)
+                     | prev[j - 1]
+                     | (prev[j - 1] << 1)
+                     | (new[j - 1] << 1)
+                     | seeds[j]) & 0xFFFFFFFF
+                )
+            R = new
+        if R[k] & mb:
+            out.append(i + 1)
+    return np.asarray(out, dtype=np.int64)
+
+
+def line_matches(model: ApproxModel, line: bytes) -> bool:
+    """Does this (newline-free) line contain a <= k-error match?"""
+    return scan_reference(model, line).size > 0
+
+
+def dp_oracle_line(pattern_syms: list[list[tuple[int, int]]], line: bytes, k: int) -> bool:
+    """Independent O(n*m) edit-distance-substring oracle for tests: does
+    some substring of `line` match the symbol sequence within k edits?
+    Symbols are the shift-and (lo, hi) range lists."""
+    m = len(pattern_syms)
+    prev = list(range(m + 1))  # D[0][j] = j (deletions); free start in text
+    best = prev[m]
+    for c in line:
+        cur = [0] * (m + 1)  # free start: D[i][0] = 0
+        for j in range(1, m + 1):
+            hit = any(lo <= c <= hi for lo, hi in pattern_syms[j - 1])
+            cur[j] = min(
+                prev[j - 1] + (0 if hit else 1),  # match / substitution
+                prev[j] + 1,  # insertion (extra text char)
+                cur[j - 1] + 1,  # deletion (skip pattern char)
+            )
+        best = min(best, cur[m])
+        prev = cur
+    return best <= k

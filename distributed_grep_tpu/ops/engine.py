@@ -17,6 +17,10 @@ interface):
    the subset (e.g. newline-consuming) — the reference's own strategy
    (application/grep.go:20-30), kept as the escape hatch.
 
+Orthogonal modes: ``fdr`` (large literal sets — Pallas bucket filter +
+exact host confirm, models/fdr.py) and ``approx`` (``max_errors=k`` agrep
+matching — k+1-row bit-parallel recurrence, models/approx.py).
+
 Large documents are scanned in segments (bounded device memory — the
 reference instead reads whole files and cannot handle files larger than
 RAM, worker.go:72-76); segment starts and stripe starts are boundary
@@ -39,6 +43,13 @@ from distributed_grep_tpu.models.dfa import (
     choose_stride,
     compile_dfa,
     reference_scan,
+)
+from distributed_grep_tpu.models.approx import (
+    MAX_ERRORS,
+    ApproxModel,
+    line_matches as approx_line_matches,
+    scan_reference as approx_scan_reference,
+    try_compile_approx,
 )
 from distributed_grep_tpu.models.nfa import GlushkovModel, try_compile_glushkov
 from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
@@ -70,6 +81,7 @@ class GrepEngine:
         patterns: list[str] | None = None,  # multi-literal set -> Aho-Corasick
         ignore_case: bool = False,
         backend: str = "device",  # "device" (jnp/pallas) | "cpu" (host re/native)
+        max_errors: int = 0,  # agrep: match within <= k edit errors
         target_lanes: int = 1024,
         segment_bytes: int = 64 * 1024 * 1024,
         max_states: int = 4096,
@@ -77,6 +89,8 @@ class GrepEngine:
     ):
         if (pattern is None) == (patterns is None):
             raise ValueError("exactly one of pattern / patterns is required")
+        if max_errors and patterns is not None:
+            raise ValueError("max_errors applies to a single pattern, not a set")
         self.backend = backend
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
@@ -95,8 +109,33 @@ class GrepEngine:
         self._fdr_short: list[DfaTable] = []
         self._fdr_dev_tables: list | None = None
         self._fdr_broken = False
+        self.approx: ApproxModel | None = None
+        self._approx_all_lines = False
 
-        if patterns is not None:
+        if max_errors:
+            # agrep family (models/approx.py): literal/class-sequence
+            # patterns only — the k-error recurrence rides the shift-and
+            # symbol model.
+            self.pattern = pattern
+            if not 1 <= max_errors <= MAX_ERRORS:
+                raise ValueError(f"max_errors must be 1..{MAX_ERRORS}")
+            base = try_compile_shift_and(pattern, ignore_case=ignore_case)
+            if base is None:
+                raise ValueError(
+                    "approximate matching needs a literal/class-sequence "
+                    "pattern of <= 32 symbols (no anchors/alternation/repeats)"
+                )
+            if base.length <= max_errors:
+                # deleting the whole pattern costs <= k edits: every line
+                # (incl. empty ones) contains a match — like an empty regex
+                self._approx_all_lines = True
+            else:
+                self.approx = try_compile_approx(
+                    pattern, max_errors, ignore_case=ignore_case
+                )
+                assert self.approx is not None
+            self.mode = "approx"
+        elif patterns is not None:
             self.pattern = f"<set of {len(patterns)}>"
             # Exact AC banks always exist: they are the CPU/native engine,
             # the DFA-bank device fallback, AND the host confirm oracle for
@@ -156,10 +195,12 @@ class GrepEngine:
     def scan(self, data: bytes) -> ScanResult:
         if self.mode == "re":
             return self._scan_re(data)
-        if self.tables and any(t.accept[t.start] for t in self.tables):
+        if self._approx_all_lines or (
+            self.tables and any(t.accept[t.start] for t in self.tables)
+        ):
             # Pattern matches the empty string -> every line matches (grep
             # semantics); also sidesteps empty-match bookkeeping on device.
-            n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") or not data else 1)
+            n_lines = lines_mod.count_lines(data)
             return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
         if self.mode == "native":
             return self._scan_native(data)
@@ -174,15 +215,24 @@ class GrepEngine:
         return ScanResult(np.asarray(matched, dtype=np.int64), len(matched), len(data))
 
     def _scan_native(self, data: bytes) -> ScanResult:
-        offsets = np.unique(np.concatenate(
-            [reference_scan(t, data) for t in self.tables]
-        )) if self.tables else np.zeros(0, dtype=np.int64)
+        if self.approx is not None:
+            # host oracle (python recurrence) — correct, not a perf path;
+            # the device XLA/Pallas cores are the fast approx engines
+            offsets = approx_scan_reference(self.approx, data)
+        elif self.tables:
+            offsets = np.unique(np.concatenate(
+                [reference_scan(t, data) for t in self.tables]
+            ))
+        else:
+            offsets = np.zeros(0, dtype=np.int64)
         nl = lines_mod.newline_index(data)
         lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
             np.zeros(0, dtype=np.int64)
         return ScanResult(lns.astype(np.int64), int(offsets.size), len(data))
 
     def _host_line_matcher(self, line: bytes) -> bool:
+        if self.approx is not None:
+            return approx_line_matches(self.approx, line)
         return any(reference_scan(t, line).size > 0 for t in self.tables)
 
     def _device_tables(self) -> list[tuple]:
@@ -237,7 +287,12 @@ class GrepEngine:
         boundaries: list[int] = []
         n_matches = 0
         seg = self.segment_bytes
-        from distributed_grep_tpu.ops import pallas_fdr, pallas_nfa, pallas_scan
+        from distributed_grep_tpu.ops import (
+            pallas_approx,
+            pallas_fdr,
+            pallas_nfa,
+            pallas_scan,
+        )
 
         use_pallas_sa = (
             self.mode == "shift_and"
@@ -257,7 +312,12 @@ class GrepEngine:
         use_fdr = (
             self.mode == "fdr" and not self._fdr_broken and pallas_scan.available()
         )
-        use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr
+        use_pallas_approx = (
+            self.mode == "approx"
+            and pallas_scan.available()
+            and pallas_approx.eligible(self.approx)
+        )
+        use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
         for seg_start in range(0, max(len(data), 1), seg):
             seg_bytes = data[seg_start : seg_start + seg]
             if use_fdr and self.ignore_case:
@@ -300,12 +360,18 @@ class GrepEngine:
             elif use_pallas:
                 if use_pallas_sa:
                     words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                elif use_pallas_approx:
+                    words = pallas_approx.approx_scan_words(arr, self.approx)
                 else:
                     words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
                 idx, vals = scan_jnp.sparse_nonzero(words)
                 offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
             elif self.mode == "shift_and":
                 packed = scan_jnp.shift_and_scan(arr, self.shift_and)
+                idx, vals = scan_jnp.sparse_nonzero(packed)
+                offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+            elif self.mode == "approx":
+                packed = scan_jnp.approx_scan(arr, self.approx)
                 idx, vals = scan_jnp.sparse_nonzero(packed)
                 offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
             else:

@@ -84,3 +84,11 @@ def stitch_lines(
 def newline_index(data: bytes) -> np.ndarray:
     """Byte offsets of every '\\n' (native fast path)."""
     return native.newline_index(data).astype(np.int64)
+
+
+def count_lines(data: bytes) -> int:
+    """Line count with grep -n semantics: a trailing '\\n' closes the last
+    line rather than opening an empty one; empty input has zero lines."""
+    if not data:
+        return 0
+    return data.count(b"\n") + (0 if data.endswith(b"\n") else 1)

@@ -1,0 +1,164 @@
+"""Pallas TPU kernel: agrep approximate (<= k edit errors) byte scan.
+
+Same shell as ops/pallas_scan.py (lanes x chunk tiles, range-compare
+B-masks, time-packed uint32 match words, VMEM scratch carried across chunk
+blocks) but the per-byte step is the Wu-Manber k-error recurrence from
+models/approx.py: k+1 uint32 state rows per lane, ~6 extra VPU ops per
+error level — so k=1..3 stays within a small factor of the exact
+shift-and kernel's throughput instead of paying a DFA-product blowup.
+
+Newlines reset the rows to their seeds before the match check (grep line
+semantics: an errorful match never spans or consumes '\n'); stripe starts
+use the same seeds, and boundary lines get the usual exact host re-scan
+(models/approx.scan_reference is the oracle the engine stitches with).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.approx import MAX_ERRORS, ApproxModel
+from distributed_grep_tpu.ops.pallas_scan import (
+    CHUNK_BLOCK_WORDS,
+    LANE_COLS,
+    LANES_PER_BLOCK,
+    MAX_TOTAL_RANGES,
+    SUBLANES,
+    available,
+)
+
+NL = 0x0A
+
+
+def eligible(model: ApproxModel) -> bool:
+    return model.base.total_ranges <= MAX_TOTAL_RANGES and model.k <= MAX_ERRORS
+
+
+def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, k, steps):
+    from jax.experimental import pallas as pl  # deferred: import cost
+
+    ci = pl.program_id(1)
+    seeds = [jnp.uint32((1 << j) - 1) for j in range(k + 1)]
+
+    @pl.when(ci == 0)
+    def _init():
+        for j in range(k + 1):
+            state_ref[j] = jnp.full((SUBLANES, LANE_COLS), seeds[j], jnp.uint32)
+
+    zero = jnp.uint32(0)
+    one = jnp.uint32(1)
+
+    def word_body(w, carry):
+        R = list(carry)
+        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        for t in range(32):
+            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
+            bmask = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+            for j, ranges in enumerate(sym_ranges):
+                bit = jnp.uint32(1 << j)
+                hit = None
+                for lo, hi in ranges:
+                    r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                    hit = r if hit is None else (hit | r)
+                bmask = bmask | jnp.where(hit, bit, zero)
+            new = [((R[0] << one) | one) & bmask]
+            for j in range(1, k + 1):
+                new.append(
+                    (((R[j] << one) | one) & bmask)
+                    | R[j - 1]
+                    | (R[j - 1] << one)
+                    | (new[j - 1] << one)
+                    | seeds[j]
+                )
+            nl_m = zero - (b == NL).astype(jnp.uint32)  # all-ones at '\n'
+            R = [(nl_m & seeds[j]) | (~nl_m & new[j]) for j in range(k + 1)]
+            m = (R[k] & jnp.uint32(match_bit)) != 0
+            word = word | jnp.where(m, jnp.uint32(1 << t), zero)
+        out_ref[w] = word
+        return tuple(R)
+
+    carry0 = tuple(state_ref[j] for j in range(k + 1))
+    final = jax.lax.fori_loop(0, steps // 32, word_body, carry0)
+    for j in range(k + 1):
+        state_ref[j] = final[j]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sym_ranges", "match_bit", "k", "chunk", "lane_blocks", "interpret"),
+)
+def _approx_pallas(data, *, sym_ranges, match_bit, k, chunk, lane_blocks, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = 32 * CHUNK_BLOCK_WORDS
+    chunk_blocks = chunk // steps
+    kernel = functools.partial(
+        _kernel, sym_ranges=sym_ranges, match_bit=match_bit, k=k, steps=steps
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (steps, SUBLANES, LANE_COLS),
+                lambda li, ci: (ci, li, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), jnp.uint32
+        ),
+        scratch_shapes=[pltpu.VMEM((k + 1, SUBLANES, LANE_COLS), jnp.uint32)],
+        interpret=interpret,
+    )(data)
+
+
+def approx_scan_words(
+    arr_cl: np.ndarray, model: ApproxModel, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Run the kernel; time-packed match words in the shared Pallas
+    convention (decode via ops/sparse.offsets_from_sparse_words)."""
+    chunk, lanes = arr_cl.shape
+    steps = 32 * CHUNK_BLOCK_WORDS
+    if lanes % LANES_PER_BLOCK or chunk % steps:
+        raise ValueError(
+            f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0"
+        )
+    if not eligible(model):
+        raise ValueError("model exceeds the pallas approx budget")
+    lane_blocks = lanes // LANES_PER_BLOCK
+    data = np.ascontiguousarray(
+        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
+    )
+    if interpret is None:
+        interpret = not available()
+    return _approx_pallas(
+        jnp.asarray(data),
+        sym_ranges=tuple(tuple(r) for r in model.base.sym_ranges),
+        match_bit=int(model.match_bit),
+        k=model.k,
+        chunk=chunk,
+        lane_blocks=lane_blocks,
+        interpret=interpret,
+    )
+
+
+def approx_scan(
+    arr_cl: np.ndarray, model: ApproxModel, interpret: bool | None = None
+) -> np.ndarray:
+    """Dense-output wrapper (tests): packed bits in the scan_jnp convention."""
+    from distributed_grep_tpu.ops.pallas_scan import _unpack_words_to_lane_bits
+
+    chunk, lanes = arr_cl.shape
+    words = approx_scan_words(arr_cl, model, interpret)
+    return _unpack_words_to_lane_bits(np.asarray(words), chunk, lanes)

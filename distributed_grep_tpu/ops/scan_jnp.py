@@ -215,3 +215,47 @@ def sparse_nonzero(packed_dev) -> tuple[np.ndarray, np.ndarray]:
     idx = np.asarray(idx)[:nnz].astype(np.int64)
     vals = np.asarray(vals)[:nnz]
     return idx, vals
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _approx_scan_core(
+    data_cl: jnp.ndarray,  # (chunk, lanes) uint8
+    b_table: jnp.ndarray,  # (256,) uint32
+    match_bit: jnp.ndarray,  # () uint32
+    k: int,
+) -> jnp.ndarray:
+    """agrep <= k-error scan (models/approx.py recurrence), lane-parallel:
+    k+1 uint32 rows per lane, newline-reset before the match check so
+    errorful matches never span lines."""
+    b_all = b_table[data_cl.astype(jnp.int32)]  # (chunk, lanes) uint32
+    is_nl = data_cl == NL
+    lanes = data_cl.shape[1]
+    seeds = [jnp.uint32((1 << j) - 1) for j in range(k + 1)]
+    init = tuple(jnp.full((lanes,), s, dtype=jnp.uint32) for s in seeds)
+
+    def step(R, inputs):
+        b_row, nl_row = inputs
+        new = [((R[0] << jnp.uint32(1)) | jnp.uint32(1)) & b_row]
+        for j in range(1, k + 1):
+            new.append(
+                (((R[j] << jnp.uint32(1)) | jnp.uint32(1)) & b_row)
+                | R[j - 1]
+                | (R[j - 1] << jnp.uint32(1))
+                | (new[j - 1] << jnp.uint32(1))
+                | seeds[j]
+            )
+        new = [jnp.where(nl_row, seeds[j], new[j]) for j in range(k + 1)]
+        return tuple(new), (new[k] & match_bit) != 0
+
+    _, match = jax.lax.scan(step, init, (b_all, is_nl))
+    return _pack_lane_bits(match)
+
+
+def approx_scan(data_cl: np.ndarray, model) -> jnp.ndarray:
+    """Packed match bits for the approximate model (see dfa_scan)."""
+    return _approx_scan_core(
+        jnp.asarray(data_cl),
+        jnp.asarray(model.base.b_table),
+        jnp.uint32(model.match_bit),
+        model.k,
+    )

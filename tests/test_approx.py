@@ -1,0 +1,143 @@
+"""Approximate (agrep <= k errors) matching: model vs an independent DP
+edit-distance oracle, XLA core vs model reference, Pallas kernel
+(interpret) vs XLA core, and the engine end-to-end including newline
+resets and stripe boundaries."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models import approx as ax
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import pallas_approx, scan_jnp
+from distributed_grep_tpu.ops.engine import GrepEngine
+
+from tests.test_ops import make_text
+
+
+# ------------------------------------------------------------------- model
+
+def test_model_vs_dp_oracle_fuzz():
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        m = int(rng.integers(3, 12))
+        k = int(rng.integers(1, min(3, m - 1) + 1))
+        pat = "".join(chr(c) for c in rng.integers(97, 103, m))
+        model = ax.try_compile_approx(pat, k)
+        assert model is not None
+        line = bytes(rng.integers(97, 104, int(rng.integers(0, 30))).tolist())
+        assert ax.line_matches(model, line) == ax.dp_oracle_line(
+            model.base.sym_ranges, line, k
+        ), (pat, k, line)
+
+
+def test_model_class_pattern_and_cases():
+    model = ax.try_compile_approx("h[ae]llo", 1)
+    cases = [(b"hallo", True), (b"hxllo", True), (b"hxlxo", False),
+             (b"xxhelloxx", True), (b"helo", True), (b"heelloo", True),
+             (b"hello", True), (b"", False)]
+    for line, want in cases:
+        assert ax.line_matches(model, line) == want, line
+
+
+def test_newline_never_spanned():
+    model = ax.try_compile_approx("abcd", 1)
+    # 'ab\ncd' — an error budget of 1 must not bridge the newline
+    assert ax.scan_reference(model, b"ab\ncd").size == 0
+    # but each line is scanned independently
+    assert ax.scan_reference(model, b"abcd\nabxd\n").size >= 2
+
+
+def test_compile_bounds():
+    assert ax.try_compile_approx("abc", 3) is None  # k >= length
+    assert ax.try_compile_approx("abcdef", 4) is None  # k > MAX_ERRORS
+    assert ax.try_compile_approx("a(b|c)d", 1) is None  # not shift-and-able
+    assert ax.try_compile_approx("abcdef", 2) is not None
+
+
+# --------------------------------------------------------------- XLA core
+
+def _lay_arr(data):
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512,
+        lane_multiple=4096, chunk_multiple=512,
+    )
+    return lay, layout_mod.to_device_array(data, lay)
+
+
+def test_xla_core_matches_reference_per_stripe():
+    model = ax.try_compile_approx("needle", 1)
+    data = make_text(200, inject=[(3, b"a needxe here"), (100, b"nedle and needles"),
+                                  (199, b"needle")])
+    lay, arr = _lay_arr(data)
+    packed = np.asarray(scan_jnp.approx_scan(arr, model))
+    want = np.zeros((lay.chunk, lay.lanes), dtype=bool)
+    for lane in range(lay.lanes):
+        stripe = bytes(arr[:, lane])
+        ends = ax.scan_reference(model, stripe)
+        want[(ends - 1), lane] = True
+    np.testing.assert_array_equal(packed, np.packbits(want, axis=1, bitorder="little"))
+
+
+# ----------------------------------------------------------- pallas kernel
+
+@pytest.mark.parametrize("pattern,k", [("needle", 1), ("volcano", 2), ("h[ae]llo", 1)])
+def test_pallas_interpret_matches_xla(pattern, k):
+    model = ax.try_compile_approx(pattern, k)
+    assert model is not None and pallas_approx.eligible(model)
+    data = make_text(
+        120,
+        inject=[(5, b"needxe volcxno hxllo"), (60, b"nedle volano hallo"),
+                (119, b"the needle")],
+    )
+    lay, arr = _lay_arr(data)
+    got = pallas_approx.approx_scan(arr, model, interpret=True)
+    want = np.asarray(scan_jnp.approx_scan(arr, model))
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------------------- engine
+
+def _oracle_lines(model, data):
+    return {
+        i for i, line in enumerate(data.split(b"\n"), 1)
+        if ax.dp_oracle_line(model.base.sym_ranges, line, model.k)
+    }
+
+
+def test_engine_approx_end_to_end():
+    data = make_text(300, inject=[(4, b"a needxe in line"), (150, b"nedle"),
+                                  (299, b"needle exact")])
+    eng = GrepEngine("needle", max_errors=1)
+    assert eng.mode == "approx"
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == _oracle_lines(eng.approx, data)
+
+
+def test_engine_approx_ignore_case():
+    data = b"NEEDLE\nNEDLE\nnothing\nNeEdLx\n"
+    eng = GrepEngine("needle", max_errors=1, ignore_case=True)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == {1, 2, 4}
+
+
+def test_engine_approx_all_lines_when_k_ge_len():
+    eng = GrepEngine("ab", max_errors=2)
+    res = eng.scan(b"xx\nyy\n")
+    assert res.matched_lines.tolist() == [1, 2]
+
+
+def test_engine_approx_cpu_backend():
+    data = b"needle\nnedle\nno\n"
+    eng = GrepEngine("needle", max_errors=1, backend="cpu")
+    assert set(eng.scan(data).matched_lines.tolist()) == {1, 2}
+
+
+def test_engine_approx_rejects():
+    with pytest.raises(ValueError):
+        GrepEngine("a(b|c)+", max_errors=1)
+    with pytest.raises(ValueError):
+        GrepEngine(patterns=["ab", "cd"], max_errors=1)
+    with pytest.raises(ValueError):
+        GrepEngine("abcdef", max_errors=9)

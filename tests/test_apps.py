@@ -93,3 +93,15 @@ def test_grep_cpu_pattern_set_uses_ac():
     assert [kv.key for kv in out] == [
         "f (line number #1)", "f (line number #3)"
     ]
+
+
+def test_grep_invert_both_apps():
+    from distributed_grep_tpu.apps import grep as cpu_app
+    from distributed_grep_tpu.apps import grep_tpu as tpu_app
+
+    data = b"hello world\nno match here\nhello again\nplain\n"
+    cpu_app.configure(pattern="hello", invert=True)
+    tpu_app.configure(pattern="hello", invert=True, backend="cpu")
+    want = ["f (line number #2)", "f (line number #4)"]
+    assert [kv.key for kv in cpu_app.map_fn("f", data)] == want
+    assert [kv.key for kv in tpu_app.map_fn("f", data)] == want
