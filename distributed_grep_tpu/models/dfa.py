@@ -434,8 +434,17 @@ class DfaTable:
         return self.trans.shape[1]
 
     def full_table(self) -> np.ndarray:
-        """[n_states, 256] uint16 — for the native/C++ scanner oracle."""
-        return np.ascontiguousarray(self.trans[:, self.byte_to_cls])
+        """[n_states, 256] uint16 — for the native/C++ scanner oracle.
+
+        Cached: a 10k-pattern Aho-Corasick bank densifies to ~30 MB, and
+        the engine's per-line confirm/stitch path calls this once per
+        suspect line."""
+        full = getattr(self, "_full_cache", None)
+        if full is None:
+            full = np.ascontiguousarray(self.trans[:, self.byte_to_cls])
+            full.flags.writeable = False  # shared across calls
+            object.__setattr__(self, "_full_cache", full)
+        return full
 
 
 @dataclass

@@ -43,16 +43,32 @@ NL = 0x0A
 N_BUCKETS = 32
 MAX_M = 5  # pair checks per position; window = MAX_M + 1 bytes
 DOMAINS = (128, 256, 512)  # kernel gathers per lookup = D / 128
-HASH_A, HASH_B = 37, 101
+# Two independent pair hashes: ANDing both lookups squares the per-check
+# density (d -> d1*d2), which beats adding banks for dense full-alphabet
+# sets (a 10k Snort-style set needs 12 single-hash banks but only 2
+# two-hash banks for the same FP) at 2x the per-bank lookup cost.
+HASHES = ((37, 101), (171, 59))
 # Sets whose best achievable candidate rate is still above this are not
 # worth filtering (the host confirm would dominate): compile_fdr raises and
 # the engine keeps the exact DFA banks instead.
 FP_CEILING_PER_BYTE = 1e-2
+# Mosaic compile ceiling, measured on TPU v5e (2026-07-30): kernels up to 24
+# lane-gathers per byte compile; 32 (e.g. m=4 x D=512 x 2 hashes) crash the
+# compiler.  The tuner never emits a bank over this.
+MAX_GATHERS = 24
+# Total-cost model for the tuner, per scanned byte: one scan_cost unit
+# costs ~2.1 ps on v5e (calibrated: a 480-unit 12-bank config measured
+# 1.0 GB/s), and one expected candidate costs ~120 ns of host confirm
+# (~120-byte line re-scanned by the native DFA at ~1 GB/s).  The optimum
+# trades filter passes against confirm work instead of chasing a fixed FP.
+COST_PS_PER_UNIT = 2.1
+CONFIRM_PS_PER_CANDIDATE = 120_000.0
 
 
-def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int):
+def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int, which: int = 0):
     """The kernel's pair-domain hash — shared host/device definition."""
-    return ((b0 * HASH_A) ^ (b1 * HASH_B)) & (domain - 1)
+    a, b = HASHES[which]
+    return ((b0 * a) ^ (b1 * b)) & (domain - 1)
 
 
 class FdrError(ValueError):
@@ -61,13 +77,18 @@ class FdrError(ValueError):
 
 @dataclass(frozen=True)
 class FdrBank:
-    """One filter pass: m pair-position reach tables over a D-entry domain."""
+    """One filter pass: m pair-position reach tables over a D-entry domain,
+    optionally ANDed across two independent hashes."""
 
     m: int  # pair checks (window = m+1 bytes)
     domain: int  # table entries; D/128 lane-gathers per lookup
-    tables: np.ndarray  # (m, domain) uint32 bucket masks
+    tables: np.ndarray  # (n_hashes, m, domain) uint32 bucket masks
     patterns: list[bytes]  # normalized members (for debugging/repr)
     fp_per_byte: float  # expected candidate rate on uniform bytes
+
+    @property
+    def n_hashes(self) -> int:
+        return self.tables.shape[0]
 
     @property
     def n_subtables(self) -> int:
@@ -75,7 +96,7 @@ class FdrBank:
 
     def scan_cost(self) -> int:
         """Relative per-byte device cost (gathers dominate)."""
-        return self.m * (2 * self.n_subtables + 2)
+        return self.m * self.n_hashes * (2 * self.n_subtables + 2)
 
 
 @dataclass(frozen=True)
@@ -110,8 +131,8 @@ def _normalize(patterns: list[str | bytes], ignore_case: bool) -> list[bytes]:
     return out
 
 
-def _bank_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
-    """Build (m, domain) uint32 reach tables for one bank.
+def _bank_tables(group: list[bytes], m: int, domain: int, n_hashes: int) -> np.ndarray:
+    """Build (n_hashes, m, domain) uint32 reach tables for one bank.
 
     Bucket assignment sorts patterns by their final-pair hash so literals
     sharing a tail land in the same bucket — distinct hashes per (bucket,
@@ -122,7 +143,7 @@ def _bank_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
         range(len(group)),
         key=lambda i: int(pair_hash(group[i][-2], group[i][-1], domain)),
     )
-    tables = np.zeros((m, domain), dtype=np.uint32)
+    tables = np.zeros((n_hashes, m, domain), dtype=np.uint32)
     n = len(group)
     for rank, i in enumerate(order):
         p = group[i]
@@ -130,43 +151,73 @@ def _bank_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
         bit = np.uint32(1 << bucket)
         for k in range(m):
             # Pipeline slot k is applied k steps after the oldest check, so
-            # tables[k] holds the pair at depth m-1-k from the pattern end:
-            # candidate(t) = AND_k tables[k][h_{t-(m-1-k)}], and the pair at
-            # depth d ends exactly at byte t-d.
+            # tables[:, k] holds the pair at depth m-1-k from the pattern
+            # end: candidate(t) = AND_k AND_h tables[h, k][hash_h(pair at
+            # t-(m-1-k))], and the pair at depth d ends exactly at byte t-d.
             d = m - 1 - k
             b0, b1 = p[len(p) - 2 - d], p[len(p) - 1 - d]
-            tables[k, int(pair_hash(b0, b1, domain))] |= bit
+            for h in range(n_hashes):
+                tables[h, k, int(pair_hash(b0, b1, domain, which=h))] |= bit
     return tables
 
 
 def _fp_estimate(tables: np.ndarray) -> float:
     """Expected candidate probability per byte on uniform random pairs:
-    sum over buckets of prod over positions of that bucket's density."""
-    m, domain = tables.shape
-    bits = (tables[:, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
-    dens = bits.sum(axis=1) / domain  # (m, N_BUCKETS)
-    return float(np.prod(dens, axis=0).sum())
+    sum over buckets of prod over (position, hash) of that bucket's
+    density (the two hashes of one pair are treated as independent)."""
+    n_hashes, m, domain = tables.shape
+    bits = (tables[:, :, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
+    dens = bits.sum(axis=2) / domain  # (n_hashes, m, N_BUCKETS)
+    return float(np.prod(dens.reshape(n_hashes * m, N_BUCKETS), axis=0).sum())
 
 
 def _compile_group(
     group: list[bytes], m: int, fp_budget: float, max_banks: int
 ) -> list[FdrBank]:
-    """Pick (domain, n_banks) for one length-stratified group: the cheapest
-    configuration whose exact FP estimate meets the budget, else min-FP."""
-    candidates = []
+    """Pick (domain, n_hashes, n_banks) for one length-stratified group by
+    minimizing the total-cost model (scan + expected confirm) subject to
+    the FP budget, with a statistical prescreen so only the most promising
+    few configurations pay for an exact table build."""
+
+    def total_ps(cost_units: float, fp: float) -> float:
+        return cost_units * COST_PS_PER_UNIT + fp * CONFIRM_PS_PER_CANDIDATE
+
+    prescreen = []
     for domain in DOMAINS:
-        for n_banks in (1, 2, 4, 8, 16, 32):
-            if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * 4):
-                continue
-            cost = n_banks * m * (2 * (domain // 128) + 2)
-            candidates.append((cost, domain, n_banks))
-    candidates.sort()
-    best: tuple[float, list[FdrBank]] | None = None
-    for _cost, domain, n_banks in candidates:
+        for n_hashes in (1, 2):
+            if n_hashes * m * (domain // 128) > MAX_GATHERS:
+                continue  # measured Mosaic compile ceiling
+            for n_banks in (1, 2, 4, 8, 16, 32):
+                if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * 4):
+                    continue
+                cost = n_banks * m * n_hashes * (2 * (domain // 128) + 2)
+                # statistical density: distinct-pair collisions into D slots
+                per_bucket = max(1, -(-len(group) // (n_banks * N_BUCKETS)))
+                d_est = 1.0 - (1.0 - 1.0 / domain) ** per_bucket
+                fp_est = n_banks * N_BUCKETS * d_est ** (m * n_hashes)
+                prescreen.append(
+                    (total_ps(cost, fp_est), cost, domain, n_hashes, n_banks)
+                )
+    prescreen.sort()
+    # exact-build set: best few by estimated total, plus the lowest
+    # estimated-FP configs so a tight explicit budget stays satisfiable
+    by_fp = sorted(
+        prescreen,
+        key=lambda t: t[0] - t[1] * COST_PS_PER_UNIT,  # confirm term only
+    )
+    chosen, seen = [], set()
+    for entry in prescreen[:4] + by_fp[:2]:
+        if entry[2:] not in seen:
+            seen.add(entry[2:])
+            chosen.append(entry)
+    best: tuple[float, float, list[FdrBank]] | None = None  # (key0, key1, banks)
+
+    def try_config(cost, domain, n_hashes, n_banks):
+        nonlocal best
         shards = [group[i::n_banks] for i in range(n_banks)]
         banks = []
         for shard in shards:
-            tables = _bank_tables(shard, m, domain)
+            tables = _bank_tables(shard, m, domain, n_hashes)
             banks.append(
                 FdrBank(
                     m=m,
@@ -177,12 +228,25 @@ def _compile_group(
                 )
             )
         fp = sum(b.fp_per_byte for b in banks)
-        if fp <= fp_budget:
-            return banks
-        if best is None or fp < best[0]:
-            best = (fp, banks)
+        total = total_ps(cost, fp)
+        # prefer configurations within budget; among those, min total cost;
+        # if none fits the budget, min FP keeps the confirm bounded
+        key = (0, total) if fp <= fp_budget else (1, fp)
+        if best is None or key < (best[0], best[1]):
+            best = (key[0], key[1], banks)
+
+    for _, cost, domain, n_hashes, n_banks in chosen:
+        try_config(cost, domain, n_hashes, n_banks)
+    if best is not None and best[0] == 1 and best[1] > FP_CEILING_PER_BYTE:
+        # the statistical prescreen can misrank skewed sets (duplicate
+        # tails); before compile_fdr gives up and strands the engine on the
+        # slow DFA path, exhaustively build the remaining configurations
+        for entry in prescreen:
+            if entry[2:] not in seen:
+                seen.add(entry[2:])
+                try_config(*entry[1:])
     assert best is not None
-    return best[1]
+    return best[2]
 
 
 def compile_fdr(
@@ -244,8 +308,11 @@ def reference_candidates(bank: FdrBank, data: bytes) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     prev = np.concatenate([[0], arr[:-1]])
-    h = pair_hash(prev, arr, bank.domain)
-    masks = bank.tables[:, h]  # (m, n) uint32
+    masks = None  # (m, n) uint32: AND over hashes of per-position reach
+    for h_i in range(bank.n_hashes):
+        h = pair_hash(prev, arr, bank.domain, which=h_i)
+        got = bank.tables[h_i][:, h]
+        masks = got if masks is None else (masks & got)
     ones = np.uint32(0xFFFFFFFF)
     # pipeline: V_0(t) = masks[0, t]; V_k(t) = V_{k-1}(t-1) & masks[k, t]
     Vs = np.empty((bank.m, n), dtype=np.uint32)

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_grep_tpu.models.fdr import HASH_A, HASH_B, FdrBank
+from distributed_grep_tpu.models.fdr import HASHES, MAX_GATHERS, FdrBank
 from distributed_grep_tpu.ops.pallas_scan import (
     CHUNK_BLOCK_WORDS,
     LANE_COLS,
@@ -45,23 +45,28 @@ from distributed_grep_tpu.ops.pallas_scan import (
 
 def eligible(bank: FdrBank) -> bool:
     """models/fdr only emits kernel-sized banks; guard anyway."""
-    return bank.m <= 6 and bank.domain <= 512 and bank.domain % 128 == 0
+    return (
+        bank.m <= 6
+        and bank.domain <= 512
+        and bank.domain % 128 == 0
+        and bank.n_hashes * bank.m * (bank.domain // LANE_COLS) <= MAX_GATHERS
+    )
 
 
 def bank_device_tables(bank: FdrBank) -> np.ndarray:
-    """(m * n_subtables, SUBLANES, LANE_COLS) uint32 — each 128-entry
-    subtable broadcast across sublanes, ready to pass to the kernel.
-    Upload once per engine; ~16 KB per subtable."""
-    m, d = bank.tables.shape
+    """(n_hashes * m * n_subtables, SUBLANES, LANE_COLS) uint32 — each
+    128-entry subtable broadcast across sublanes, ready to pass to the
+    kernel.  Upload once per engine; ~16 KB per subtable."""
+    nh, m, d = bank.tables.shape
     g = d // LANE_COLS
-    sub = bank.tables.reshape(m, g, LANE_COLS)  # (m, G, 128)
+    sub = bank.tables.reshape(nh, m, g, LANE_COLS)
     tiles = np.broadcast_to(
-        sub[:, :, None, :], (m, g, SUBLANES, LANE_COLS)
-    ).reshape(m * g, SUBLANES, LANE_COLS)
+        sub[:, :, :, None, :], (nh, m, g, SUBLANES, LANE_COLS)
+    ).reshape(nh * m * g, SUBLANES, LANE_COLS)
     return np.ascontiguousarray(tiles)
 
 
-def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, steps):
+def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, n_hashes, steps):
     from jax.experimental import pallas as pl  # deferred: import cost
 
     ci = pl.program_id(1)
@@ -79,22 +84,31 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, steps):
         word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
         for t in range(32):
             b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            h = ((prev_b * HASH_A) ^ (b * HASH_B)) & (n_sub * LANE_COLS - 1)
+            los, all_sels = [], []
+            for hi_i in range(n_hashes):
+                ha, hb = HASHES[hi_i]
+                h = ((prev_b * ha) ^ (b * hb)) & (n_sub * LANE_COLS - 1)
+                los.append(h & (LANE_COLS - 1))
+                if n_sub > 1:
+                    hi = h >> 7
+                    # all-ones/all-zero select masks, shared by all m lookups
+                    all_sels.append(
+                        [zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)]
+                    )
             prev_b = b
-            lo = h & (LANE_COLS - 1)
-            if n_sub > 1:
-                hi = h >> 7
-                # all-ones/all-zero uint32 select masks, shared by all m lookups
-                sels = [zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)]
             masks = []
             for p in range(m):
-                acc = None
-                for j in range(n_sub):
-                    g = jnp.take_along_axis(tabs_ref[p * n_sub + j], lo, axis=1)
-                    if n_sub > 1:
-                        g = g & sels[j]
-                    acc = g if acc is None else (acc | g)
-                masks.append(acc)
+                anded = None  # AND over hashes of this position's reach
+                for hi_i in range(n_hashes):
+                    acc = None
+                    base = (hi_i * m + p) * n_sub
+                    for j in range(n_sub):
+                        g = jnp.take_along_axis(tabs_ref[base + j], los[hi_i], axis=1)
+                        if n_sub > 1:
+                            g = g & all_sels[hi_i][j]
+                        acc = g if acc is None else (acc | g)
+                    anded = acc if anded is None else (anded & acc)
+                masks.append(anded)
             V = [masks[0]] + [V[k - 1] & masks[k] for k in range(1, m)]
             word = word | jnp.where(V[m - 1] != 0, jnp.uint32(1 << t), zero)
         out_ref[w] = word
@@ -108,15 +122,18 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, steps):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("m", "n_sub", "chunk", "lane_blocks", "interpret")
+    jax.jit,
+    static_argnames=("m", "n_sub", "n_hashes", "chunk", "lane_blocks", "interpret"),
 )
-def _fdr_pallas(data, tabs, *, m, n_sub, chunk, lane_blocks, interpret=False):
+def _fdr_pallas(data, tabs, *, m, n_sub, n_hashes=1, chunk, lane_blocks, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
-    kernel = functools.partial(_kernel, m=m, n_sub=n_sub, steps=steps)
+    kernel = functools.partial(
+        _kernel, m=m, n_sub=n_sub, n_hashes=n_hashes, steps=steps
+    )
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),
@@ -127,7 +144,7 @@ def _fdr_pallas(data, tabs, *, m, n_sub, chunk, lane_blocks, interpret=False):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (m * n_sub, SUBLANES, LANE_COLS),
+                (n_hashes * m * n_sub, SUBLANES, LANE_COLS),
                 lambda li, ci: (0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -182,6 +199,7 @@ def fdr_scan_words(
         dev_tables,
         m=bank.m,
         n_sub=bank.domain // LANE_COLS,
+        n_hashes=bank.n_hashes,
         chunk=chunk,
         lane_blocks=lane_blocks,
         interpret=interpret,

@@ -158,13 +158,16 @@ def test_device_tables_layout():
     bank = model.banks[0]
     tiles = pallas_fdr.bank_device_tables(bank)
     g = bank.domain // 128
-    assert tiles.shape == (bank.m * g, 32, 128)
-    # row p*g+j, any sublane s, lane l == tables[p, j*128 + l]
-    for p in range(bank.m):
-        for j in range(g):
-            np.testing.assert_array_equal(
-                tiles[p * g + j, 5], bank.tables[p, j * 128 : (j + 1) * 128]
-            )
+    nh = bank.n_hashes
+    assert tiles.shape == (nh * bank.m * g, 32, 128)
+    # row (h*m+p)*g+j, any sublane s, lane l == tables[h, p, j*128 + l]
+    for h in range(nh):
+        for p in range(bank.m):
+            for j in range(g):
+                np.testing.assert_array_equal(
+                    tiles[(h * bank.m + p) * g + j, 5],
+                    bank.tables[h, p, j * 128 : (j + 1) * 128],
+                )
 
 
 # ----------------------------------------------------- engine (device path)
@@ -256,8 +259,11 @@ def test_engine_cpu_backend_ignores_fdr():
 
 
 def test_too_dense_set_raises():
-    # thousands of distinct 2-byte literals saturate every table: the model
-    # must refuse (engine then keeps the exact DFA banks)
-    pats = [bytes([a, b]) for a in range(97, 123) for b in range(97, 123)]
+    # ~16k distinct full-alphabet 2-byte literals saturate every table and
+    # hash combination: the model must refuse (engine then keeps the exact
+    # DFA banks)
+    rng = np.random.default_rng(12)
+    pats = {bytes(p.tolist()) for p in rng.integers(1, 256, size=(30000, 2), dtype=np.uint8)}
+    pats = sorted(p for p in pats if b"\n" not in p)[:16384]
     with pytest.raises(fdr_mod.FdrError):
-        fdr_mod.compile_fdr(pats * 2)
+        fdr_mod.compile_fdr(pats)
