@@ -31,12 +31,15 @@ def configure(
     backend: str = "device",
     patterns: list[str] | None = None,
     invert: bool = False,
+    devices: object = "all",  # worker drives every local chip by default
     **engine_opts: object,
 ) -> None:
     global _engine, _invert, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
     _invert = bool(invert)
+    if backend == "device":
+        engine_opts["devices"] = devices
     key = (pattern, ignore_case, backend, tuple(patterns or ()), _invert,
            tuple(sorted(engine_opts.items())))
     if key == _configured_with:

@@ -237,10 +237,13 @@ def _compile_group(
 
     for _, cost, domain, n_hashes, n_banks in chosen:
         try_config(cost, domain, n_hashes, n_banks)
-    if best is not None and best[0] == 1 and best[1] > FP_CEILING_PER_BYTE:
-        # the statistical prescreen can misrank skewed sets (duplicate
-        # tails); before compile_fdr gives up and strands the engine on the
-        # slow DFA path, exhaustively build the remaining configurations
+    if best is not None and best[0] == 1:
+        # Nothing in the prescreen's picks met the budget.  The statistical
+        # estimate can misrank skewed sets (duplicate tails), so before
+        # returning an over-budget config — or letting compile_fdr give up
+        # and strand the engine on the slow DFA path — exhaustively build
+        # the remaining configurations (the old guarantee: if any candidate
+        # satisfies the budget, it is found).
         for entry in prescreen:
             if entry[2:] not in seen:
                 seen.add(entry[2:])

@@ -82,6 +82,8 @@ class GrepEngine:
         ignore_case: bool = False,
         backend: str = "device",  # "device" (jnp/pallas) | "cpu" (host re/native)
         max_errors: int = 0,  # agrep: match within <= k edit errors
+        devices: object = None,  # None = default device; "all" = every local
+        # chip (segments round-robin across them); or an explicit list
         target_lanes: int = 1024,
         segment_bytes: int = 64 * 1024 * 1024,
         max_states: int = 4096,
@@ -92,6 +94,7 @@ class GrepEngine:
         if max_errors and patterns is not None:
             raise ValueError("max_errors applies to a single pattern, not a set")
         self.backend = backend
+        self.devices = devices
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
         self.ignore_case = ignore_case
@@ -103,11 +106,11 @@ class GrepEngine:
         # several independent banks (Hyperscan-style ruleset sharding); each
         # bank is one extra device pass and matched lines are unioned.
         self.tables: list[DfaTable] = []
-        self._dev_tables: list[tuple] | None = None
+        self._dev_tables: dict | None = None  # device -> bank tables
         self._re_fallback: _re.Pattern[bytes] | None = None
         self.fdr: FdrModel | None = None
         self._fdr_short: list[DfaTable] = []
-        self._fdr_dev_tables: list | None = None
+        self._fdr_dev_tables: dict | None = None  # device -> reach tables
         self._fdr_broken = False
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
@@ -235,22 +238,26 @@ class GrepEngine:
             return approx_line_matches(self.approx, line)
         return any(reference_scan(t, line).size > 0 for t in self.tables)
 
-    def _device_tables(self) -> list[tuple]:
-        """Per-bank device-resident scan tables, uploaded once per engine.
+    def _device_tables(self, dev=None) -> list[tuple]:
+        """Per-bank device-resident scan tables, uploaded once per engine
+        per device (multi-chip round-robin needs operands colocated with
+        the compute device — call under jax.default_device(dev)).
 
         Each entry is ("stride", args) when the k-byte-stride composition
         pays (chunk/k scan steps, one gather each — see models/dfa
         StrideTable) or ("plain", args) for the per-byte core ('$' accepts,
         or class counts whose composed table would blow the budget)."""
         if self._dev_tables is None:
+            self._dev_tables = {}
+        if dev not in self._dev_tables:
             import jax.numpy as jnp
 
-            self._dev_tables = []
+            tabs = []
             for t in self.tables:
                 k = choose_stride(t)
                 if k > 1:
                     st = build_stride_table(t, k)
-                    self._dev_tables.append(("stride", (
+                    tabs.append(("stride", (
                         jnp.asarray(st.trans_k.reshape(-1)),
                         jnp.asarray(st.byte_to_cls.astype(np.int32)),
                         jnp.int32(st.start),
@@ -258,7 +265,7 @@ class GrepEngine:
                         st.n_classes,
                     )))
                 else:
-                    self._dev_tables.append(("plain", (
+                    tabs.append(("plain", (
                         jnp.asarray(t.trans.astype(np.int32).reshape(-1)),
                         jnp.asarray(t.byte_to_cls.astype(np.int32)),
                         jnp.asarray(t.accept),
@@ -266,19 +273,23 @@ class GrepEngine:
                         jnp.int32(t.start),
                         t.n_classes,
                     )))
-        return self._dev_tables
+            self._dev_tables[dev] = tabs
+        return self._dev_tables[dev]
 
-    def _fdr_device_tables(self) -> list:
-        """Per-bank FDR reach tables on device, uploaded once per engine."""
+    def _fdr_device_tables(self, dev=None) -> list:
+        """Per-bank FDR reach tables, uploaded once per engine per device
+        (call under jax.default_device(dev))."""
         if self._fdr_dev_tables is None:
+            self._fdr_dev_tables = {}
+        if dev not in self._fdr_dev_tables:
             import jax.numpy as jnp
 
             from distributed_grep_tpu.ops import pallas_fdr
 
-            self._fdr_dev_tables = [
+            self._fdr_dev_tables[dev] = [
                 jnp.asarray(pallas_fdr.bank_device_tables(b)) for b in self.fdr.banks
             ]
-        return self._fdr_dev_tables
+        return self._fdr_dev_tables[dev]
 
     # --------------------------------------------------------- device engine
     def _scan_device(self, data: bytes) -> ScanResult:
@@ -318,89 +329,156 @@ class GrepEngine:
             and pallas_approx.eligible(self.approx)
         )
         use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
-        for seg_start in range(0, max(len(data), 1), seg):
-            seg_bytes = data[seg_start : seg_start + seg]
-            if use_fdr and self.ignore_case:
-                # FDR hashes raw bytes; fold the haystack like the patterns
-                # were folded (the exact DFA confirm is case-aware either way)
-                seg_bytes = seg_bytes.lower()
-            if seg_start > 0:
-                boundaries.append(seg_start)
-            if use_pallas:
-                lay = layout_mod.choose_layout(
-                    len(seg_bytes),
-                    target_lanes=max(self.target_lanes, pallas_scan.LANES_PER_BLOCK),
-                    min_chunk=512,
-                    lane_multiple=pallas_scan.LANES_PER_BLOCK,
-                    chunk_multiple=512,
-                )
-            else:
-                lay = layout_mod.choose_layout(len(seg_bytes), target_lanes=self.target_lanes)
-            arr = layout_mod.to_device_array(seg_bytes, lay)
-            # Device scan, then sparse fetch: a 4-byte count round-trip plus
-            # O(matches) coordinates — never the dense packed plane.
-            if use_fdr:
-                try:
-                    words = None
-                    for bank, dev_tab in zip(self.fdr.banks, self._fdr_device_tables()):
-                        w = pallas_fdr.fdr_scan_words(arr, bank, dev_tables=dev_tab)
-                        words = w if words is None else words | w
-                    idx, vals = scan_jnp.sparse_nonzero(words)
-                except Exception as e:  # Mosaic limits are empirical; stay exact
-                    log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
-                    self._fdr_broken = True
-                    return self._scan_device(data)
-                offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-                if self._fdr_short:
-                    # len<2 literals: exact host scan (native DFA, tiny sets)
-                    short = np.unique(np.concatenate(
-                        [reference_scan(t, seg_bytes) for t in self._fdr_short]
-                    ))
-                    offsets = np.union1d(offsets, short.astype(np.int64))
-            elif use_pallas:
-                if use_pallas_sa:
-                    words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
-                elif use_pallas_approx:
-                    words = pallas_approx.approx_scan_words(arr, self.approx)
-                else:
-                    words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
-                idx, vals = scan_jnp.sparse_nonzero(words)
-                offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-            elif self.mode == "shift_and":
-                packed = scan_jnp.shift_and_scan(arr, self.shift_and)
-                idx, vals = scan_jnp.sparse_nonzero(packed)
-                offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
-            elif self.mode == "approx":
-                packed = scan_jnp.approx_scan(arr, self.approx)
-                idx, vals = scan_jnp.sparse_nonzero(packed)
-                offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
-            else:
-                # One device pass per automaton bank; bytes AND bank tables
-                # are uploaded once (tables are cached on the engine — a
-                # near-full bank's table is ~67 MB, re-uploading it per
-                # segment would swamp the link the sparse fetch protects).
-                import jax.numpy as jnp
 
-                arr_dev = jnp.asarray(arr)
-                per_bank = []
-                for kind, bank in self._device_tables():
-                    if kind == "stride":
-                        packed = scan_jnp._dfa_stride_core(arr_dev, *bank)
-                    else:
-                        packed = scan_jnp._dfa_scan_core(arr_dev, *bank)
-                    idx, vals = scan_jnp.sparse_nonzero(packed)
-                    per_bank.append(
-                        sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
-                    )
-                offsets = np.unique(np.concatenate(per_bank)) if per_bank else \
-                    np.zeros(0, dtype=np.int64)
+        # Segments round-robin across local chips (the worker drives every
+        # chip on its host, SURVEY.md §7 step 5).  Dispatch is async — the
+        # dense result plane stays on its device and the O(matches) sparse
+        # fetch happens in a second phase, so device i+1 scans while device
+        # i's results drain; MAX_INFLIGHT bounds resident result planes.
+        import jax
+        from contextlib import nullcontext
+
+        if self.devices == "all":
+            try:
+                devs: list = list(jax.local_devices())
+            except Exception:  # noqa: BLE001 — no backend: default placement
+                devs = [None]
+        elif self.devices:
+            devs = list(self.devices)  # type: ignore[arg-type]
+        else:
+            devs = [None]
+        max_inflight = 2 * len(devs)
+
+        # job: (sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev)
+        pending: list[tuple] = []
+
+        def collect(job) -> None:
+            nonlocal n_matches
+            sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev = job
+            # Fetch under the job's device context so the decode runs where
+            # the plane lives instead of copying it to the default device.
+            ctx = jax.default_device(dev) if dev is not None else nullcontext()
+            with ctx:
+                if sparse_kind == "words":
+                    idx, vals = scan_jnp.sparse_nonzero(payload)
+                    offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
+                elif sparse_kind == "lane_bytes":
+                    idx, vals = scan_jnp.sparse_nonzero(payload)
+                    offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+                else:  # "bank_list": one packed plane per DFA bank
+                    per_bank = []
+                    for packed in payload:
+                        idx, vals = scan_jnp.sparse_nonzero(packed)
+                        per_bank.append(
+                            sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
+                        )
+                    offsets = np.unique(np.concatenate(per_bank)) if per_bank else \
+                        np.zeros(0, dtype=np.int64)
+            if short_offsets is not None:
+                offsets = np.union1d(offsets, short_offsets)
             n_matches += int(offsets.size)
             if offsets.size:
-                seg_nl = lines_mod.newline_index(seg_bytes)
+                # transient slice: jobs hold (start, len), not segment copies
+                seg_view = data[seg_start : seg_start + seg_len]
+                seg_nl = lines_mod.newline_index(seg_view)
                 seg_lines = np.unique(lines_mod.line_of_offsets(offsets, seg_nl))
                 base = int(np.searchsorted(nl, seg_start))  # lines before segment
                 device_lines.update((seg_lines + base).tolist())
-            boundaries.extend((seg_start + lay.stripe_starts()).tolist())
+
+        try:
+            for i, seg_start in enumerate(range(0, max(len(data), 1), seg)):
+                seg_bytes = data[seg_start : seg_start + seg]
+                if use_fdr and self.ignore_case:
+                    # FDR hashes raw bytes; fold the haystack like the
+                    # patterns were folded (the exact DFA confirm is
+                    # case-aware either way)
+                    seg_bytes = seg_bytes.lower()
+                if seg_start > 0:
+                    boundaries.append(seg_start)
+                if use_pallas:
+                    lay = layout_mod.choose_layout(
+                        len(seg_bytes),
+                        target_lanes=max(self.target_lanes, pallas_scan.LANES_PER_BLOCK),
+                        min_chunk=512,
+                        lane_multiple=pallas_scan.LANES_PER_BLOCK,
+                        chunk_multiple=512,
+                    )
+                else:
+                    lay = layout_mod.choose_layout(
+                        len(seg_bytes), target_lanes=self.target_lanes
+                    )
+                arr = layout_mod.to_device_array(seg_bytes, lay)
+                dev = devs[i % len(devs)]
+                ctx = jax.default_device(dev) if dev is not None else nullcontext()
+                # Dispatch the device scan; the sparse fetch (a 4-byte count
+                # round-trip plus O(matches) coordinates — never the dense
+                # packed plane) happens in collect().
+                short_offsets = None
+                with ctx:
+                    if use_fdr:
+                        words = None
+                        for bank, dev_tab in zip(
+                            self.fdr.banks, self._fdr_device_tables(dev)
+                        ):
+                            w = pallas_fdr.fdr_scan_words(arr, bank, dev_tables=dev_tab)
+                            words = w if words is None else words | w
+                        if self._fdr_short:
+                            # len<2 literals: exact host scan now (native
+                            # DFA, tiny sets) — keeps seg_bytes out of the job
+                            short_offsets = np.unique(np.concatenate(
+                                [reference_scan(t, seg_bytes) for t in self._fdr_short]
+                            )).astype(np.int64)
+                        job = ("words", words, lay, seg_start, len(seg_bytes),
+                               short_offsets, dev)
+                    elif use_pallas:
+                        if use_pallas_sa:
+                            words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                        elif use_pallas_approx:
+                            words = pallas_approx.approx_scan_words(arr, self.approx)
+                        else:
+                            words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
+                        job = ("words", words, lay, seg_start, len(seg_bytes), None, dev)
+                    elif self.mode == "shift_and":
+                        packed = scan_jnp.shift_and_scan(arr, self.shift_and)
+                        job = ("lane_bytes", packed, lay, seg_start, len(seg_bytes),
+                               None, dev)
+                    elif self.mode == "approx":
+                        packed = scan_jnp.approx_scan(arr, self.approx)
+                        job = ("lane_bytes", packed, lay, seg_start, len(seg_bytes),
+                               None, dev)
+                    else:
+                        # One device pass per automaton bank; bytes AND bank
+                        # tables are uploaded once (tables are cached on the
+                        # engine — a near-full bank's table is ~67 MB,
+                        # re-uploading it per segment would swamp the link
+                        # the sparse fetch protects).
+                        import jax.numpy as jnp
+
+                        arr_dev = jnp.asarray(arr)
+                        planes = []
+                        for kind, bank in self._device_tables(dev):
+                            if kind == "stride":
+                                planes.append(scan_jnp._dfa_stride_core(arr_dev, *bank))
+                            else:
+                                planes.append(scan_jnp._dfa_scan_core(arr_dev, *bank))
+                        job = ("bank_list", planes, lay, seg_start, len(seg_bytes),
+                               None, dev)
+                boundaries.extend((seg_start + lay.stripe_starts()).tolist())
+                pending.append(job)
+                if len(pending) >= max_inflight:
+                    collect(pending.pop(0))
+            for job in pending:
+                collect(job)
+        except Exception as e:
+            # Dispatch is async: a kernel can fail at execution time (first
+            # consumed in collect) as well as at compile time.  Mosaic
+            # limits are empirical — on any FDR device failure, flip to the
+            # exact DFA banks and rescan; everything else propagates.
+            if not use_fdr:
+                raise
+            log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
+            self._fdr_broken = True
+            return self._scan_device(data)
 
         if use_fdr and device_lines:
             # FDR lines are *candidates* (bucket superimposition + domain

@@ -275,3 +275,40 @@ def test_pallas_class_pattern_interpret():
     got = pallas_scan.shift_and_scan(arr, model, interpret=True)
     want = scan_jnp.shift_and_scan(arr, model)
     np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------- multi-device round-robin
+
+def test_engine_multi_device_segments():
+    """Segments round-robin across all 8 virtual devices; results must be
+    identical to single-device scanning, including cross-segment matches."""
+    import jax
+
+    data = make_text(800, inject=[(3, b"a needle"), (400, b"needle mid"),
+                                  (799, b"needle end")])
+    kw = dict(segment_bytes=4096, target_lanes=16)
+    multi = GrepEngine("needle", devices="all", **kw)
+    single = GrepEngine("needle", **kw)
+    assert len(jax.local_devices()) == 8
+    rm, rs = multi.scan(data), single.scan(data)
+    np.testing.assert_array_equal(rm.matched_lines, rs.matched_lines)
+    assert rm.n_matches == rs.n_matches
+
+
+def test_engine_multi_device_dfa_banks():
+    data = make_text(400, inject=[(5, b"needle here or neet")])
+    kw = dict(segment_bytes=4096, target_lanes=16)
+    multi = GrepEngine("nee(dle|t)$", devices="all", **kw)
+    assert multi.mode == "dfa"  # '$' accept -> DFA path with bank tables
+    single = GrepEngine("nee(dle|t)$", **kw)
+    np.testing.assert_array_equal(
+        multi.scan(data).matched_lines, single.scan(data).matched_lines
+    )
+
+
+def test_grep_tpu_app_devices_all():
+    from distributed_grep_tpu.apps import grep_tpu
+
+    grep_tpu.configure(pattern="needle", devices="all")
+    out = grep_tpu.map_fn("f", b"a needle\nnothing\n")
+    assert [kv.key for kv in out] == ["f (line number #1)"]
