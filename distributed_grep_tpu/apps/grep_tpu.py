@@ -76,5 +76,35 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     return out
 
 
+def map_path_fn(filename: str, path: str) -> list[KeyValue]:
+    """Streaming map: the worker hands over a local path and the engine
+    scans it in newline-aligned chunks (GrepEngine.scan_file) — splits
+    larger than worker RAM flow end-to-end, the capability the reference's
+    whole-file read forecloses (worker.go:72-76).  Matched line text is
+    collected while each chunk is in memory, so output stays O(matches).
+
+    grep -v needs every non-matching line — the complement of a stream of
+    matches isn't itself bounded — so invert falls back to the whole-bytes
+    path (the runtime only streams when this function is used).
+    """
+    if _engine is None:
+        raise RuntimeError("grep_tpu used before configure() — no pattern set")
+    if _invert:
+        with open(path, "rb") as f:
+            return map_fn(filename, f.read())
+    out: list[KeyValue] = []
+
+    def emit(line_no: int, line: bytes) -> None:
+        out.append(
+            KeyValue(
+                key=f"{filename} (line number #{line_no})",
+                value=line.decode("utf-8", errors="replace"),
+            )
+        )
+
+    _engine.scan_file(path, emit=emit)
+    return out
+
+
 def reduce_fn(key: str, values: list[str]) -> str:
     return values[0]

@@ -34,6 +34,9 @@ class LoadedApplication:
     map_fn: Callable[[str, bytes], list[KeyValue]]
     reduce_fn: Callable[[str, list[str]], str]
     module: Any
+    # optional streaming entry: receives a local file path instead of bytes
+    # (the worker then spools/streams the split — splits larger than RAM)
+    map_path_fn: Callable[[str, str], list[KeyValue]] | None = None
 
     def configure(self, **options: Any) -> None:
         hook = getattr(self.module, "configure", None)
@@ -93,7 +96,14 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
             f"application {spec!r} must expose callable map_fn/reduce_fn "
             f"(or Map/Reduce); got map={map_fn!r} reduce={reduce_fn!r}"
         )
-    app = LoadedApplication(name=spec, map_fn=map_fn, reduce_fn=reduce_fn, module=module)
+    map_path_fn = getattr(module, "map_path_fn", None)
+    app = LoadedApplication(
+        name=spec,
+        map_fn=map_fn,
+        reduce_fn=reduce_fn,
+        module=module,
+        map_path_fn=map_path_fn if callable(map_path_fn) else None,
+    )
     if options:
         app.configure(**options)
     return app

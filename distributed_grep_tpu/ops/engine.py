@@ -209,10 +209,64 @@ class GrepEngine:
             return self._scan_native(data)
         return self._scan_device(data)
 
+    def scan_file(self, path, chunk_bytes: int | None = None, emit=None) -> ScanResult:
+        """Stream a file of any size through the scanner: chunks are cut at
+        newline boundaries (partial tail lines carry into the next chunk),
+        so no line — and hence no grep match — ever spans a chunk, and host
+        memory stays bounded by one chunk regardless of file size.  The
+        reference reads whole files and cannot exceed worker RAM
+        (worker.go:72-76); this is the end-to-end long-context path
+        (SURVEY.md §5).
+
+        ``emit(line_no, line_bytes)`` is called per matched line while the
+        chunk is still in memory — collecting output costs O(matches), not
+        a second pass.  Line numbers in the result are file-global.  A
+        single line longer than chunk_bytes is accumulated whole (a line
+        must fit in memory; grep semantics need the full line anyway).
+        """
+        chunk_target = chunk_bytes or max(self.segment_bytes, 1 << 26)
+        matched: list[int] = []
+        n_matches = 0
+        total = 0
+        lines_before = 0
+        carry = b""
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(chunk_target)
+                if not block:
+                    buf, carry, final = carry, b"", True
+                else:
+                    buf = carry + block
+                    cut = buf.rfind(b"\n")
+                    if cut < 0:
+                        carry = buf  # line longer than the chunk: keep growing
+                        continue
+                    carry, buf = buf[cut + 1 :], buf[: cut + 1]
+                    final = False
+                if buf:
+                    res = self.scan(buf)
+                    total += len(buf)
+                    n_matches += res.n_matches
+                    if res.matched_lines.size:
+                        if emit is not None:
+                            nl_idx = lines_mod.newline_index(buf)
+                            for ln in res.matched_lines.tolist():
+                                s, e = lines_mod.line_span(nl_idx, ln, len(buf))
+                                emit(lines_before + ln, buf[s:e])
+                        matched.extend((res.matched_lines + lines_before).tolist())
+                    lines_before += lines_mod.count_lines(buf)
+                if final:
+                    break
+        return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
+
     # ---------------------------------------------------------- host engines
     def _scan_re(self, data: bytes) -> ScanResult:
         matched = []
-        for i, line in enumerate(data.split(b"\n"), start=1):
+        lines = data.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()  # trailing '\n' closes the last line (grep -n);
+            # also keeps scan_file's per-chunk line accounting exact
+        for i, line in enumerate(lines, start=1):
             if self._re_fallback.search(line):
                 matched.append(i)
         return ScanResult(np.asarray(matched, dtype=np.int64), len(matched), len(data))

@@ -13,11 +13,13 @@ completion is the normal shutdown signal, as in the reference).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from pathlib import Path
 
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.utils.config import JobConfig
@@ -89,6 +91,45 @@ class HttpTransport:
     # ---------------------------------------------------------- data plane
     def read_input(self, filename: str) -> bytes:
         return self._request("GET", f"/data/input/{urllib.parse.quote(filename, safe='')}")
+
+    def read_input_path(self, filename: str):
+        """(local_path, is_temp): stream the split to a spool file so the
+        worker never holds the whole input in memory (streaming apps then
+        scan it in bounded chunks).  Same liveness retry policy as
+        _request; a partial download is discarded and restarted."""
+        import shutil
+        import tempfile
+
+        import http.client
+
+        url = f"{self.base}/data/input/{urllib.parse.quote(filename, safe='')}"
+        deadline: float | None = None
+        while True:
+            tmp = tempfile.NamedTemporaryFile(prefix="dgrep-in-", delete=False)
+            try:
+                try:
+                    with urllib.request.urlopen(url, timeout=self.rpc_timeout_s) as resp:
+                        shutil.copyfileobj(resp, tmp, length=1 << 20)
+                    tmp.close()
+                    return Path(tmp.name), True
+                finally:
+                    # any non-success path discards the partial spool file
+                    if not tmp.closed:
+                        tmp.close()
+                        os.unlink(tmp.name)
+            except urllib.error.HTTPError as e:
+                raise RuntimeError(f"GET {url} -> {e.code}") from e
+            except (urllib.error.URLError, socket.timeout, ConnectionError,
+                    http.client.HTTPException, OSError) as e:
+                # IncompleteRead (truncated body: coordinator restarted
+                # mid-transfer) is an HTTPException, not a URLError — retry
+                # it like any other liveness failure
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + RETRY_BUDGET_S
+                if now >= deadline:
+                    raise CoordinatorGone(f"GET {url}: {e}") from e
+                time.sleep(RETRY_DELAY_S)
 
     def write_intermediate(self, name: str, data: bytes) -> None:
         self._request("PUT", f"/data/intermediate/{urllib.parse.quote(name)}", data)

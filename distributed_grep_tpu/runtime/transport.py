@@ -55,6 +55,12 @@ class LocalTransport:
     def read_input(self, filename: str) -> bytes:
         return resolve_input_path(filename, self.workdir).read_bytes()
 
+    def read_input_path(self, filename: str):
+        """(local_path, is_temp) — streaming apps (map_path_fn) read the
+        file themselves in bounded chunks instead of receiving all bytes.
+        Shared-FS transport: the original path, nothing to clean up."""
+        return resolve_input_path(filename, self.workdir), False
+
     def write_intermediate(self, name: str, data: bytes) -> None:
         atomic_write(self.workdir.root / "intermediate" / name, data)
 

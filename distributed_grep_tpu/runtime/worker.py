@@ -75,12 +75,36 @@ class WorkerLoop:
     def _run_map(self, a: rpc.AssignTaskReply) -> None:
         t0 = time.perf_counter()
         self.app.configure(**a.app_options)
-        with trace.annotate(f"map_read:{a.task_id}"):
-            contents = self.transport.read_input(a.filename)
-        self._fault("after_map_read")
-        with self.metrics.timer("map_compute"), trace.annotate(f"map_compute:{a.task_id}"):
-            records = self.app.map_fn(a.filename, contents)
-        self.metrics.record_scan(len(contents), time.perf_counter() - t0)
+        # Streaming boundary: an app exposing map_path_fn receives a local
+        # file path and reads it in bounded chunks (engine.scan_file) —
+        # splits larger than worker RAM flow end-to-end.  Everyone else
+        # gets the reference-shaped whole-bytes map_fn (worker.go:72-76).
+        use_path = getattr(self.app, "map_path_fn", None) is not None and hasattr(
+            self.transport, "read_input_path"
+        )
+        if use_path:
+            import os
+
+            with trace.annotate(f"map_read:{a.task_id}"):
+                path, is_temp = self.transport.read_input_path(a.filename)
+            self._fault("after_map_read")
+            try:
+                n_bytes = os.path.getsize(path)
+                with self.metrics.timer("map_compute"), \
+                        trace.annotate(f"map_compute:{a.task_id}"):
+                    records = self.app.map_path_fn(a.filename, str(path))
+            finally:
+                if is_temp:
+                    os.unlink(path)
+            self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
+        else:
+            with trace.annotate(f"map_read:{a.task_id}"):
+                contents = self.transport.read_input(a.filename)
+            self._fault("after_map_read")
+            with self.metrics.timer("map_compute"), \
+                    trace.annotate(f"map_compute:{a.task_id}"):
+                records = self.app.map_fn(a.filename, contents)
+            self.metrics.record_scan(len(contents), time.perf_counter() - t0)
         buckets = shuffle.bucketize(records, a.n_reduce)
         self._fault("before_map_commit")
         produced: list[int] = []

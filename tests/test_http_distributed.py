@@ -205,3 +205,43 @@ def test_multiprocess_cli_job(tmp_path, corpus):
         for p in [coord, *workers]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_http_read_input_path_spools_to_temp(tmp_path, corpus):
+    server = make_server(tmp_path, corpus)
+    try:
+        t = HttpTransport(f"127.0.0.1:{server.port}")
+        fname = server.config.input_files[0]
+        path, is_temp = t.read_input_path(fname)
+        assert is_temp
+        try:
+            assert path.read_bytes() == Path(fname).read_bytes()
+        finally:
+            path.unlink()
+    finally:
+        server.shutdown()
+
+
+def test_http_streaming_app_end_to_end(tmp_path, corpus):
+    """grep_tpu's map_path_fn over the HTTP transport: the worker spools
+    each split to disk and streams it — output identical to the whole-bytes
+    CPU app."""
+    server = make_server(
+        tmp_path, corpus,
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello", "backend": "cpu"},
+    )
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        app = load_application("distributed_grep_tpu.apps.grep_tpu")
+        assert app.map_path_fn is not None  # loader must expose streaming entry
+        t = HttpTransport(addr)
+
+        def no_whole_read(filename):  # streaming must never load whole bytes
+            raise AssertionError("read_input called on the streaming path")
+
+        t.read_input = no_whole_read
+        WorkerLoop(t, app).run()
+        assert output_lines(server.config.work_dir) == expected_grep_lines(corpus)
+    finally:
+        server.shutdown()

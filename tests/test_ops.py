@@ -312,3 +312,58 @@ def test_grep_tpu_app_devices_all():
     grep_tpu.configure(pattern="needle", devices="all")
     out = grep_tpu.map_fn("f", b"a needle\nnothing\n")
     assert [kv.key for kv in out] == ["f (line number #1)"]
+
+
+# ------------------------------------------------------ streaming scan_file
+
+def test_scan_file_matches_scan(tmp_path):
+    data = make_text(600, inject=[(0, b"needle first"), (299, b"mid needle"),
+                                  (599, b"needle last")])
+    p = tmp_path / "doc.txt"
+    p.write_bytes(data)
+    eng = GrepEngine("needle", segment_bytes=4096, target_lanes=16)
+    whole = eng.scan(data)
+    got_lines = []
+    chunked = eng.scan_file(p, chunk_bytes=1000,
+                            emit=lambda ln, b: got_lines.append((ln, b)))
+    np.testing.assert_array_equal(chunked.matched_lines, whole.matched_lines)
+    assert chunked.bytes_scanned == len(data)
+    # emit delivered exact global line numbers + exact line text
+    all_lines = data.split(b"\n")
+    for ln, b in got_lines:
+        assert all_lines[ln - 1] == b
+    assert [ln for ln, _ in got_lines] == sorted(whole.matched_lines.tolist())
+
+
+def test_scan_file_line_longer_than_chunk(tmp_path):
+    long_line = b"x" * 5000 + b" needle " + b"y" * 3000
+    data = b"short\n" + long_line + b"\nneedle tail\n"
+    p = tmp_path / "doc.txt"
+    p.write_bytes(data)
+    eng = GrepEngine("needle", target_lanes=16)
+    res = eng.scan_file(p, chunk_bytes=512)
+    assert res.matched_lines.tolist() == [2, 3]
+
+
+def test_grep_tpu_map_path_fn_matches_map_fn(tmp_path):
+    from distributed_grep_tpu.apps import grep_tpu
+
+    data = make_text(300, inject=[(5, b"a needle"), (250, b"needle b")])
+    p = tmp_path / "doc.txt"
+    p.write_bytes(data)
+    grep_tpu.configure(pattern="needle", segment_bytes=4096, target_lanes=16)
+    want = grep_tpu.map_fn(str(p), data)
+    got = grep_tpu.map_path_fn(str(p), str(p))
+    assert got == want
+    # invert falls back to whole-bytes and still agrees
+    grep_tpu.configure(pattern="needle", invert=True, segment_bytes=4096,
+                       target_lanes=16)
+    assert grep_tpu.map_path_fn(str(p), str(p)) == grep_tpu.map_fn(str(p), data)
+
+
+def test_scan_re_no_phantom_trailing_line():
+    # re-fallback engine (newline-consuming pattern) with an empty-matching
+    # regex must not count the segment after a trailing '\n' as a line
+    eng = GrepEngine("(a\nb)?")
+    assert eng.mode == "re"
+    assert eng.scan(b"one\ntwo\n").matched_lines.tolist() == [1, 2]
