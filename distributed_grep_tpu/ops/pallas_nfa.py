@@ -8,13 +8,18 @@ recurrence is the position-automaton step from models/nfa.py:
             | (prev_nl ? init_anchor : 0)      ('^' starts, line-start only)
             | ((D & chain_src) << 1)           (concat runs — one shift/word)
             | OR_specials (D[p] ? follow[p] : 0)
-    D       = reached & B[byte]                (B via per-class range compares)
+    D       = reached & B[byte]
     match   = (D & final) != 0
 
-Everything is uint32 tile bit-ops and compares — no gathers, so general
-regex (alternations, classes, bounded repeats, '^') runs at Pallas speeds
-instead of the XLA lax.scan DFA path's ~0.1 GB/s (the gap that motivated
-this kernel; benchmarks/kernel_compare.py).
+B[byte] comes from one of two modes, chosen by measured cost crossover
+(use_gather_b): per-class range compares for small/simple patterns, or
+per-state-word 256-entry tables fetched with 128-lane ``take_along_axis``
+gathers (the ops/pallas_fdr.py primitive) for class-heavy patterns, where
+compare counts scale with the alphabet but the gather cost is fixed per
+word.  Either way general regex (alternations, classes, bounded repeats,
+'^') runs at Pallas speeds instead of the XLA lax.scan DFA path's
+~0.1 GB/s (the gap that motivated this kernel;
+benchmarks/kernel_compare.py).
 
 The select trick: a per-position select is (0 - ((D >> j) & 1)) & mask —
 an all-ones/all-zero uint32 mask from one bit, avoiding jnp.where's
@@ -44,9 +49,34 @@ NL = 0x0A
 MAX_COST = 160
 
 
+def _b_cost_compare(model: GlushkovModel) -> int:
+    return model.total_ranges + sum(len(pw) for pw in model.cls_pos_words)
+
+
+def _b_cost_gather(model: GlushkovModel) -> int:
+    # per word: two 128-entry lane gathers + select — but a gather is worth
+    # several plain VPU ops.  Calibrated on v5e (2026-07-30): the 8-word
+    # alternation (compare cost 54) ran 33 -> 116 GB/s with gathers, while
+    # compare cost 19 ran 34 -> 26 (compare wins).
+    return 12 * model.n_words
+
+
+# measured crossover: compare-B wins at compare cost 19, gather-B at 54
+GATHER_B_THRESHOLD = 32
+
+
+def use_gather_b(model: GlushkovModel) -> bool:
+    """Fetch B[byte] from per-word 256-entry tables via lane gathers when
+    the per-class range compares get expensive — alternation-heavy patterns
+    have many classes (compares scale with them), while the gather cost is
+    fixed per state word (the same primitive ops/pallas_fdr.py rides)."""
+    return _b_cost_compare(model) > max(GATHER_B_THRESHOLD, _b_cost_gather(model))
+
+
 def kernel_cost(model: GlushkovModel) -> int:
-    """Rough per-byte op count — eligibility metric."""
-    b_cost = model.total_ranges + sum(len(pw) for pw in model.cls_pos_words)
+    """Rough per-byte op count — eligibility metric.  Mirrors the dispatch:
+    charge the B-mode the kernel will actually run."""
+    b_cost = _b_cost_gather(model) if use_gather_b(model) else _b_cost_compare(model)
     special_cost = sum(2 + len(f) for _, _, f in model.specials)
     return b_cost + special_cost + 4 * model.n_words
 
@@ -55,9 +85,29 @@ def eligible(model: GlushkovModel) -> bool:
     return kernel_cost(model) <= MAX_COST
 
 
-def _kernel(data_ref, out_ref, d_ref, nl_ref, *, plan, steps):
+def build_b_tables(model: GlushkovModel) -> np.ndarray:
+    """(n_words * 2, SUBLANES, LANE_COLS) uint32 — per state word, the
+    256-entry B[byte] table split into lo/hi 128-lane subtables, broadcast
+    across sublanes (the ops/pallas_fdr.py table convention)."""
+    full = np.zeros((model.n_words, 256), dtype=np.uint32)
+    for ranges, pos_words in zip(model.cls_ranges, model.cls_pos_words):
+        for wi, m in pos_words:
+            for lo, hi in ranges:
+                full[wi, lo : hi + 1] |= np.uint32(m)
+    sub = full.reshape(model.n_words * 2, LANE_COLS)
+    tiles = np.broadcast_to(
+        sub[:, None, :], (model.n_words * 2, SUBLANES, LANE_COLS)
+    )
+    return np.ascontiguousarray(tiles)
+
+
+def _kernel(data_ref, *refs, plan, steps, gather_b):
     from jax.experimental import pallas as pl  # deferred: import cost
 
+    if gather_b:
+        tabs_ref, out_ref, d_ref, nl_ref = refs
+    else:
+        out_ref, d_ref, nl_ref = refs
     (n_words, classes, chain_src, specials, init_float, init_anchor,
      final_words, anchored) = plan
     ci = pl.program_id(1)
@@ -74,16 +124,26 @@ def _kernel(data_ref, out_ref, d_ref, nl_ref, *, plan, steps):
         word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
         for t in range(32):
             b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            # ---- B[byte] per state word, via per-class range compares
-            bmask = [zero] * n_words
-            for ranges, pos_words in classes:
-                hit = None
-                for lo, hi in ranges:
-                    r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
-                    hit = r if hit is None else (hit | r)
-                hit_m = zero - hit.astype(jnp.uint32)  # all-ones where hit
-                for wi, m in pos_words:
-                    bmask[wi] = bmask[wi] | (hit_m & jnp.uint32(m))
+            if gather_b:
+                # ---- B[byte] per state word, via 128-lane table gathers
+                lo_idx = b & 127
+                hi_sel = zero - (b >= 128).astype(jnp.uint32)  # all-ones hi
+                bmask = []
+                for wi in range(n_words):
+                    g_lo = jnp.take_along_axis(tabs_ref[wi * 2], lo_idx, axis=1)
+                    g_hi = jnp.take_along_axis(tabs_ref[wi * 2 + 1], lo_idx, axis=1)
+                    bmask.append((g_hi & hi_sel) | (g_lo & ~hi_sel))
+            else:
+                # ---- B[byte] per state word, via per-class range compares
+                bmask = [zero] * n_words
+                for ranges, pos_words in classes:
+                    hit = None
+                    for lo, hi in ranges:
+                        r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                        hit = r if hit is None else (hit | r)
+                    hit_m = zero - hit.astype(jnp.uint32)  # all-ones where hit
+                    for wi, m in pos_words:
+                        bmask[wi] = bmask[wi] | (hit_m & jnp.uint32(m))
             # ---- reached = init | chains | specials
             reached = [jnp.full((SUBLANES, LANE_COLS), f, dtype=jnp.uint32)
                        for f in init_float]
@@ -121,26 +181,38 @@ def _kernel(data_ref, out_ref, d_ref, nl_ref, *, plan, steps):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "chunk", "lane_blocks", "interpret")
+    jax.jit, static_argnames=("plan", "chunk", "lane_blocks", "gather_b", "interpret")
 )
-def _nfa_pallas(data, *, plan, chunk, lane_blocks, interpret=False):
+def _nfa_pallas(data, b_tabs=None, *, plan, chunk, lane_blocks, gather_b=False,
+                interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
     n_words = plan[0]
-    kernel = functools.partial(_kernel, plan=plan, steps=steps)
+    kernel = functools.partial(_kernel, plan=plan, steps=steps, gather_b=gather_b)
+    in_specs = [
+        pl.BlockSpec(
+            (steps, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        )
+    ]
+    args = (data,)
+    if gather_b:
+        in_specs.append(
+            pl.BlockSpec(
+                (n_words * 2, SUBLANES, LANE_COLS),
+                lambda li, ci: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args = (data, b_tabs)
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),
-        in_specs=[
-            pl.BlockSpec(
-                (steps, SUBLANES, LANE_COLS),
-                lambda li, ci: (ci, li, 0),
-                memory_space=pltpu.VMEM,
-            )
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
             lambda li, ci: (ci, li, 0),
@@ -154,7 +226,7 @@ def _nfa_pallas(data, *, plan, chunk, lane_blocks, interpret=False):
             pltpu.VMEM((SUBLANES, LANE_COLS), jnp.uint32),
         ],
         interpret=interpret,
-    )(data)
+    )(*args)
 
 
 def nfa_scan_words(
@@ -178,11 +250,14 @@ def nfa_scan_words(
     )
     if interpret is None:
         interpret = not available()
+    gather_b = use_gather_b(model)
     return _nfa_pallas(
         jnp.asarray(data),
+        jnp.asarray(build_b_tables(model)) if gather_b else None,
         plan=model.kernel_plan(),
         chunk=chunk,
         lane_blocks=lane_blocks,
+        gather_b=gather_b,
         interpret=interpret,
     )
 

@@ -49,6 +49,8 @@ class HttpTransport:
 
     # ------------------------------------------------------------- plumbing
     def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
+        import http.client
+
         url = f"{self.base}{path}"
         deadline: float | None = None  # anchored at the FIRST failure
         while True:
@@ -61,7 +63,10 @@ class HttpTransport:
             except urllib.error.HTTPError as e:
                 # Server answered: 4xx/5xx are not liveness failures.
                 raise RuntimeError(f"{method} {path} -> {e.code}: {e.read()[:200]!r}") from e
-            except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+            except (urllib.error.URLError, socket.timeout, ConnectionError,
+                    http.client.HTTPException, OSError) as e:
+                # HTTPException covers IncompleteRead: the coordinator died
+                # mid-body — a liveness failure like any connection error
                 now = time.monotonic()
                 if deadline is None:
                     deadline = now + RETRY_BUDGET_S
@@ -96,16 +101,23 @@ class HttpTransport:
         """(local_path, is_temp): stream the split to a spool file so the
         worker never holds the whole input in memory (streaming apps then
         scan it in bounded chunks).  Same liveness retry policy as
-        _request; a partial download is discarded and restarted."""
+        _request (incl. IncompleteRead: coordinator died mid-body); a
+        partial download is discarded and restarted.  Spool dir: the
+        DGREP_SPOOL_DIR env var, else the system temp dir — point it at a
+        disk-backed path on hosts where /tmp is RAM-backed tmpfs, or the
+        spool itself would consume the RAM the streaming path protects."""
+        import errno
+        import http.client
         import shutil
         import tempfile
 
-        import http.client
-
+        spool_dir = os.environ.get("DGREP_SPOOL_DIR") or None
         url = f"{self.base}/data/input/{urllib.parse.quote(filename, safe='')}"
         deadline: float | None = None
         while True:
-            tmp = tempfile.NamedTemporaryFile(prefix="dgrep-in-", delete=False)
+            tmp = tempfile.NamedTemporaryFile(
+                prefix="dgrep-in-", dir=spool_dir, delete=False
+            )
             try:
                 try:
                     with urllib.request.urlopen(url, timeout=self.rpc_timeout_s) as resp:
@@ -121,9 +133,12 @@ class HttpTransport:
                 raise RuntimeError(f"GET {url} -> {e.code}") from e
             except (urllib.error.URLError, socket.timeout, ConnectionError,
                     http.client.HTTPException, OSError) as e:
-                # IncompleteRead (truncated body: coordinator restarted
-                # mid-transfer) is an HTTPException, not a URLError — retry
-                # it like any other liveness failure
+                # Local disk problems are NOT liveness failures — retrying
+                # the download cannot fix a full spool disk; surface them.
+                if isinstance(e, OSError) and e.errno in (
+                    errno.ENOSPC, errno.EDQUOT, errno.EROFS,
+                ):
+                    raise
                 now = time.monotonic()
                 if deadline is None:
                     deadline = now + RETRY_BUDGET_S

@@ -87,8 +87,8 @@ class WorkerLoop:
 
             with trace.annotate(f"map_read:{a.task_id}"):
                 path, is_temp = self.transport.read_input_path(a.filename)
-            self._fault("after_map_read")
             try:
+                self._fault("after_map_read")
                 n_bytes = os.path.getsize(path)
                 with self.metrics.timer("map_compute"), \
                         trace.annotate(f"map_compute:{a.task_id}"):

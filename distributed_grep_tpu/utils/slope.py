@@ -158,14 +158,19 @@ def pallas_nfa_setup(data: bytes, model, *, target_lanes: int = 8192):
     """Device array + scan closure for slope-timing the Pallas Glushkov NFA
     kernel (ops/pallas_nfa.py) — same layout contract as the shift-and
     setup, shared by benchmarks/."""
+    import jax.numpy as jnp
+
     from distributed_grep_tpu.ops import pallas_nfa
 
     dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
     plan = model.kernel_plan()
+    gather_b = pallas_nfa.use_gather_b(model)
+    b_tabs = jnp.asarray(pallas_nfa.build_b_tables(model)) if gather_b else None
 
     def scan(win):
         return pallas_nfa._nfa_pallas(
-            win, plan=plan, chunk=lay.chunk, lane_blocks=lane_blocks, interpret=False
+            win, b_tabs, plan=plan, chunk=lay.chunk, lane_blocks=lane_blocks,
+            gather_b=gather_b, interpret=False
         )
 
     return dev, lay.chunk, pad_rows, scan

@@ -159,11 +159,30 @@ def test_pallas_nfa_ignore_case_interpret():
 def test_kernel_cost_and_eligibility():
     m = nfa_mod.try_compile_glushkov("nee(dle|t)")
     assert pallas_nfa.kernel_cost(m) < pallas_nfa.MAX_COST
-    # 60 positions with 60 distinct 2-range classes compiles (<= 64
-    # positions) but blows the per-byte compare budget -> XLA DFA path.
+    # 60 positions with 60 distinct 2-range classes used to blow the
+    # per-byte compare budget; the gather-B path (fixed cost per state
+    # word) keeps it on the Pallas kernel now.
     import string
 
     chars = string.ascii_letters + "!#%&,;:@"
     big = nfa_mod.try_compile_glushkov("".join(f"[{c}0-9]" for c in chars[:60]))
     assert big is not None
-    assert not pallas_nfa.eligible(big)
+    assert pallas_nfa.use_gather_b(big) and pallas_nfa.eligible(big)
+    assert pallas_nfa._b_cost_gather(big) < pallas_nfa._b_cost_compare(big)
+
+
+def test_gather_b_mode_picked_and_exact():
+    # alternations have many classes -> the gather-B path should win, and
+    # its interpret-mode output must stay byte-identical to the DFA scan
+    words = ["volcano", "anarchy", "physics", "quantum", "needle", "breadth",
+             "zeppelin", "obsidian"]
+    pattern = "(" + "|".join(words) + ")"
+    model = nfa_mod.try_compile_glushkov(pattern)
+    assert pallas_nfa.use_gather_b(model)
+    data = make_text(2500, inject=[(10, b"zeppelin obsidian"), (2400, b"quantum")])
+    _kernel_vs_dfa(pattern, data)
+
+
+def test_compare_b_mode_for_small_patterns():
+    model = nfa_mod.try_compile_glushkov("colou?r")
+    assert not pallas_nfa.use_gather_b(model)
