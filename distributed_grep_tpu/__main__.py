@@ -53,15 +53,32 @@ def cmd_grep(args: argparse.Namespace) -> int:
             print(f"error: empty pattern file: {args.patterns_file}", file=sys.stderr)
             return 2
         if any(not ln for ln in raw):
-            # grep -F -f: an empty pattern line matches every line
+            # grep -f: an empty pattern line matches every line
             patterns = None
             args.pattern = ""
+        elif args.extended_regexp:
+            # grep -E -f: each line is a regex; the set is their alternation,
+            # compiled by the single-pattern engines (NFA/DFA)
+            decoded = [ln.decode("utf-8", "surrogateescape") for ln in raw]
+            for rx in decoded:
+                try:
+                    re.compile(rx)
+                except re.error as e:
+                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
+                    return 2
+            patterns = None
+            # plain groups: the device subset compiler (models/dfa) knows
+            # (..) but not (?:..); groups are non-capturing there anyway
+            args.pattern = "(" + "|".join(f"({rx})" for rx in decoded) + ")"
         else:
             patterns = [ln.decode("utf-8", "surrogateescape") for ln in raw]
     if args.pattern is None and patterns is None:
         print("error: need a PATTERN or -f FILE", file=sys.stderr)
         return 2
-    if patterns is None and not args.patterns_file:
+    # validate any single-pattern path — including the -E -f alternation,
+    # whose wrapping can break group-sensitive regexes (backreferences)
+    # even when every line compiled on its own
+    if patterns is None and args.pattern is not None:
         try:
             re.compile(args.pattern)
         except re.error as e:
@@ -194,9 +211,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="print match counts per file instead of lines (grep -c)")
     p.add_argument(
         "-f", "--patterns-file", default=None,
-        help="literal pattern set, one per line (grep -F -f semantics; "
-             "device scan uses Aho-Corasick/FDR pattern-set engines)",
+        help="pattern set, one per line: literals by default (grep -F -f; "
+             "device scan uses Aho-Corasick/FDR pattern-set engines), or "
+             "regexes with -E (compiled as one alternation)",
     )
+    p.add_argument("-E", "--extended-regexp", action="store_true",
+                   help="with -f: treat pattern-file lines as regexes")
     _add_common(p)
     p.set_defaults(fn=cmd_grep)
 

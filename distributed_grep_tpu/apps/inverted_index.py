@@ -1,0 +1,36 @@
+"""Inverted index — the third application on the Map/Reduce boundary.
+
+The 6.824 lab family's other canonical app (the reference ships only grep,
+application/grep.go): Map emits (word, filename) per distinct word in the
+split; Reduce folds the filenames into "count file1,file2,..." sorted and
+de-duplicated.  Exists to prove the application boundary generalizes
+beyond grep and wordcount — no engine coupling, pure contract.
+"""
+
+from __future__ import annotations
+
+import re
+
+from distributed_grep_tpu.apps.base import KeyValue
+
+_word_re = re.compile(rb"[A-Za-z]+")
+_min_len = 1
+
+
+def configure(min_word_len: int = 1, **_: object) -> None:
+    global _min_len
+    _min_len = int(min_word_len)
+
+
+def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
+    words = {
+        w.lower().decode("ascii")
+        for w in _word_re.findall(contents)
+        if len(w) >= _min_len
+    }
+    return [KeyValue(key=w, value=filename) for w in sorted(words)]
+
+
+def reduce_fn(key: str, values: list[str]) -> str:
+    files = sorted(set(values))
+    return f"{len(files)} {','.join(files)}"

@@ -105,3 +105,36 @@ def test_grep_invert_both_apps():
     want = ["f (line number #2)", "f (line number #4)"]
     assert [kv.key for kv in cpu_app.map_fn("f", data)] == want
     assert [kv.key for kv in tpu_app.map_fn("f", data)] == want
+
+
+def test_inverted_index_app():
+    from distributed_grep_tpu.apps.base import group_reduce
+    from distributed_grep_tpu.apps.loader import load_application
+
+    # fresh module instance (the runtime's isolation) — no state leaks
+    ii = load_application("distributed_grep_tpu.apps.inverted_index").module
+    ii.configure(min_word_len=2)
+    recs = ii.map_fn("a.txt", b"the cat sat\nThe dog") + \
+        ii.map_fn("b.txt", b"a cat runs")
+    out = group_reduce(recs, ii.reduce_fn)
+    assert out["cat"] == "2 a.txt,b.txt"
+    assert out["dog"] == "1 a.txt"
+    assert "a" not in out  # min_word_len filters
+
+
+def test_inverted_index_through_runtime(tmp_path):
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    f1, f2 = tmp_path / "x.txt", tmp_path / "y.txt"
+    f1.write_bytes(b"alpha beta\n")
+    f2.write_bytes(b"beta gamma\n")
+    cfg = JobConfig(
+        input_files=[str(f1), str(f2)],
+        application="distributed_grep_tpu.apps.inverted_index",
+        n_reduce=3,
+        work_dir=str(tmp_path / "job"),
+    )
+    res = run_job(cfg, n_workers=2)
+    assert res.results["beta"] == f"2 {f1},{f2}"
+    assert res.results["alpha"] == f"1 {f1}"
