@@ -67,9 +67,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
                     print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
                     return 2
             patterns = None
-            # plain groups: the device subset compiler (models/dfa) knows
-            # (..) but not (?:..); groups are non-capturing there anyway
-            args.pattern = "(" + "|".join(f"({rx})" for rx in decoded) + ")"
+            # non-capturing groups: wrapping with (..) would renumber any
+            # backreferences inside the lines (the device subset compiler
+            # parses (?:..) too, models/dfa.py)
+            args.pattern = "(?:" + "|".join(f"(?:{rx})" for rx in decoded) + ")"
         else:
             patterns = [ln.decode("utf-8", "surrogateescape") for ln in raw]
     if args.pattern is None and patterns is None:

@@ -535,7 +535,15 @@ def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
     from distributed_grep_tpu.utils import native
 
     full = table.full_table()
-    offsets, _ = native.dfa_scan(data, full, table.accept.astype(np.uint8), table.start)
+    if len(data) >= native.MT_THRESHOLD_BYTES:
+        # multi-core native scan; newline-aligned chunks keep it exact
+        offsets = native.dfa_scan_mt(
+            data, full, table.accept.astype(np.uint8), table.start
+        )
+    else:
+        offsets, _ = native.dfa_scan(
+            data, full, table.accept.astype(np.uint8), table.start
+        )
     if not table.accept_eol.any():
         return offsets
     # Recompute the state sequence to evaluate accept_eol positions.

@@ -72,6 +72,18 @@ def _try_load() -> ctypes.CDLL | None:
         ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_uint32),
     ]
+    if hasattr(lib, "dgrep_dfa_scan_mt"):
+        lib.dgrep_dfa_scan_mt.restype = ctypes.c_size_t
+        lib.dgrep_dfa_scan_mt.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+            ctypes.c_uint32,
+        ]
     _lib = lib
     return _lib
 
@@ -178,4 +190,50 @@ def dfa_scan(
         )
         if n <= cap:
             return np.ctypeslib.as_array(buf)[:n].copy(), int(final.value)
+        cap = n
+
+
+# Big inputs fan the DFA scan across threads; newline-aligned chunking keeps
+# output byte-identical (every state's '\n' transition is the start state —
+# the table invariant the device stripes rely on too).
+MT_THRESHOLD_BYTES = 1 << 22
+
+
+def dfa_scan_mt(
+    data: bytes,
+    table: np.ndarray,
+    accept: np.ndarray,
+    start_state: int = 0,
+    n_threads: int | None = None,
+) -> np.ndarray:
+    """Multithreaded DFA scan (accept end-offsets only; no final state —
+    chunked scans have no single sequential final state)."""
+    import os
+
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_dfa_scan_mt"):
+        offsets, _ = dfa_scan(data, table, accept, start_state)
+        return offsets
+    if n_threads is None:
+        n_threads = min(8, os.cpu_count() or 1)
+    table = np.ascontiguousarray(table, dtype=np.uint16)
+    accept_bytes = np.ascontiguousarray(accept, dtype=np.uint8).tobytes()
+    # this path only runs on multi-MB inputs: size the first buffer off the
+    # data (one match per ~64 bytes) so a match-dense corpus doesn't pay a
+    # second full scan just to learn the count
+    cap = max(4096, len(data) >> 6)
+    while True:
+        buf = (ctypes.c_uint64 * cap)()
+        n = lib.dgrep_dfa_scan_mt(
+            data,
+            len(data),
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            accept_bytes,
+            start_state,
+            buf,
+            cap,
+            n_threads,
+        )
+        if n <= cap:
+            return np.ctypeslib.as_array(buf)[:n].copy()
         cap = n

@@ -21,6 +21,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <thread>
+#include <vector>
 
 extern "C" {
 
@@ -95,6 +97,60 @@ size_t dgrep_dfa_scan(const uint8_t* data, size_t len,
         }
     }
     if (final_state) *final_state = s;
+    return count;
+}
+
+// Multithreaded DFA scan.  Chunk boundaries snap to the byte AFTER a
+// newline; because every state's '\n' transition is the start state (the
+// newline-reset invariant all tables here share, models/dfa.py DfaTable),
+// scanning each chunk from start_state produces byte-identical output to
+// the sequential scan — the same property the device path's stripe layout
+// exploits.  Offsets are written in ascending order; returns the total
+// accept count (writes up to max_out).
+size_t dgrep_dfa_scan_mt(const uint8_t* data, size_t len,
+                         const uint16_t* table, const uint8_t* accept,
+                         uint32_t start_state,
+                         uint64_t* out, size_t max_out,
+                         uint32_t n_threads) {
+    if (n_threads < 2 || len < (size_t)n_threads * 4096) {
+        uint32_t fin;
+        return dgrep_dfa_scan(data, len, table, accept, start_state,
+                              out, max_out, &fin);
+    }
+    std::vector<size_t> bounds;
+    bounds.push_back(0);
+    for (uint32_t t = 1; t < n_threads; ++t) {
+        size_t want = len * t / n_threads;
+        if (want <= bounds.back()) continue;
+        const void* nl = memchr(data + want, '\n', len - want);
+        size_t b = nl ? (size_t)((const uint8_t*)nl - data) + 1 : len;
+        if (b > bounds.back() && b < len) bounds.push_back(b);
+    }
+    bounds.push_back(len);
+
+    size_t parts = bounds.size() - 1;
+    std::vector<std::vector<uint64_t>> hits(parts);
+    std::vector<std::thread> threads;
+    for (size_t p = 0; p < parts; ++p) {
+        threads.emplace_back([&, p]() {
+            size_t lo = bounds[p], hi = bounds[p + 1];
+            uint32_t s = start_state;
+            std::vector<uint64_t>& h = hits[p];
+            for (size_t i = lo; i < hi; ++i) {
+                s = table[((size_t)s << 8) | data[i]];
+                if (accept[s]) h.push_back((uint64_t)i + 1);
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+
+    size_t count = 0;
+    for (size_t p = 0; p < parts; ++p) {
+        for (uint64_t off : hits[p]) {
+            if (count < max_out) out[count] = off;
+            ++count;
+        }
+    }
     return count;
 }
 

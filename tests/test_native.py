@@ -60,3 +60,29 @@ def test_dfa_scan_and_state_carry():
 def test_native_lib_actually_loaded():
     # The toolchain is baked into the image; the native path must be active.
     assert native.native_available()
+
+
+def test_dfa_scan_mt_matches_sequential():
+    from distributed_grep_tpu.models.aho import compile_aho_corasick
+    from distributed_grep_tpu.models.dfa import compile_dfa
+
+    rng = np.random.default_rng(7)
+    data = bytes(rng.choice(list(b"abcdefg \n"), size=1 << 20).tolist())
+    data += b"needle at end"
+    for table in (compile_dfa("nee(dle|g)"), compile_aho_corasick([b"needle", b"fgab"])):
+        full = table.full_table()
+        acc = table.accept.astype(np.uint8)
+        seq, _ = native.dfa_scan(data, full, acc, table.start)
+        for nt in (2, 3, 8):
+            mt = native.dfa_scan_mt(data, full, acc, table.start, n_threads=nt)
+            np.testing.assert_array_equal(mt, seq, err_msg=f'n_threads={nt}')
+
+
+def test_dfa_scan_mt_small_input_falls_through():
+    from distributed_grep_tpu.models.dfa import compile_dfa
+
+    t = compile_dfa("ab")
+    data = b"xxabyy\nab\n"
+    seq, _ = native.dfa_scan(data, t.full_table(), t.accept.astype(np.uint8), t.start)
+    mt = native.dfa_scan_mt(data, t.full_table(), t.accept.astype(np.uint8), t.start)
+    np.testing.assert_array_equal(mt, seq)
