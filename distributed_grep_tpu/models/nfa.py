@@ -48,7 +48,11 @@ import numpy as np
 from distributed_grep_tpu.models import dfa as _dfa
 from distributed_grep_tpu.models.dfa import NL, RegexError
 
-MAX_POSITIONS = 64  # state spans <= 2 uint32 words per lane
+# State spans MAX_POSITIONS/32 uint32 words per lane.  128 (4 words) since
+# the kernel's gather-B mode made wide patterns affordable — per-word B
+# cost is fixed (ops/pallas_nfa.use_gather_b) — and pallas_nfa.MAX_COST
+# still gates genuinely expensive automata onto the XLA DFA path.
+MAX_POSITIONS = 128
 WORD_BITS = 32
 
 

@@ -6,10 +6,11 @@ interface):
 
 1. ``shift_and`` — literal/class sequences <= 32 symbols: bit-parallel VPU
    scan (Pallas kernel on TPU, XLA scan elsewhere);
-2. ``nfa``       — general regex (alternations, repeats, '^') <= 64
+2. ``nfa``       — general regex (alternations, repeats, '^') <= 128
    Glushkov positions: bit-parallel position-automaton Pallas kernel
-   (models/nfa.py, ops/pallas_nfa.py) — gather-free, so it keeps Pallas
-   throughput where the DFA's table gather would fall off the cliff;
+   (models/nfa.py, ops/pallas_nfa.py) — per-word bit-ops (range-compare
+   or lane-gather B), so it keeps Pallas throughput where the DFA's
+   per-byte table gather would fall off the cliff;
 3. ``dfa``       — anything the subset compiler handles within the state
    cap ('$' accepts, big patterns, pattern-set banks): vectorized DFA
    table scan (XLA);

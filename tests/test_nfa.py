@@ -186,3 +186,34 @@ def test_gather_b_mode_picked_and_exact():
 def test_compare_b_mode_for_small_patterns():
     model = nfa_mod.try_compile_glushkov("colou?r")
     assert not pallas_nfa.use_gather_b(model)
+
+
+def test_wide_pattern_four_word_state():
+    # ~100 Glushkov positions -> 4 uint32 state words; interpret-mode kernel
+    # must agree with the DFA oracle byte-for-byte
+    words = ["volcano", "anarchism", "philosophy", "wikipedia", "quantum",
+             "zeppelin", "obsidian", "telescope", "metabolic", "hurricane",
+             "labyrinth", "xylophone"]
+    pattern = "(" + "|".join(words) + ")"
+    model = nfa_mod.try_compile_glushkov(pattern)
+    assert model is not None and model.n_words == 4, model and model.n_pos
+    assert pallas_nfa.eligible(model)
+    data = make_text(2000, inject=[(3, b"a labyrinth of xylophones"),
+                                   (1000, b"metabolic hurricane"),
+                                   (1999, b"telescope")])
+    _kernel_vs_dfa(pattern, data)
+
+
+def test_wide_pattern_bounded_repeat():
+    # 92 positions compile now (>64); the ~50 optional-tail specials put it
+    # over the kernel budget (XLA DFA path), but the model itself must be
+    # exact — bit-parallel reference vs the DFA oracle
+    model = nfa_mod.try_compile_glushkov("a[bc]{40,90}d")
+    assert model is not None and model.n_pos > 64
+    assert not pallas_nfa.eligible(model)  # specials-heavy -> XLA path
+    table = dfa_mod.compile_dfa("a[bc]{40,90}d")
+    data = make_text(500, inject=[(7, b"a" + b"bc" * 30 + b"d"),
+                                  (400, b"a" + b"c" * 95 + b"d")])
+    np.testing.assert_array_equal(
+        nfa_mod.scan_reference(model, data), dfa_mod.reference_scan(table, data)
+    )
