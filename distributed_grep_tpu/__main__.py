@@ -21,6 +21,30 @@ import sys
 from distributed_grep_tpu.utils.config import JobConfig
 
 
+def _has_backref(rx: str) -> bool:
+    """True if the regex uses any group-number-sensitive construct: a
+    numeric (\\1) or named ((?P=name)) backreference, or a conditional
+    group test ((?(1)...)).  Walks re's own parse tree rather than
+    scanning text, so octal escapes inside character classes ("[\\1]") and
+    literal '(?P=' inside classes are not false positives.  Only called
+    on patterns re.compile already accepted."""
+    import re._parser as parser
+
+    def walk(node) -> bool:
+        if isinstance(node, parser.SubPattern):
+            return any(walk(item) for item in node)
+        if isinstance(node, tuple):
+            op = node[0]
+            if op in (parser.GROUPREF, parser.GROUPREF_EXISTS):
+                return True
+            return any(walk(x) for x in node[1:])
+        if isinstance(node, list):
+            return any(walk(x) for x in node)
+        return False
+
+    return walk(parser.parse(rx))
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--n-reduce", type=int, default=None)
     p.add_argument("--workers", type=int, default=2, help="in-process worker threads")
@@ -47,8 +71,12 @@ def cmd_grep(args: argparse.Namespace) -> int:
             print(f"error: no such file: {args.patterns_file}", file=sys.stderr)
             return 2
         # bytes + surrogateescape: pattern files need not be UTF-8 (the apps
-        # re-encode with surrogateescape, so arbitrary bytes round-trip)
-        raw = pf.read_bytes().splitlines()
+        # re-encode with surrogateescape, so arbitrary bytes round-trip).
+        # Split on \n only — splitlines() would also split on \r/\v/\f/\x85
+        # and silently fragment literal patterns containing those bytes.
+        raw = pf.read_bytes().split(b"\n")
+        if raw and raw[-1] == b"":
+            raw.pop()  # a trailing newline is a terminator, not an empty pattern
         if not raw:
             print(f"error: empty pattern file: {args.patterns_file}", file=sys.stderr)
             return 2
@@ -66,6 +94,18 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 except re.error as e:
                     print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
                     return 2
+            if len(decoded) > 1 and any(_has_backref(rx) for rx in decoded):
+                # Joining lines into one alternation offsets group numbers
+                # by the capturing groups of earlier lines, so a line's
+                # backreference would silently point at another line's
+                # group.  re.compile can't catch the semantic change.
+                print(
+                    "error: -E -f pattern lines use backreferences, which "
+                    "do not survive being joined into one alternation; "
+                    "run such patterns individually",
+                    file=sys.stderr,
+                )
+                return 2
             patterns = None
             # non-capturing groups: wrapping with (..) would renumber any
             # backreferences inside the lines (the device subset compiler

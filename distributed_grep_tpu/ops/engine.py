@@ -527,8 +527,15 @@ class GrepEngine:
         except Exception as e:
             # Dispatch is async: a kernel can fail at execution time (first
             # consumed in collect) as well as at compile time.  Mosaic
-            # limits are empirical — on any FDR device failure, flip to the
+            # limits are empirical — on an FDR device failure, flip to the
             # exact DFA banks and rescan; everything else propagates.
+            # Host-side failures that cannot come from the Pallas/Mosaic
+            # layer must not be misattributed to it (and silently retried
+            # on the slower DFA path).  Only types jax internals never
+            # surface kernel failures as: AttributeError/KeyError/etc. DO
+            # occur inside jax on version skew, so they stay in the net.
+            if isinstance(e, (MemoryError, UnicodeError)):
+                raise
             if not use_fdr:
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)

@@ -52,7 +52,11 @@ def collate_outputs(workdir: WorkDir) -> dict[str, str]:
     """
     results: dict[str, str] = {}
     for path in workdir.list_outputs():
-        for line in path.read_text(encoding="utf-8").splitlines():
+        # Read as bytes and split on \n only: values may contain \r (or
+        # \x85,  , ...) — text-mode read_text() would translate a lone
+        # \r to \n (universal newlines), and splitlines() would fragment
+        # the record at any of those characters.
+        for line in path.read_bytes().decode("utf-8", "replace").split("\n"):
             if line:
                 k, _, v = line.partition("\t")
                 results[k] = v

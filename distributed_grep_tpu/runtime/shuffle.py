@@ -36,7 +36,10 @@ def encode_records(records: list[KeyValue]) -> bytes:
 
 def decode_records(data: bytes) -> list[KeyValue]:
     out: list[KeyValue] = []
-    for line in data.decode("utf-8").splitlines():
+    # Split on \n only: JSON escapes \r and \n inside strings but leaves
+    #  /  literal with ensure_ascii=False, and splitlines() would
+    # fragment records at those characters.
+    for line in data.decode("utf-8").split("\n"):
         if line:
             k, v = json.loads(line)
             out.append(KeyValue(k, v))

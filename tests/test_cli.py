@@ -1,0 +1,113 @@
+"""CLI-level tests for the grep launcher (``__main__.py``).
+
+The reference's launchers take bare argv and hardcode the rest
+(main/coordinator_launch.go:11-23, main/worker_launch.go:11-19); ours parse
+real flags, so the flag semantics need their own coverage — particularly
+the grep -f byte-exactness contract (patterns are arbitrary bytes split on
+'\\n' only) and the -E -f alternation-join restrictions.
+"""
+
+import sys
+
+import pytest
+
+from distributed_grep_tpu.__main__ import _has_backref, main
+
+
+def run_cli(argv, capsys):
+    code = main(argv)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_grep_literal(tmp_path, corpus, capsys):
+    code, out, _ = run_cli(
+        ["grep", "hello", str(corpus["a.txt"]), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "hello world" in out and "hello again" in out
+    assert "quick brown" not in out
+
+
+def test_patterns_file_splits_on_newline_only(tmp_path, corpus, capsys):
+    """grep -f splits patterns on \\n only: a literal containing \\r (or \\v,
+    \\f, \\x85) must stay one pattern, not fragment into two."""
+    target = tmp_path / "crlf.txt"
+    target.write_bytes(b"seek\rhere\nplain text\njust seek\n")
+    pf = tmp_path / "pats.txt"
+    pf.write_bytes(b"seek\rhere\n")  # one pattern with an embedded \r
+    code, out, _ = run_cli(
+        ["grep", "-f", str(pf), str(target), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "seek\rhere" in out
+    # "just seek" matches only if the pattern fragmented at the \r
+    assert "just seek" not in out
+
+
+def test_patterns_file_trailing_newline_not_empty_pattern(tmp_path, corpus, capsys):
+    """A pattern file ending in \\n has no empty last pattern (grep semantics:
+    an empty pattern would match every line)."""
+    pf = tmp_path / "pats.txt"
+    pf.write_bytes(b"fox\n")
+    code, out, _ = run_cli(
+        ["grep", "-f", str(pf), str(corpus["a.txt"]), str(corpus["b.txt"]),
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "quick brown fox" in out and "fox says hello" in out
+    assert "nothing here" not in out  # empty pattern would have matched all
+
+
+def test_e_f_backreference_rejected(tmp_path, capsys):
+    """-E -f lines joined into one alternation renumber capturing groups, so
+    a backreference would silently bind to another line's group: reject."""
+    target = tmp_path / "t.txt"
+    target.write_text("abab\ncdcd\n")
+    pf = tmp_path / "pats.txt"
+    pf.write_text("(a)b\\1\n(c)d\n")
+    code, _, err = run_cli(
+        ["grep", "-E", "-f", str(pf), str(target), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 2
+    assert "backreference" in err
+
+
+def test_e_f_single_backref_line_ok(tmp_path, capsys):
+    """One line alone is wrapped only in non-capturing groups — group numbers
+    survive, so a single-line backreference still works."""
+    target = tmp_path / "t.txt"
+    target.write_text("abab\nabcd\n")
+    pf = tmp_path / "pats.txt"
+    pf.write_text("(ab)\\1\n")
+    code, out, _ = run_cli(
+        ["grep", "-E", "-f", str(pf), str(target), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "abab" in out and "abcd" not in out
+
+
+@pytest.mark.parametrize(
+    "rx,expect",
+    [
+        (r"(a)\1", True),
+        (r"(?P<x>a)(?P=x)", True),
+        (r"a\\1", False),  # escaped backslash then digit — not a backref
+        (r"\0", False),  # octal zero, not a backref
+        (r"(a)(b)", False),
+        (r"(a)\\\1", True),  # escaped backslash, then a real backref
+        (r"(a)[\1]", False),  # inside a class: octal escape, not a backref
+        (r"[(?P=]", False),  # inside a class: literal characters
+        (r"(a)[]\1]", False),  # ']' literal as first member; still in class
+        (r"(a)[^]\1]", False),  # same with negation
+        (r"(a)[^^]\1", True),  # class closed, then a real backref
+        (r"(c)(?(1)z|w)", True),  # conditional group test — number-sensitive
+    ],
+)
+def test_has_backref(rx, expect):
+    assert _has_backref(rx) is expect
