@@ -8,29 +8,33 @@ Aho-Corasick DFA over 10k patterns has ~60k states, and a per-byte table
 gather at that size is the XLA scan path's ~0.1 GB/s cliff.  Hyperscan's
 answer is FDR: superimpose the set into a few *buckets*, filter the stream
 with shift-AND over per-position reach tables, and confirm rare candidates
-exactly.  This module is that idea rebuilt around what the TPU can do fast:
+exactly.  This module is that idea rebuilt around what the TPU can do fast.
 
-* 32 buckets — one uint32 per lane, the same tile shape every other kernel
-  here uses;
-* reach tables indexed by a *pair-domain hash* ``h = ((b0*37) ^ (b1*101))
-  & (D-1)`` of two consecutive bytes — single-byte reach saturates at these
-  set sizes, a pair domain of 128..512 entries keeps per-bucket densities
-  in the few-percent range;
-* D <= 512 because the kernel's lane-gather (``take_along_axis`` over a
-  128-lane vreg) covers 128 entries per op — D/128 gathers + selects per
-  lookup (ops/pallas_fdr.py);
-* the filter checks the last ``m+1`` bytes of every position (m pair
-  checks, m <= 5); a candidate only says "some bucket's superimposition
-  matched here" — the engine re-checks the candidate's *line* on the host
-  with the exact Aho-Corasick tables (ops/engine.py), so end-to-end output
-  is exact, mirroring how boundary lines are already stitched.
-* sets whose densities are still too high shard into independent *banks*
-  (extra device passes over the same bytes), length-stratified so short
-  patterns don't drag the window down for everyone.
+Design (v2 — the round-2 redesign that took config 5 off its 5-pass cost):
 
-The expected false-positive rate is computed exactly from the built tables
-(``FdrBank.fp_per_byte``), and bank/domain choice is a small cost search
-over that estimate — not a heuristic guess.
+* 32 buckets — one uint32 per lane, the tile shape every kernel here uses.
+* One *suffix window* per bank: every member is represented by its last
+  ``m+1`` bytes (a true match always contains its suffix, so candidates
+  stay a superset; the exact confirm restores precision).  No per-length
+  bank fan-out — one device pass hosts the whole set.
+* Reach tables indexed by a pair-domain hash ``h = ((b0*a) ^ (b1*b)) &
+  (D-1)`` of two consecutive bytes, D <= 512 (the kernel's lane-gather
+  covers 128 entries per op, D/128 gathers per lookup).
+* **Clustered bucket assignment** — the key density trick: members are
+  sorted by their final-pair hash and buckets are rank ranges, so each
+  bucket covers a contiguous ~D/32 slice of hash space at the final-pair
+  check.  That one check's bucket density is ~1/32 *independent of set
+  size* (vs ~n_bucket/D for an unclustered check): for a 10k set it is
+  worth ~4.4 unclustered lookups for the price of one.
+* A tunable **check plan**: a list of (pipeline slot, hash family) table
+  lookups.  Slot k checks the byte pair at depth m-1-k from the window
+  end; two independent hash families (HASHES) give up to 2 checks per
+  slot.  The tuner picks how many lookups to spend (more lookups = lower
+  candidate rate = more device time), minimizing measured total cost
+  (device scan + expected confirm) rather than chasing a fixed FP.
+
+The expected candidate rate is computed exactly from the built tables
+(``_fp_of_stack``), so the clustering win is measured, not assumed.
 """
 
 from __future__ import annotations
@@ -41,28 +45,37 @@ import numpy as np
 
 NL = 0x0A
 N_BUCKETS = 32
-MAX_M = 5  # pair checks per position; window = MAX_M + 1 bytes
+MAX_DEPTHS = 6  # pipeline slots; window = depths + 1 <= 7 bytes
 DOMAINS = (128, 256, 512)  # kernel gathers per lookup = D / 128
-# Two independent pair hashes: ANDing both lookups squares the per-check
-# density (d -> d1*d2), which beats adding banks for dense full-alphabet
-# sets (a 10k Snort-style set needs 12 single-hash banks but only 2
-# two-hash banks for the same FP) at 2x the per-bank lookup cost.
+# Two independent pair hash families; ANDing lookups of both families at
+# one slot squares that slot's density (d -> d0*d1), which beats adding
+# banks for dense full-alphabet sets.
 HASHES = ((37, 101), (171, 59))
 # Sets whose best achievable candidate rate is still above this are not
-# worth filtering (the host confirm would dominate): compile_fdr raises and
-# the engine keeps the exact DFA banks instead.
-FP_CEILING_PER_BYTE = 1e-2
-# Mosaic compile ceiling, measured on TPU v5e (2026-07-30): kernels up to 24
-# lane-gathers per byte compile; 32 (e.g. m=4 x D=512 x 2 hashes) crash the
-# compiler.  The tuner never emits a bank over this.
-MAX_GATHERS = 24
-# Total-cost model for the tuner, per scanned byte: one scan_cost unit
-# costs ~2.1 ps on v5e (calibrated: a 480-unit 12-bank config measured
-# 1.0 GB/s), and one expected candidate costs ~120 ns of host confirm
-# (~120-byte line re-scanned by the native DFA at ~1 GB/s).  The optimum
-# trades filter passes against confirm work instead of chasing a fixed FP.
-COST_PS_PER_UNIT = 2.1
-CONFIRM_PS_PER_CANDIDATE = 120_000.0
+# worth filtering (the confirm would dominate): compile_fdr raises and the
+# engine keeps the exact DFA banks instead.
+FP_CEILING_PER_BYTE = 2e-2
+
+# Total-cost model for the tuner, per scanned byte, calibrated on TPU v5e
+# (2026-07-30, probe recorded in ops/pallas_fdr.py docstring): a merged
+# one-pass kernel runs at ~56/L GB/s for L D=512 lookups (smaller domains
+# cost proportionally fewer gathers), i.e. ~17.9 ps per lookup-unit.  One
+# expected candidate costs ~9 ns of confirm (measured: the native
+# suffix-hash probe, utils/native.ConfirmSet, 7.5 ns/candidate
+# single-thread on this host's 10k-set over sorted uniform offsets; the
+# margin covers FDR candidates being hash-biased toward slot hits, which
+# walk pattern chains more often).  The engine overlaps the confirm
+# of segment i with the device scan of segment i+1, so the steady-state
+# per-byte cost is max(scan, confirm) plus a small non-overlapped share —
+# the objective below — not their sum.
+COST_PS_PER_LOOKUP = 17.9
+LOOKUP_UNITS = {128: 0.3, 256: 0.55, 512: 1.0}
+CONFIRM_PS_PER_CANDIDATE = 9_000.0
+OVERLAP_RESIDUE = 0.2  # fraction of the smaller leg that fails to overlap
+# Kernel compile ceiling: lane-gathers per byte step (= lookups * D/128).
+# Probed on v5e at the kernel's unroll=8: 40 compiles and runs; the old
+# 24-gather ceiling was an unroll-32 artifact (ops/pallas_fdr.py notes).
+MAX_GATHERS = 40
 
 
 def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int, which: int = 0):
@@ -77,26 +90,36 @@ class FdrError(ValueError):
 
 @dataclass(frozen=True)
 class FdrBank:
-    """One filter pass: m pair-position reach tables over a D-entry domain,
-    optionally ANDed across two independent hashes."""
+    """One filter pass: a check plan over an m-slot pipeline.
 
-    m: int  # pair checks (window = m+1 bytes)
+    ``checks[i] = (slot, family)``: lookup i probes ``tables[i]`` with hash
+    family ``family`` of the byte pair at slot ``slot``; slot k is applied
+    k steps after the oldest check, so it covers the pair at depth m-1-k
+    from the window end.  Checks sharing a slot AND together before
+    entering the pipeline."""
+
+    m: int  # pipeline slots (window = m+1 bytes)
     domain: int  # table entries; D/128 lane-gathers per lookup
-    tables: np.ndarray  # (n_hashes, m, domain) uint32 bucket masks
-    patterns: list[bytes]  # normalized members (for debugging/repr)
+    checks: tuple[tuple[int, int], ...]  # (slot, family) per lookup
+    tables: np.ndarray  # (n_checks, domain) uint32 bucket masks
+    patterns: list[bytes]  # normalized suffix members (for debugging/repr)
     fp_per_byte: float  # expected candidate rate on uniform bytes
 
     @property
-    def n_hashes(self) -> int:
-        return self.tables.shape[0]
+    def n_checks(self) -> int:
+        return len(self.checks)
 
     @property
     def n_subtables(self) -> int:
         return self.domain // 128
 
-    def scan_cost(self) -> int:
-        """Relative per-byte device cost (gathers dominate)."""
-        return self.m * self.n_hashes * (2 * self.n_subtables + 2)
+    @property
+    def families(self) -> tuple[int, ...]:
+        return tuple(sorted({f for _, f in self.checks}))
+
+    def scan_cost_ps(self) -> float:
+        """Modeled per-byte device cost (lookups dominate)."""
+        return COST_PS_PER_LOOKUP * LOOKUP_UNITS[self.domain] * self.n_checks
 
 
 @dataclass(frozen=True)
@@ -109,8 +132,8 @@ class FdrModel:
     def fp_per_byte(self) -> float:
         return float(sum(b.fp_per_byte for b in self.banks))
 
-    def scan_cost(self) -> int:
-        return sum(b.scan_cost() for b in self.banks)
+    def scan_cost_ps(self) -> float:
+        return sum(b.scan_cost_ps() for b in self.banks)
 
     @property
     def window(self) -> int:
@@ -131,162 +154,154 @@ def _normalize(patterns: list[str | bytes], ignore_case: bool) -> list[bytes]:
     return out
 
 
-def _bank_tables(group: list[bytes], m: int, domain: int, n_hashes: int) -> np.ndarray:
-    """Build (n_hashes, m, domain) uint32 reach tables for one bank.
+def _full_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
+    """Build the full (2 families x m slots, domain) uint32 reach stack for
+    one bank over the members' (m+1)-byte suffixes.
 
-    Bucket assignment sorts patterns by their final-pair hash so literals
-    sharing a tail land in the same bucket — distinct hashes per (bucket,
-    position) is what sets the density, so clustering identical tails is
-    free selectivity.
+    Bucket assignment sorts members by their final-pair hash (family 0) and
+    buckets are rank ranges — so the slot m-1 / family 0 check sees each
+    bucket covering a contiguous ~domain/N_BUCKETS hash slice: its density
+    is ~1/N_BUCKETS regardless of set size (the clustering trick).  Rows
+    are ordered ``family * m + slot``.
     """
     order = sorted(
         range(len(group)),
-        key=lambda i: int(pair_hash(group[i][-2], group[i][-1], domain)),
+        key=lambda i: (int(pair_hash(group[i][-2], group[i][-1], domain)), group[i]),
     )
-    tables = np.zeros((n_hashes, m, domain), dtype=np.uint32)
+    tables = np.zeros((2 * m, domain), dtype=np.uint32)
     n = len(group)
     for rank, i in enumerate(order):
         p = group[i]
         bucket = rank * N_BUCKETS // n
         bit = np.uint32(1 << bucket)
         for k in range(m):
-            # Pipeline slot k is applied k steps after the oldest check, so
-            # tables[:, k] holds the pair at depth m-1-k from the pattern
-            # end: candidate(t) = AND_k AND_h tables[h, k][hash_h(pair at
-            # t-(m-1-k))], and the pair at depth d ends exactly at byte t-d.
+            # Slot k covers the pair at depth m-1-k from the suffix end;
+            # the pair at depth d ends exactly at byte t-d.
             d = m - 1 - k
             b0, b1 = p[len(p) - 2 - d], p[len(p) - 1 - d]
-            for h in range(n_hashes):
-                tables[h, k, int(pair_hash(b0, b1, domain, which=h))] |= bit
+            for h in range(2):
+                tables[h * m + k, int(pair_hash(b0, b1, domain, which=h))] |= bit
     return tables
 
 
-def _fp_estimate(tables: np.ndarray) -> float:
+def _fp_of_stack(stack: np.ndarray) -> float:
     """Expected candidate probability per byte on uniform random pairs:
-    sum over buckets of prod over (position, hash) of that bucket's
-    density (the two hashes of one pair are treated as independent)."""
-    n_hashes, m, domain = tables.shape
-    bits = (tables[:, :, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
-    dens = bits.sum(axis=2) / domain  # (n_hashes, m, N_BUCKETS)
-    return float(np.prod(dens.reshape(n_hashes * m, N_BUCKETS), axis=0).sum())
+    sum over buckets of prod over checks of that bucket's density (checks
+    are treated as independent — different pairs, or different hash
+    families of one pair)."""
+    bits = (stack[:, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
+    dens = bits.sum(axis=1) / stack.shape[1]  # (n_checks, N_BUCKETS)
+    return float(np.prod(dens, axis=0).sum())
+
+
+def _plan(m: int, n_lookups: int) -> tuple[tuple[int, int], ...]:
+    """Check plan for a lookup budget: first family 0 at every slot (slot
+    m-1 — the final pair — is the clustered check and always included),
+    then family 1 from the deepest slot down (slot m-1's family-1 density
+    rides the residual clustering, measurably below an unclustered check)."""
+    checks = [(k, 0) for k in range(m)]
+    checks += [(k, 1) for k in range(m - 1, -1, -1)]
+    if not 1 <= n_lookups <= 2 * m:
+        raise ValueError(f"lookup budget {n_lookups} outside 1..{2 * m}")
+    chosen = checks[:n_lookups]
+    if (m - 1, 0) not in chosen:  # tiny budgets: keep the clustered check
+        chosen[-1] = (m - 1, 0)
+    return tuple(chosen)
 
 
 def _compile_group(
-    group: list[bytes], m: int, fp_budget: float, max_banks: int
+    group: list[bytes], m: int, fp_budget: float, max_banks: int = 4
 ) -> list[FdrBank]:
-    """Pick (domain, n_hashes, n_banks) for one length-stratified group by
-    minimizing the total-cost model (scan + expected confirm) subject to
-    the FP budget, with a statistical prescreen so only the most promising
-    few configurations pay for an exact table build."""
+    """Pick (domain, n_lookups, n_banks) for one window group by minimizing
+    the total-cost model (scan + expected confirm), preferring
+    budget-satisfying configurations when any exists."""
 
-    def total_ps(cost_units: float, fp: float) -> float:
-        return cost_units * COST_PS_PER_UNIT + fp * CONFIRM_PS_PER_CANDIDATE
+    def total_ps(cost_ps: float, fp: float) -> float:
+        confirm = fp * CONFIRM_PS_PER_CANDIDATE
+        return max(cost_ps, confirm) + OVERLAP_RESIDUE * min(cost_ps, confirm)
 
-    prescreen = []
-    for domain in DOMAINS:
-        for n_hashes in (1, 2):
-            if n_hashes * m * (domain // 128) > MAX_GATHERS:
-                continue  # measured Mosaic compile ceiling
-            for n_banks in (1, 2, 4, 8, 16, 32):
-                if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * 4):
-                    continue
-                cost = n_banks * m * n_hashes * (2 * (domain // 128) + 2)
-                # statistical density: distinct-pair collisions into D slots
-                per_bucket = max(1, -(-len(group) // (n_banks * N_BUCKETS)))
-                d_est = 1.0 - (1.0 - 1.0 / domain) ** per_bucket
-                fp_est = n_banks * N_BUCKETS * d_est ** (m * n_hashes)
-                prescreen.append(
-                    (total_ps(cost, fp_est), cost, domain, n_hashes, n_banks)
-                )
-    prescreen.sort()
-    # exact-build set: best few by estimated total, plus the lowest
-    # estimated-FP configs so a tight explicit budget stays satisfiable
-    by_fp = sorted(
-        prescreen,
-        key=lambda t: t[0] - t[1] * COST_PS_PER_UNIT,  # confirm term only
-    )
-    chosen, seen = [], set()
-    for entry in prescreen[:4] + by_fp[:2]:
-        if entry[2:] not in seen:
-            seen.add(entry[2:])
-            chosen.append(entry)
-    best: tuple[float, float, list[FdrBank]] | None = None  # (key0, key1, banks)
-
-    def try_config(cost, domain, n_hashes, n_banks):
-        nonlocal best
+    best: tuple[tuple, list[FdrBank]] | None = None
+    for n_banks in (1, 2, 4):
+        if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * N_BUCKETS):
+            continue
         shards = [group[i::n_banks] for i in range(n_banks)]
-        banks = []
-        for shard in shards:
-            tables = _bank_tables(shard, m, domain, n_hashes)
-            banks.append(
-                FdrBank(
-                    m=m,
-                    domain=domain,
-                    tables=tables,
-                    patterns=shard,
-                    fp_per_byte=_fp_estimate(tables),
-                )
-            )
-        fp = sum(b.fp_per_byte for b in banks)
-        total = total_ps(cost, fp)
-        # prefer configurations within budget; among those, min total cost;
-        # if none fits the budget, min FP keeps the confirm bounded
-        key = (0, total) if fp <= fp_budget else (1, fp)
-        if best is None or key < (best[0], best[1]):
-            best = (key[0], key[1], banks)
-
-    for _, cost, domain, n_hashes, n_banks in chosen:
-        try_config(cost, domain, n_hashes, n_banks)
-    if best is not None and best[0] == 1:
-        # Nothing in the prescreen's picks met the budget.  The statistical
-        # estimate can misrank skewed sets (duplicate tails), so before
-        # returning an over-budget config — or letting compile_fdr give up
-        # and strand the engine on the slow DFA path — exhaustively build
-        # the remaining configurations (the old guarantee: if any candidate
-        # satisfies the budget, it is found).
-        for entry in prescreen:
-            if entry[2:] not in seen:
-                seen.add(entry[2:])
-                try_config(*entry[1:])
+        for domain in DOMAINS:
+            fulls = [_full_tables(s, m, domain) for s in shards]
+            for n_lookups in range(m, 2 * m + 1):
+                if n_lookups * (domain // 128) > MAX_GATHERS:
+                    continue  # outside the kernel's probed compile ceiling
+                plan = _plan(m, n_lookups)
+                rows = [f * m + k for k, f in plan]
+                banks = []
+                for shard, full in zip(shards, fulls):
+                    stack = np.ascontiguousarray(full[rows])
+                    banks.append(
+                        FdrBank(
+                            m=m,
+                            domain=domain,
+                            checks=plan,
+                            tables=stack,
+                            patterns=shard,
+                            fp_per_byte=_fp_of_stack(stack),
+                        )
+                    )
+                fp = sum(b.fp_per_byte for b in banks)
+                cost = sum(b.scan_cost_ps() for b in banks)
+                # prefer configurations within budget; among those, min
+                # total cost; if none fits, min FP bounds the confirm
+                key = (0, total_ps(cost, fp)) if fp <= fp_budget else (1, fp, cost)
+                if best is None or key < best[0]:
+                    best = (key, banks)
     assert best is not None
-    return best[2]
+    return best[1]
 
 
 def compile_fdr(
     patterns: list[str | bytes],
     *,
     ignore_case: bool = False,
-    fp_budget_per_byte: float = 2e-4,
-    max_banks: int = 32,
+    fp_budget_per_byte: float = FP_CEILING_PER_BYTE,
+    max_banks: int = 4,
 ) -> FdrModel:
     """Compile a literal set (every literal >= 2 bytes) into filter banks.
 
-    Patterns are stratified by length class so each group's window is as
-    long as its shortest member allows (m = min(len)-1, capped at MAX_M);
-    groups too small to be worth a device pass merge into the next shorter
-    window.  Raises FdrError for sets this filter cannot host (the engine
-    routes those members to the exact DFA-bank path instead).
-    """
+    The window is set by the shortest member (suffix truncation makes every
+    longer member representable in it).  When the set's lengths are mixed
+    enough that splitting pays — a long-window group gets more slots and a
+    short group stops poisoning it — the tuner compares every two-group
+    split against the single-bank compile by total cost.  Raises FdrError
+    for sets this filter cannot host (the engine routes those to the exact
+    DFA-bank path instead)."""
     norm = _normalize(patterns, ignore_case)
     if not norm:
         raise FdrError("empty pattern set")
     if any(len(p) < 2 for p in norm):
         raise FdrError("FDR needs literals >= 2 bytes")
 
-    groups: dict[int, list[bytes]] = {}
-    for p in norm:
-        groups.setdefault(min(MAX_M, len(p) - 1), []).append(p)
-    # merge small groups downward (their patterns still satisfy smaller m)
-    for m in sorted(groups.keys(), reverse=True):
-        if len(groups) > 1 and len(groups[m]) < 32:
-            smaller = [k for k in groups if k < m]
-            if smaller:
-                groups[max(smaller)].extend(groups.pop(m))
+    def window_of(subset: list[bytes]) -> int:
+        return min(MAX_DEPTHS + 1, min(len(p) for p in subset))
 
-    budget_each = fp_budget_per_byte / len(groups)
-    banks: list[FdrBank] = []
-    for m in sorted(groups.keys(), reverse=True):
-        banks.extend(_compile_group(groups[m], m, budget_each, max_banks))
+    def group_cost(banks: list[FdrBank]) -> float:
+        scan = sum(b.scan_cost_ps() for b in banks)
+        confirm = CONFIRM_PS_PER_CANDIDATE * sum(b.fp_per_byte for b in banks)
+        return max(scan, confirm) + OVERLAP_RESIDUE * min(scan, confirm)
+
+    candidates: list[list[FdrBank]] = []
+    single = _compile_group(
+        norm, window_of(norm) - 1, fp_budget_per_byte, max_banks
+    )
+    candidates.append(single)
+    lengths = sorted({min(len(p), MAX_DEPTHS + 1) for p in norm})
+    for t in lengths[1:]:
+        short = [p for p in norm if min(len(p), MAX_DEPTHS + 1) < t]
+        long_ = [p for p in norm if min(len(p), MAX_DEPTHS + 1) >= t]
+        if len(short) < N_BUCKETS or len(long_) < N_BUCKETS:
+            continue
+        candidates.append(
+            _compile_group(short, window_of(short) - 1, fp_budget_per_byte / 2, max_banks)
+            + _compile_group(long_, window_of(long_) - 1, fp_budget_per_byte / 2, max_banks)
+        )
+    banks = min(candidates, key=group_cost)
     model = FdrModel(banks=banks, ignore_case=ignore_case, n_patterns=len(norm))
     if model.fp_per_byte > FP_CEILING_PER_BYTE:
         raise FdrError(
@@ -304,25 +319,26 @@ def reference_candidates(bank: FdrBank, data: bytes) -> np.ndarray:
 
     Mirrors the kernel exactly, including the all-ones pipeline seed at the
     stripe start (conservative: early positions over-report rather than
-    miss, and the engine host-confirms candidates anyway).
+    miss, and the engine confirms candidates exactly anyway).
     """
     arr = np.frombuffer(data, dtype=np.uint8).astype(np.int64)
     n = arr.size
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     prev = np.concatenate([[0], arr[:-1]])
-    masks = None  # (m, n) uint32: AND over hashes of per-position reach
-    for h_i in range(bank.n_hashes):
-        h = pair_hash(prev, arr, bank.domain, which=h_i)
-        got = bank.tables[h_i][:, h]
-        masks = got if masks is None else (masks & got)
+    hashes = {
+        f: pair_hash(prev, arr, bank.domain, which=f) for f in bank.families
+    }
     ones = np.uint32(0xFFFFFFFF)
+    slot_masks = np.full((bank.m, n), ones, dtype=np.uint32)
+    for i, (slot, fam) in enumerate(bank.checks):
+        slot_masks[slot] &= bank.tables[i][hashes[fam]]
     # pipeline: V_0(t) = masks[0, t]; V_k(t) = V_{k-1}(t-1) & masks[k, t]
     Vs = np.empty((bank.m, n), dtype=np.uint32)
-    Vs[0] = masks[0]
+    Vs[0] = slot_masks[0]
     for k in range(1, bank.m):
         shifted = np.concatenate([[ones], Vs[k - 1][:-1]])
-        Vs[k] = shifted & masks[k]
+        Vs[k] = shifted & slot_masks[k]
     return np.nonzero(Vs[bank.m - 1] != 0)[0].astype(np.int64) + 1
 
 

@@ -112,6 +112,7 @@ class GrepEngine:
         self.fdr: FdrModel | None = None
         self._fdr_short: list[DfaTable] = []
         self._fdr_dev_tables: dict | None = None  # device -> reach tables
+        self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
@@ -169,6 +170,19 @@ class GrepEngine:
                                 short_pats, ignore_case=ignore_case,
                                 max_states_per_bank=max_states_per_bank,
                             )
+                        # Exact candidate confirm: suffix-hash probe + memcmp
+                        # over the normalized members (native when available,
+                        # ~8 ns/candidate — utils/native.ConfirmSet).  Runs
+                        # per segment inside collect(), overlapped with the
+                        # next segment's device scan — which is why the FDR
+                        # tuner prices candidates at max(scan, confirm)
+                        # rather than their sum (models/fdr.py).
+                        from distributed_grep_tpu.utils.native import ConfirmSet
+
+                        self._fdr_confirm = ConfirmSet(
+                            [p for b in self.fdr.banks for p in b.patterns],
+                            ignore_case=ignore_case,
+                        )
                         self.mode = "fdr"
                     except FdrError as e:
                         log.info("pattern set -> DFA banks (FDR: %s)", e)
@@ -372,9 +386,10 @@ class GrepEngine:
             and pallas_scan.available()
             and pallas_nfa.eligible(self.glushkov)
         )
-        # FDR filter path: candidates on device, exact confirm per line on
-        # host; without a TPU (or after a kernel failure) the same engine
-        # falls back to the exact DFA banks below.
+        # FDR filter path: candidates on device, exact per-offset confirm on
+        # host (ConfirmSet probe inside collect, overlapped with the next
+        # segment's device scan); without a TPU (or after a kernel failure)
+        # the same engine falls back to the exact DFA banks below.
         use_fdr = (
             self.mode == "fdr" and not self._fdr_broken and pallas_scan.available()
         )
@@ -417,6 +432,15 @@ class GrepEngine:
                 if sparse_kind == "words":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
+                    if use_fdr:
+                        # Exact per-candidate confirm (suffix probe + memcmp)
+                        # against the WHOLE document, so a window reaching
+                        # back across the segment start still confirms; runs
+                        # here so it overlaps the next segment's device scan.
+                        # n_matches still reports pre-confirm candidates.
+                        n_matches += int(offsets.size)
+                        keep = self._fdr_confirm.confirm(data, offsets + seg_start)
+                        offsets = offsets[keep]
                 elif sparse_kind == "lane_bytes":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
@@ -431,7 +455,9 @@ class GrepEngine:
                         np.zeros(0, dtype=np.int64)
             if short_offsets is not None:
                 offsets = np.union1d(offsets, short_offsets)
-            n_matches += int(offsets.size)
+                n_matches += int(short_offsets.size)
+            if not use_fdr:  # FDR counted its pre-confirm candidates above
+                n_matches += int(offsets.size)
             if offsets.size:
                 # transient slice: jobs hold (start, len), not segment copies
                 seg_view = data[seg_start : seg_start + seg_len]
@@ -542,15 +568,9 @@ class GrepEngine:
             self._fdr_broken = True
             return self._scan_device(data)
 
-        if use_fdr and device_lines:
-            # FDR lines are *candidates* (bucket superimposition + domain
-            # hashing over-report); confirm each against the exact AC banks.
-            confirmed = set()
-            for ln in device_lines:
-                start, end = lines_mod.line_span(nl, ln, len(data))
-                if self._host_line_matcher(data[start:end]):
-                    confirmed.add(ln)
-            device_lines = confirmed
+        # FDR candidates were already confirmed offset-exactly in collect();
+        # boundary lines (stripe/segment heads, where the filter's all-ones
+        # seed under-reports) are restored by the stitching pass below.
         stitched = lines_mod.stitch_lines(
             device_lines, data, nl, boundaries, self._host_line_matcher
         )

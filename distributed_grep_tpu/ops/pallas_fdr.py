@@ -4,25 +4,34 @@ Same shell as ops/pallas_scan.py / ops/pallas_nfa.py (lanes x chunk tiles,
 time-packed uint32 candidate words, VMEM scratch carried across chunk
 blocks), but the per-byte step is the bucketed pair-hash filter:
 
-    h      = ((prev*37) ^ (b*101)) & (D-1)       pair-domain hash
-    R_j    = tables[j][h]                        m reach lookups
-    V_0    = R_0 ;  V_k = V_k-1(prev byte) & R_k pipeline over pair checks
-    cand   = V_{m-1} != 0                        some bucket passed all m
+    h_f    = ((prev*a_f) ^ (b*b_f)) & (D-1)      pair-domain hash, family f
+    R_i    = tables[i][h_fam(i)]                 one lookup per check
+    M_k    = AND of R_i with slot(i) == k        per-slot reach masks
+    V_0    = M_0 ;  V_k = V_{k-1}(prev byte) & M_k   pipeline over slots
+    cand   = V_{m-1} != 0                        some bucket passed all checks
 
 The reach lookup is the part the VPU had no primitive for until lane
 gathers: ``jnp.take_along_axis(table_tile, idx, axis=1)`` gathers within a
 128-lane vreg row, so a D-entry table is D/128 broadcast tiles selected by
-the hash's high bits (the ``hi == j`` selects are shared across all m
-position tables — one compare set per byte, not per lookup).
+the hash's high bits (the ``hi == j`` masks are shared across all checks
+of one family — one compare set per byte, not per lookup).
 
-Probed on TPU v5e (2026-07-30): m=4/D=256 ~22 GB/s, m=5/D=512 ~11.5 GB/s;
-D=1024 crashes the Mosaic compiler, hence models/fdr.DOMAINS caps at 512.
+Probed on TPU v5e (2026-07-30, unroll sweep):
+
+* the per-(8,128)-vreg 128-entry u32 gather issues at ~4.5 cycles and is
+  the kernel's bottleneck resource — throughput ~= 940 MHz * 4096 /
+  (4.56 * lookups * (D/128) * 4) bytes/s, i.e. ~56/L GB/s at D=512;
+* **the old "MAX_GATHERS = 24" Mosaic compile ceiling was an unroll
+  artifact**: at unroll=32 a 32-gather/byte kernel crashes the compiler,
+  at unroll<=16 it compiles and runs (measured 6.6 GB/s for 32 gathers);
+* unroll=8 is also ~20% faster than unroll=32 at equal gather counts
+  (11.4 vs 9.3 GB/s for 20 gathers), so the kernel now fixes unroll=8
+  with a lax.fori_loop carrying the pipeline across sub-blocks.
 
 The V pipeline is seeded ALL-ONES at each stripe start: the first m
 positions of a stripe then over-report candidates instead of missing
-matches whose window spans the stripe head, and the engine's host
-confirmation (exact Aho-Corasick on the candidate's line) keeps the final
-output exact either way.
+matches whose window spans the stripe head, and the engine's exact
+confirmation keeps the final output exact either way.
 """
 
 from __future__ import annotations
@@ -42,75 +51,95 @@ from distributed_grep_tpu.ops.pallas_scan import (
     available,
 )
 
+UNROLL = 8  # byte steps unrolled per fori iteration (see probe notes above)
+
 
 def eligible(bank: FdrBank) -> bool:
     """models/fdr only emits kernel-sized banks; guard anyway."""
     return (
-        bank.m <= 6
+        bank.m <= 8
         and bank.domain <= 512
         and bank.domain % 128 == 0
-        and bank.n_hashes * bank.m * (bank.domain // LANE_COLS) <= MAX_GATHERS
+        and bank.n_checks * bank.n_subtables <= MAX_GATHERS
     )
 
 
 def bank_device_tables(bank: FdrBank) -> np.ndarray:
-    """(n_hashes * m * n_subtables, SUBLANES, LANE_COLS) uint32 — each
+    """(n_checks * n_subtables, SUBLANES, LANE_COLS) uint32 — each
     128-entry subtable broadcast across sublanes, ready to pass to the
     kernel.  Upload once per engine; ~16 KB per subtable."""
-    nh, m, d = bank.tables.shape
+    nc, d = bank.tables.shape
     g = d // LANE_COLS
-    sub = bank.tables.reshape(nh, m, g, LANE_COLS)
+    sub = bank.tables.reshape(nc, g, LANE_COLS)
     tiles = np.broadcast_to(
-        sub[:, :, :, None, :], (nh, m, g, SUBLANES, LANE_COLS)
-    ).reshape(nh * m * g, SUBLANES, LANE_COLS)
+        sub[:, :, None, :], (nc, g, SUBLANES, LANE_COLS)
+    ).reshape(nc * g, SUBLANES, LANE_COLS)
     return np.ascontiguousarray(tiles)
 
 
-def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, n_hashes, steps):
+def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, plan, steps):
     from jax.experimental import pallas as pl  # deferred: import cost
 
     ci = pl.program_id(1)
 
     @pl.when(ci == 0)
     def _init():
-        # all-ones: stripe heads over-report (host confirm), never miss
+        # all-ones: stripe heads over-report (exact confirm), never miss
         v_ref[...] = jnp.full_like(v_ref, jnp.uint32(0xFFFFFFFF))
         prev_ref[...] = jnp.zeros_like(prev_ref)
 
     zero = jnp.uint32(0)
+    families = sorted({f for _, f in plan})
+    n_inner = 32 // UNROLL
 
     def word_body(w, carry):
-        prev_b, *V = carry
-        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
-        for t in range(32):
-            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            los, all_sels = [], []
-            for hi_i in range(n_hashes):
-                ha, hb = HASHES[hi_i]
-                h = ((prev_b * ha) ^ (b * hb)) & (n_sub * LANE_COLS - 1)
-                los.append(h & (LANE_COLS - 1))
-                if n_sub > 1:
-                    hi = h >> 7
-                    # all-ones/all-zero select masks, shared by all m lookups
-                    all_sels.append(
-                        [zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)]
-                    )
-            prev_b = b
-            masks = []
-            for p in range(m):
-                anded = None  # AND over hashes of this position's reach
-                for hi_i in range(n_hashes):
+        def sub_body(s, inner):
+            prev_b, word, *V = inner
+            for tt in range(UNROLL):
+                b = data_ref[w * 32 + s * UNROLL + tt].astype(jnp.int32)
+                los, sels = {}, {}
+                for f in families:
+                    ha, hb = HASHES[f]
+                    h = ((prev_b * ha) ^ (b * hb)) & (n_sub * LANE_COLS - 1)
+                    los[f] = h & (LANE_COLS - 1)
+                    if n_sub > 1:
+                        hi = h >> 7
+                        # all-ones/all-zero masks, shared by the family's checks
+                        sels[f] = [
+                            zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)
+                        ]
+                prev_b = b
+                masks = [None] * m
+                for i, (slot, fam) in enumerate(plan):
                     acc = None
-                    base = (hi_i * m + p) * n_sub
                     for j in range(n_sub):
-                        g = jnp.take_along_axis(tabs_ref[base + j], los[hi_i], axis=1)
+                        g = jnp.take_along_axis(
+                            tabs_ref[i * n_sub + j], los[fam], axis=1
+                        )
                         if n_sub > 1:
-                            g = g & all_sels[hi_i][j]
+                            g = g & sels[fam][j]
                         acc = g if acc is None else (acc | g)
-                    anded = acc if anded is None else (anded & acc)
-                masks.append(anded)
-            V = [masks[0]] + [V[k - 1] & masks[k] for k in range(1, m)]
-            word = word | jnp.where(V[m - 1] != 0, jnp.uint32(1 << t), zero)
+                    masks[slot] = acc if masks[slot] is None else (masks[slot] & acc)
+                # slots with no check stay None -> all-ones (no AND needed)
+                V_new = []
+                for k in range(m):
+                    prev_v = V[k - 1] if k else None
+                    if masks[k] is None:
+                        V_new.append(prev_v if k else jnp.full_like(V[0], ~zero))
+                    else:
+                        V_new.append(masks[k] if k == 0 else (prev_v & masks[k]))
+                V = V_new
+                bit = jnp.uint32(1 << tt) << (s * jnp.uint32(UNROLL))
+                word = word | jnp.where(V[m - 1] != 0, bit, zero)
+            return (prev_b, word, *V)
+
+        prev_b, *V = carry
+        word0 = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        if n_inner == 1:
+            out = sub_body(0, (prev_b, word0, *V))
+        else:
+            out = jax.lax.fori_loop(0, n_inner, sub_body, (prev_b, word0, *V))
+        prev_b, word, *V = out
         out_ref[w] = word
         return (prev_b, *V)
 
@@ -123,17 +152,16 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, n_hashes,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("m", "n_sub", "n_hashes", "chunk", "lane_blocks", "interpret"),
+    static_argnames=("m", "n_sub", "plan", "chunk", "lane_blocks", "interpret"),
 )
-def _fdr_pallas(data, tabs, *, m, n_sub, n_hashes=1, chunk, lane_blocks, interpret=False):
+def _fdr_pallas(data, tabs, *, m, n_sub, plan, chunk, lane_blocks, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
-    kernel = functools.partial(
-        _kernel, m=m, n_sub=n_sub, n_hashes=n_hashes, steps=steps
-    )
+    n_checks = len(plan)
+    kernel = functools.partial(_kernel, m=m, n_sub=n_sub, plan=plan, steps=steps)
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),
@@ -144,7 +172,7 @@ def _fdr_pallas(data, tabs, *, m, n_sub, n_hashes=1, chunk, lane_blocks, interpr
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (n_hashes * m * n_sub, SUBLANES, LANE_COLS),
+                (n_checks * n_sub, SUBLANES, LANE_COLS),
                 lambda li, ci: (0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -185,7 +213,7 @@ def fdr_scan_words(
             f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0"
         )
     if not eligible(bank):
-        raise ValueError("bank outside the kernel's m/domain budget")
+        raise ValueError("bank outside the kernel's check/domain budget")
     lane_blocks = lanes // LANES_PER_BLOCK
     data = np.ascontiguousarray(
         arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
@@ -199,7 +227,7 @@ def fdr_scan_words(
         dev_tables,
         m=bank.m,
         n_sub=bank.domain // LANE_COLS,
-        n_hashes=bank.n_hashes,
+        plan=tuple(bank.checks),
         chunk=chunk,
         lane_blocks=lane_blocks,
         interpret=interpret,

@@ -28,7 +28,12 @@ def _try_load() -> ctypes.CDLL | None:
     if _load_attempted:
         return _lib
     _load_attempted = True
-    if not _LIB_PATH.exists():
+    src = _NATIVE_DIR / "dgrep.cpp"
+    stale = (
+        not _LIB_PATH.exists()
+        or (src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+    )
+    if stale:
         try:
             subprocess.run(
                 ["make", "-C", str(_NATIVE_DIR)],
@@ -37,7 +42,8 @@ def _try_load() -> ctypes.CDLL | None:
                 timeout=120,
             )
         except (subprocess.SubprocessError, OSError):
-            return None
+            if not _LIB_PATH.exists():
+                return None  # a stale lib still loads; no lib does not
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError:
@@ -82,6 +88,26 @@ def _try_load() -> ctypes.CDLL | None:
             ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_size_t,
+            ctypes.c_uint32,
+        ]
+    if hasattr(lib, "dgrep_confirm_build"):
+        lib.dgrep_confirm_build.restype = ctypes.c_void_p
+        lib.dgrep_confirm_build.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ]
+        lib.dgrep_confirm_free.restype = None
+        lib.dgrep_confirm_free.argtypes = [ctypes.c_void_p]
+        lib.dgrep_confirm_scan.restype = None
+        lib.dgrep_confirm_scan.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint32,
         ]
     _lib = lib
@@ -191,6 +217,95 @@ def dfa_scan(
         if n <= cap:
             return np.ctypeslib.as_array(buf)[:n].copy(), int(final.value)
         cap = n
+
+
+# --- Literal-set candidate confirm (FDR filter path, models/fdr.py) --------
+
+class ConfirmSet:
+    """Batch-confirm FDR candidate end-offsets against a literal set.
+
+    Native path: hash probe on the last-4-byte key + full memcmp
+    (native/dgrep.cpp dgrep_confirm_*, ~10 ns/candidate) — the cost that
+    lets the FDR tuner run a cheaper device filter and accept more
+    candidates.  Fallback: a dict keyed the same way.
+
+    ``patterns`` must be pre-normalized (lowercased when ignore_case);
+    ``ignore_case`` controls folding of the *data* bytes at probe time.
+    """
+
+    def __init__(self, patterns: list[bytes], ignore_case: bool = False,
+                 use_native: bool = True):
+        self.ignore_case = bool(ignore_case)
+        self._patterns = [bytes(p) for p in patterns]
+        self._handle = None
+        lib = _try_load() if use_native else None
+        self._free = None
+        if lib is not None and hasattr(lib, "dgrep_confirm_build"):
+            blob = b"".join(self._patterns)
+            offs = np.zeros(len(self._patterns) + 1, dtype=np.uint32)
+            np.cumsum([len(p) for p in self._patterns], out=offs[1:])
+            self._offs = offs  # keep alive
+            self._blob = blob
+            self._handle = lib.dgrep_confirm_build(
+                blob,
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                len(self._patterns),
+                1 if ignore_case else 0,
+            )
+            self._free = lib.dgrep_confirm_free  # bound now: survives shutdown
+        if self._handle is None:
+            by_key: dict[bytes, list[bytes]] = {}
+            shorts: list[bytes] = []
+            for p in self._patterns:
+                if len(p) < 4:
+                    shorts.append(p)
+                else:
+                    by_key.setdefault(p[-4:], []).append(p)
+            self._by_key, self._shorts = by_key, shorts
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and getattr(self, "_free", None):
+            self._free(self._handle)
+            self._handle = None
+
+    def confirm(self, data: bytes, ends: np.ndarray,
+                n_threads: int | None = None) -> np.ndarray:
+        """Boolean mask: does some pattern truly end at each offset?"""
+        ends = np.ascontiguousarray(ends, dtype=np.uint64)
+        if ends.size == 0:
+            return np.zeros(0, dtype=bool)
+        if self._handle is not None:
+            import os
+
+            lib = _try_load()
+            out = np.zeros(ends.size, dtype=np.uint8)
+            lib.dgrep_confirm_scan(
+                self._handle,
+                data,
+                len(data),
+                ends.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ends.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                n_threads if n_threads is not None else min(8, os.cpu_count() or 1),
+            )
+            return out.astype(bool)
+        hay = data.lower() if self.ignore_case else data
+        out_b = np.zeros(ends.size, dtype=bool)
+        for i, e in enumerate(ends.tolist()):
+            if e > len(hay) or e == 0:
+                continue
+            hit = False
+            for p in self._by_key.get(hay[max(0, e - 4):e], ()):
+                if e >= len(p) and hay[e - len(p):e] == p:
+                    hit = True
+                    break
+            if not hit:
+                for p in self._shorts:
+                    if e >= len(p) and hay[e - len(p):e] == p:
+                        hit = True
+                        break
+            out_b[i] = hit
+        return out_b
 
 
 # Big inputs fan the DFA scan across threads; newline-aligned chunking keeps

@@ -136,16 +136,16 @@ def pallas_fdr_setup(data: bytes, model, *, target_lanes: int = 8192):
 
     dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
     banks = [
-        (b.m, b.domain // pallas_fdr.LANE_COLS, b.n_hashes,
+        (b.m, b.domain // pallas_fdr.LANE_COLS, tuple(b.checks),
          jnp.asarray(pallas_fdr.bank_device_tables(b)))
         for b in model.banks
     ]
 
     def scan(win):
         words = None
-        for m, n_sub, n_hashes, tabs in banks:
+        for m, n_sub, plan, tabs in banks:
             w = pallas_fdr._fdr_pallas(
-                win, tabs, m=m, n_sub=n_sub, n_hashes=n_hashes, chunk=lay.chunk,
+                win, tabs, m=m, n_sub=n_sub, plan=plan, chunk=lay.chunk,
                 lane_blocks=lane_blocks, interpret=False,
             )
             words = w if words is None else words | w

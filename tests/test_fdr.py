@@ -99,7 +99,7 @@ def test_big_set_banks_within_budget():
     model = fdr_mod.compile_fdr(pats, fp_budget_per_byte=2e-4)
     assert model.n_patterns == 2000
     for b in model.banks:
-        assert b.domain in fdr_mod.DOMAINS and 1 <= b.m <= fdr_mod.MAX_M
+        assert b.domain in fdr_mod.DOMAINS and 1 <= b.m <= fdr_mod.MAX_DEPTHS
     # cost search should prefer meeting the budget when feasible
     assert model.fp_per_byte <= 2e-3
 
@@ -140,8 +140,8 @@ def test_pallas_fdr_interpret_matches_reference():
 
 
 def test_pallas_fdr_interpret_multi_subtable():
-    # force domain 512 (n_sub=4) via a big enough set
-    pats = _rand_literals(600, 5, 9, seed=7)
+    # force a multi-subtable domain (n_sub > 1) via a big enough set
+    pats = _rand_literals(5000, 5, 9, seed=7)
     model = fdr_mod.compile_fdr(pats)
     assert any(b.domain >= 256 for b in model.banks)
     data = make_text(60, inject=[(5, pats[3] + b" mid " + pats[4])])
@@ -158,16 +158,14 @@ def test_device_tables_layout():
     bank = model.banks[0]
     tiles = pallas_fdr.bank_device_tables(bank)
     g = bank.domain // 128
-    nh = bank.n_hashes
-    assert tiles.shape == (nh * bank.m * g, 32, 128)
-    # row (h*m+p)*g+j, any sublane s, lane l == tables[h, p, j*128 + l]
-    for h in range(nh):
-        for p in range(bank.m):
-            for j in range(g):
-                np.testing.assert_array_equal(
-                    tiles[(h * bank.m + p) * g + j, 5],
-                    bank.tables[h, p, j * 128 : (j + 1) * 128],
-                )
+    assert tiles.shape == (bank.n_checks * g, 32, 128)
+    # row i*g+j, any sublane s, lane l == tables[i, j*128 + l]
+    for i in range(bank.n_checks):
+        for j in range(g):
+            np.testing.assert_array_equal(
+                tiles[i * g + j, 5],
+                bank.tables[i, j * 128 : (j + 1) * 128],
+            )
 
 
 # ----------------------------------------------------- engine (device path)

@@ -86,3 +86,54 @@ def test_dfa_scan_mt_small_input_falls_through():
     seq, _ = native.dfa_scan(data, t.full_table(), t.accept.astype(np.uint8), t.start)
     mt = native.dfa_scan_mt(data, t.full_table(), t.accept.astype(np.uint8), t.start)
     np.testing.assert_array_equal(mt, seq)
+
+
+# --- ConfirmSet (FDR candidate confirm, native + fallback) ------------------
+
+def _confirm_oracle(pats, data, ends, ignore_case=False):
+    hay = data.lower() if ignore_case else data
+    ps = [p.lower() if ignore_case else p for p in pats]
+    out = np.zeros(len(ends), dtype=bool)
+    for i, e in enumerate(ends):
+        out[i] = any(0 < len(p) <= e <= len(hay) and hay[e - len(p):e] == p
+                     for p in ps)
+    return out
+
+
+@pytest.mark.parametrize("force_fallback", [False, True])
+@pytest.mark.parametrize("ignore_case", [False, True])
+def test_confirm_set_matches_oracle(force_fallback, ignore_case):
+    rng = np.random.default_rng(13)
+    pats = [b"needle", b"XY", b"abc", b"zzz\xffq", b"Q" * 9]
+    norm = [p.lower() if ignore_case else p for p in pats]
+    data = b"a needle XY\nabczzz\xffq " + b"Q" * 9 + b" nEEdle xy end"
+    # use_native=False exercises the pure-Python path (hosts without a
+    # C++ toolchain) — the same exactness-critical code with no lib
+    cs = native.ConfirmSet(norm, ignore_case=ignore_case,
+                           use_native=not force_fallback)
+    assert (cs._handle is None) == force_fallback
+    ends = np.arange(0, len(data) + 2, dtype=np.uint64)
+    got = cs.confirm(data, ends)
+    np.testing.assert_array_equal(
+        got, _confirm_oracle(pats, data, ends.tolist(), ignore_case)
+    )
+
+
+def test_confirm_set_fallback_equals_native_random():
+    rng = np.random.default_rng(14)
+    pats = sorted({bytes(rng.integers(1, 256, size=int(rng.integers(2, 10)),
+                                      dtype=np.uint8).tolist()).replace(b"\n", b"-")
+                   for _ in range(300)})
+    data = bytes(rng.integers(0, 256, size=1 << 16, dtype=np.uint8).tolist())
+    # plant a few
+    arr = np.frombuffer(data, dtype=np.uint8).copy()
+    for pos in (100, 5000, 60000):
+        p = pats[pos % len(pats)]
+        arr[pos:pos + len(p)] = np.frombuffer(p, dtype=np.uint8)
+    data = arr.tobytes()
+    nat = native.ConfirmSet(pats)
+    assert nat._handle is not None
+    fb = native.ConfirmSet(pats, use_native=False)
+    assert fb._handle is None
+    ends = rng.integers(0, len(data) + 1, size=5000).astype(np.uint64)
+    np.testing.assert_array_equal(nat.confirm(data, ends), fb.confirm(data, ends))
