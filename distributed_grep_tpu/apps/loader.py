@@ -37,6 +37,10 @@ class LoadedApplication:
     # optional streaming entry: receives a local file path instead of bytes
     # (the worker then spools/streams the split — splits larger than RAM)
     map_path_fn: Callable[[str, str], list[KeyValue]] | None = None
+    # optional streaming reduce: receives a value ITERATOR — hot keys never
+    # materialize their value list (runtime/extsort.py); must agree with
+    # reduce_fn on every input
+    reduce_stream_fn: Callable[[str, Any], str] | None = None
 
     def configure(self, **options: Any) -> None:
         hook = getattr(self.module, "configure", None)
@@ -97,12 +101,14 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
             f"(or Map/Reduce); got map={map_fn!r} reduce={reduce_fn!r}"
         )
     map_path_fn = getattr(module, "map_path_fn", None)
+    reduce_stream_fn = getattr(module, "reduce_stream_fn", None)
     app = LoadedApplication(
         name=spec,
         map_fn=map_fn,
         reduce_fn=reduce_fn,
         module=module,
         map_path_fn=map_path_fn if callable(map_path_fn) else None,
+        reduce_stream_fn=reduce_stream_fn if callable(reduce_stream_fn) else None,
     )
     if options:
         app.configure(**options)

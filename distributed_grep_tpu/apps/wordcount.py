@@ -23,3 +23,10 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
 
 def reduce_fn(key: str, values: list[str]) -> str:
     return str(sum(int(v) for v in values))
+
+
+def reduce_stream_fn(key: str, values) -> str:
+    """Streaming fold — the worker prefers this over reduce_fn: a hot key
+    ("the" across a 100 GB corpus) never materializes its value list
+    (runtime/extsort.py)."""
+    return str(sum(int(v) for v in values))

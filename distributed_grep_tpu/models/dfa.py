@@ -107,7 +107,8 @@ class _Parser:
     """Recursive-descent parser for the grep -E subset."""
 
     def __init__(self, pattern: str, ignore_case: bool):
-        self.src = pattern.encode("utf-8") if isinstance(pattern, str) else bytes(pattern)
+        self.src = (pattern.encode("utf-8", "surrogateescape")
+                    if isinstance(pattern, str) else bytes(pattern))
         self.pos = 0
         self.ignore_case = ignore_case
 

@@ -203,7 +203,8 @@ class GrepEngine:
                 log.info("pattern %r -> host re fallback (%s)", pattern, e)
                 flags = _re.IGNORECASE if ignore_case else 0
                 self._re_fallback = _re.compile(
-                    pattern.encode("utf-8") if isinstance(pattern, str) else pattern, flags
+                    pattern.encode("utf-8", "surrogateescape")
+                    if isinstance(pattern, str) else pattern, flags
                 )
                 self.mode = "re"
         if backend == "cpu" and self.mode != "re":

@@ -1,0 +1,141 @@
+"""External sort-merge grouping: the reduce phase with bounded memory.
+
+The reference decodes every record of a reduce partition into RAM, sorts,
+and groups (map_reduce/worker.go:146-176, reduceDistinctKeys at :22-43) —
+an OOM for a hot partition of the north star's 100 GB corpus.  Here records
+accumulate only up to a memory cap; overflow spills as a *sorted run* to
+local disk (the shuffle wire format, runtime/shuffle.py), and grouping is a
+lazy k-way heap merge over the runs plus the final in-memory batch.  The
+map side solved its version of this with newline-aligned chunk streaming
+(ops/engine.py scan_file); this is the reduce-side counterpart.
+
+Determinism contract (matches the in-memory path): keys stream in sorted
+order; within one key, values keep their arrival order — the merge
+tie-breaks on (run index, sequence within run), and runs spill in arrival
+order.
+
+Hot-key note: ``reduce_fn(key, values)`` receives a list per the reference
+contract, so one key's values are still materialized.  Applications that
+fold associatively can expose ``reduce_stream_fn(key, values_iter)`` to
+stay O(1) per key (apps/wordcount.py does); the worker prefers it when
+present.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import shutil
+import tempfile
+from itertools import groupby
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from distributed_grep_tpu.apps.base import KeyValue, sort_by_key
+from distributed_grep_tpu.runtime import shuffle
+
+# Rough per-record bookkeeping overhead (tuple + two str objects) used for
+# the memory estimate; exactness doesn't matter, boundedness does.
+_RECORD_OVERHEAD = 120
+
+
+class ExternalReducer:
+    """Accumulate KeyValue records under a memory cap; group-reduce by
+    streaming a sorted merge of spilled runs."""
+
+    def __init__(self, memory_limit_bytes: int = 128 << 20,
+                 spill_dir: str | None = None):
+        """``spill_dir``: where runs land.  Pass a real-disk directory in
+        production — the system temp dir is often RAM-backed tmpfs, which
+        would defeat the memory cap (the worker passes one, worker.py)."""
+        if memory_limit_bytes <= 0:
+            raise ValueError("memory_limit_bytes must be positive")
+        self.memory_limit = memory_limit_bytes
+        self._spill_parent = spill_dir
+        self._tmp: str | None = None
+        self._mem: list[KeyValue] = []
+        self._mem_bytes = 0
+        self._runs: list[Path] = []
+
+    @property
+    def spill_count(self) -> int:
+        return len(self._runs)
+
+    def add_many(self, records: Iterable[KeyValue]) -> None:
+        for kv in records:
+            self._mem.append(kv)
+            self._mem_bytes += len(kv.key) + len(kv.value) + _RECORD_OVERHEAD
+            if self._mem_bytes >= self.memory_limit:
+                self._spill()
+
+    def _spill(self) -> None:
+        if not self._mem:
+            return
+        if self._tmp is None:
+            self._tmp = tempfile.mkdtemp(prefix="dgrep-reduce-",
+                                         dir=self._spill_parent)
+        run = Path(self._tmp) / f"run-{len(self._runs)}"
+        recs = sort_by_key(self._mem)
+        with open(run, "wb") as f:
+            # batched encode: the whole run as one string+bytes would
+            # transiently ~triple memory right when the cap was hit
+            for i in range(0, len(recs), 4096):
+                f.write(shuffle.encode_records(recs[i : i + 4096]))
+        self._runs.append(run)
+        self._mem = []
+        self._mem_bytes = 0
+
+    @staticmethod
+    def _iter_run(path: Path) -> Iterator[tuple[str, str]]:
+        # Text-mode line iteration is safe here: the wire format JSON-escapes
+        # \r and \n inside strings, so the only newlines in the file are the
+        # record separators (universal-newline translation has nothing to
+        # translate; U+2028/U+2029 are not file line breaks).
+        with open(path, encoding="utf-8", errors="surrogateescape",
+                  newline="\n") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    k, v = json.loads(line)
+                    yield k, v
+
+    def _merged(self) -> Iterator[tuple[str, str]]:
+        """All records in (key, run index, sequence) order — i.e. key-sorted,
+        arrival-stable within a key."""
+        def tagged(stream, idx):
+            # idx must bind per-stream (a bare generator expression would
+            # late-bind the loop variable and break the run tie-break)
+            return ((k, idx, i, v) for i, (k, v) in enumerate(stream))
+
+        streams = [tagged(self._iter_run(run), idx)
+                   for idx, run in enumerate(self._runs)]
+        tail = ((kv.key, kv.value) for kv in sort_by_key(self._mem))
+        streams.append(tagged(tail, len(self._runs)))
+        for k, _, _, v in heapq.merge(*streams):
+            yield k, v
+
+    def reduce(self, reduce_fn, stream_fn=None) -> Iterator[tuple[str, str]]:
+        """Yield (key, reduced_value) in sorted key order, streaming.
+
+        ``stream_fn(key, values_iterator)`` — when the application provides
+        one — is preferred over ``reduce_fn(key, values_list)``: it never
+        materializes a hot key's value list.
+        """
+        for k, grp in groupby(self._merged(), key=lambda t: t[0]):
+            vals = (v for _, v in grp)
+            yield (k, stream_fn(k, vals)) if stream_fn is not None else (
+                k, reduce_fn(k, list(vals))
+            )
+
+    def close(self) -> None:
+        if self._tmp is not None:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
+        self._mem = []
+        self._runs = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -155,6 +155,37 @@ class HttpTransport:
     def write_output(self, name: str, data: bytes) -> None:
         self._request("PUT", f"/data/out/{urllib.parse.quote(name)}", data)
 
+    def write_output_from_file(self, name: str, path: str) -> None:
+        """Streaming PUT: the body is a file object sent in blocks with an
+        explicit Content-Length (http.client streams ~8 KB at a time), so a
+        reduce output larger than worker RAM commits without ever being
+        held whole.  Same liveness/retry policy as _request; each retry
+        reopens the file from the start."""
+        import http.client
+
+        url = f"{self.base}/data/out/{urllib.parse.quote(name)}"
+        size = os.path.getsize(path)
+        deadline: float | None = None
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    req = urllib.request.Request(url, data=f, method="PUT")
+                    req.add_header("Content-Length", str(size))
+                    with urllib.request.urlopen(req, timeout=self.rpc_timeout_s):
+                        return
+            except urllib.error.HTTPError as e:
+                raise RuntimeError(
+                    f"PUT {url} -> {e.code}: {e.read()[:200]!r}"
+                ) from e
+            except (urllib.error.URLError, socket.timeout, ConnectionError,
+                    http.client.HTTPException, OSError) as e:
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + RETRY_BUDGET_S
+                if now >= deadline:
+                    raise CoordinatorGone(f"PUT {url}: {e}") from e
+                time.sleep(RETRY_DELAY_S)
+
     # ------------------------------------------------------------ bootstrap
     def fetch_config(self) -> JobConfig:
         return JobConfig(**json.loads(self._request("GET", "/config")))
@@ -186,7 +217,14 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     app = load_application(config.application, **config.app_options)
 
     def run_loop(slot: int) -> None:
-        loop = WorkerLoop(HttpTransport(addr, rpc_timeout_s=config.rpc_timeout_s), app)
+        loop = WorkerLoop(
+            HttpTransport(addr, rpc_timeout_s=config.rpc_timeout_s),
+            app,
+            reduce_memory_bytes=config.reduce_memory_bytes,
+            # config.spill_dir is a coordinator-host path; HTTP workers only
+            # honor it when explicitly set (operators ensure it exists)
+            spill_dir=config.spill_dir,
+        )
         try:
             loop.run()
         except CoordinatorGone:

@@ -56,7 +56,7 @@ def collate_outputs(workdir: WorkDir) -> dict[str, str]:
         # \x85,  , ...) — text-mode read_text() would translate a lone
         # \r to \n (universal newlines), and splitlines() would fragment
         # the record at any of those characters.
-        for line in path.read_bytes().decode("utf-8", "replace").split("\n"):
+        for line in path.read_bytes().decode("utf-8", "surrogateescape").split("\n"):
             if line:
                 k, _, v = line.partition("\t")
                 results[k] = v
@@ -107,6 +107,8 @@ def run_job(
             app,
             metrics=metrics,
             fault_hooks=hooks,
+            reduce_memory_bytes=config.reduce_memory_bytes,
+            spill_dir=config.spill_dir or str(Path(config.work_dir) / "spill"),
         )
         try:
             loop.run()

@@ -29,9 +29,12 @@ def bucketize(records: list[KeyValue], n_reduce: int) -> dict[int, list[KeyValue
 
 
 def encode_records(records: list[KeyValue]) -> bytes:
+    # surrogateescape: keys embed filenames, which on POSIX may contain
+    # non-UTF8 bytes that argv/os decoding maps to lone surrogates — they
+    # must round-trip the wire format (CLAUDE.md invariant), not crash it.
     return "".join(
         json.dumps([kv.key, kv.value], ensure_ascii=False) + "\n" for kv in records
-    ).encode("utf-8")
+    ).encode("utf-8", "surrogateescape")
 
 
 def decode_records(data: bytes) -> list[KeyValue]:
@@ -39,7 +42,7 @@ def decode_records(data: bytes) -> list[KeyValue]:
     # Split on \n only: JSON escapes \r and \n inside strings but leaves
     #  /  literal with ensure_ascii=False, and splitlines() would
     # fragment records at those characters.
-    for line in data.decode("utf-8").split("\n"):
+    for line in data.decode("utf-8", "surrogateescape").split("\n"):
         if line:
             k, v = json.loads(line)
             out.append(KeyValue(k, v))

@@ -15,7 +15,12 @@ from typing import Protocol
 
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.scheduler import Scheduler
-from distributed_grep_tpu.utils.io import WorkDir, atomic_write, resolve_input_path
+from distributed_grep_tpu.utils.io import (
+    WorkDir,
+    atomic_write,
+    atomic_write_from_file,
+    resolve_input_path,
+)
 
 
 class Transport(Protocol):
@@ -30,6 +35,10 @@ class Transport(Protocol):
     def write_intermediate(self, name: str, data: bytes) -> None: ...
     def read_intermediate(self, name: str) -> bytes: ...
     def write_output(self, name: str, data: bytes) -> None: ...
+    # Optional: write_output_from_file(name, path) — commit a local file as
+    # an output without loading it whole (the streaming-reduce counterpart
+    # of write_output).  The worker falls back to write_output when a
+    # transport lacks it (runtime/worker.py).
 
 
 class LocalTransport:
@@ -69,3 +78,6 @@ class LocalTransport:
 
     def write_output(self, name: str, data: bytes) -> None:
         atomic_write(self.workdir.root / "out" / name, data)
+
+    def write_output_from_file(self, name: str, path: str) -> None:
+        atomic_write_from_file(self.workdir.root / "out" / name, path)

@@ -23,9 +23,9 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-from distributed_grep_tpu.apps.base import KeyValue, group_reduce
 from distributed_grep_tpu.apps.loader import LoadedApplication
 from distributed_grep_tpu.runtime import rpc, shuffle
+from distributed_grep_tpu.runtime.extsort import ExternalReducer
 from distributed_grep_tpu.runtime.transport import Transport
 from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.logging import get_logger
@@ -45,11 +45,17 @@ class WorkerLoop:
         app: LoadedApplication,
         metrics: Optional[Metrics] = None,
         fault_hooks: Optional[dict[str, Callable[[], None]]] = None,
+        reduce_memory_bytes: int = 128 << 20,
+        spill_dir: Optional[str] = None,
     ):
         self.transport = transport
         self.app = app
         self.metrics = metrics or Metrics()
         self.fault_hooks = fault_hooks or {}
+        self.reduce_memory_bytes = reduce_memory_bytes
+        # Spills must land on real disk: the system temp dir is often a
+        # RAM-backed tmpfs, which would defeat the reduce memory cap.
+        self.spill_dir = spill_dir
         self.worker_id = -1
 
     def _fault(self, point: str) -> None:
@@ -123,30 +129,62 @@ class WorkerLoop:
 
     # ---------------------------------------------------------------- reduce
     def _run_reduce(self, a: rpc.AssignTaskReply) -> None:
+        import os
+        import tempfile
+
         t0 = time.perf_counter()
         self.app.configure(**a.app_options)
-        records: list[KeyValue] = []
-        files_processed = 0
-        while True:
-            r = self.transport.reduce_next_file(
-                rpc.ReduceNextFileArgs(task_id=a.task_id, files_processed=files_processed)
-            )
-            if r.done:
-                break
-            if not r.next_file:
-                continue  # long-poll window expired; re-poll (worker.go:153-160)
-            data = self.transport.read_intermediate(r.next_file)
-            records.extend(shuffle.decode_records(data))
-            files_processed += 1
-            self._fault("after_reduce_file")
-        with self.metrics.timer("reduce_compute"), trace.annotate(f"reduce_compute:{a.task_id}"):
-            reduced = group_reduce(records, self.app.reduce_fn)
-        self._fault("before_reduce_commit")
-        # One "key<TAB>value\n" line per key (the reference writes "key value",
-        # worker.go:111-124, but grep keys contain spaces — a tab keeps the
-        # k/v split unambiguous).  Sorted for determinism.
-        text = "".join(f"{k}\t{v}\n" for k, v in sorted(reduced.items()))
-        self.transport.write_output(f"mr-out-{a.task_id}", text.encode("utf-8"))
+        # Bounded-memory grouping: records spill to sorted on-disk runs past
+        # the cap and group-reduce as a streaming merge (runtime/extsort.py).
+        # The reference materializes the whole partition (worker.go:161-162).
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+        reducer = ExternalReducer(
+            memory_limit_bytes=self.reduce_memory_bytes, spill_dir=self.spill_dir
+        )
+        # Associative apps expose reduce_stream_fn to keep hot keys O(1) too.
+        stream_fn = getattr(self.app, "reduce_stream_fn", None)
+        try:
+            files_processed = 0
+            while True:
+                r = self.transport.reduce_next_file(
+                    rpc.ReduceNextFileArgs(task_id=a.task_id, files_processed=files_processed)
+                )
+                if r.done:
+                    break
+                if not r.next_file:
+                    continue  # long-poll window expired; re-poll (worker.go:153-160)
+                data = self.transport.read_intermediate(r.next_file)
+                reducer.add_many(shuffle.decode_records(data))
+                files_processed += 1
+                self._fault("after_reduce_file")
+            # One "key<TAB>value\n" line per key (the reference writes
+            # "key value", worker.go:111-124, but grep keys contain spaces —
+            # a tab keeps the k/v split unambiguous).  The merge streams keys
+            # in sorted order (determinism) straight to a local spool file,
+            # so output size never bounds on worker memory either.
+            fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
+                                         dir=self.spill_dir or None)
+            try:
+                with self.metrics.timer("reduce_compute"), \
+                        trace.annotate(f"reduce_compute:{a.task_id}"), \
+                        os.fdopen(fd, "w", encoding="utf-8",
+                                  errors="surrogateescape", newline="") as out:
+                    for k, v in reducer.reduce(self.app.reduce_fn, stream_fn):
+                        out.write(f"{k}\t{v}\n")
+                self._fault("before_reduce_commit")
+                wof = getattr(self.transport, "write_output_from_file", None)
+                if wof is not None:
+                    wof(f"mr-out-{a.task_id}", spool)
+                else:  # custom transports without the streaming commit
+                    with open(spool, "rb") as f:
+                        self.transport.write_output(f"mr-out-{a.task_id}", f.read())
+            finally:
+                os.unlink(spool)
+        finally:
+            if reducer.spill_count:
+                self.metrics.inc("reduce_spills", reducer.spill_count)
+            reducer.close()
         self.transport.reduce_finished(
             rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
         )

@@ -39,6 +39,17 @@ class JobConfig:
     sweep_interval_s: float = 1.0  # coordinator.go:122
     journal: bool = True  # durable task-commit journal for coordinator resume
 
+    # --- Worker resources --------------------------------------------------
+    # Reduce-side grouping memory cap: records past this spill to sorted
+    # on-disk runs and merge-stream (runtime/extsort.py).  The reference
+    # materializes whole partitions in RAM (worker.go:161-162).
+    reduce_memory_bytes: int = 128 << 20
+    # Where reduce spills land.  None: in-process jobs use
+    # <work_dir>/spill; HTTP workers use the system temp dir (the
+    # coordinator's path may not exist on their host).  Set explicitly to
+    # real disk when the temp dir is RAM-backed tmpfs.
+    spill_dir: str | None = None
+
     # --- TPU execution -----------------------------------------------------
     backend: str = "auto"  # "cpu" | "tpu" | "auto" — pick the grep map engine
     mesh_shape: tuple[int, ...] = ()  # () = all local devices on one data axis

@@ -10,6 +10,7 @@ reference's /tmp/mr-data (host) + /tmp/mr (remote) + SFTP star topology
 from __future__ import annotations
 
 import os
+import shutil
 import tempfile
 from pathlib import Path
 from typing import Iterator
@@ -24,6 +25,25 @@ def atomic_write(path: str | Path, data: bytes) -> None:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
         os.replace(tmp, path)  # atomic on POSIX; duplicate executions are safe
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_from_file(path: str | Path, src: str | Path,
+                           chunk_bytes: int = 1 << 20) -> None:
+    """Chunked copy-to-temp-then-rename: the atomic commit for outputs too
+    large to hold in memory (the streaming-reduce path)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
+    try:
+        with os.fdopen(fd, "wb") as out, open(src, "rb") as f:
+            shutil.copyfileobj(f, out, chunk_bytes)
+        os.replace(tmp, path)
     except BaseException:
         try:
             os.unlink(tmp)

@@ -121,7 +121,9 @@ def native_available() -> bool:
 # --- FNV-32a partition hash (reference ihash, worker.go:13-17) -------------
 
 def fnv32a(key: str | bytes) -> int:
-    data = key.encode("utf-8") if isinstance(key, str) else key
+    # surrogateescape: keys embed filenames whose non-UTF8 bytes arrive as
+    # lone surrogates — hash the original bytes, don't crash
+    data = key.encode("utf-8", "surrogateescape") if isinstance(key, str) else key
     lib = _try_load()
     if lib is not None:
         return lib.dgrep_fnv32a(data, len(data))

@@ -1,0 +1,128 @@
+"""Streaming reduce (runtime/extsort.py): bounded-memory grouping must be
+byte-identical to the in-memory sort-merge the reference specifies
+(worker.go:146-176), including value-arrival order within a key."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_grep_tpu.apps.base import KeyValue, group_reduce
+from distributed_grep_tpu.runtime.extsort import ExternalReducer
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.utils.config import JobConfig
+
+
+def _records(n, n_keys, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        KeyValue(key=f"k{int(rng.integers(0, n_keys)):05d}", value=f"v{i}")
+        for i in range(n)
+    ]
+
+
+def test_spilled_matches_in_memory_order_sensitive():
+    """An order-sensitive reduce (join) proves the merge keeps each key's
+    values in arrival order across spill boundaries."""
+    recs = _records(5000, 40, seed=1)
+    join = lambda k, vs: ",".join(vs)  # noqa: E731
+    want = group_reduce(recs, join)
+    with ExternalReducer(memory_limit_bytes=16 << 10) as r:
+        # feed in several batches like the worker does per intermediate file
+        for i in range(0, len(recs), 700):
+            r.add_many(recs[i : i + 700])
+        assert r.spill_count > 1  # the cap actually bit
+        got = dict(r.reduce(join))
+    assert got == want
+
+
+def test_no_spill_small_input():
+    recs = _records(100, 10, seed=2)
+    want = group_reduce(recs, lambda k, vs: str(len(vs)))
+    with ExternalReducer(memory_limit_bytes=64 << 20) as r:
+        r.add_many(recs)
+        assert r.spill_count == 0
+        got = dict(r.reduce(lambda k, vs: str(len(vs))))
+    assert got == want
+
+
+def test_keys_stream_sorted():
+    with ExternalReducer(memory_limit_bytes=4 << 10) as r:
+        r.add_many(_records(3000, 200, seed=3))
+        keys = [k for k, _ in r.reduce(lambda k, vs: "x")]
+    assert keys == sorted(keys) and len(keys) == len(set(keys))
+
+
+def test_stream_fn_never_builds_list():
+    """reduce_stream_fn receives an iterator; consuming lazily must agree
+    with the list-based reduce."""
+    recs = _records(4000, 30, seed=4)
+    with ExternalReducer(memory_limit_bytes=8 << 10) as r:
+        r.add_many(recs)
+        got = dict(r.reduce(None, stream_fn=lambda k, vs: str(sum(1 for _ in vs))))
+    want = group_reduce(recs, lambda k, vs: str(len(vs)))
+    assert got == want
+
+
+def test_values_with_awkward_bytes_roundtrip():
+    """Values containing \\r, tabs, U+2028 and non-ASCII must survive the
+    spill wire format exactly."""
+    recs = [
+        KeyValue("a", "line\rwith\rcr"),
+        KeyValue("a", "tab\there"),
+        KeyValue("b", "uni sep"),
+        KeyValue("b", "café \udcff"),  # surrogateescape byte
+    ] * 50
+    join = lambda k, vs: "|".join(vs)  # noqa: E731
+    with ExternalReducer(memory_limit_bytes=1 << 10) as r:
+        r.add_many(recs)
+        assert r.spill_count > 0
+        got = dict(r.reduce(join))
+    assert got == group_reduce(recs, join)
+
+
+# ------------------------------------------------------------- job level
+
+def test_job_with_tiny_reduce_memory_identical_output(tmp_path, corpus):
+    files = [str(p) for p in corpus.values()]
+
+    def run(cap):
+        cfg = JobConfig(
+            input_files=files,
+            application="distributed_grep_tpu.apps.wordcount",
+            n_reduce=3,
+            work_dir=str(tmp_path / f"wd-{cap}"),
+            reduce_memory_bytes=cap,
+        )
+        return run_job(cfg, n_workers=2)
+
+    small = run(1 << 10)  # a few records per spill
+    big = run(256 << 20)
+    assert small.results == big.results and small.results
+    # identical bytes, not just dicts: outputs are sorted + deterministic
+    small_bytes = b"".join(p.read_bytes() for p in small.output_files)
+    big_bytes = b"".join(p.read_bytes() for p in big.output_files)
+    assert small_bytes == big_bytes
+    assert small.metrics["counters"].get("reduce_spills", 0) > 0
+    assert big.metrics["counters"].get("reduce_spills", 0) == 0
+
+
+def test_non_utf8_filename_survives_wire_format(tmp_path):
+    """POSIX filenames need not be UTF-8; argv decoding maps raw bytes to
+    lone surrogates, which embed in grep keys and must round-trip the
+    shuffle + output wire formats (they used to crash encode_records)."""
+    import os
+
+    raw = os.fsencode(str(tmp_path)) + b"/bad-\xff-name.txt"
+    with open(raw, "wb") as f:
+        f.write(b"hello world\nnope\n")
+    fname = os.fsdecode(raw)  # contains \udcff
+    cfg = JobConfig(
+        input_files=[fname],
+        app_options={"pattern": "hello"},
+        n_reduce=2,
+        work_dir=str(tmp_path / "wd"),
+    )
+    res = run_job(cfg, n_workers=1)
+    assert list(res.results.values()) == ["hello world"]
+    (key,) = res.results.keys()
+    assert key == f"{fname} (line number #1)"
