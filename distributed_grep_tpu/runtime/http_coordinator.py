@@ -36,11 +36,22 @@ from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils.config import JobConfig
-from distributed_grep_tpu.utils.io import WorkDir, atomic_write, resolve_input_path
+from distributed_grep_tpu.utils.io import (
+    WorkDir,
+    atomic_write_from_stream,
+    resolve_input_path,
+)
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 
 log = get_logger("http_coordinator")
+
+# Data-plane block size: GET responses stream from disk and PUT bodies
+# stream to disk in blocks of this many bytes, so no split, intermediate
+# file, or output ever materializes in coordinator memory (the reference
+# whole-file io.Copy's through SFTP, coordinator.go:222-265 — but buffers
+# fit Raspberry-Pi-sized files only).  Tests shrink this to prove flow.
+BLOCK_BYTES = 1 << 20
 
 def long_poll_window_s(config: JobConfig) -> float:
     """Server-side long-poll window, derived from the single rpc_timeout_s
@@ -89,7 +100,7 @@ class CoordinatorServer:
         log.info(
             "coordinator serving on %s:%d (%d map tasks, %d reduce tasks)",
             self.config.coordinator_host,
-            self.config.coordinator_port,
+            self.port,  # the BOUND port (differs from config when it is 0)
             len(self.scheduler.map_tasks),
             self.config.n_reduce,
         )
@@ -158,16 +169,63 @@ def _make_handler(server: CoordinatorServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def _send_bytes(self, data: bytes, code: int = 200) -> None:
-            self.send_response(code)
-            self.send_header("Content-Type", "application/octet-stream")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+        def _send_file(self, path) -> None:
+            """Stream a file in BLOCK_BYTES chunks; honors a single
+            'Range: bytes=N-' prefix range (206 + Content-Range) so a
+            worker whose download died mid-body can resume instead of
+            refetching the whole split."""
+            import shutil
+
+            size = path.stat().st_size
+            start = 0
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                spec = rng[len("bytes="):].split(",")[0].strip()
+                lo, _, hi = spec.partition("-")
+                if lo.isdigit() and (not hi or hi.isdigit()):
+                    start = int(lo)
+                    # open-ended or to-EOF prefix ranges only, and only
+                    # inside the file; anything else (incl. start >= size —
+                    # a 206 with 'bytes N-(N-1)' would be malformed) falls
+                    # back to a full 200, which the client handles by
+                    # restarting its spool
+                    if start >= size or (hi and int(hi) != size - 1):
+                        start = 0
+            with open(path, "rb") as f:
+                f.seek(start)
+                if start:
+                    self.send_response(206)
+                    self.send_header("Content-Range", f"bytes {start}-{size-1}/{size}")
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(size - start))
+                self.end_headers()
+                # headers are out: from here a failure must NOT write a JSON
+                # error into the half-sent body (the client's Range resume
+                # would silently splice those bytes into file content)
+                self._streaming_body = True
+                shutil.copyfileobj(f, self.wfile, BLOCK_BYTES)
 
         def _read_body(self) -> bytes:
             length = int(self.headers.get("Content-Length", 0))
             return self.rfile.read(length) if length else b""
+
+        def _receive_file(self, dst) -> None:
+            """Stream the PUT body straight to a temp file + rename commit —
+            the body never materializes in coordinator memory."""
+            length = int(self.headers.get("Content-Length", 0))
+            atomic_write_from_stream(dst, self.rfile, length, BLOCK_BYTES)
+
+        def _drain_body(self) -> None:
+            """Discard a request body in bounded blocks (404 paths must not
+            buffer a multi-GB body just to answer)."""
+            remaining = int(self.headers.get("Content-Length", 0))
+            while remaining > 0:
+                block = self.rfile.read(min(BLOCK_BYTES, remaining))
+                if not block:
+                    break
+                remaining -= len(block)
 
         # --- POST /rpc/<verb> ---------------------------------------------
         def do_POST(self):
@@ -189,6 +247,7 @@ def _make_handler(server: CoordinatorServer):
 
         # --- GET /config /status /data/... --------------------------------
         def do_GET(self):
+            self._streaming_body = False  # per request (keep-alive reuses us)
             try:
                 if self.path == "/config":
                     self._send_json(json.loads(server.config.to_json()))
@@ -201,25 +260,33 @@ def _make_handler(server: CoordinatorServer):
                         # the job's own input splits.
                         self._send_json({"error": f"not an input split: {fname}"}, 403)
                         return
-                    try:
-                        data = resolve_input_path(fname, workdir).read_bytes()
-                    except FileNotFoundError:
+                    p = resolve_input_path(fname, workdir)
+                    if not p.exists():
                         self._send_json({"error": f"no such input: {fname}"}, 404)
                         return
-                    self._send_bytes(data)
+                    self._send_file(p)
                 elif self.path.startswith("/data/intermediate/"):
                     name = _safe_name(self.path[len("/data/intermediate/") :])
                     p = workdir.root / "intermediate" / name
                     if not p.exists():
                         self._send_json({"error": f"no such file: {name}"}, 404)
                         return
-                    self._send_bytes(p.read_bytes())
+                    self._send_file(p)
                 else:
                     self._send_json({"error": "not found"}, 404)
             except BrokenPipeError:
-                pass
+                self.close_connection = True
             except Exception as e:  # noqa: BLE001
+                # a failure mid-stream leaves the connection unusable for
+                # keep-alive; the client's IncompleteRead triggers its retry
+                self.close_connection = True
                 log.exception("get error on %s", self.path)
+                if getattr(self, "_streaming_body", False):
+                    # response headers already sent: writing a JSON error
+                    # now would masquerade as body bytes and a Range resume
+                    # would commit them as file content — just drop the
+                    # connection (short body -> client retries)
+                    return
                 try:
                     self._send_json({"error": str(e)}, 500)
                 except OSError:
@@ -228,18 +295,23 @@ def _make_handler(server: CoordinatorServer):
         # --- PUT /data/intermediate/<name>, /data/out/<name> --------------
         def do_PUT(self):
             try:
-                data = self._read_body()
                 if self.path.startswith("/data/intermediate/"):
                     name = _safe_name(self.path[len("/data/intermediate/") :])
-                    atomic_write(workdir.root / "intermediate" / name, data)
+                    self._receive_file(workdir.root / "intermediate" / name)
                     self._send_json({"ok": True})
                 elif self.path.startswith("/data/out/"):
                     name = _safe_name(self.path[len("/data/out/") :])
-                    atomic_write(workdir.root / "out" / name, data)
+                    self._receive_file(workdir.root / "out" / name)
                     self._send_json({"ok": True})
                 else:
+                    self._drain_body()  # bounded drain so the 404 gets through
                     self._send_json({"error": "not found"}, 404)
             except Exception as e:  # noqa: BLE001
+                # a partially-consumed body pollutes the connection for
+                # keep-alive — force a close.  The client surfaces the 500
+                # as a failed task attempt; the scheduler's task-timeout
+                # re-enqueue is what retries the work.
+                self.close_connection = True
                 log.exception("put error on %s", self.path)
                 try:
                     self._send_json({"error": str(e)}, 500)

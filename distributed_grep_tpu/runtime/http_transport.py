@@ -114,37 +114,46 @@ class HttpTransport:
         spool_dir = os.environ.get("DGREP_SPOOL_DIR") or None
         url = f"{self.base}/data/input/{urllib.parse.quote(filename, safe='')}"
         deadline: float | None = None
-        while True:
-            tmp = tempfile.NamedTemporaryFile(
-                prefix="dgrep-in-", dir=spool_dir, delete=False
-            )
-            try:
+        tmp = tempfile.NamedTemporaryFile(
+            prefix="dgrep-in-", dir=spool_dir, delete=False
+        )
+        try:
+            while True:
                 try:
-                    with urllib.request.urlopen(url, timeout=self.rpc_timeout_s) as resp:
+                    req = urllib.request.Request(url)
+                    got = tmp.tell()
+                    if got:
+                        # resume after a mid-body death: the coordinator
+                        # serves 'bytes=N-' prefix ranges (206); a 200 means
+                        # no range support — start the spool over
+                        req.add_header("Range", f"bytes={got}-")
+                    with urllib.request.urlopen(req, timeout=self.rpc_timeout_s) as resp:
+                        if got and resp.status != 206:
+                            tmp.seek(0)
+                            tmp.truncate()
                         shutil.copyfileobj(resp, tmp, length=1 << 20)
                     tmp.close()
                     return Path(tmp.name), True
-                finally:
-                    # any non-success path discards the partial spool file
-                    if not tmp.closed:
-                        tmp.close()
-                        os.unlink(tmp.name)
-            except urllib.error.HTTPError as e:
-                raise RuntimeError(f"GET {url} -> {e.code}") from e
-            except (urllib.error.URLError, socket.timeout, ConnectionError,
-                    http.client.HTTPException, OSError) as e:
-                # Local disk problems are NOT liveness failures — retrying
-                # the download cannot fix a full spool disk; surface them.
-                if isinstance(e, OSError) and e.errno in (
-                    errno.ENOSPC, errno.EDQUOT, errno.EROFS,
-                ):
-                    raise
-                now = time.monotonic()
-                if deadline is None:
-                    deadline = now + RETRY_BUDGET_S
-                if now >= deadline:
-                    raise CoordinatorGone(f"GET {url}: {e}") from e
-                time.sleep(RETRY_DELAY_S)
+                except urllib.error.HTTPError as e:
+                    raise RuntimeError(f"GET {url} -> {e.code}") from e
+                except (urllib.error.URLError, socket.timeout, ConnectionError,
+                        http.client.HTTPException, OSError) as e:
+                    # Local disk problems are NOT liveness failures — retrying
+                    # the download cannot fix a full spool disk; surface them.
+                    if isinstance(e, OSError) and e.errno in (
+                        errno.ENOSPC, errno.EDQUOT, errno.EROFS,
+                    ):
+                        raise
+                    now = time.monotonic()
+                    if deadline is None:
+                        deadline = now + RETRY_BUDGET_S
+                    if now >= deadline:
+                        raise CoordinatorGone(f"GET {url}: {e}") from e
+                    time.sleep(RETRY_DELAY_S)
+        except BaseException:
+            tmp.close()
+            os.unlink(tmp.name)
+            raise
 
     def write_intermediate(self, name: str, data: bytes) -> None:
         self._request("PUT", f"/data/intermediate/{urllib.parse.quote(name)}", data)

@@ -52,6 +52,35 @@ def atomic_write_from_file(path: str | Path, src: str | Path,
         raise
 
 
+def atomic_write_from_stream(path: str | Path, stream, length: int,
+                             chunk_bytes: int = 1 << 20) -> None:
+    """Read exactly ``length`` bytes from a stream into a temp file in
+    bounded blocks, then rename-commit — the data-plane PUT receiver
+    (bodies larger than RAM never materialize).  Raises ConnectionError on
+    a short read so callers treat a died peer as a failed upload."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
+    try:
+        with os.fdopen(fd, "wb") as out:
+            remaining = length
+            while remaining > 0:
+                block = stream.read(min(chunk_bytes, remaining))
+                if not block:
+                    raise ConnectionError(
+                        f"short body: {remaining} of {length} bytes missing"
+                    )
+                out.write(block)
+                remaining -= len(block)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def read_chunks(path: str | Path, chunk_bytes: int, overlap: int = 0) -> Iterator[tuple[int, bytes]]:
     """Stream a file as (offset, chunk) pairs with an overlap halo.
 
