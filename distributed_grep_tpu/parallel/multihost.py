@@ -20,8 +20,6 @@ from __future__ import annotations
 
 import os
 
-import jax
-
 from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("multihost")
@@ -33,9 +31,11 @@ def init_distributed(
     process_id: int | None = None,
 ) -> bool:
     """Initialize jax.distributed from args or standard env vars
-    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
-    Returns True if distributed mode was initialized, False for
-    single-process operation (the common single-host case)."""
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID);
+    explicit args win over env.  Returns True if distributed mode was
+    initialized, False for single-process operation (the common
+    single-host case).  jax is imported only when an address is
+    configured, so CPU-only workers never pay the import."""
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if addr is None:
         return False
@@ -46,6 +46,8 @@ def init_distributed(
         kwargs["num_processes"] = int(n)
     if pid is not None:
         kwargs["process_id"] = int(pid)
+    import jax
+
     jax.distributed.initialize(coordinator_address=addr, **kwargs)
     log.info(
         "jax.distributed initialized: process %d/%d, %d local / %d global devices",
@@ -59,4 +61,6 @@ def init_distributed(
 
 def local_mesh_devices() -> list:
     """Devices this process should put in its worker-local mesh."""
+    import jax
+
     return jax.local_devices()

@@ -217,6 +217,14 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     from distributed_grep_tpu.apps.loader import load_application
     from distributed_grep_tpu.runtime.worker import WorkerLoop
 
+    # Multi-host pod slices: when the standard JAX env vars are present
+    # (JAX_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID), wire
+    # jax.distributed before any backend touch so this worker's chips join
+    # the global mesh (parallel/multihost.py); single-host runs skip it.
+    from distributed_grep_tpu.parallel.multihost import init_distributed
+
+    init_distributed()
+
     transport = HttpTransport(addr)
     try:
         config = transport.fetch_config()

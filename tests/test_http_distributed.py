@@ -333,17 +333,12 @@ def test_coordinator_rss_flat_on_large_split(tmp_path):
     coord = subprocess.Popen(
         [sys.executable, "-m", "distributed_grep_tpu", "coordinator",
          "--config", str(cfg)],
-        stderr=subprocess.PIPE, stdout=subprocess.PIPE, env=coord_env, text=True,
+        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL, env=coord_env, text=True,
     )
     try:
-        port = None
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline:
-            line = coord.stderr.readline()
-            m = re_mod.search(r"serving on .*:(\d+)", line or "")
-            if m:
-                port = int(m.group(1))
-                break
+        from tests.test_multihost import port_from_stderr
+
+        port = port_from_stderr(coord)
         assert port, "coordinator never announced its port"
         worker = subprocess.run(
             [sys.executable, "-m", "distributed_grep_tpu", "worker",

@@ -1,0 +1,213 @@
+"""Multi-host glue (parallel/multihost.py): arg/env resolution for
+jax.distributed, and a two-process CLI job bound to a non-loopback
+interface — the closest this single machine gets to the reference's
+actually-deployed two-Raspberry-Pi topology (coordinator.go:316-327).
+
+Real federation cannot run here (this JAX build does not federate CPU
+processes — CLAUDE.md); jax.distributed.initialize is therefore recorded,
+not executed, and multi-host SPMD logic is validated on the virtual mesh
+(tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import pytest
+
+from distributed_grep_tpu.parallel import multihost
+
+
+@pytest.fixture
+def record_init(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "local_device_count", lambda: 4)
+    monkeypatch.setattr(jax, "device_count", lambda: 8)
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    return calls
+
+
+def test_no_address_means_single_process(record_init):
+    assert multihost.init_distributed() is False
+    assert record_init == []
+
+
+def test_explicit_args(record_init):
+    assert multihost.init_distributed("10.0.0.1:9999", 2, 1) is True
+    assert record_init == [
+        {"coordinator_address": "10.0.0.1:9999", "num_processes": 2, "process_id": 1}
+    ]
+
+
+def test_env_resolution(record_init, monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.2:1111")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    assert multihost.init_distributed() is True
+    assert record_init == [
+        {"coordinator_address": "10.0.0.2:1111", "num_processes": 4, "process_id": 3}
+    ]
+
+
+def test_args_override_env(record_init, monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.2:1111")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    assert multihost.init_distributed("10.9.9.9:2222", process_id=0) is True
+    assert record_init == [
+        {"coordinator_address": "10.9.9.9:2222", "num_processes": 4, "process_id": 0}
+    ]
+
+
+def test_partial_spec_omits_kwargs(record_init):
+    """jax.distributed can infer num_processes/process_id on real TPU pods;
+    only pass what was configured."""
+    assert multihost.init_distributed("10.0.0.1:9999") is True
+    assert record_init == [{"coordinator_address": "10.0.0.1:9999"}]
+
+
+def test_process_id_zero_env(record_init, monkeypatch):
+    """'0' from the environment must not be dropped as falsy."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.2:1111")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    assert multihost.init_distributed() is True
+    assert record_init[0]["process_id"] == 0
+
+
+def test_local_mesh_devices_are_local():
+    assert multihost.local_mesh_devices() == jax.local_devices()
+
+
+def test_http_worker_calls_init_distributed(monkeypatch, tmp_path, corpus):
+    """The HTTP worker entry point wires the glue: with the JAX env vars
+    set, run_http_worker must call init_distributed before working."""
+    from distributed_grep_tpu.runtime import http_transport
+    from distributed_grep_tpu.runtime.http_coordinator import CoordinatorServer
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    called = []
+    monkeypatch.setattr(
+        "distributed_grep_tpu.parallel.multihost.init_distributed",
+        lambda *a, **k: called.append(True) or False,
+    )
+    server = CoordinatorServer(JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        app_options={"pattern": "hello"},
+        n_reduce=2,
+        work_dir=str(tmp_path / "job"),
+        coordinator_port=0,
+    ))
+    server.start()
+    try:
+        http_transport.run_http_worker(f"127.0.0.1:{server.port}")
+        assert called == [True]
+        assert server.wait_done(timeout=10.0)
+    finally:
+        server.shutdown(linger_s=0.1)
+
+
+# ------------------------------------------------- non-loopback two-process
+
+def port_from_stderr(proc, timeout: float = 15.0) -> int | None:
+    """Parse the coordinator's bound port from its stderr via a drain
+    thread — readline() in the test thread could block past any deadline,
+    and an undrained pipe can stall the coordinator mid-job once its
+    ~64 KB buffer fills."""
+    import queue
+    import threading
+
+    q: "queue.Queue[str]" = queue.Queue()
+
+    def drain():
+        for line in proc.stderr:  # runs to EOF: the pipe never fills
+            q.put(line)
+
+    threading.Thread(target=drain, daemon=True).start()
+    import re as re_mod
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            line = q.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        m = re_mod.search(r"serving on .*:(\d+)", line)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def _primary_ip() -> str | None:
+    """The host's non-loopback address, if it has one."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("192.0.2.1", 80))  # no traffic sent (UDP)
+            ip = s.getsockname()[0]
+        return None if ip.startswith("127.") else ip
+    except OSError:
+        return None
+
+
+@pytest.mark.slow
+def test_two_process_job_non_loopback(tmp_path, corpus):
+    """Coordinator and worker as separate processes over the host's real
+    interface (not loopback), distinct working directories — the deployed
+    shape of the reference (2 Raspberry Pis + a host, README.md:5)."""
+    ip = _primary_ip()
+    if ip is None:
+        pytest.skip("host has no non-loopback interface")
+    cfg = tmp_path / "job.json"
+    cfg.write_text(json.dumps({
+        "input_files": [str(p) for p in corpus.values()],
+        "application": "distributed_grep_tpu.apps.grep",
+        "app_options": {"pattern": "hello"},
+        "n_reduce": 2,
+        "work_dir": str(tmp_path / "coord-wd"),  # coordinator-private
+        "coordinator_host": ip,
+        "coordinator_port": 0,
+    }))
+    import os
+    import re as re_mod
+
+    env = {**os.environ, "DGREP_LOG": "INFO",
+           # worker-private spool/temp dir — proves no shared filesystem
+           "DGREP_SPOOL_DIR": str(tmp_path / "worker-tmp"),
+           "TMPDIR": str(tmp_path / "worker-tmp")}
+    (tmp_path / "worker-tmp").mkdir()
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "distributed_grep_tpu", "coordinator",
+         "--config", str(cfg)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        env={**env, "PYTHONPATH": ""}, cwd=str(Path(__file__).resolve().parents[1]),
+    )
+    try:
+        port = port_from_stderr(coord)
+        assert port
+        worker = subprocess.run(
+            [sys.executable, "-m", "distributed_grep_tpu", "worker",
+             "--addr", f"{ip}:{port}"],
+            capture_output=True, timeout=120, env=env,
+            cwd=str(Path(__file__).resolve().parents[1]),
+        )
+        assert worker.returncode == 0, worker.stderr[-800:]
+        assert coord.wait(timeout=30) == 0
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait()
+    out = b"".join(
+        p.read_bytes() for p in (tmp_path / "coord-wd" / "out").glob("mr-out-*")
+    )
+    assert b"hello world" in out and b"fox says hello" in out
