@@ -14,9 +14,10 @@ Prints one JSON line per config:
     {"config": N, "name": "...", "value": GB/s, "unit": "GB/s",
      "matched_lines": M, "mode": "..."}
 
---check additionally greps a 1 MB slice with Python re and asserts the
-engine's matched lines agree exactly (recall check, Hyperscan-equivalent
-semantics at line granularity).
+--check additionally greps the WHOLE synthetic corpus (every split) with an
+independent oracle — system ``grep -F -f`` for pattern sets, Python re per
+line otherwise — and asserts the engine's matched lines agree exactly
+(recall check, Hyperscan-equivalent semantics at line granularity).
 """
 
 from __future__ import annotations
@@ -356,11 +357,19 @@ def run_config(
             "bytes": total_bytes,
         }
     if check:
-        sample = datas[0][: 1 << 20]
-        got = set(eng.scan(sample).matched_lines.tolist())
-        want = _oracle_lines(spec, sample)
-        out["check"] = "ok" if got == want else f"MISMATCH +{len(got - want)} -{len(want - got)}"
-        if got != want:
+        # Full-corpus recall check (every split) against the independent
+        # oracle — system grep for sets, Python re otherwise.  VERDICT
+        # round-1 weak #5: a 1 MB slice was not enough to back the
+        # "Hyperscan-equivalent recall" claim; this is the whole corpus.
+        mism = []
+        for i, d in enumerate(datas):
+            got = set(eng.scan(d).matched_lines.tolist())
+            want = _oracle_lines(spec, d)
+            if got != want:
+                mism.append(f"split{i}:+{len(got - want)}-{len(want - got)}")
+        out["check"] = "ok" if not mism else "MISMATCH " + ",".join(mism)
+        out["check_bytes"] = sum(len(d) for d in datas)
+        if mism:
             out["value"] = 0.0
     return out
 

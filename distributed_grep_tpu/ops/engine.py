@@ -62,12 +62,19 @@ from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("engine")
 
+# Coarse span path: above this many candidate lines per segment, per-line
+# Python confirm would crawl — one native DFA pass over the whole segment
+# (C, ~GB/s, vectorized line mapping) resolves everything instead.
+SPAN_CONFIRM_LINE_LIMIT = 4096
+
 
 @dataclass
 class ScanResult:
     matched_lines: np.ndarray  # sorted 1-based line numbers (always exact)
-    # device end-offset count (>= matched lines; for the FDR filter mode
-    # these are pre-confirmation candidates — matched_lines is post-confirm)
+    # device candidate count — end offsets on the exact paths, pre-confirm
+    # candidates in FDR mode, candidate LINES on the coarse shift-and span
+    # path (span granularity hides exact end-offset counts).  A telemetry
+    # figure, not a match count; matched_lines is the exact result.
     n_matches: int
     bytes_scanned: int
 
@@ -430,6 +437,48 @@ class GrepEngine:
             # the plane lives instead of copying it to the default device.
             ctx = jax.default_device(dev) if dev is not None else nullcontext()
             with ctx:
+                if sparse_kind == "span_words":
+                    # Coarse shift-and: nonzero words name 32-byte spans that
+                    # contain >= 1 true match end (no span-level FPs).  Map
+                    # spans to their overlapping lines, confirm each line
+                    # once on host — overlapped with the next segment's scan.
+                    idx, _ = scan_jnp.sparse_nonzero(payload)
+                    starts = sparse_mod.span_starts_from_sparse_words(idx, lay)
+                    if starts.size:
+                        g0 = starts + seg_start  # global span starts
+                        g1 = np.minimum(g0 + 32, len(data))
+                        l0 = lines_mod.line_of_offsets(g0 + 1, nl)
+                        l1 = lines_mod.line_of_offsets(g1, nl)
+                        cand = set()
+                        for a, b in zip(l0.tolist(), l1.tolist()):
+                            cand.update(range(a, b + 1))
+                        cand -= device_lines  # already confirmed earlier
+                        # n_matches on this path counts candidate lines
+                        # (span granularity hides exact end-offset counts;
+                        # see ScanResult)
+                        n_matches += len(cand)
+                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
+                            # dense pattern: per-line Python confirm would
+                            # crawl; one native DFA pass over the segment
+                            # (C, ~GB/s) resolves every line vectorized
+                            from distributed_grep_tpu.utils.native import dfa_scan_mt
+
+                            t = self.table
+                            offs = dfa_scan_mt(
+                                data[seg_start : seg_start + seg_len],
+                                t.full_table(), t.accept, t.start,
+                            )
+                            if offs.size:
+                                seg_lines = lines_mod.line_of_offsets(
+                                    offs.astype(np.int64) + seg_start, nl
+                                )
+                                device_lines.update(np.unique(seg_lines).tolist())
+                        else:
+                            for ln in cand:
+                                start, end = lines_mod.line_span(nl, ln, len(data))
+                                if self._host_line_matcher(data[start:end]):
+                                    device_lines.add(ln)
+                    return
                 if sparse_kind == "words":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
@@ -514,12 +563,20 @@ class GrepEngine:
                                short_offsets, dev)
                     elif use_pallas:
                         if use_pallas_sa:
-                            words = pallas_scan.shift_and_scan_words(arr, self.shift_and)
+                            # coarse packing: a nonzero word = "a match ends
+                            # in this 32-byte span" (~2x kernel throughput);
+                            # the span's lines are confirmed in collect()
+                            words = pallas_scan.shift_and_scan_words(
+                                arr, self.shift_and, coarse=True
+                            )
+                            kind = "span_words"
                         elif use_pallas_approx:
                             words = pallas_approx.approx_scan_words(arr, self.approx)
+                            kind = "words"
                         else:
                             words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
-                        job = ("words", words, lay, seg_start, len(seg_bytes), None, dev)
+                            kind = "words"
+                        job = (kind, words, lay, seg_start, len(seg_bytes), None, dev)
                     elif self.mode == "shift_and":
                         packed = scan_jnp.shift_and_scan(arr, self.shift_and)
                         job = ("lane_bytes", packed, lay, seg_start, len(seg_bytes),

@@ -38,6 +38,28 @@ def offsets_from_sparse_lane_bytes(
     return offsets
 
 
+def span_starts_from_sparse_words(
+    idx: np.ndarray, layout: Layout
+) -> np.ndarray:
+    """Decode the COARSE Pallas packing (pallas_scan coarse=True): a nonzero
+    word means "some match ends in this 32-byte stripe span"; values don't
+    matter.  Returns sorted document offsets of span starts — each span is
+    [start, min(start+32, stripe/document end)); the engine confirms the
+    lines overlapping it."""
+    if idx.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    S = layout.lanes // LANE_COLS
+    l = idx % LANE_COLS
+    rest = idx // LANE_COLS
+    s = rest % S
+    w = rest // S
+    lane = (s // SUBLANES) * LANES_PER_BLOCK + (s % SUBLANES) * LANE_COLS + l
+    starts = lane * layout.chunk + w * 32
+    starts = starts[starts < layout.n_real]
+    starts.sort()
+    return starts.astype(np.int64)
+
+
 def offsets_from_sparse_words(
     idx: np.ndarray, vals: np.ndarray, layout: Layout
 ) -> np.ndarray:

@@ -67,11 +67,25 @@ def slope_per_pass(
             int(chained(dev, r))
         return (time.perf_counter() - t0) / iters
 
-    d1, d2 = timed(r1), timed(r2)
-    per_pass = (d2 - d1) / (r2 - r1)
-    if per_pass <= 0:
-        raise RuntimeError(f"non-positive slope: {d1=:.4f}s ({r1}) {d2=:.4f}s ({r2})")
-    return per_pass, c1 / r1
+    # Fast kernels need long chains: if the rep-count difference doesn't
+    # dominate dispatch noise (non-positive slope, or the delta is under
+    # 30% of the r1 time), escalate r2 and try again — a 170 GB/s kernel
+    # at r2=10 runs ~15 ms of chain against ~100 ms of tunnel jitter.  A
+    # measurement that never clears the noise gate raises rather than
+    # reporting a number the gate itself distrusts (benchmark credibility
+    # is the repo's core contract).
+    for attempt in range(4):
+        d1, d2 = timed(r1), timed(r2)
+        delta = d2 - d1
+        if delta > 0 and delta >= 0.3 * d1:
+            return delta / (r2 - r1), c1 / r1
+        if attempt < 3:
+            r2 = r2 * 3
+            c2 = int(chained(dev, r2))
+            assert c2 * r1 == c1 * r2, f"count drift: {c1}/{r1} vs {c2}/{r2}"
+    raise RuntimeError(
+        f"slope never cleared the noise gate: {d1=:.4f}s ({r1}) {d2=:.4f}s ({r2})"
+    )
 
 
 def _pallas_device_setup(data: bytes, target_lanes: int):
@@ -100,11 +114,14 @@ def _pallas_device_setup(data: bytes, target_lanes: int):
     return dev, lay, lay.lanes // pallas_scan.LANES_PER_BLOCK, pad_rows
 
 
-def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
+def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192,
+                           coarse: bool = True):
     """Device array + scan closure for slope-timing the Pallas shift-and
     kernel.  The one copy of this setup (layout choice, 512 '\\n' pad rows,
     kernel closure) shared by bench.py and benchmarks/baseline_configs.py so
-    the two benches measure the identical configuration.
+    the two benches measure the identical configuration.  ``coarse``
+    defaults to True because that is what the engine runs (span-granular
+    candidate words + host line confirm, ops/pallas_scan.py).
 
     Returns (dev_array, chunk, pad_rows, scan_fn) ready for slope_per_pass.
     """
@@ -121,6 +138,7 @@ def pallas_shift_and_setup(data: bytes, model, *, target_lanes: int = 8192):
             chunk=lay.chunk,
             lane_blocks=lane_blocks,
             interpret=False,
+            coarse=coarse,
         )
 
     return dev, lay.chunk, pad_rows, scan

@@ -29,6 +29,41 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def coordinator_port_reader():
+    """Returns port_from_stderr(proc, timeout): parse a coordinator
+    subprocess's bound port from its stderr via a drain thread —
+    readline() in the test thread could block past any deadline, and an
+    undrained pipe can stall the coordinator once its ~64 KB buffer
+    fills.  Lives in conftest so it needs no cross-test-module import
+    (tests/ is not a package)."""
+    import queue
+    import re
+    import threading
+    import time
+
+    def port_from_stderr(proc, timeout: float = 15.0):
+        q: "queue.Queue[str]" = queue.Queue()
+
+        def drain():
+            for line in proc.stderr:  # runs to EOF: the pipe never fills
+                q.put(line)
+
+        threading.Thread(target=drain, daemon=True).start()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                line = q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            m = re.search(r"serving on .*:(\d+)", line)
+            if m:
+                return int(m.group(1))
+        return None
+
+    return port_from_stderr
+
+
+@pytest.fixture
 def workdir(tmp_path):
     from distributed_grep_tpu.utils.io import WorkDir
 

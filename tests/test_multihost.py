@@ -120,35 +120,6 @@ def test_http_worker_calls_init_distributed(monkeypatch, tmp_path, corpus):
 
 # ------------------------------------------------- non-loopback two-process
 
-def port_from_stderr(proc, timeout: float = 15.0) -> int | None:
-    """Parse the coordinator's bound port from its stderr via a drain
-    thread — readline() in the test thread could block past any deadline,
-    and an undrained pipe can stall the coordinator mid-job once its
-    ~64 KB buffer fills."""
-    import queue
-    import threading
-
-    q: "queue.Queue[str]" = queue.Queue()
-
-    def drain():
-        for line in proc.stderr:  # runs to EOF: the pipe never fills
-            q.put(line)
-
-    threading.Thread(target=drain, daemon=True).start()
-    import re as re_mod
-
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            line = q.get(timeout=0.2)
-        except queue.Empty:
-            continue
-        m = re_mod.search(r"serving on .*:(\d+)", line)
-        if m:
-            return int(m.group(1))
-    return None
-
-
 def _primary_ip() -> str | None:
     """The host's non-loopback address, if it has one."""
     try:
@@ -161,7 +132,7 @@ def _primary_ip() -> str | None:
 
 
 @pytest.mark.slow
-def test_two_process_job_non_loopback(tmp_path, corpus):
+def test_two_process_job_non_loopback(tmp_path, corpus, coordinator_port_reader):
     """Coordinator and worker as separate processes over the host's real
     interface (not loopback), distinct working directories — the deployed
     shape of the reference (2 Raspberry Pis + a host, README.md:5)."""
@@ -193,7 +164,7 @@ def test_two_process_job_non_loopback(tmp_path, corpus):
         env={**env, "PYTHONPATH": ""}, cwd=str(Path(__file__).resolve().parents[1]),
     )
     try:
-        port = port_from_stderr(coord)
+        port = coordinator_port_reader(coord)
         assert port
         worker = subprocess.run(
             [sys.executable, "-m", "distributed_grep_tpu", "worker",

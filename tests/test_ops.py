@@ -277,6 +277,109 @@ def test_pallas_class_pattern_interpret():
     np.testing.assert_array_equal(got, want)
 
 
+def test_pallas_coarse_words_span_contract():
+    """Coarse packing contract: a word is nonzero IFF some true match ends
+    inside its 32-byte span (no span-level false positives or negatives)."""
+    import jax.numpy as jnp
+
+    data = make_text(
+        3000, inject=[(3, b"needle a"), (700, b"needleneedle"), (2999, b"needle")]
+    )
+    model = try_compile_shift_and("needle")
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512, lane_multiple=4096, chunk_multiple=512
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    words = np.asarray(
+        pallas_scan.shift_and_scan_words(arr, model, interpret=True, coarse=True)
+    )
+    # expected spans from the exact per-lane oracle
+    from distributed_grep_tpu.models.dfa import compile_dfa, reference_scan
+
+    table = compile_dfa("needle")
+    nonzero = set()
+    S = lay.lanes // 128
+    for lane in range(lay.lanes):
+        stripe = bytes(arr[:, lane])
+        for off in reference_scan(table, stripe):
+            w = (int(off) - 1) // 32
+            s_idx = (lane // 4096) * 32 + (lane % 4096) // 128
+            nonzero.add((w, s_idx, lane % 128))
+    got = {tuple(map(int, c)) for c in np.argwhere(words != 0)}
+    assert got == nonzero
+
+
+def test_pallas_coarse_span_decode():
+    """span_starts_from_sparse_words maps nonzero coarse words back to
+    document span starts covering every true match end."""
+    from distributed_grep_tpu.ops import scan_jnp as sj
+    from distributed_grep_tpu.ops import sparse as sparse_mod
+
+    data = make_text(2500, inject=[(11, b"needle x"), (2400, b"tail needle")])
+    model = try_compile_shift_and("needle")
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512, lane_multiple=4096, chunk_multiple=512
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    words = pallas_scan.shift_and_scan_words(arr, model, interpret=True, coarse=True)
+    idx, _ = sj.sparse_nonzero(words)
+    starts = sparse_mod.span_starts_from_sparse_words(np.asarray(idx), lay)
+    # every true end offset must fall in some reported span
+    true_ends = []
+    pos = 0
+    while True:
+        i = data.find(b"needle", pos)
+        if i < 0:
+            break
+        true_ends.append(i + len(b"needle"))
+        pos = i + 1
+    spans = [(int(s), int(s) + 32) for s in starts]
+    for e in true_ends:
+        assert any(a < e <= b for a, b in spans), (e, spans[:5])
+
+
+def test_engine_shift_and_coarse_interpret(monkeypatch):
+    """Engine end-to-end on the coarse pallas path (interpret mode):
+    span candidates + host line confirm must be exact."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    data = make_text(
+        800, inject=[(2, b"xx needle yy"), (400, b"needleneedle"), (799, b"needle")]
+    )
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    orig = pallas_scan.shift_and_scan_words
+    monkeypatch.setattr(
+        pallas_scan, "shift_and_scan_words",
+        lambda arr, model, interpret=None, coarse=False:
+            orig(arr, model, interpret=True, coarse=coarse),
+    )
+    eng = engine_mod.GrepEngine("needle")
+    assert eng.mode == "shift_and"
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == oracle_lines("needle", data)
+
+
+def test_engine_shift_and_coarse_dense_native_rescan(monkeypatch):
+    """Dense patterns trip the native-rescan path (SPAN_CONFIRM_LINE_LIMIT):
+    one C DFA pass over the segment instead of per-line Python confirm —
+    output must stay exact."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    data = make_text(300, inject=[(5, b"the fox ran")])  # 'e' is everywhere
+    monkeypatch.setattr(engine_mod, "SPAN_CONFIRM_LINE_LIMIT", 3)
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    orig = pallas_scan.shift_and_scan_words
+    monkeypatch.setattr(
+        pallas_scan, "shift_and_scan_words",
+        lambda arr, model, interpret=None, coarse=False:
+            orig(arr, model, interpret=True, coarse=coarse),
+    )
+    eng = engine_mod.GrepEngine("e")
+    assert eng.mode == "shift_and"
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == oracle_lines("e", data)
+
+
 # ------------------------------------------------- multi-device round-robin
 
 def test_engine_multi_device_segments():
