@@ -148,6 +148,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
             print("error: --max-errors needs a literal/class-sequence pattern "
                   "of <= 32 symbols", file=sys.stderr)
             return 2
+        if args.only_matching:
+            print("error: -o is not supported with --max-errors (approximate "
+                  "matches have no unique matched substring)", file=sys.stderr)
+            return 2
     use_engine_app = (args.backend or "cpu") in ("tpu", "auto") or args.max_errors
     cfg = JobConfig(
         input_files=[str(Path(f).resolve()) for f in args.files],
@@ -181,26 +185,114 @@ def cmd_grep(args: argparse.Namespace) -> int:
         import tempfile
 
         cfg.work_dir = tempfile.mkdtemp(prefix="dgrep-")
+    ctx_before = args.context if args.context is not None else args.before_context
+    ctx_after = args.context if args.context is not None else args.after_context
+
+    from distributed_grep_tpu.runtime.job import GREP_KEY_RE
+
     res = run_job(cfg, n_workers=args.workers)
-    if args.count:
-        # grep -c: one "<file>:<count>" line per input, in argv order.
-        # Parse the result KEYS with the end-anchored grep-key shape (the
-        # value may itself contain " (line number #"), not the joined lines.
-        counts = {f: 0 for f in cfg.input_files}
-        key_re = re.compile(r"^(.*) \(line number #\d+\)$")
-        for key in res.results:
-            m = key_re.match(key)
-            if m:
-                counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    # Parse matched (file, line number) pairs from the result KEYS (the
+    # shared end-anchored grep-key shape — a value may itself contain
+    # " (line number #"), not the joined lines.
+    matched: dict[str, set[int]] = {f: set() for f in cfg.input_files}
+    for key in res.results:
+        m = GREP_KEY_RE.match(key)
+        if m and m.group(1) in matched:
+            matched[m.group(1)].add(int(m.group(2)))
+
+    if args.files_with_matches:
+        # grep -l: names only, argv order, each file once
+        for f in cfg.input_files:
+            if matched[f]:
+                print(f)
+    elif args.count:
+        # grep -c: one "<file>:<count>" line per input, in argv order
         for f in cfg.input_files:
             prefix = f"{f}:" if len(cfg.input_files) > 1 else ""
-            print(f"{prefix}{counts[f]}")
+            print(f"{prefix}{len(matched[f])}")
+    elif args.only_matching:
+        # grep -o: each matched substring on its own line.  -v has no
+        # matched substrings (grep prints nothing for -v -o).
+        if not args.invert:
+            _print_only_matching(res, args, patterns)
+    elif ctx_before or ctx_after:
+        # the '--' group separator is global across input files, like grep
+        printed_any = False
+        for f in cfg.input_files:
+            printed_any = _print_with_context(
+                f, matched[f], ctx_before, ctx_after, printed_any
+            )
     else:
         for line in res.sorted_lines():
             print(line)
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
     return 0
+
+
+def _print_only_matching(res, args, patterns) -> None:
+    import re
+
+    from distributed_grep_tpu.runtime.job import GREP_KEY_RE, grep_key_sort
+
+    flags = re.IGNORECASE if args.ignore_case else 0
+    if patterns is not None:
+        # literal set: leftmost-longest among the alternatives, like grep -F
+        rx = re.compile(
+            "|".join(re.escape(p) for p in
+                     sorted(patterns, key=len, reverse=True)), flags
+        )
+    else:
+        rx = re.compile(args.pattern, flags)
+
+    for key, value in sorted(res.results.items(), key=grep_key_sort):
+        m = GREP_KEY_RE.match(key)
+        prefix = f"{m.group(1)} (line number #{m.group(2)}) " if m else ""
+        for hit in rx.finditer(value):
+            if hit.group(0):
+                print(f"{prefix}{hit.group(0)}")
+
+
+def _print_with_context(path: str, lines_set: set[int], before: int,
+                        after: int, printed_any: bool) -> bool:
+    """grep -A/-B/-C over one file, streaming (memory bounded by the
+    context width).  Matched lines print in the usual key format; context
+    lines use ')-' instead of ') ' and non-contiguous groups are separated
+    by '--', mirroring grep's match/context markers.  ``printed_any``
+    carries across files so the separator is global like grep's; returns
+    the updated flag."""
+    import collections
+
+    prevq: collections.deque = collections.deque(maxlen=max(before, 0))
+    pending_after = 0
+    last_printed = 0
+    with open(path, "rb") as f:
+        for n, raw in enumerate(f, 1):
+            # errors="replace" matches the default output mode exactly: map
+            # values are replace-decoded at emit time (apps/grep.py), so the
+            # same matched line must print identically under -C.  (Lone
+            # surrogates would also crash a strict-encoding stdout.)
+            line = raw.rstrip(b"\n").decode("utf-8", "replace")
+            if n in lines_set:
+                if printed_any and (
+                    last_printed == 0 or n - last_printed > len(prevq) + 1
+                ):
+                    print("--")
+                for qn, qline in prevq:
+                    if qn > last_printed:
+                        print(f"{path} (line number #{qn})- {qline}")
+                prevq.clear()
+                print(f"{path} (line number #{n}) {line}")
+                printed_any = True
+                last_printed = n
+                pending_after = after
+            elif pending_after > 0:
+                print(f"{path} (line number #{n})- {line}")
+                last_printed = n
+                pending_after -= 1
+            elif before:
+                prevq.append((n, line))
+    return printed_any
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -250,6 +342,16 @@ def main(argv: list[str] | None = None) -> int:
                         "patterns, K=1..3)")
     p.add_argument("-c", "--count", action="store_true",
                    help="print match counts per file instead of lines (grep -c)")
+    p.add_argument("-l", "--files-with-matches", action="store_true",
+                   help="print only names of files containing matches (grep -l)")
+    p.add_argument("-o", "--only-matching", action="store_true",
+                   help="print each matched substring on its own line (grep -o)")
+    p.add_argument("-A", "--after-context", type=int, default=0, metavar="N",
+                   help="print N lines of trailing context (grep -A)")
+    p.add_argument("-B", "--before-context", type=int, default=0, metavar="N",
+                   help="print N lines of leading context (grep -B)")
+    p.add_argument("-C", "--context", type=int, default=None, metavar="N",
+                   help="print N lines of context before and after (grep -C)")
     p.add_argument(
         "-f", "--patterns-file", default=None,
         help="pattern set, one per line: literals by default (grep -F -f; "

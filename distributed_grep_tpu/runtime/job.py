@@ -25,6 +25,20 @@ from distributed_grep_tpu.utils.metrics import Metrics
 
 log = get_logger("job")
 
+# The grep applications' key shape (apps/grep.py map_fn) — end-anchored so
+# values containing " (line number #" can't confuse parsing.  The single
+# definition every output mode (sorted_lines, -c/-l/-o in __main__) shares.
+import re as _re
+
+GREP_KEY_RE = _re.compile(r"^(.*) \(line number #(\d+)\)$")
+
+
+def grep_key_sort(item: tuple[str, str]):
+    """Sort key for (key, value) result items: grep-style keys order by
+    (file, line number); anything else lexicographically."""
+    m = GREP_KEY_RE.match(item[0])
+    return (m.group(1), int(m.group(2))) if m else (item[0], 0)
+
 
 @dataclass
 class JobResult:
@@ -35,13 +49,7 @@ class JobResult:
     def sorted_lines(self) -> list[str]:
         """Output lines sorted naturally: grep-style keys sort by (file, line
         number); anything else sorts lexicographically."""
-        import re
-
-        def sort_key(item):
-            m = re.match(r"^(.*) \(line number #(\d+)\)$", item[0])
-            return (m.group(1), int(m.group(2))) if m else (item[0], 0)
-
-        return [f"{k} {v}" for k, v in sorted(self.results.items(), key=sort_key)]
+        return [f"{k} {v}" for k, v in sorted(self.results.items(), key=grep_key_sort)]
 
 
 def collate_outputs(workdir: WorkDir) -> dict[str, str]:

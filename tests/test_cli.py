@@ -111,3 +111,114 @@ def test_e_f_single_backref_line_ok(tmp_path, capsys):
 )
 def test_has_backref(rx, expect):
     assert _has_backref(rx) is expect
+
+
+def test_files_with_matches(tmp_path, corpus, capsys):
+    code, out, _ = run_cli(
+        ["grep", "-l", "fox", str(corpus["a.txt"]), str(corpus["b.txt"]),
+         str(corpus["c.txt"]), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert out.splitlines() == [str(corpus["a.txt"]), str(corpus["b.txt"])]
+
+
+def test_only_matching(tmp_path, corpus, capsys):
+    code, out, _ = run_cli(
+        ["grep", "-o", "hel+o", str(corpus["a.txt"]), str(corpus["c.txt"]),
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    lines = out.splitlines()
+    # c.txt line 2 has "hellohello": two matches from one line
+    assert sum(1 for l in lines if l.endswith(" hello")) == 5
+    assert any("(line number #2)" in l for l in lines)
+
+
+def test_only_matching_literal_set_prefers_longest(tmp_path, capsys):
+    t = tmp_path / "t.txt"
+    t.write_text("xabcdx\n")
+    pf = tmp_path / "p.txt"
+    pf.write_bytes(b"abc\nabcd\n")
+    code, out, _ = run_cli(
+        ["grep", "-o", "-f", str(pf), str(t), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert out.splitlines()[0].endswith(" abcd")  # leftmost-longest, like grep -F
+
+
+def test_context_lines(tmp_path, capsys):
+    t = tmp_path / "t.txt"
+    t.write_text("l1\nl2\nhit A\nl4\nl5\nl6\nhit B\nl8\n")
+    code, out, _ = run_cli(
+        ["grep", "-C", "1", "hit", str(t), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    got = [l.split(") ", 1)[-1] if ") " in l else l for l in out.splitlines()]
+    # context lines carry a ')-' marker; normalize for comparison
+    norm = []
+    for l in out.splitlines():
+        if l == "--":
+            norm.append("--")
+        elif ")- " in l:
+            norm.append("ctx:" + l.split(")- ", 1)[1])
+        else:
+            norm.append("hit:" + l.split(") ", 1)[1])
+    assert norm == [
+        "ctx:l2", "hit:hit A", "ctx:l4", "--", "ctx:l6", "hit:hit B", "ctx:l8",
+    ]
+
+
+def test_context_adjacent_groups_no_separator(tmp_path, capsys):
+    t = tmp_path / "t.txt"
+    t.write_text("hit1\nmid\nhit2\nx\n")
+    code, out, _ = run_cli(
+        ["grep", "-C", "1", "hit", str(t), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "--" not in out.splitlines()
+    assert len(out.splitlines()) == 4  # hit1, mid(ctx), hit2, x(ctx)
+
+
+def test_only_matching_with_invert_prints_nothing(tmp_path, corpus, capsys):
+    code, out, _ = run_cli(
+        ["grep", "-o", "-v", "hello", str(corpus["a.txt"]),
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0 and out == ""
+
+
+def test_context_separator_across_files(tmp_path, capsys):
+    a = tmp_path / "a.txt"
+    a.write_text("hit a\nx\n")
+    b = tmp_path / "b.txt"
+    b.write_text("y\nhit b\n")
+    code, out, _ = run_cli(
+        ["grep", "-C", "1", "hit", str(a), str(b), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    # grep's group separator is global: one '--' between the two files' groups
+    assert out.splitlines().count("--") == 1
+
+
+def test_context_non_utf8_line_round_trips(tmp_path, capsys):
+    t = tmp_path / "t.bin"
+    t.write_bytes(b"caf\xe9 hit\nplain\n")
+    code, out_ctx, _ = run_cli(
+        ["grep", "-C", "1", "hit", str(t), "--work-dir", str(tmp_path / "w1")],
+        capsys,
+    )
+    code2, out_plain, _ = run_cli(
+        ["grep", "hit", str(t), "--work-dir", str(tmp_path / "w2")],
+        capsys,
+    )
+    assert code == 0 and code2 == 0
+    # both modes must print the matched line's bytes identically
+    (plain_line,) = [l for l in out_plain.splitlines() if "hit" in l]
+    assert plain_line in out_ctx.splitlines()
