@@ -10,31 +10,43 @@ answer is FDR: superimpose the set into a few *buckets*, filter the stream
 with shift-AND over per-position reach tables, and confirm rare candidates
 exactly.  This module is that idea rebuilt around what the TPU can do fast.
 
-Design (v2 — the round-2 redesign that took config 5 off its 5-pass cost):
+Design (v3 — round-2 final: per-check domains + cell-snapped clustering):
 
 * 32 buckets — one uint32 per lane, the tile shape every kernel here uses.
 * One *suffix window* per bank: every member is represented by its last
   ``m+1`` bytes (a true match always contains its suffix, so candidates
-  stay a superset; the exact confirm restores precision).  No per-length
-  bank fan-out — one device pass hosts the whole set.
+  stay a superset; the exact confirm restores precision).
 * Reach tables indexed by a pair-domain hash ``h = ((b0*a) ^ (b1*b)) &
-  (D-1)`` of two consecutive bytes, D <= 512 (the kernel's lane-gather
-  covers 128 entries per op, D/128 gathers per lookup).
-* **Clustered bucket assignment** — the key density trick: members are
-  sorted by their final-pair hash and buckets are rank ranges, so each
-  bucket covers a contiguous ~D/32 slice of hash space at the final-pair
-  check.  That one check's bucket density is ~1/32 *independent of set
-  size* (vs ~n_bucket/D for an unclustered check): for a 10k set it is
-  worth ~4.4 unclustered lookups for the price of one.
-* A tunable **check plan**: a list of (pipeline slot, hash family) table
-  lookups.  Slot k checks the byte pair at depth m-1-k from the window
-  end; two independent hash families (HASHES) give up to 2 checks per
-  slot.  The tuner picks how many lookups to spend (more lookups = lower
-  candidate rate = more device time), minimizing measured total cost
-  (device scan + expected confirm) rather than chasing a fixed FP.
+  (D-1)`` of two consecutive bytes.  **Each check chooses its own domain**
+  (the kernel's lane-gather covers 128 entries per op, so a check costs
+  D/128 gathers) — the unit of currency is the gather, and the information
+  argument says a check's false-positive density depends only on
+  ``n / (32 * D)``, i.e. on table bits, making the cost/density frontier
+  flat in D.  What breaks the tie is the clustered check:
+* **Cell-snapped clustered bucket assignment** — members are sorted by
+  their final-pair hash at D=128 and buckets are runs of whole hash
+  *cells* (a cell is never split across buckets).  Each bucket's density
+  at the clustered check is then exactly its cell count / 128, and the
+  *sum* over buckets is exactly 1 — independent of set size.  Because
+  that property holds at ANY domain, the clustered check runs at the
+  minimum D=128: **one gather buys a Σ-density-1 check** that would cost
+  an unclustered plan ~log(32·d)/log(1/d) extra checks.  (v2 clustered at
+  the filler domain and paid 4 gathers for it; that plus rank-straddled
+  cells is where the 28-gather plan went.)
+* A tunable **check plan**: ``(slot, family, domain)`` lookups.  Slot k
+  covers the byte pair at depth m-1-k from the window end; two hash
+  families (HASHES) give up to 2 checks per slot; checks sharing a slot
+  AND together before entering the pipeline.  The tuner enumerates filler
+  domain × lookup count × bank count and minimizes measured total cost
+  (device gathers + expected confirm, overlapped), with expected candidate
+  rates computed exactly from the built tables (``_fp_of_tables``).
 
-The expected candidate rate is computed exactly from the built tables
-(``_fp_of_stack``), so the clustering win is measured, not assumed.
+For the 10k-pattern config-5 set this lands on clustered@128 + 3×D512 +
+3×D256 = 19 gathers/byte at fp ~2e-2 (measured ~11.2 GB/s/chip) — vs v2's
+28 gathers at fp 9e-3 (7.8 GB/s) — because the confirm side (native
+bloom-filtered suffix probe, ~4 ns/candidate, utils/native.ConfirmSet)
+got cheap enough to absorb the higher candidate rate while staying hidden
+behind the device scan.
 """
 
 from __future__ import annotations
@@ -46,7 +58,8 @@ import numpy as np
 NL = 0x0A
 N_BUCKETS = 32
 MAX_DEPTHS = 6  # pipeline slots; window = depths + 1 <= 7 bytes
-DOMAINS = (128, 256, 512)  # kernel gathers per lookup = D / 128
+DOMAINS = (128, 256, 512)  # kernel gathers per check = D / 128
+CLUSTER_DOMAIN = 128  # the clustered check's domain: Σ-density 1 at 1 gather
 # Two independent pair hash families; ANDing lookups of both families at
 # one slot squares that slot's density (d -> d0*d1), which beats adding
 # banks for dense full-alphabet sets.
@@ -54,32 +67,35 @@ HASHES = ((37, 101), (171, 59))
 # Sets whose best achievable candidate rate is still above this are not
 # worth filtering (the confirm would dominate): compile_fdr raises and the
 # engine keeps the exact DFA banks instead.
-FP_CEILING_PER_BYTE = 2e-2
+FP_CEILING_PER_BYTE = 6e-2
 
 # Total-cost model for the tuner, per scanned byte, calibrated on TPU v5e
-# (2026-07-30, probe recorded in ops/pallas_fdr.py docstring): a merged
-# one-pass kernel runs at ~56/L GB/s for L D=512 lookups (smaller domains
-# cost proportionally fewer gathers), i.e. ~17.9 ps per lookup-unit.  One
-# expected candidate costs ~9 ns of confirm (measured: the native
-# suffix-hash probe, utils/native.ConfirmSet, 7.5 ns/candidate
-# single-thread on this host's 10k-set over sorted uniform offsets; the
-# margin covers FDR candidates being hash-biased toward slot hits, which
-# walk pattern chains more often).  The engine overlaps the confirm
-# of segment i with the device scan of segment i+1, so the steady-state
-# per-byte cost is max(scan, confirm) plus a small non-overlapped share —
-# the objective below — not their sum.
-COST_PS_PER_LOOKUP = 17.9
-LOOKUP_UNITS = {128: 0.3, 256: 0.55, 512: 1.0}
-CONFIRM_PS_PER_CANDIDATE = 9_000.0
+# (2026-07-30, probe in ops/pallas_fdr.py docstring): the 128-entry lane
+# gather issues at ~4.5 cycles per (8,128) vreg and is the kernel's
+# bottleneck resource — ~4.7 ps per gather per byte at unroll=4.  One
+# expected candidate costs ~4 ns of confirm (measured: the native
+# bloom-filtered suffix probe, utils/native.ConfirmSet, ~3.8-4.3
+# ns/candidate single-thread on the build host over sorted offsets at
+# config-5 densities).  The engine overlaps the confirm of segment i with
+# the device scan of segment i+1, so the steady-state per-byte cost is
+# max(scan, confirm) plus a small non-overlapped share — the objective
+# below — not their sum.
+COST_PS_PER_GATHER = 4.7
+CONFIRM_PS_PER_CANDIDATE = 4_000.0
 OVERLAP_RESIDUE = 0.2  # fraction of the smaller leg that fails to overlap
-# Kernel compile ceiling: lane-gathers per byte step (= lookups * D/128).
-# Probed on v5e at the kernel's unroll=8: 40 compiles and runs; the old
-# 24-gather ceiling was an unroll-32 artifact (ops/pallas_fdr.py notes).
+# Kernel compile ceiling: lane-gathers per byte step.  Probed on v5e at
+# both production unroll factors (4 and 8): a 40-gather kernel compiles
+# and runs; the old 24-gather ceiling was an unroll-32 artifact
+# (ops/pallas_fdr.py notes).
 MAX_GATHERS = 40
 
 
 def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int, which: int = 0):
-    """The kernel's pair-domain hash — shared host/device definition."""
+    """The kernel's pair-domain hash — shared host/device definition.
+
+    Domains are nested: ``pair_hash(..., D) == pair_hash(..., D') & (D-1)``
+    for D <= D', which is what lets the kernel compute one hash per family
+    and mask it down per check."""
     a, b = HASHES[which]
     return ((b0 * a) ^ (b1 * b)) & (domain - 1)
 
@@ -92,16 +108,16 @@ class FdrError(ValueError):
 class FdrBank:
     """One filter pass: a check plan over an m-slot pipeline.
 
-    ``checks[i] = (slot, family)``: lookup i probes ``tables[i]`` with hash
-    family ``family`` of the byte pair at slot ``slot``; slot k is applied
-    k steps after the oldest check, so it covers the pair at depth m-1-k
-    from the window end.  Checks sharing a slot AND together before
-    entering the pipeline."""
+    ``checks[i] = (slot, family, domain)``: lookup i probes ``tables[i]``
+    (a (domain,) uint32 bucket-mask array) with hash family ``family`` of
+    the byte pair at slot ``slot``; slot k is applied k steps after the
+    oldest check, so it covers the pair at depth m-1-k from the window
+    end.  Checks sharing a slot AND together before entering the
+    pipeline."""
 
     m: int  # pipeline slots (window = m+1 bytes)
-    domain: int  # table entries; D/128 lane-gathers per lookup
-    checks: tuple[tuple[int, int], ...]  # (slot, family) per lookup
-    tables: np.ndarray  # (n_checks, domain) uint32 bucket masks
+    checks: tuple[tuple[int, int, int], ...]  # (slot, family, domain)
+    tables: tuple[np.ndarray, ...]  # per check: (domain,) uint32 bucket masks
     patterns: list[bytes]  # normalized suffix members (for debugging/repr)
     fp_per_byte: float  # expected candidate rate on uniform bytes
 
@@ -110,16 +126,21 @@ class FdrBank:
         return len(self.checks)
 
     @property
-    def n_subtables(self) -> int:
-        return self.domain // 128
+    def domain(self) -> int:
+        """Largest check domain (kernel hash width)."""
+        return max(d for _, _, d in self.checks)
 
     @property
     def families(self) -> tuple[int, ...]:
-        return tuple(sorted({f for _, f in self.checks}))
+        return tuple(sorted({f for _, f, _ in self.checks}))
+
+    @property
+    def total_gathers(self) -> int:
+        return sum(d // 128 for _, _, d in self.checks)
 
     def scan_cost_ps(self) -> float:
-        """Modeled per-byte device cost (lookups dominate)."""
-        return COST_PS_PER_LOOKUP * LOOKUP_UNITS[self.domain] * self.n_checks
+        """Modeled per-byte device cost (gathers dominate)."""
+        return COST_PS_PER_GATHER * self.total_gathers
 
 
 @dataclass(frozen=True)
@@ -154,67 +175,117 @@ def _normalize(patterns: list[str | bytes], ignore_case: bool) -> list[bytes]:
     return out
 
 
-def _full_tables(group: list[bytes], m: int, domain: int) -> np.ndarray:
-    """Build the full (2 families x m slots, domain) uint32 reach stack for
-    one bank over the members' (m+1)-byte suffixes.
+def _bucket_of(group: list[bytes]) -> np.ndarray:
+    """Cell-snapped clustered bucket assignment.
 
-    Bucket assignment sorts members by their final-pair hash (family 0) and
-    buckets are rank ranges — so the slot m-1 / family 0 check sees each
-    bucket covering a contiguous ~domain/N_BUCKETS hash slice: its density
-    is ~1/N_BUCKETS regardless of set size (the clustering trick).  Rows
-    are ordered ``family * m + slot``.
-    """
-    order = sorted(
-        range(len(group)),
-        key=lambda i: (int(pair_hash(group[i][-2], group[i][-1], domain)), group[i]),
-    )
-    tables = np.zeros((2 * m, domain), dtype=np.uint32)
+    Sort members by their final-pair hash at CLUSTER_DOMAIN and pack whole
+    hash cells into buckets targeting equal member counts.  Because no
+    cell is split, bucket b's density at the clustered check is exactly
+    cells(b)/CLUSTER_DOMAIN and Σ_b density_b == 1 (every cell belongs to
+    exactly one bucket) — rank-range assignment (v2) leaked ~N_BUCKETS
+    straddled cells, i.e. a 1.2x fp factor at D=128."""
     n = len(group)
+    cells = [int(pair_hash(p[-2], p[-1], CLUSTER_DOMAIN)) for p in group]
+    order = sorted(range(n), key=lambda i: (cells[i], group[i]))
+    bucket = np.zeros(n, dtype=np.int64)
+    b = 0
     for rank, i in enumerate(order):
-        p = group[i]
-        bucket = rank * N_BUCKETS // n
-        bit = np.uint32(1 << bucket)
-        for k in range(m):
-            # Slot k covers the pair at depth m-1-k from the suffix end;
-            # the pair at depth d ends exactly at byte t-d.
-            d = m - 1 - k
-            b0, b1 = p[len(p) - 2 - d], p[len(p) - 1 - d]
-            for h in range(2):
-                tables[h * m + k, int(pair_hash(b0, b1, domain, which=h))] |= bit
-    return tables
+        want = min(N_BUCKETS - 1, rank * N_BUCKETS // n)
+        if want > b and cells[i] != cells[order[rank - 1]]:
+            b = want
+        bucket[i] = b
+    return bucket
 
 
-def _fp_of_stack(stack: np.ndarray) -> float:
+def _pair_arrays(group: list[bytes], m: int) -> tuple[np.ndarray, np.ndarray]:
+    """(m, n) arrays of the byte pair at each depth d from the suffix end."""
+    b0 = np.empty((m, len(group)), dtype=np.int64)
+    b1 = np.empty((m, len(group)), dtype=np.int64)
+    for d in range(m):
+        for i, p in enumerate(group):
+            b0[d, i] = p[len(p) - 2 - d]
+            b1[d, i] = p[len(p) - 1 - d]
+    return b0, b1
+
+
+def _build_tables(
+    group: list[bytes],
+    bucket: np.ndarray,
+    m: int,
+    checks: tuple[tuple[int, int, int], ...],
+    pair_cache: dict | None = None,
+) -> tuple[np.ndarray, ...]:
+    """Reach tables for one check plan (vectorized over members)."""
+    if pair_cache is None or "pairs" not in pair_cache:
+        pairs = _pair_arrays(group, m)
+        if pair_cache is not None:
+            pair_cache["pairs"] = pairs
+    else:
+        pairs = pair_cache["pairs"]
+    b0, b1 = pairs
+    bits = (np.uint32(1) << bucket.astype(np.uint32)).astype(np.uint32)
+    out = []
+    for slot, fam, domain in checks:
+        key = (slot, fam, domain)
+        if pair_cache is not None and key in pair_cache:
+            out.append(pair_cache[key])
+            continue
+        d = m - 1 - slot
+        idx = pair_hash(b0[d], b1[d], domain, which=fam)
+        t = np.zeros(domain, dtype=np.uint32)
+        np.bitwise_or.at(t, idx, bits)
+        if pair_cache is not None:
+            pair_cache[key] = t
+        out.append(t)
+    return tuple(out)
+
+
+def _fp_of_tables(tables: tuple[np.ndarray, ...]) -> float:
     """Expected candidate probability per byte on uniform random pairs:
     sum over buckets of prod over checks of that bucket's density (checks
     are treated as independent — different pairs, or different hash
     families of one pair)."""
-    bits = (stack[:, :, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
-    dens = bits.sum(axis=1) / stack.shape[1]  # (n_checks, N_BUCKETS)
-    return float(np.prod(dens, axis=0).sum())
+    prod = np.ones(N_BUCKETS, dtype=np.float64)
+    for t in tables:
+        bits = (t[:, None] >> np.arange(N_BUCKETS, dtype=np.uint32)) & 1
+        prod *= bits.sum(axis=0) / t.shape[0]
+    return float(prod.sum())
 
 
-def _plan(m: int, n_lookups: int) -> tuple[tuple[int, int], ...]:
-    """Check plan for a lookup budget: first family 0 at every slot (slot
-    m-1 — the final pair — is the clustered check and always included),
-    then family 1 from the deepest slot down (slot m-1's family-1 density
-    rides the residual clustering, measurably below an unclustered check)."""
-    checks = [(k, 0) for k in range(m)]
-    checks += [(k, 1) for k in range(m - 1, -1, -1)]
-    if not 1 <= n_lookups <= 2 * m:
-        raise ValueError(f"lookup budget {n_lookups} outside 1..{2 * m}")
-    chosen = checks[:n_lookups]
-    if (m - 1, 0) not in chosen:  # tiny budgets: keep the clustered check
-        chosen[-1] = (m - 1, 0)
-    return tuple(chosen)
+def _filler_slots(m: int) -> list[tuple[int, int]]:
+    """Filler priority: family 0 from the deepest unused slot down, then
+    family 1 (slot m-1 first: it shares the clustered pair and rides
+    residual clustering)."""
+    return [(k, 0) for k in range(m - 2, -1, -1)] + [
+        (k, 1) for k in range(m - 1, -1, -1)
+    ]
+
+
+def _plans(m: int):
+    """All candidate check plans: the cell-snapped clustered check (slot
+    m-1, family 0) at CLUSTER_DOMAIN plus every multiset of filler domains
+    (largest domains assigned to the highest-priority fillers).  Mixed
+    domains matter: the gather is the unit of cost, and e.g. swapping one
+    D=512 filler for D=256 drops 2 gathers for a ~1.5x fp factor — the
+    right trade exactly when the confirm has slack (the 10k-set pick is
+    clustered@128 + 3x512 + 3x256 = 19 gathers)."""
+    from itertools import combinations_with_replacement
+
+    slots = _filler_slots(m)
+    for n_fill in range(1, len(slots) + 1):
+        for doms in combinations_with_replacement(DOMAINS, n_fill):
+            ds = sorted(doms, reverse=True)
+            yield ((m - 1, 0, CLUSTER_DOMAIN),) + tuple(
+                (k, f, d) for (k, f), d in zip(slots, ds)
+            )
 
 
 def _compile_group(
     group: list[bytes], m: int, fp_budget: float, max_banks: int = 4
 ) -> list[FdrBank]:
-    """Pick (domain, n_lookups, n_banks) for one window group by minimizing
-    the total-cost model (scan + expected confirm), preferring
-    budget-satisfying configurations when any exists."""
+    """Pick (fill domain, n_lookups, n_banks) for one window group by
+    minimizing the total-cost model (scan + expected confirm, overlapped),
+    preferring budget-satisfying configurations when any exists."""
 
     def total_ps(cost_ps: float, fp: float) -> float:
         confirm = fp * CONFIRM_PS_PER_CANDIDATE
@@ -225,33 +296,31 @@ def _compile_group(
         if n_banks > max_banks or (n_banks > 1 and len(group) < n_banks * N_BUCKETS):
             continue
         shards = [group[i::n_banks] for i in range(n_banks)]
-        for domain in DOMAINS:
-            fulls = [_full_tables(s, m, domain) for s in shards]
-            for n_lookups in range(m, 2 * m + 1):
-                if n_lookups * (domain // 128) > MAX_GATHERS:
-                    continue  # outside the kernel's probed compile ceiling
-                plan = _plan(m, n_lookups)
-                rows = [f * m + k for k, f in plan]
-                banks = []
-                for shard, full in zip(shards, fulls):
-                    stack = np.ascontiguousarray(full[rows])
-                    banks.append(
-                        FdrBank(
-                            m=m,
-                            domain=domain,
-                            checks=plan,
-                            tables=stack,
-                            patterns=shard,
-                            fp_per_byte=_fp_of_stack(stack),
-                        )
+        buckets = [_bucket_of(s) for s in shards]
+        caches = [{} for _ in shards]
+        for plan in _plans(m):
+            gathers = sum(d // 128 for _, _, d in plan)
+            if gathers > MAX_GATHERS:
+                continue  # outside the kernel's probed compile ceiling
+            banks = []
+            for shard, bucket, cache in zip(shards, buckets, caches):
+                tabs = _build_tables(shard, bucket, m, plan, cache)
+                banks.append(
+                    FdrBank(
+                        m=m,
+                        checks=plan,
+                        tables=tabs,
+                        patterns=shard,
+                        fp_per_byte=_fp_of_tables(tabs),
                     )
-                fp = sum(b.fp_per_byte for b in banks)
-                cost = sum(b.scan_cost_ps() for b in banks)
-                # prefer configurations within budget; among those, min
-                # total cost; if none fits, min FP bounds the confirm
-                key = (0, total_ps(cost, fp)) if fp <= fp_budget else (1, fp, cost)
-                if best is None or key < best[0]:
-                    best = (key, banks)
+                )
+            fp = sum(b.fp_per_byte for b in banks)
+            cost = sum(b.scan_cost_ps() for b in banks)
+            # prefer configurations within budget; among those, min
+            # total cost; if none fits, min FP bounds the confirm
+            key = (0, total_ps(cost, fp)) if fp <= fp_budget else (1, fp, cost)
+            if best is None or key < best[0]:
+                best = (key, banks)
     assert best is not None
     return best[1]
 
@@ -326,13 +395,11 @@ def reference_candidates(bank: FdrBank, data: bytes) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     prev = np.concatenate([[0], arr[:-1]])
-    hashes = {
-        f: pair_hash(prev, arr, bank.domain, which=f) for f in bank.families
-    }
     ones = np.uint32(0xFFFFFFFF)
     slot_masks = np.full((bank.m, n), ones, dtype=np.uint32)
-    for i, (slot, fam) in enumerate(bank.checks):
-        slot_masks[slot] &= bank.tables[i][hashes[fam]]
+    for i, (slot, fam, domain) in enumerate(bank.checks):
+        h = pair_hash(prev, arr, domain, which=fam)
+        slot_masks[slot] &= bank.tables[i][h]
     # pipeline: V_0(t) = masks[0, t]; V_k(t) = V_{k-1}(t-1) & masks[k, t]
     Vs = np.empty((bank.m, n), dtype=np.uint32)
     Vs[0] = slot_masks[0]

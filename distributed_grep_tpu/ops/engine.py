@@ -177,9 +177,10 @@ class GrepEngine:
                                 short_pats, ignore_case=ignore_case,
                                 max_states_per_bank=max_states_per_bank,
                             )
-                        # Exact candidate confirm: suffix-hash probe + memcmp
-                        # over the normalized members (native when available,
-                        # ~8 ns/candidate — utils/native.ConfirmSet).  Runs
+                        # Exact candidate confirm: bloom-filtered suffix
+                        # probe + memcmp over the normalized members (native
+                        # when available, ~4 ns/candidate —
+                        # utils/native.ConfirmSet).  Runs
                         # per segment inside collect(), overlapped with the
                         # next segment's device scan — which is why the FDR
                         # tuner prices candidates at max(scan, confirm)

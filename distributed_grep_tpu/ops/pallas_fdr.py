@@ -4,8 +4,8 @@ Same shell as ops/pallas_scan.py / ops/pallas_nfa.py (lanes x chunk tiles,
 time-packed uint32 candidate words, VMEM scratch carried across chunk
 blocks), but the per-byte step is the bucketed pair-hash filter:
 
-    h_f    = ((prev*a_f) ^ (b*b_f)) & (D-1)      pair-domain hash, family f
-    R_i    = tables[i][h_fam(i)]                 one lookup per check
+    h_f    = ((prev*a_f) ^ (b*b_f)) & (Dmax-1)   pair-domain hash, family f
+    R_i    = tables[i][h_fam(i) & (D_i-1)]       one lookup per check
     M_k    = AND of R_i with slot(i) == k        per-slot reach masks
     V_0    = M_0 ;  V_k = V_{k-1}(prev byte) & M_k   pipeline over slots
     cand   = V_{m-1} != 0                        some bucket passed all checks
@@ -13,20 +13,26 @@ blocks), but the per-byte step is the bucketed pair-hash filter:
 The reach lookup is the part the VPU had no primitive for until lane
 gathers: ``jnp.take_along_axis(table_tile, idx, axis=1)`` gathers within a
 128-lane vreg row, so a D-entry table is D/128 broadcast tiles selected by
-the hash's high bits (the ``hi == j`` masks are shared across all checks
-of one family — one compare set per byte, not per lookup).
+the hash's high bits.  **Domains are per check** (models/fdr.py v3): hash
+families nest (h_D == h_Dmax & (D-1)), so the kernel computes one hash per
+family at that family's widest domain, shares the low-7-bit gather index
+across every check of the family, and derives each narrower domain's
+subtable-select masks by masking the same hash — the clustered check's
+single-gather D=128 table costs exactly one take_along_axis.
 
-Probed on TPU v5e (2026-07-30, unroll sweep):
+Probed on TPU v5e (2026-07-30, unroll sweeps):
 
-* the per-(8,128)-vreg 128-entry u32 gather issues at ~4.5 cycles and is
-  the kernel's bottleneck resource — throughput ~= 940 MHz * 4096 /
-  (4.56 * lookups * (D/128) * 4) bytes/s, i.e. ~56/L GB/s at D=512;
+* the per-(8,128)-vreg 128-entry u32 gather issues at ~4.5-5 cycles and is
+  the kernel's bottleneck resource — throughput ~= 1000 / (4.7 ps *
+  total_gathers) GB/s at the best unroll;
 * **the old "MAX_GATHERS = 24" Mosaic compile ceiling was an unroll
   artifact**: at unroll=32 a 32-gather/byte kernel crashes the compiler,
   at unroll<=16 it compiles and runs (measured 6.6 GB/s for 32 gathers);
-* unroll=8 is also ~20% faster than unroll=32 at equal gather counts
-  (11.4 vs 9.3 GB/s for 20 gathers), so the kernel now fixes unroll=8
-  with a lax.fori_loop carrying the pipeline across sub-blocks.
+* unroll sweep at a 21-gather plan (clustered@128 + 5xD512): unroll=2 ->
+  9.5, 4 -> ~10.1, 8 -> 9.0-9.6, 16 -> 9.5 GB/s; at the old 28-gather
+  plan unroll=8 beat 32 by ~20%.  unroll_for picks 4 for gather-heavy
+  plans, 8 for small ones; the production 10k-set pick (clustered@128 +
+  3x512 + 3x256 = 19 gathers, models/fdr.py v3) measures ~11.2 GB/s.
 
 The V pipeline is seeded ALL-ONES at each stripe start: the first m
 positions of a stripe then over-report candidates instead of missing
@@ -51,33 +57,53 @@ from distributed_grep_tpu.ops.pallas_scan import (
     available,
 )
 
-UNROLL = 8  # byte steps unrolled per fori iteration (see probe notes above)
+def unroll_for(plan) -> int:
+    """Unroll factor for a (slot, family, n_sub) kernel plan.
+
+    Probed on v5e (2026-07-30): gather-heavy kernels (the 10k-set 19-21
+    gather plans) run ~10% faster at unroll=4 — register pressure — while
+    small-gather kernels (the 1k-set 5-gather plan: 42 vs 35 GB/s) want
+    unroll=8 to amortize the per-iteration pipeline carries.  The
+    MAX_GATHERS=40 compile ceiling was re-probed at BOTH unroll factors
+    (a 12-check 40-gather m=6 plan compiles and runs at unroll 4 and 8)."""
+    return 4 if sum(ns for _, _, ns in plan) >= 12 else 8
 
 
 def eligible(bank: FdrBank) -> bool:
     """models/fdr only emits kernel-sized banks; guard anyway."""
+    from distributed_grep_tpu.models.fdr import DOMAINS
+
     return (
         bank.m <= 8
-        and bank.domain <= 512
-        and bank.domain % 128 == 0
-        and bank.n_checks * bank.n_subtables <= MAX_GATHERS
+        # exact DOMAINS membership, not just d%128==0: the kernel's nested
+        # hi/lo hash decomposition needs power-of-two domains (d=384 would
+        # mask with 0b101111111 and never select subtable 1)
+        and all(d in DOMAINS for _, _, d in bank.checks)
+        and bank.total_gathers <= MAX_GATHERS
     )
 
 
+def kernel_plan(bank: FdrBank) -> tuple[tuple[int, int, int], ...]:
+    """Static (slot, family, n_subtables) plan the kernel compiles against."""
+    return tuple((slot, fam, d // LANE_COLS) for slot, fam, d in bank.checks)
+
+
 def bank_device_tables(bank: FdrBank) -> np.ndarray:
-    """(n_checks * n_subtables, SUBLANES, LANE_COLS) uint32 — each
-    128-entry subtable broadcast across sublanes, ready to pass to the
-    kernel.  Upload once per engine; ~16 KB per subtable."""
-    nc, d = bank.tables.shape
-    g = d // LANE_COLS
-    sub = bank.tables.reshape(nc, g, LANE_COLS)
+    """(sum of per-check subtables, SUBLANES, LANE_COLS) uint32 — each
+    check's 128-entry subtables broadcast across sublanes and stacked in
+    plan order, ready to pass to the kernel.  Upload once per engine;
+    ~16 KB per subtable."""
+    rows = []
+    for t in bank.tables:
+        rows.append(t.reshape(-1, LANE_COLS))
+    sub = np.concatenate(rows, axis=0)
     tiles = np.broadcast_to(
-        sub[:, :, None, :], (nc, g, SUBLANES, LANE_COLS)
-    ).reshape(nc * g, SUBLANES, LANE_COLS)
+        sub[:, None, :], (sub.shape[0], SUBLANES, LANE_COLS)
+    )
     return np.ascontiguousarray(tiles)
 
 
-def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, plan, steps):
+def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unroll):
     from jax.experimental import pallas as pl  # deferred: import cost
 
     ci = pl.program_id(1)
@@ -89,35 +115,44 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, plan, ste
         prev_ref[...] = jnp.zeros_like(prev_ref)
 
     zero = jnp.uint32(0)
-    families = sorted({f for _, f in plan})
-    n_inner = 32 // UNROLL
+    families = sorted({f for _, f, _ in plan})
+    # widest domain per family: the hash is computed once at that width and
+    # masked down per check (domains nest — models/fdr.pair_hash)
+    fam_sub = {f: max(ns for _, ff, ns in plan if ff == f) for f in families}
+    # static row offset of each check's subtables in tabs_ref
+    offs, o = [], 0
+    for _, _, ns in plan:
+        offs.append(o)
+        o += ns
+    n_inner = 32 // unroll
 
     def word_body(w, carry):
         def sub_body(s, inner):
             prev_b, word, *V = inner
-            for tt in range(UNROLL):
-                b = data_ref[w * 32 + s * UNROLL + tt].astype(jnp.int32)
+            for tt in range(unroll):
+                b = data_ref[w * 32 + s * unroll + tt].astype(jnp.int32)
                 los, sels = {}, {}
                 for f in families:
                     ha, hb = HASHES[f]
-                    h = ((prev_b * ha) ^ (b * hb)) & (n_sub * LANE_COLS - 1)
+                    h = ((prev_b * ha) ^ (b * hb)) & (fam_sub[f] * LANE_COLS - 1)
                     los[f] = h & (LANE_COLS - 1)
-                    if n_sub > 1:
-                        hi = h >> 7
-                        # all-ones/all-zero masks, shared by the family's checks
-                        sels[f] = [
-                            zero - (hi == j).astype(jnp.uint32) for j in range(n_sub)
+                    for ns in sorted({n for _, ff, n in plan if ff == f and n > 1}):
+                        hi = (h & (ns * LANE_COLS - 1)) >> 7
+                        # all-ones/all-zero masks, shared by every check of
+                        # this (family, domain) combination
+                        sels[f, ns] = [
+                            zero - (hi == j).astype(jnp.uint32) for j in range(ns)
                         ]
                 prev_b = b
                 masks = [None] * m
-                for i, (slot, fam) in enumerate(plan):
+                for i, (slot, fam, ns) in enumerate(plan):
                     acc = None
-                    for j in range(n_sub):
+                    for j in range(ns):
                         g = jnp.take_along_axis(
-                            tabs_ref[i * n_sub + j], los[fam], axis=1
+                            tabs_ref[offs[i] + j], los[fam], axis=1
                         )
-                        if n_sub > 1:
-                            g = g & sels[fam][j]
+                        if ns > 1:
+                            g = g & sels[fam, ns][j]
                         acc = g if acc is None else (acc | g)
                     masks[slot] = acc if masks[slot] is None else (masks[slot] & acc)
                 # slots with no check stay None -> all-ones (no AND needed)
@@ -129,7 +164,7 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, plan, ste
                     else:
                         V_new.append(masks[k] if k == 0 else (prev_v & masks[k]))
                 V = V_new
-                bit = jnp.uint32(1 << tt) << (s * jnp.uint32(UNROLL))
+                bit = jnp.uint32(1 << tt) << (s * jnp.uint32(unroll))
                 word = word | jnp.where(V[m - 1] != 0, bit, zero)
             return (prev_b, word, *V)
 
@@ -152,16 +187,19 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, n_sub, plan, ste
 
 @functools.partial(
     jax.jit,
-    static_argnames=("m", "n_sub", "plan", "chunk", "lane_blocks", "interpret"),
+    static_argnames=("m", "plan", "chunk", "lane_blocks", "interpret", "unroll"),
 )
-def _fdr_pallas(data, tabs, *, m, n_sub, plan, chunk, lane_blocks, interpret=False):
+def _fdr_pallas(data, tabs, *, m, plan, chunk, lane_blocks, interpret=False,
+                unroll=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
-    n_checks = len(plan)
-    kernel = functools.partial(_kernel, m=m, n_sub=n_sub, plan=plan, steps=steps)
+    n_rows = sum(ns for _, _, ns in plan)
+    if unroll is None:
+        unroll = unroll_for(plan)
+    kernel = functools.partial(_kernel, m=m, plan=plan, steps=steps, unroll=unroll)
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),
@@ -172,7 +210,7 @@ def _fdr_pallas(data, tabs, *, m, n_sub, plan, chunk, lane_blocks, interpret=Fal
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (n_checks * n_sub, SUBLANES, LANE_COLS),
+                (n_rows, SUBLANES, LANE_COLS),
                 lambda li, ci: (0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -226,8 +264,7 @@ def fdr_scan_words(
         jnp.asarray(data),
         dev_tables,
         m=bank.m,
-        n_sub=bank.domain // LANE_COLS,
-        plan=tuple(bank.checks),
+        plan=kernel_plan(bank),
         chunk=chunk,
         lane_blocks=lane_blocks,
         interpret=interpret,

@@ -226,10 +226,12 @@ def dfa_scan(
 class ConfirmSet:
     """Batch-confirm FDR candidate end-offsets against a literal set.
 
-    Native path: hash probe on the last-4-byte key + full memcmp
-    (native/dgrep.cpp dgrep_confirm_*, ~10 ns/candidate) — the cost that
-    lets the FDR tuner run a cheaper device filter and accept more
-    candidates.  Fallback: a dict keyed the same way.
+    Native path: an L1-resident bloom bitmap rejects absent last-4-byte
+    keys, survivors take a hash-table probe + full memcmp
+    (native/dgrep.cpp dgrep_confirm_*, ~4 ns/candidate at FDR candidate
+    densities) — the cost that lets the FDR tuner run a cheaper device
+    filter and accept more candidates (models/fdr.py
+    CONFIRM_PS_PER_CANDIDATE).  Fallback: a dict keyed the same way.
 
     ``patterns`` must be pre-normalized (lowercased when ignore_case);
     ``ignore_case`` controls folding of the *data* bytes at probe time.

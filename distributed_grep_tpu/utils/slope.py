@@ -154,16 +154,16 @@ def pallas_fdr_setup(data: bytes, model, *, target_lanes: int = 8192):
 
     dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
     banks = [
-        (b.m, b.domain // pallas_fdr.LANE_COLS, tuple(b.checks),
+        (b.m, pallas_fdr.kernel_plan(b),
          jnp.asarray(pallas_fdr.bank_device_tables(b)))
         for b in model.banks
     ]
 
     def scan(win):
         words = None
-        for m, n_sub, plan, tabs in banks:
+        for m, plan, tabs in banks:
             w = pallas_fdr._fdr_pallas(
-                win, tabs, m=m, n_sub=n_sub, plan=plan, chunk=lay.chunk,
+                win, tabs, m=m, plan=plan, chunk=lay.chunk,
                 lane_blocks=lane_blocks, interpret=False,
             )
             words = w if words is None else words | w

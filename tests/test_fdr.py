@@ -99,9 +99,31 @@ def test_big_set_banks_within_budget():
     model = fdr_mod.compile_fdr(pats, fp_budget_per_byte=2e-4)
     assert model.n_patterns == 2000
     for b in model.banks:
-        assert b.domain in fdr_mod.DOMAINS and 1 <= b.m <= fdr_mod.MAX_DEPTHS
+        assert 1 <= b.m <= fdr_mod.MAX_DEPTHS
+        for _, _, d in b.checks:
+            assert d in fdr_mod.DOMAINS
+        assert b.total_gathers <= fdr_mod.MAX_GATHERS
     # cost search should prefer meeting the budget when feasible
     assert model.fp_per_byte <= 2e-3
+
+
+def test_clustered_check_cells_never_split():
+    """The cell-snapped clustered check assigns each hash cell to exactly
+    one bucket, so its bucket densities sum to used_cells/domain <= 1 —
+    the property that makes it worth one gather (models/fdr._bucket_of).
+    Rank-range assignment (v2) would split ~N_BUCKETS cells and push the
+    sum toward 1.25."""
+    pats = _rand_literals(3000, 5, 9, seed=11)
+    model = fdr_mod.compile_fdr(pats)
+    for b in model.banks:
+        slot, fam, domain = b.checks[0]
+        assert (slot, fam, domain) == (b.m - 1, 0, fdr_mod.CLUSTER_DOMAIN)
+        # no cell split: each table cell's mask is a single-bucket bit
+        t = b.tables[0]
+        nonzero = t[t != 0]
+        assert np.all((nonzero & (nonzero - 1)) == 0)
+        bits = (t[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+        assert float((bits.sum(axis=0) / t.shape[0]).sum()) <= 1.0 + 1e-9
 
 
 # ------------------------------------------------------------------ kernel
@@ -157,15 +179,18 @@ def test_device_tables_layout():
     model = fdr_mod.compile_fdr(pats)
     bank = model.banks[0]
     tiles = pallas_fdr.bank_device_tables(bank)
-    g = bank.domain // 128
-    assert tiles.shape == (bank.n_checks * g, 32, 128)
-    # row i*g+j, any sublane s, lane l == tables[i, j*128 + l]
-    for i in range(bank.n_checks):
-        for j in range(g):
+    n_rows = sum(d // 128 for _, _, d in bank.checks)
+    assert tiles.shape == (n_rows, 32, 128)
+    # per-check subtables stack in plan order; any sublane row holds the
+    # broadcast 128-entry slice
+    row = 0
+    for i, (_, _, d) in enumerate(bank.checks):
+        for j in range(d // 128):
             np.testing.assert_array_equal(
-                tiles[i * g + j, 5],
-                bank.tables[i, j * 128 : (j + 1) * 128],
+                tiles[row, 5],
+                bank.tables[i][j * 128 : (j + 1) * 128],
             )
+            row += 1
 
 
 # ----------------------------------------------------- engine (device path)
