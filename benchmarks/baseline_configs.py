@@ -196,8 +196,11 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
     )
     use_pallas_fdr = eng.mode == "fdr" and pallas_scan.available() and eng.fdr
     if use_pallas_sa:
-        label = "pallas_shift_and"
-        dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, eng.shift_and)
+        sa_model = eng._sa_filtered or eng.shift_and
+        n_checked = sum(1 for r in sa_model.sym_ranges if r)
+        label = ("pallas_shift_and" if sa_model is eng.shift_and
+                 else f"pallas_shift_and_filt{n_checked}")
+        dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, sa_model)
     elif use_pallas_nfa:
         label = "pallas_nfa"
         dev, chunk, pad_rows, scan = pallas_nfa_setup(data, eng.glushkov)

@@ -86,6 +86,89 @@ def try_compile_shift_and(
     )
 
 
+# ------------------------------------------------------- rare-class filter
+
+# Byte-frequency prior for choosing which classes the device filter checks.
+# English letter frequencies (upper+lower folded), whitespace/digits, and a
+# uniform floor for everything else.  Exactness NEVER depends on this prior
+# — it only tunes device work vs host confirm (the span-confirm pass in
+# ops/engine.py restores exact lines either way), and the engine disables
+# the filter for the rest of a scan if a segment's candidate rate shows
+# the prior was badly wrong for the corpus.
+_LETTER_FREQ = {
+    "e": 0.127, "t": 0.091, "a": 0.082, "o": 0.075, "i": 0.070, "n": 0.067,
+    "s": 0.063, "h": 0.061, "r": 0.060, "d": 0.043, "l": 0.040, "c": 0.028,
+    "u": 0.028, "m": 0.024, "w": 0.024, "f": 0.022, "g": 0.020, "y": 0.020,
+    "p": 0.019, "b": 0.015, "v": 0.0098, "k": 0.0077, "x": 0.0015,
+    "q": 0.00095, "j": 0.00015, "z": 0.00007,
+}
+
+
+def _byte_prior() -> np.ndarray:
+    prior = np.full(256, 1.0 / 256, dtype=np.float64)
+    for ch, f in _LETTER_FREQ.items():
+        prior[ord(ch)] = f
+        prior[ord(ch.upper())] = f / 4  # uppercase much rarer in prose
+    prior[ord(" ")] = 0.15
+    for d in b"0123456789":
+        prior[d] = 0.01
+    return prior / prior.sum()
+
+
+_PRIOR = _byte_prior()
+
+# Keep adding checked classes until the modeled false-candidate rate drops
+# below this.  Economics: a span candidate costs ~1 us of host line confirm,
+# the full-class device scan ~5 ps/byte — at 2e-6/byte the confirm is ~2 ps
+# /byte, safely hidden, with ~2.5x margin for prior error.
+FILTER_FP_TARGET = 2e-6
+
+
+def filtered_for_device(
+    model: ShiftAndModel, fp_target: float = FILTER_FP_TARGET
+) -> ShiftAndModel | None:
+    """A device-filter variant of ``model`` that checks only its rarest
+    byte-classes (remaining positions become wildcards), or None when no
+    class can be dropped.
+
+    The per-class compare chain is the Pallas kernel's ALU bottleneck
+    (ops/pallas_scan.py); every dropped class removes its compares while
+    the kernel's span-candidate contract is preserved — candidates stay a
+    superset, the engine's span line confirm restores exactness.  Classes
+    are added rarest-first (every position of a chosen class is checked:
+    repeated classes square their frequency for free) until the modeled
+    false-candidate rate on the byte prior clears ``fp_target``."""
+    classes: dict[tuple, list[int]] = {}
+    for j, ranges in enumerate(model.sym_ranges):
+        classes.setdefault(tuple(ranges), []).append(j)
+
+    def freq(ranges: tuple) -> float:
+        return float(sum(_PRIOR[lo : hi + 1].sum() for lo, hi in ranges))
+
+    order = sorted(classes.items(), key=lambda kv: freq(kv[0]))
+    fp = 1.0
+    kept: set[int] = set()
+    for ranges, positions in order:
+        kept.update(positions)
+        fp *= freq(ranges) ** len(positions)
+        if fp <= fp_target:
+            break
+    if len(kept) == model.length:
+        return None  # nothing dropped — use the full model
+    b = model.b_table.copy()
+    sym_ranges: list[list[tuple[int, int]]] = []
+    for j in range(model.length):
+        if j in kept:
+            sym_ranges.append(model.sym_ranges[j])
+        else:
+            sym_ranges.append([])  # wildcard: every byte matches position j
+            b |= np.uint32(1 << j)
+    return ShiftAndModel(
+        b_table=b, sym_ranges=sym_ranges, length=model.length,
+        pattern=model.pattern,
+    )
+
+
 def _mask_to_ranges(mask: int) -> list[tuple[int, int]]:
     """256-bit membership mask -> sorted disjoint inclusive (lo, hi) ranges."""
     ranges: list[tuple[int, int]] = []

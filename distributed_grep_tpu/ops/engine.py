@@ -53,7 +53,11 @@ from distributed_grep_tpu.models.approx import (
     try_compile_approx,
 )
 from distributed_grep_tpu.models.nfa import GlushkovModel, try_compile_glushkov
-from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
+from distributed_grep_tpu.models.shift_and import (
+    ShiftAndModel,
+    filtered_for_device,
+    try_compile_shift_and,
+)
 from distributed_grep_tpu.ops import layout as layout_mod
 from distributed_grep_tpu.ops import lines as lines_mod
 from distributed_grep_tpu.ops import scan_jnp
@@ -108,6 +112,7 @@ class GrepEngine:
         self.ignore_case = ignore_case
 
         self.shift_and: ShiftAndModel | None = None
+        self._sa_filtered: ShiftAndModel | None = None  # rare-class device filter
         self.glushkov: GlushkovModel | None = None
         self.table: DfaTable | None = None
         # Pattern sets beyond one automaton's uint16 state space compile to
@@ -202,6 +207,12 @@ class GrepEngine:
                 self.shift_and = try_compile_shift_and(pattern, ignore_case=ignore_case)
                 if self.shift_and is not None:
                     self.mode = "shift_and"
+                    # Rare-class device filter: check only the pattern's
+                    # rarest byte-classes on device (fewer compares, the
+                    # kernel's ALU bottleneck) — the span confirm pass
+                    # already restores exact lines.  Disabled mid-scan if a
+                    # corpus defeats the byte prior (see collect()).
+                    self._sa_filtered = filtered_for_device(self.shift_and)
                 else:
                     self.glushkov = try_compile_glushkov(pattern, ignore_case=ignore_case)
                     self.mode = "nfa" if self.glushkov is not None else "dfa"
@@ -408,6 +419,10 @@ class GrepEngine:
             and pallas_approx.eligible(self.approx)
         )
         use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
+        # Scan-local rare-class filter state: the dense-candidate guard in
+        # collect() drops it for the REST OF THIS SCAN only (a dense corpus
+        # says nothing about the next file this engine greps).
+        sa_filtered = self._sa_filtered
 
         # Segments round-robin across local chips (the worker drives every
         # chip on its host, SURVEY.md §7 step 5).  Dispatch is async — the
@@ -439,10 +454,12 @@ class GrepEngine:
             ctx = jax.default_device(dev) if dev is not None else nullcontext()
             with ctx:
                 if sparse_kind == "span_words":
-                    # Coarse shift-and: nonzero words name 32-byte spans that
-                    # contain >= 1 true match end (no span-level FPs).  Map
-                    # spans to their overlapping lines, confirm each line
-                    # once on host — overlapped with the next segment's scan.
+                    # Coarse shift-and: nonzero words name 32-byte spans
+                    # that contain >= 1 candidate match end (exact at span
+                    # granularity for the full model; a superset when the
+                    # rare-class filter ran).  Map spans to their
+                    # overlapping lines, confirm each line once on host —
+                    # overlapped with the next segment's device scan.
                     idx, _ = scan_jnp.sparse_nonzero(payload)
                     starts = sparse_mod.span_starts_from_sparse_words(idx, lay)
                     if starts.size:
@@ -464,16 +481,35 @@ class GrepEngine:
                             # (C, ~GB/s) resolves every line vectorized
                             from distributed_grep_tpu.utils.native import dfa_scan_mt
 
+
                             t = self.table
                             offs = dfa_scan_mt(
                                 data[seg_start : seg_start + seg_len],
                                 t.full_table(), t.accept, t.start,
                             )
+                            true_lines = 0
                             if offs.size:
                                 seg_lines = lines_mod.line_of_offsets(
                                     offs.astype(np.int64) + seg_start, nl
                                 )
-                                device_lines.update(np.unique(seg_lines).tolist())
+                                uniq = np.unique(seg_lines)
+                                true_lines = int(uniq.size)
+                                device_lines.update(uniq.tolist())
+                            nonlocal sa_filtered
+                            if sa_filtered is not None and true_lines * 4 < len(cand):
+                                # mostly-false candidates: the corpus defeats
+                                # the filter's byte prior — remaining segments
+                                # of THIS scan run the full compare set.  (A
+                                # dense corpus of TRUE matches keeps the
+                                # filter: the DFA fallback was inevitable
+                                # either way.)
+                                log.info(
+                                    "rare-class filter mostly false on this "
+                                    "corpus (%d candidate lines, %d true) -> "
+                                    "full model for this scan",
+                                    len(cand), true_lines,
+                                )
+                                sa_filtered = None
                         else:
                             for ln in cand:
                                 start, end = lines_mod.line_span(nl, ln, len(data))
@@ -568,7 +604,8 @@ class GrepEngine:
                             # in this 32-byte span" (~2x kernel throughput);
                             # the span's lines are confirmed in collect()
                             words = pallas_scan.shift_and_scan_words(
-                                arr, self.shift_and, coarse=True
+                                arr, sa_filtered or self.shift_and,
+                                coarse=True,
                             )
                             kind = "span_words"
                         elif use_pallas_approx:

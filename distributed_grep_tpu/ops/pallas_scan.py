@@ -67,10 +67,14 @@ def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, steps, coars
 
     * exact  — bit t set iff a match ends at byte t (the original packing);
       costs ~4 extra vector ops per byte for the per-position test+pack.
-    * coarse — the word is nonzero iff ANY match ends inside its 32-byte
-      span (the running state ORs into an accumulator; one mask per word).
-      No false positives at span granularity — the engine confirms the
-      span's line(s) on host, overlapped with the next segment's scan.
+    * coarse — the word is nonzero iff ANY candidate match ends inside its
+      32-byte span (the running state ORs into an accumulator; one mask
+      per word).  For a full model spans are exact (no span-level false
+      positives); for a rare-class filtered model (wildcard positions,
+      models/shift_and.filtered_for_device) spans are a superset.  Either
+      way the engine confirms the span's line(s) on host, overlapped with
+      the next segment's scan — coarse words are candidates, never final
+      output.
       Measured on v5e (2026-07-30): 139 -> ~290 GB/s for a 7-symbol
       literal; the exact per-byte pack was ~40% of the kernel's ALU work.
     """
@@ -86,8 +90,15 @@ def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, steps, coars
     # distinct classes across 6 positions, so its B-mask build costs 4
     # compares + 4 selects instead of 6 + 6 (repeated letters are the norm
     # in real patterns; the compare loop dominates the kernel's ALU work).
+    # Positions with an EMPTY range list are wildcards (the rare-class
+    # device filter, models/shift_and.filtered_for_device): their bits are
+    # a compile-time constant OR — zero ALU cost per byte.
     groups: dict[tuple, int] = {}
+    wildcard = 0
     for j, ranges in enumerate(sym_ranges):
+        if not ranges:
+            wildcard |= 1 << j
+            continue
         groups[tuple(ranges)] = groups.get(tuple(ranges), 0) | (1 << j)
     range_groups = tuple(groups.items())
 
@@ -95,7 +106,7 @@ def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, steps, coars
         word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
         for t in range(32):
             b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            bmask = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+            bmask = jnp.full((SUBLANES, LANE_COLS), jnp.uint32(wildcard))
             for ranges, mask in range_groups:
                 hit = None
                 for lo, hi in ranges:
@@ -167,9 +178,11 @@ def shift_and_scan_words(
 
     ``coarse=False``: bit t of a word = match ends at that byte — decode
     via ops/sparse.offsets_from_sparse_words.  ``coarse=True``: a word is
-    nonzero iff some match ends in its 32-byte span (~2x kernel
-    throughput; no span-level false positives) — decode via
-    ops/sparse.span_starts_from_sparse_words and confirm the span's lines.
+    nonzero iff some candidate match ends in its 32-byte span (~2x kernel
+    throughput; exact at span granularity for full models, a superset for
+    rare-class filtered ones) — decode via
+    ops/sparse.span_starts_from_sparse_words and CONFIRM the span's lines
+    (mandatory for filtered models).
 
     Requires lanes % 4096 == 0 and chunk % 512 == 0 (the engine's layout
     guarantees this on the pallas path).

@@ -470,3 +470,58 @@ def test_scan_re_no_phantom_trailing_line():
     eng = GrepEngine("(a\nb)?")
     assert eng.mode == "re"
     assert eng.scan(b"one\ntwo\n").matched_lines.tolist() == [1, 2]
+
+
+# ------------------------------------------------- rare-class device filter
+
+def test_filtered_for_device_picks_rare_classes():
+    from distributed_grep_tpu.models.shift_and import (
+        filtered_for_device, try_compile_shift_and,
+    )
+
+    model = try_compile_shift_and("volcano")
+    filt = filtered_for_device(model)
+    assert filt is not None
+    checked = [j for j, r in enumerate(filt.sym_ranges) if r]
+    dropped = [j for j, r in enumerate(filt.sym_ranges) if not r]
+    assert dropped, "some class must be dropped for a 6-class literal"
+    # 'v' (rarest) must be checked; 'o'/'a' (common) should be wildcards
+    assert 0 in checked  # position of 'v'
+    assert 1 in dropped or 4 in dropped  # 'o' or 'a'
+    # wildcard positions match every byte in the b_table
+    for j in dropped:
+        assert np.all(filt.b_table >> np.uint32(j) & 1 == 1)
+    # length/match-bit semantics unchanged
+    assert filt.length == model.length and filt.match_bit == model.match_bit
+
+
+def test_filtered_kernel_superset_and_engine_exact(monkeypatch):
+    """Filtered kernel candidates are a superset of true matches; the
+    engine path (span confirm) stays line-exact."""
+    from distributed_grep_tpu.models.shift_and import (
+        filtered_for_device, scan_reference, try_compile_shift_and,
+    )
+    from distributed_grep_tpu.ops import pallas_scan
+
+    model = try_compile_shift_and("volcano")
+    filt = filtered_for_device(model)
+    data = make_text(200, inject=[(3, b"a volcano erupts"), (150, b"volcanovolcano")])
+    # reference-level: filtered match ends must be a superset
+    full_ends = set(scan_reference(model, data).tolist())
+    filt_ends = set(scan_reference(filt, data).tolist())
+    assert full_ends <= filt_ends
+    # engine-level exactness with the pallas interpret path forced on
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    orig = pallas_scan.shift_and_scan_words
+    monkeypatch.setattr(
+        pallas_scan, "shift_and_scan_words",
+        lambda arr, m, interpret=None, coarse=False:
+            orig(arr, m, interpret=True, coarse=coarse),
+    )
+    eng = GrepEngine("volcano", backend="device")
+    assert eng._sa_filtered is not None
+    got = set(eng.scan(data).matched_lines.tolist())
+    want = {i for i, line in enumerate(data.split(b"\n"), 1) if b"volcano" in line}
+    assert got == want
