@@ -59,7 +59,53 @@ def cmd_grep(args: argparse.Namespace) -> int:
 
     from distributed_grep_tpu.runtime.job import run_job
 
+    if args.fixed_strings and args.extended_regexp:
+        print("error: -E and -F are conflicting matchers", file=sys.stderr)
+        return 2
+    if args.word_regexp and args.line_regexp:
+        args.word_regexp = False  # grep: -x subsumes -w
+    if args.max_count is not None and args.max_count < 0:
+        print("error: invalid max count", file=sys.stderr)
+        return 2
+    if args.max_errors and (args.word_regexp or args.line_regexp):
+        print("error: -w/-x are not supported with --max-errors (approximate "
+              "matches have no exact boundaries)", file=sys.stderr)
+        return 2
     patterns: list[str] | None = None
+    if args.e_patterns:
+        # like grep: -e supplies the pattern(s); the positional slot, if
+        # used, parses as the first input file
+        if args.pattern is not None:
+            args.files.insert(0, args.pattern)
+            args.pattern = None
+        if args.patterns_file:
+            print("error: use -e or -f, not both", file=sys.stderr)
+            return 2
+        if args.fixed_strings:
+            # literal set -> set engines; like grep -F, an embedded newline
+            # separates alternative patterns
+            patterns = [p for e in args.e_patterns for p in e.split("\n")]
+        elif len(args.e_patterns) == 1:
+            args.pattern = args.e_patterns[0]
+        else:
+            for rx in args.e_patterns:
+                try:
+                    re.compile(rx)
+                except re.error as e:
+                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
+                    return 2
+            if any(_has_backref(rx) for rx in args.e_patterns):
+                print("error: -e patterns use backreferences, which do not "
+                      "survive being joined into one alternation",
+                      file=sys.stderr)
+                return 2
+            args.pattern = "(?:" + "|".join(
+                f"(?:{rx})" for rx in args.e_patterns) + ")"
+    elif args.fixed_strings and args.pattern is not None:
+        if "\n" in args.pattern:
+            patterns = args.pattern.split("\n")  # grep -F: newline = OR
+        else:
+            args.pattern = re.escape(args.pattern)
     if args.patterns_file:
         if args.pattern is not None:
             # like grep: -f replaces the positional pattern, which then
@@ -132,6 +178,34 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if missing:
         print(f"error: no such file: {', '.join(missing)}", file=sys.stderr)
         return 2
+    if args.recursive:
+        import fnmatch
+
+        expanded: list[str] = []
+        for f in args.files:
+            pf = Path(f)
+            if pf.is_dir():
+                for sub in sorted(pf.rglob("*")):
+                    if not sub.is_file():
+                        continue
+                    if args.include and not any(
+                        fnmatch.fnmatch(sub.name, g) for g in args.include
+                    ):
+                        continue
+                    expanded.append(str(sub))
+            else:
+                expanded.append(f)  # explicit files are always searched
+        if not expanded:
+            print("error: no files matched under the given directories",
+                  file=sys.stderr)
+            return 2
+        args.files = expanded
+    else:
+        dirs = [f for f in args.files if Path(f).is_dir()]
+        if dirs:
+            print(f"error: {', '.join(dirs)}: is a directory (use -r)",
+                  file=sys.stderr)
+            return 2
 
     if args.max_errors:
         if patterns:
@@ -165,6 +239,8 @@ def cmd_grep(args: argparse.Namespace) -> int:
         app_options={
             "ignore_case": args.ignore_case,
             "invert": args.invert,
+            **({"word_regexp": True} if args.word_regexp else {}),
+            **({"line_regexp": True} if args.line_regexp else {}),
             **({"max_errors": args.max_errors} if args.max_errors else {}),
             # --max-errors with no explicit backend still uses the engine's
             # device path: without a TPU it runs the XLA approx core on the
@@ -199,7 +275,25 @@ def cmd_grep(args: argparse.Namespace) -> int:
         m = GREP_KEY_RE.match(key)
         if m and m.group(1) in matched:
             matched[m.group(1)].add(int(m.group(2)))
+    if args.max_count is not None:
+        # grep -m: keep only the first NUM selected lines per file
+        matched = {f: set(sorted(ln)[: args.max_count])
+                   for f, ln in matched.items()}
+    any_selected = any(matched[f] for f in cfg.input_files)
 
+    if args.quiet:
+        return 0 if any_selected else 1
+    if args.files_without_match:
+        # grep -L: names of files with no selected lines, argv order;
+        # exit 0 iff at least one file is listed (GNU grep -L semantics)
+        listed = [f for f in cfg.input_files if not matched[f]]
+        for f in listed:
+            print(f)
+        exit_early = 0 if listed else 1
+        if args.metrics:
+            print(json.dumps(res.metrics, indent=2, sort_keys=True),
+                  file=sys.stderr)
+        return exit_early
     if args.files_with_matches:
         # grep -l: names only, argv order, each file once
         for f in cfg.input_files:
@@ -214,7 +308,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # grep -o: each matched substring on its own line.  -v has no
         # matched substrings (grep prints nothing for -v -o).
         if not args.invert:
-            _print_only_matching(res, args, patterns)
+            _print_only_matching(res, args, patterns, matched)
     elif ctx_before or ctx_after:
         # the '--' group separator is global across input files, like grep
         printed_any = False
@@ -223,30 +317,49 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 f, matched[f], ctx_before, ctx_after, printed_any
             )
     else:
-        for line in res.sorted_lines():
-            print(line)
+        if args.max_count is None:
+            for line in res.sorted_lines():
+                print(line)
+        else:
+            # re-derive printable lines from the capped matched sets
+            from distributed_grep_tpu.runtime.job import grep_key_sort
+
+            for key, value in sorted(res.results.items(), key=grep_key_sort):
+                m = GREP_KEY_RE.match(key)
+                if m and int(m.group(2)) in matched.get(m.group(1), ()):
+                    print(f"{key} {value}")
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
-    return 0
+    # grep exit status: 0 = a line was selected, 1 = none were
+    return 0 if any_selected else 1
 
 
-def _print_only_matching(res, args, patterns) -> None:
+def _print_only_matching(res, args, patterns, matched) -> None:
     import re
 
     from distributed_grep_tpu.runtime.job import GREP_KEY_RE, grep_key_sort
 
+    from distributed_grep_tpu.apps.grep import wrap_mode
+
+    mode = ("line" if args.line_regexp
+            else ("word" if args.word_regexp else "search"))
     flags = re.IGNORECASE if args.ignore_case else 0
     if patterns is not None:
         # literal set: leftmost-longest among the alternatives, like grep -F
-        rx = re.compile(
-            "|".join(re.escape(p) for p in
-                     sorted(patterns, key=len, reverse=True)), flags
-        )
+        base = "|".join(re.escape(p) for p in
+                        sorted(patterns, key=len, reverse=True))
     else:
-        rx = re.compile(args.pattern, flags)
+        base = args.pattern
+    # -w/-x constrain which substrings count as matches, not just which
+    # lines are selected — wrap before finditer (str-pattern variant of the
+    # apps' bytes wrapping)
+    rx = re.compile(wrap_mode(base.encode("utf-8", "surrogateescape"),
+                              mode).decode("utf-8", "surrogateescape"), flags)
 
     for key, value in sorted(res.results.items(), key=grep_key_sort):
         m = GREP_KEY_RE.match(key)
+        if m and int(m.group(2)) not in matched.get(m.group(1), ()):
+            continue  # line dropped by the -m cap
         prefix = f"{m.group(1)} (line number #{m.group(2)}) " if m else ""
         for hit in rx.finditer(value):
             if hit.group(0):
@@ -360,6 +473,27 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("-E", "--extended-regexp", action="store_true",
                    help="with -f: treat pattern-file lines as regexes")
+    p.add_argument("-F", "--fixed-strings", action="store_true",
+                   help="treat PATTERN / -e patterns as literal strings")
+    p.add_argument("-e", "--regexp", action="append", default=None,
+                   metavar="PATTERN", dest="e_patterns",
+                   help="pattern to match (repeatable; lines matching any "
+                        "are selected)")
+    p.add_argument("-w", "--word-regexp", action="store_true",
+                   help="match only whole words (grep -w)")
+    p.add_argument("-x", "--line-regexp", action="store_true",
+                   help="match only whole lines (grep -x)")
+    p.add_argument("-m", "--max-count", type=int, default=None, metavar="NUM",
+                   help="stop after NUM selected lines per file (grep -m)")
+    p.add_argument("-L", "--files-without-match", action="store_true",
+                   help="print only names of files with no matches (grep -L)")
+    p.add_argument("-q", "--quiet", "--silent", action="store_true",
+                   help="no output; exit 0 iff any line is selected (grep -q)")
+    p.add_argument("-r", "--recursive", action="store_true",
+                   help="descend into directory arguments (grep -r)")
+    p.add_argument("--include", action="append", default=None, metavar="GLOB",
+                   help="with -r: search only files whose basename matches "
+                        "GLOB (repeatable)")
     _add_common(p)
     p.set_defaults(fn=cmd_grep)
 

@@ -28,8 +28,23 @@ from distributed_grep_tpu.apps.base import KeyValue
 # per-process, state.
 _pattern: re.Pattern[bytes] | None = re.compile(b"")
 _ac_tables: list | None = None  # Aho-Corasick banks when configured with a set
+_ac_confirm: re.Pattern[bytes] | None = None  # -w/-x confirm for set mode
 _invert: bool = False  # grep -v
+_line_mode: str = "search"  # "search" | "word" (-w) | "line" (-x)
 _configured_with: tuple | None = None
+
+# GNU grep word constituents in the C locale: [A-Za-z0-9_]
+_W = rb"[0-9A-Za-z_]"
+
+
+def wrap_mode(pattern: bytes, mode: str) -> bytes:
+    """Wrap a regex for grep -w / -x semantics.  Non-capturing, so group
+    numbers (and any backreferences) inside ``pattern`` are unchanged."""
+    if mode == "word":
+        return rb"(?<!" + _W + rb")(?:" + pattern + rb")(?!" + _W + rb")"
+    if mode == "line":
+        return rb"\A(?:" + pattern + rb")\Z"
+    return pattern
 
 
 def configure(
@@ -37,20 +52,28 @@ def configure(
     ignore_case: bool = False,
     patterns: list[str | bytes] | None = None,
     invert: bool = False,
+    word_regexp: bool = False,
+    line_regexp: bool = False,
     **_: object,
 ) -> None:
     """``pattern`` is a regex; ``patterns`` is a literal set (grep -F -f).
     Sets compile to Aho-Corasick banks scanned by the native C DFA scanner
     (a 10k-literal alternation through Python re would be O(set) per byte),
     keeping the CPU app interchangeable with the TPU app on big rulesets.
-    ``invert`` = grep -v: emit the lines that do NOT match."""
-    global _pattern, _ac_tables, _invert, _configured_with
+    ``invert`` = grep -v: emit the lines that do NOT match.  ``word_regexp``
+    / ``line_regexp`` = grep -w / -x: the scan stays on the raw pattern
+    (set mode: candidates from the AC banks) and each candidate line is
+    confirmed against the boundary-wrapped regex."""
+    global _pattern, _ac_tables, _ac_confirm, _invert, _line_mode, _configured_with
     if isinstance(pattern, str):
         pattern = pattern.encode("utf-8", "surrogateescape")
     _invert = bool(invert)
-    key = (pattern, ignore_case, tuple(patterns) if patterns else None, _invert)
+    _line_mode = "line" if line_regexp else ("word" if word_regexp else "search")
+    key = (pattern, ignore_case, tuple(patterns) if patterns else None, _invert,
+           _line_mode)
     if key == _configured_with:
         return  # configure runs per task assignment; skip the recompile
+    flags = re.IGNORECASE if ignore_case else 0
     if patterns:
         from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
 
@@ -60,9 +83,14 @@ def configure(
         ]
         _ac_tables = compile_aho_corasick_banks(norm, ignore_case=ignore_case)
         _pattern = None
+        _ac_confirm = None
+        if _line_mode != "search":
+            alt = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
+            _ac_confirm = re.compile(wrap_mode(alt, _line_mode), flags)
     else:
         _ac_tables = None
-        _pattern = re.compile(pattern, re.IGNORECASE if ignore_case else 0)
+        _ac_confirm = None
+        _pattern = re.compile(wrap_mode(pattern, _line_mode), flags)
     _configured_with = key
 
 
@@ -76,7 +104,12 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         lines.pop()  # trailing '\n' does not open a phantom empty line (grep -n)
     out: list[KeyValue] = []
     for lineno, line in enumerate(lines, start=1):
-        hit = (lineno in matched) if matched is not None else _pattern.search(line)
+        if matched is not None:
+            hit = lineno in matched and (
+                _ac_confirm is None or _ac_confirm.search(line)
+            )
+        else:
+            hit = _pattern.search(line)
         if bool(hit) != _invert:
             out.append(
                 KeyValue(

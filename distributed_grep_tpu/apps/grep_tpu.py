@@ -22,6 +22,7 @@ from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
 
 _engine: GrepEngine | None = None
 _invert: bool = False  # grep -v
+_confirm = None  # -w/-x: boundary-wrapped host regex over candidate lines
 _configured_with: tuple | None = None
 
 
@@ -31,16 +32,19 @@ def configure(
     backend: str = "device",
     patterns: list[str] | None = None,
     invert: bool = False,
+    word_regexp: bool = False,
+    line_regexp: bool = False,
     devices: object = "all",  # worker drives every local chip by default
     **engine_opts: object,
 ) -> None:
-    global _engine, _invert, _configured_with
+    global _engine, _invert, _confirm, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
     _invert = bool(invert)
+    mode = "line" if line_regexp else ("word" if word_regexp else "search")
     if backend == "device":
         engine_opts["devices"] = devices
-    key = (pattern, ignore_case, backend, tuple(patterns or ()), _invert,
+    key = (pattern, ignore_case, backend, tuple(patterns or ()), _invert, mode,
            tuple(sorted(engine_opts.items())))
     if key == _configured_with:
         return
@@ -51,6 +55,27 @@ def configure(
         backend=backend,
         **engine_opts,  # type: ignore[arg-type]
     )
+    _confirm = None
+    if mode != "search":
+        # grep -w / -x: the device scan stays on the raw pattern (its
+        # matched lines are a SUPERSET of word/line matches — a word/line
+        # match is in particular a substring match), and each candidate
+        # line is confirmed against the boundary-wrapped regex host-side.
+        import re
+
+        from distributed_grep_tpu.apps.grep import wrap_mode
+
+        if patterns is not None:
+            norm = [
+                p.encode("utf-8", "surrogateescape") if isinstance(p, str)
+                else bytes(p) for p in patterns
+            ]
+            base = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
+        else:
+            base = pattern.encode("utf-8", "surrogateescape")
+        _confirm = re.compile(
+            wrap_mode(base, mode), re.IGNORECASE if ignore_case else 0
+        )
     _configured_with = key
 
 
@@ -59,11 +84,19 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
     result = _engine.scan(contents)
     emit = result.matched_lines.tolist()
+    nl = None
+    if _confirm is not None and emit:
+        nl = newline_index(contents)
+        emit = [
+            ln for ln in emit
+            if _confirm.search(contents[slice(*line_span(nl, ln, len(contents)))])
+        ]
     if _invert:
         emit = sorted(set(range(1, count_lines(contents) + 1)) - set(emit))
     if not emit:
         return []
-    nl = newline_index(contents)
+    if nl is None:
+        nl = newline_index(contents)
     out: list[KeyValue] = []
     for line_no in emit:
         start, end = line_span(nl, line_no, len(contents))
@@ -95,6 +128,8 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
     out: list[KeyValue] = []
 
     def emit(line_no: int, line: bytes) -> None:
+        if _confirm is not None and not _confirm.search(line):
+            return  # -w/-x: candidate line fails the boundary confirm
         out.append(
             KeyValue(
                 key=f"{filename} (line number #{line_no})",

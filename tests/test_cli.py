@@ -222,3 +222,140 @@ def test_context_non_utf8_line_round_trips(tmp_path, capsys):
     # both modes must print the matched line's bytes identically
     (plain_line,) = [l for l in out_plain.splitlines() if "hit" in l]
     assert plain_line in out_ctx.splitlines()
+
+
+# ------------------------------------------------- round-2 surface additions
+
+def test_word_regexp(tmp_path, capsys):
+    t = tmp_path / "w.txt"
+    t.write_text("hell yes\nhello\nshell hell\nx_hell\n")
+    code, out, _ = run_cli(
+        ["grep", "-w", "hell", str(t), "--work-dir", str(tmp_path / "w")], capsys
+    )
+    assert code == 0
+    lines = {int(l.split("#")[1].split(")")[0]) for l in out.splitlines()}
+    assert lines == {1, 3}  # not "hello", not "x_hell" (underscore is a word char)
+
+
+def test_line_regexp_and_exit_codes(tmp_path, capsys):
+    t = tmp_path / "x.txt"
+    t.write_text("hello\nhello there\n")
+    code, out, _ = run_cli(
+        ["grep", "-x", "hello", str(t), "--work-dir", str(tmp_path / "w")], capsys
+    )
+    assert code == 0 and len(out.splitlines()) == 1
+    code, out, _ = run_cli(
+        ["grep", "-x", "hell", str(t), "--work-dir", str(tmp_path / "w2")], capsys
+    )
+    assert code == 1 and out == ""  # no whole-line match -> grep exit 1
+
+
+def test_word_regexp_pattern_set(tmp_path, capsys):
+    t = tmp_path / "s.txt"
+    t.write_text("alpha beta\nalphabet soup\nbeta max\n")
+    pf = tmp_path / "pats"
+    pf.write_text("alpha\nbeta\n")
+    code, out, _ = run_cli(
+        ["grep", "-w", "-f", str(pf), str(t), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    lines = {int(l.split("#")[1].split(")")[0]) for l in out.splitlines()}
+    assert lines == {1, 3}  # "alphabet" is not a word match
+
+
+def test_max_count_quiet_fixed_strings(tmp_path, capsys):
+    t = tmp_path / "m.txt"
+    t.write_text("a.b\nxay\na.b again\na.b third\n")
+    code, out, _ = run_cli(
+        ["grep", "-F", "-m", "2", "a.b", str(t), "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert len(out.splitlines()) == 2  # -F: '.' literal (no 'xay'); -m 2 caps
+    code, out, _ = run_cli(
+        ["grep", "-q", "zzz", str(t), "--work-dir", str(tmp_path / "w2")], capsys
+    )
+    assert code == 1 and out == ""
+    code, out, _ = run_cli(
+        ["grep", "-q", "xay", str(t), "--work-dir", str(tmp_path / "w3")], capsys
+    )
+    assert code == 0 and out == ""
+
+
+def test_multiple_e_patterns_and_files_without_match(tmp_path, capsys):
+    a = tmp_path / "a.txt"
+    a.write_text("apple pie\n")
+    b = tmp_path / "b.txt"
+    b.write_text("nothing here\n")
+    code, out, _ = run_cli(
+        ["grep", "-e", "apple", "-e", "cherry", str(a), str(b),
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0 and "apple pie" in out
+    code, out, _ = run_cli(
+        ["grep", "-L", "apple", str(a), str(b), "--work-dir", str(tmp_path / "w2")],
+        capsys,
+    )
+    assert code == 0 and out.strip() == str(b)
+
+
+def test_recursive_include_and_dir_error(tmp_path, capsys):
+    d = tmp_path / "tree"
+    (d / "sub").mkdir(parents=True)
+    (d / "sub" / "x.log").write_text("needle deep\n")
+    (d / "top.txt").write_text("needle top\n")
+    code, out, _ = run_cli(
+        ["grep", "-r", "needle", str(d), "--include", "*.log",
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    assert "x.log" in out and "top.txt" not in out
+    # a directory without -r is an error, like grep without -r/-d
+    code, _, err = run_cli(
+        ["grep", "needle", str(d), "--work-dir", str(tmp_path / "w2")], capsys
+    )
+    assert code == 2 and "directory" in err
+
+
+def test_review_fixes_round2_cli(tmp_path, capsys):
+    t = tmp_path / "r.txt"
+    t.write_text("hell x_hell\nzz\ncat one\ncat two\n")
+    # -w -o: only the word-bounded occurrence prints
+    code, out, _ = run_cli(
+        ["grep", "-w", "-o", "hell", str(t), "--work-dir", str(tmp_path / "w1")],
+        capsys,
+    )
+    assert code == 0 and len(out.splitlines()) == 1
+    # -o respects the -m cap
+    code, out, _ = run_cli(
+        ["grep", "-o", "-m", "1", "cat", str(t), "--work-dir", str(tmp_path / "w2")],
+        capsys,
+    )
+    assert code == 0 and len(out.splitlines()) == 1
+    # negative -m is an error like GNU grep
+    code, _, err = run_cli(
+        ["grep", "-m", "-1", "cat", str(t), "--work-dir", str(tmp_path / "w3")],
+        capsys,
+    )
+    assert code == 2 and "invalid max count" in err
+    # -F -e with embedded newline = alternative literals
+    code, out, _ = run_cli(
+        ["grep", "-F", "-e", "zz\nmissing", str(t),
+         "--work-dir", str(tmp_path / "w4")],
+        capsys,
+    )
+    assert code == 0 and len(out.splitlines()) == 1
+    # -L exit status: 0 when a file is listed, 1 when none are
+    code, out, _ = run_cli(
+        ["grep", "-L", "nothinghere", str(t), "--work-dir", str(tmp_path / "w5")],
+        capsys,
+    )
+    assert code == 0 and out.strip() == str(t)
+    code, out, _ = run_cli(
+        ["grep", "-L", "cat", str(t), "--work-dir", str(tmp_path / "w6")],
+        capsys,
+    )
+    assert code == 1 and out == ""
