@@ -103,6 +103,70 @@ class Anchor:
 _REPEAT_EXPANSION_CAP = 512  # total copies a bounded repeat may expand to
 
 
+# Literal-set extraction: how many concrete byte strings an alternation /
+# class product may expand to before we stop treating it as a literal set.
+LITERAL_SET_CAP = 256
+
+
+def enumerate_literal_set(
+    pattern: str, ignore_case: bool = False, cap: int = LITERAL_SET_CAP
+) -> list[bytes] | None:
+    """The byte strings matched by ``pattern`` when it denotes a finite
+    literal set — an alternation / concatenation / small-class product with
+    no repeats or anchors — or None when it doesn't (or would expand past
+    ``cap``).
+
+    This is the Hyperscan-style literal decomposition: patterns like
+    ``(volcano|anarchism|needle)`` are exactly literal sets, and the
+    engine's pattern-set path (Aho-Corasick banks + the FDR device filter)
+    scans them faster than the Glushkov NFA kernel compiled from the same
+    regex.  Parsing uses ignore_case=False even for case-insensitive greps
+    — the set engines fold case natively, and enumerating folded masks
+    would blow the cap at 2^len.  Newline-containing expansions return
+    None (a literal with '\n' can never match within a line; the regex
+    paths own that semantics)."""
+    try:
+        ast = _Parser(pattern, ignore_case=False).parse()
+    except RegexError:
+        return None
+
+    def enum(node) -> list[bytes] | None:
+        if isinstance(node, Char):
+            byts = [b for b in range(256) if node.mask >> b & 1]
+            if not byts or len(byts) > cap or NL in byts:
+                return None
+            return [bytes([b]) for b in byts]
+        if isinstance(node, Concat):
+            acc = [b""]
+            for part in node.parts:
+                sub = enum(part)
+                if sub is None or len(acc) * len(sub) > cap:
+                    return None
+                acc = [a + x for a in acc for x in sub]
+            return acc
+        if isinstance(node, Alt):
+            out: list[bytes] = []
+            for opt in node.options:
+                sub = enum(opt)
+                if sub is None or len(out) + len(sub) > cap:
+                    return None
+                out.extend(sub)
+            return out
+        return None  # Repeat / Anchor / anything unbounded
+
+    lits = enum(ast)
+    if lits is None or not lits or any(not x for x in lits):
+        return None  # empty-string members: the regex engines own those
+    # dedup, preserving first-seen order (cosmetic; set engines dedup too)
+    seen: set[bytes] = set()
+    out = []
+    for x in lits:
+        if x not in seen:
+            seen.add(x)
+            out.append(x)
+    return out
+
+
 class _Parser:
     """Recursive-descent parser for the grep -E subset."""
 

@@ -44,6 +44,7 @@ from distributed_grep_tpu.models.dfa import (
     choose_stride,
     compile_dfa,
     reference_scan,
+    enumerate_literal_set,
 )
 from distributed_grep_tpu.models.approx import (
     MAX_ERRORS,
@@ -129,6 +130,40 @@ class GrepEngine:
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
 
+        # Hyperscan-style literal decomposition: a regex that denotes a
+        # finite literal set — alternations / small class products like
+        # (volcano|anarchism|needle) — routes to the pattern-set engines
+        # (AC banks + FDR device filter), which scan such sets faster than
+        # the Glushkov NFA kernel compiled from the same regex.  Shift-and-
+        # eligible patterns keep their (faster still) single-pass path, and
+        # approximate matching keeps the regex form.
+        self._literal_set_source: str | None = None
+        sa_model = None  # compiled here, reused by the single-pattern branch
+        routed_fdr: FdrModel | None = None  # probe model, reused when routed
+        if pattern is not None and patterns is None and not max_errors:
+            sa_model = try_compile_shift_and(pattern, ignore_case=ignore_case)
+            if sa_model is None:
+                lits = enumerate_literal_set(pattern, ignore_case=ignore_case)
+                route = lits is not None and len(lits) >= 2
+                if route and backend == "device":
+                    # Only reroute when the FDR filter actually hosts the
+                    # set (members >= 2 bytes, candidate rate under the
+                    # ceiling): a set that falls back to the XLA DFA-bank
+                    # device path would be far slower than the Glushkov
+                    # NFA this regex otherwise compiles to.  The probe
+                    # model is kept — the set branch reuses it.
+                    try:
+                        routed_fdr = compile_fdr(lits, ignore_case=ignore_case)
+                    except FdrError:
+                        route = False
+                if route:
+                    self._literal_set_source = (
+                        pattern if isinstance(pattern, str)
+                        else pattern.decode("utf-8", "surrogateescape")
+                    )
+                    patterns = lits  # type: ignore[assignment]
+                    pattern = None
+
         if max_errors:
             # agrep family (models/approx.py): literal/class-sequence
             # patterns only — the k-error recurrence rides the shift-and
@@ -153,7 +188,9 @@ class GrepEngine:
                 assert self.approx is not None
             self.mode = "approx"
         elif patterns is not None:
-            self.pattern = f"<set of {len(patterns)}>"
+            self.pattern = (
+                self._literal_set_source or f"<set of {len(patterns)}>"
+            )
             # Exact AC banks always exist: they are the CPU/native engine,
             # the DFA-bank device fallback, AND the host confirm oracle for
             # the FDR filter path.
@@ -176,7 +213,11 @@ class GrepEngine:
                 short_pats = [p for p in patterns if _blen(p) < 2]
                 if long_pats:
                     try:
-                        self.fdr = compile_fdr(long_pats, ignore_case=ignore_case)
+                        # a routed literal set was already compiled by the
+                        # decomposition probe (short_pats empty by its guard)
+                        self.fdr = routed_fdr or compile_fdr(
+                            long_pats, ignore_case=ignore_case
+                        )
                         if short_pats:
                             self._fdr_short = compile_aho_corasick_banks(
                                 short_pats, ignore_case=ignore_case,
@@ -204,7 +245,7 @@ class GrepEngine:
             try:
                 self.table = compile_dfa(pattern, ignore_case=ignore_case, max_states=max_states)
                 self.tables = [self.table]
-                self.shift_and = try_compile_shift_and(pattern, ignore_case=ignore_case)
+                self.shift_and = sa_model
                 if self.shift_and is not None:
                     self.mode = "shift_and"
                     # Rare-class device filter: check only the pattern's

@@ -290,3 +290,38 @@ def test_too_dense_set_raises():
     pats = sorted(p for p in pats if b"\n" not in p)[:16384]
     with pytest.raises(fdr_mod.FdrError):
         fdr_mod.compile_fdr(pats)
+
+
+# ---------------------------------------------- literal-set decomposition
+
+def test_alternation_routes_to_pattern_set_engines():
+    """Hyperscan-style literal decomposition: a finite-literal-set regex
+    compiles to the pattern-set engines (FDR on device), not the NFA."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    eng = GrepEngine("(volcano|anarchism|needle)")
+    assert eng.mode in ("fdr", "dfa") and len(eng.tables) >= 1
+    assert eng.pattern == "(volcano|anarchism|needle)"
+    got = set(eng.scan(b"a volcano\nx\nneedles\nanarchism!\n").matched_lines.tolist())
+    assert got == {1, 3, 4}
+    # 1-byte members are FDR-ineligible on device: regex paths keep them
+    assert GrepEngine("(a|b)").mode == "nfa"
+    # class-sequences keep the (faster) single-pass shift-and path
+    assert GrepEngine("h[ae]llo").mode == "shift_and"
+    # case-insensitive decomposition folds in the set engines, not by
+    # enumerating case variants
+    ci = GrepEngine("nee(dle|t)", ignore_case=True)
+    assert ci.mode in ("fdr", "dfa")
+    assert set(ci.scan(b"NEEDLE\nneet\nneat\n").matched_lines.tolist()) == {1, 2}
+
+
+def test_literal_set_enumeration_caps_and_rejects():
+    from distributed_grep_tpu.models.dfa import enumerate_literal_set
+
+    assert enumerate_literal_set("(ab|cd)") == [b"ab", b"cd"]
+    assert enumerate_literal_set("x[01][01]") == [b"x00", b"x01", b"x10", b"x11"]
+    assert enumerate_literal_set("a+") is None          # unbounded
+    assert enumerate_literal_set("^ab") is None         # anchored
+    assert enumerate_literal_set("(a|)") is None        # empty member
+    assert enumerate_literal_set("[0-9]{4}") is None    # 10^4 > cap
+    assert enumerate_literal_set("volcano") == [b"volcano"]
