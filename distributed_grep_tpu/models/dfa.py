@@ -109,7 +109,7 @@ LITERAL_SET_CAP = 256
 
 
 def enumerate_literal_set(
-    pattern: str, ignore_case: bool = False, cap: int = LITERAL_SET_CAP
+    pattern: str, cap: int = LITERAL_SET_CAP
 ) -> list[bytes] | None:
     """The byte strings matched by ``pattern`` when it denotes a finite
     literal set — an alternation / concatenation / small-class product with
@@ -120,9 +120,10 @@ def enumerate_literal_set(
     ``(volcano|anarchism|needle)`` are exactly literal sets, and the
     engine's pattern-set path (Aho-Corasick banks + the FDR device filter)
     scans them faster than the Glushkov NFA kernel compiled from the same
-    regex.  Parsing uses ignore_case=False even for case-insensitive greps
-    — the set engines fold case natively, and enumerating folded masks
-    would blow the cap at 2^len.  Newline-containing expansions return
+    regex.  Parsing is always case-SENSITIVE: for a case-insensitive grep
+    the caller must forward ignore_case to the downstream set engine (the
+    engines fold natively; enumerating folded masks here would blow the
+    cap at 2^len).  Newline-containing expansions return
     None (a literal with '\n' can never match within a line; the regex
     paths own that semantics)."""
     try:

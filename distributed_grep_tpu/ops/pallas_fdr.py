@@ -106,6 +106,9 @@ def bank_device_tables(bank: FdrBank) -> np.ndarray:
 def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unroll):
     from jax.experimental import pallas as pl  # deferred: import cost
 
+    if not (1 <= unroll <= 32 and 32 % unroll == 0):
+        raise ValueError(f"unroll must divide 32: {unroll}")
+
     ci = pl.program_id(1)
 
     @pl.when(ci == 0)

@@ -101,8 +101,16 @@ def build_b_tables(model: GlushkovModel) -> np.ndarray:
     return np.ascontiguousarray(tiles)
 
 
-def _kernel(data_ref, *refs, plan, steps, gather_b):
+# Byte steps unrolled per fori iteration.  v5e sweep (2026-07-30): the
+# 2-word config-4 kernel runs ~10% faster at unroll=16 than fully unrolled
+# (33.4/32.6 vs 30.3 GB/s over repeated runs); 1-word kernels show no
+# consistent preference above the tunnel noise.  Same register-pressure
+# effect the FDR kernel showed (ops/pallas_fdr.unroll_for).
+def _kernel(data_ref, *refs, plan, steps, gather_b, unroll=16):
     from jax.experimental import pallas as pl  # deferred: import cost
+
+    if not (1 <= unroll <= 32 and 32 % unroll == 0):
+        raise ValueError(f"unroll must divide 32: {unroll}")
 
     if gather_b:
         tabs_ref, out_ref, d_ref, nl_ref = refs
@@ -119,57 +127,68 @@ def _kernel(data_ref, *refs, plan, steps, gather_b):
 
     zero = jnp.uint32(0)
 
+    n_inner = 32 // unroll
+
     def word_body(w, carry):
+        def sub_body(sx, inner):
+            word, *d, prev_nl = inner
+            for tt in range(unroll):
+                b = data_ref[w * 32 + sx * unroll + tt].astype(jnp.int32)  # (32, 128)
+                if gather_b:
+                    # ---- B[byte] per state word, via 128-lane table gathers
+                    lo_idx = b & 127
+                    hi_sel = zero - (b >= 128).astype(jnp.uint32)  # all-ones hi
+                    bmask = []
+                    for wi in range(n_words):
+                        g_lo = jnp.take_along_axis(tabs_ref[wi * 2], lo_idx, axis=1)
+                        g_hi = jnp.take_along_axis(tabs_ref[wi * 2 + 1], lo_idx, axis=1)
+                        bmask.append((g_hi & hi_sel) | (g_lo & ~hi_sel))
+                else:
+                    # ---- B[byte] per state word, via per-class range compares
+                    bmask = [zero] * n_words
+                    for ranges, pos_words in classes:
+                        hit = None
+                        for lo, hi in ranges:
+                            r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                            hit = r if hit is None else (hit | r)
+                        hit_m = zero - hit.astype(jnp.uint32)  # all-ones where hit
+                        for wi, m in pos_words:
+                            bmask[wi] = bmask[wi] | (hit_m & jnp.uint32(m))
+                # ---- reached = init | chains | specials
+                reached = [jnp.full((SUBLANES, LANE_COLS), f, dtype=jnp.uint32)
+                           for f in init_float]
+                if anchored:
+                    nl_m = zero - prev_nl  # all-ones after a newline
+                    for wi in range(n_words):
+                        if init_anchor[wi]:
+                            reached[wi] = reached[wi] | (nl_m & jnp.uint32(init_anchor[wi]))
+                for wi in range(n_words):
+                    if chain_src[wi]:
+                        reached[wi] = reached[wi] | (
+                            (d[wi] & jnp.uint32(chain_src[wi])) << jnp.uint32(1)
+                        )
+                for wp, jp, flist in specials:
+                    bit = (d[wp] >> jnp.uint32(jp)) & jnp.uint32(1)
+                    sel = zero - bit
+                    for wi, m in flist:
+                        reached[wi] = reached[wi] | (sel & jnp.uint32(m))
+                # ---- step + match
+                d = [reached[wi] & bmask[wi] for wi in range(n_words)]
+                acc = d[0] & jnp.uint32(final_words[0])
+                for wi in range(1, n_words):
+                    acc = acc | (d[wi] & jnp.uint32(final_words[wi]))
+                word = word | jnp.where(acc != 0, jnp.uint32(1 << tt) << (sx * jnp.uint32(unroll)), zero)
+                if anchored:
+                    prev_nl = (b == NL).astype(jnp.uint32)
+            return (word, *d, prev_nl)
+
         *d, prev_nl = carry
-        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
-        for t in range(32):
-            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            if gather_b:
-                # ---- B[byte] per state word, via 128-lane table gathers
-                lo_idx = b & 127
-                hi_sel = zero - (b >= 128).astype(jnp.uint32)  # all-ones hi
-                bmask = []
-                for wi in range(n_words):
-                    g_lo = jnp.take_along_axis(tabs_ref[wi * 2], lo_idx, axis=1)
-                    g_hi = jnp.take_along_axis(tabs_ref[wi * 2 + 1], lo_idx, axis=1)
-                    bmask.append((g_hi & hi_sel) | (g_lo & ~hi_sel))
-            else:
-                # ---- B[byte] per state word, via per-class range compares
-                bmask = [zero] * n_words
-                for ranges, pos_words in classes:
-                    hit = None
-                    for lo, hi in ranges:
-                        r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
-                        hit = r if hit is None else (hit | r)
-                    hit_m = zero - hit.astype(jnp.uint32)  # all-ones where hit
-                    for wi, m in pos_words:
-                        bmask[wi] = bmask[wi] | (hit_m & jnp.uint32(m))
-            # ---- reached = init | chains | specials
-            reached = [jnp.full((SUBLANES, LANE_COLS), f, dtype=jnp.uint32)
-                       for f in init_float]
-            if anchored:
-                nl_m = zero - prev_nl  # all-ones after a newline
-                for wi in range(n_words):
-                    if init_anchor[wi]:
-                        reached[wi] = reached[wi] | (nl_m & jnp.uint32(init_anchor[wi]))
-            for wi in range(n_words):
-                if chain_src[wi]:
-                    reached[wi] = reached[wi] | (
-                        (d[wi] & jnp.uint32(chain_src[wi])) << jnp.uint32(1)
-                    )
-            for wp, jp, flist in specials:
-                bit = (d[wp] >> jnp.uint32(jp)) & jnp.uint32(1)
-                sel = zero - bit
-                for wi, m in flist:
-                    reached[wi] = reached[wi] | (sel & jnp.uint32(m))
-            # ---- step + match
-            d = [reached[wi] & bmask[wi] for wi in range(n_words)]
-            acc = d[0] & jnp.uint32(final_words[0])
-            for wi in range(1, n_words):
-                acc = acc | (d[wi] & jnp.uint32(final_words[wi]))
-            word = word | jnp.where(acc != 0, jnp.uint32(1 << t), zero)
-            if anchored:
-                prev_nl = (b == NL).astype(jnp.uint32)
+        word0 = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        if n_inner == 1:
+            out = sub_body(0, (word0, *d, prev_nl))
+        else:
+            out = jax.lax.fori_loop(0, n_inner, sub_body, (word0, *d, prev_nl))
+        word, *d, prev_nl = out
         out_ref[w] = word
         return (*d, prev_nl)
 
@@ -181,17 +200,20 @@ def _kernel(data_ref, *refs, plan, steps, gather_b):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "chunk", "lane_blocks", "gather_b", "interpret")
+    jax.jit,
+    static_argnames=(
+        "plan", "chunk", "lane_blocks", "gather_b", "interpret", "unroll"
+    ),
 )
 def _nfa_pallas(data, b_tabs=None, *, plan, chunk, lane_blocks, gather_b=False,
-                interpret=False):
+                interpret=False, unroll=16):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
     n_words = plan[0]
-    kernel = functools.partial(_kernel, plan=plan, steps=steps, gather_b=gather_b)
+    kernel = functools.partial(_kernel, plan=plan, steps=steps, gather_b=gather_b, unroll=unroll)
     in_specs = [
         pl.BlockSpec(
             (steps, SUBLANES, LANE_COLS),

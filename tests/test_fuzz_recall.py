@@ -240,3 +240,62 @@ def test_fuzz_fdr_kernel_interpret(seed, monkeypatch):
         lines.pop()
     want = {i for i, ln in enumerate(lines, 1) if any(p in ln for p in pats)}
     assert got == want, f"seed={seed} n={len(pats)}"
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_literal_decomposition(seed):
+    """Random alternations of literals / small class products: the engine
+    routes these to the pattern-set engines (literal decomposition); output
+    must stay exactly the re oracle's."""
+    rng = np.random.default_rng(6000 + seed)
+    n = int(rng.integers(2, 9))
+    branches = []
+    for _ in range(n):
+        if rng.random() < 0.3:
+            branches.append(
+                _gen_literal(rng, int(rng.integers(1, 3)))
+                + _gen_class(rng).replace(".", "[ab]").replace("[^x]", "[xy]")
+                + _gen_literal(rng, int(rng.integers(1, 3)))
+            )
+        else:
+            branches.append(_gen_literal(rng, int(rng.integers(2, 8))))
+    pattern = "(" + "|".join(branches) + ")"
+    rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
+    needle = _sample_match(rng, pattern)
+    data = _gen_corpus(rng, "words" if seed % 2 else "binary", 48 << 10,
+                       [needle] if needle else [])
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == want, (
+            f"seed={seed} backend={backend} mode={eng.mode} pattern={pattern!r}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_word_line_modes(seed):
+    """grep -w / -x through the apps vs a wrapped-re oracle."""
+    from distributed_grep_tpu.apps import grep as grep_app
+    from distributed_grep_tpu.apps import grep_tpu as grep_tpu_app
+
+    rng = np.random.default_rng(7000 + seed)
+    pattern = _gen_literal(rng, int(rng.integers(2, 6)))
+    data = _gen_corpus(rng, "words", 32 << 10, [pattern.encode()])
+    mode_kw = {"word_regexp": True} if seed % 2 else {"line_regexp": True}
+    wrapped = grep_app.wrap_mode(
+        pattern.encode("utf-8", "surrogateescape"),
+        "word" if seed % 2 else "line",
+    )
+    rx = re.compile(wrapped)
+    want = _oracle_lines(rx, data)
+    for app in (grep_app, grep_tpu_app):
+        kw = dict(mode_kw)
+        if app is grep_tpu_app:
+            kw["backend"] = "cpu"
+        app.configure(pattern=pattern, **kw)
+        got = {
+            int(kv.key.rsplit("#", 1)[1].rstrip(")"))
+            for kv in app.map_fn("f", data)
+        }
+        assert got == want, f"seed={seed} app={app.__name__} pattern={pattern!r}"
