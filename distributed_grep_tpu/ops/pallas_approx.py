@@ -29,6 +29,7 @@ from distributed_grep_tpu.ops.pallas_scan import (
     MAX_TOTAL_RANGES,
     SUBLANES,
     available,
+    validate_unroll,
 )
 
 NL = 0x0A
@@ -38,9 +39,11 @@ def eligible(model: ApproxModel) -> bool:
     return model.base.total_ranges <= MAX_TOTAL_RANGES and model.k <= MAX_ERRORS
 
 
-def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, k, steps):
+def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, k, steps,
+            unroll=8):
     from jax.experimental import pallas as pl  # deferred: import cost
 
+    validate_unroll(unroll)
     ci = pl.program_id(1)
     seeds = [jnp.uint32((1 << j) - 1) for j in range(k + 1)]
 
@@ -51,33 +54,48 @@ def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, k, steps):
 
     zero = jnp.uint32(0)
     one = jnp.uint32(1)
+    # symbols sharing a byte-class share one compare (same dedup as the
+    # shift-and kernel: repeated letters are the norm in real patterns)
+    groups: dict[tuple, int] = {}
+    for j, ranges in enumerate(sym_ranges):
+        groups[tuple(ranges)] = groups.get(tuple(ranges), 0) | (1 << j)
+    range_groups = tuple(groups.items())
+    n_inner = 32 // unroll
 
     def word_body(w, carry):
-        R = list(carry)
-        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
-        for t in range(32):
-            b = data_ref[w * 32 + t].astype(jnp.int32)  # (32, 128)
-            bmask = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
-            for j, ranges in enumerate(sym_ranges):
-                bit = jnp.uint32(1 << j)
-                hit = None
-                for lo, hi in ranges:
-                    r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
-                    hit = r if hit is None else (hit | r)
-                bmask = bmask | jnp.where(hit, bit, zero)
-            new = [((R[0] << one) | one) & bmask]
-            for j in range(1, k + 1):
-                new.append(
-                    (((R[j] << one) | one) & bmask)
-                    | R[j - 1]
-                    | (R[j - 1] << one)
-                    | (new[j - 1] << one)
-                    | seeds[j]
-                )
-            nl_m = zero - (b == NL).astype(jnp.uint32)  # all-ones at '\n'
-            R = [(nl_m & seeds[j]) | (~nl_m & new[j]) for j in range(k + 1)]
-            m = (R[k] & jnp.uint32(match_bit)) != 0
-            word = word | jnp.where(m, jnp.uint32(1 << t), zero)
+        def sub_body(sx, inner):
+            word, *R = inner
+            for tt in range(unroll):
+                b = data_ref[w * 32 + sx * unroll + tt].astype(jnp.int32)
+                bmask = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+                for ranges, mask in range_groups:
+                    hit = None
+                    for lo, hi in ranges:
+                        r = (b >= lo) & (b <= hi) if lo != hi else (b == lo)
+                        hit = r if hit is None else (hit | r)
+                    bmask = bmask | jnp.where(hit, jnp.uint32(mask), zero)
+                new = [((R[0] << one) | one) & bmask]
+                for j in range(1, k + 1):
+                    new.append(
+                        (((R[j] << one) | one) & bmask)
+                        | R[j - 1]
+                        | (R[j - 1] << one)
+                        | (new[j - 1] << one)
+                        | seeds[j]
+                    )
+                nl_m = zero - (b == NL).astype(jnp.uint32)  # all-ones at '\n'
+                R = [(nl_m & seeds[j]) | (~nl_m & new[j]) for j in range(k + 1)]
+                m = (R[k] & jnp.uint32(match_bit)) != 0
+                bit = jnp.uint32(1 << tt) << (sx * jnp.uint32(unroll))
+                word = word | jnp.where(m, bit, zero)
+            return (word, *R)
+
+        word0 = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        if n_inner == 1:
+            out = sub_body(0, (word0, *carry))
+        else:
+            out = jax.lax.fori_loop(0, n_inner, sub_body, (word0, *carry))
+        word, *R = out
         out_ref[w] = word
         return tuple(R)
 
@@ -89,17 +107,16 @@ def _kernel(data_ref, out_ref, state_ref, *, sym_ranges, match_bit, k, steps):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sym_ranges", "match_bit", "k", "chunk", "lane_blocks", "interpret"),
+    static_argnames=("sym_ranges", "match_bit", "k", "chunk", "lane_blocks", "interpret", "unroll"),
 )
-def _approx_pallas(data, *, sym_ranges, match_bit, k, chunk, lane_blocks, interpret=False):
+def _approx_pallas(data, *, sym_ranges, match_bit, k, chunk, lane_blocks, interpret=False, unroll=8):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     steps = 32 * CHUNK_BLOCK_WORDS
     chunk_blocks = chunk // steps
     kernel = functools.partial(
-        _kernel, sym_ranges=sym_ranges, match_bit=match_bit, k=k, steps=steps
-    )
+        _kernel, sym_ranges=sym_ranges, match_bit=match_bit, k=k, steps=steps, unroll=unroll)
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),

@@ -55,6 +55,7 @@ from distributed_grep_tpu.ops.pallas_scan import (
     LANES_PER_BLOCK,
     SUBLANES,
     available,
+    validate_unroll,
 )
 
 def unroll_for(plan) -> int:
@@ -106,8 +107,7 @@ def bank_device_tables(bank: FdrBank) -> np.ndarray:
 def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unroll):
     from jax.experimental import pallas as pl  # deferred: import cost
 
-    if not (1 <= unroll <= 32 and 32 % unroll == 0):
-        raise ValueError(f"unroll must divide 32: {unroll}")
+    validate_unroll(unroll)
 
     ci = pl.program_id(1)
 

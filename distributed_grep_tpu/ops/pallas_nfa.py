@@ -41,6 +41,7 @@ from distributed_grep_tpu.ops.pallas_scan import (
     LANES_PER_BLOCK,
     SUBLANES,
     available,
+    validate_unroll,
 )
 
 NL = 0x0A
@@ -109,8 +110,7 @@ def build_b_tables(model: GlushkovModel) -> np.ndarray:
 def _kernel(data_ref, *refs, plan, steps, gather_b, unroll=16):
     from jax.experimental import pallas as pl  # deferred: import cost
 
-    if not (1 <= unroll <= 32 and 32 % unroll == 0):
-        raise ValueError(f"unroll must divide 32: {unroll}")
+    validate_unroll(unroll)
 
     if gather_b:
         tabs_ref, out_ref, d_ref, nl_ref = refs

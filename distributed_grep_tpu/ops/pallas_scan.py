@@ -39,6 +39,14 @@ CHUNK_BLOCK_WORDS = 16  # byte-steps per grid block = 32 * this
 MAX_TOTAL_RANGES = 48  # compare budget per byte step
 
 
+def validate_unroll(unroll: int) -> None:
+    """Kernels unroll byte steps in sub-blocks of a 32-step word; a factor
+    that does not divide 32 would silently skip the tail bytes of every
+    word (silent false negatives), so reject it at trace time."""
+    if not (1 <= unroll <= 32 and 32 % unroll == 0):
+        raise ValueError(f"unroll must divide 32: {unroll}")
+
+
 def available() -> bool:
     """True when a real TPU backend is present (tests use interpret=True).
 

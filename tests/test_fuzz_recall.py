@@ -265,8 +265,16 @@ def test_fuzz_literal_decomposition(seed):
     data = _gen_corpus(rng, "words" if seed % 2 else "binary", 48 << 10,
                        [needle] if needle else [])
     want = _oracle_lines(rx, data)
+    from distributed_grep_tpu.models.dfa import enumerate_literal_set
+
+    lits = enumerate_literal_set(pattern)
     for backend in ("device", "cpu"):
         eng = GrepEngine(pattern, backend=backend)
+        if (backend == "device" and lits is not None and len(lits) >= 2
+                and all(len(x) >= 2 for x in lits)):
+            # the decomposition route must actually engage (non-vacuous;
+            # the cpu backend renames every table mode to "native")
+            assert eng.mode in ("fdr", "dfa"), (eng.mode, pattern)
         got = set(eng.scan(data).matched_lines.tolist())
         assert got == want, (
             f"seed={seed} backend={backend} mode={eng.mode} pattern={pattern!r}"
