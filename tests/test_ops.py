@@ -525,3 +525,26 @@ def test_filtered_kernel_superset_and_engine_exact(monkeypatch):
     got = set(eng.scan(data).matched_lines.tolist())
     want = {i for i, line in enumerate(data.split(b"\n"), 1) if b"volcano" in line}
     assert got == want
+
+
+def test_scan_file_pattern_set(tmp_path):
+    """Streaming scan_file with a literal SET (AC/FDR engines) must equal
+    the whole-file scan — pattern sets are first-class on the long-context
+    path too."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(5)
+    pats = [bytes(rng.integers(97, 123, size=int(rng.integers(4, 8))).tolist())
+            for _ in range(50)]
+    data = make_text(600, inject=[(5, pats[0] + b" x " + pats[1]),
+                                  (300, pats[2] * 2),
+                                  (599, b"tail " + pats[3])])
+    p = tmp_path / "set.txt"
+    p.write_bytes(data)
+    eng = GrepEngine(None, patterns=[x.decode() for x in pats])
+    whole = eng.scan(data)
+    emitted = []
+    chunked = eng.scan_file(p, chunk_bytes=2048,
+                            emit=lambda ln, line: emitted.append(ln))
+    assert chunked.matched_lines.tolist() == whole.matched_lines.tolist()
+    assert emitted == whole.matched_lines.tolist()
