@@ -365,3 +365,65 @@ def test_coordinator_rss_flat_on_large_split(tmp_path, coordinator_port_reader):
     out = b"".join(p.read_bytes() for p in (tmp_path / "wd" / "out").glob("mr-out-*"))
     assert b"needle is here" in out
     assert hwm_kb is not None and hwm_kb < 110 * 1024, f"coordinator VmHWM {hwm_kb} kB"
+
+
+def test_http_coordinator_crash_resume(tmp_path, corpus):
+    """Coordinator crash + restart with --resume over the HTTP plane: the
+    journal replay skips committed map work and a fresh worker finishes the
+    job.  The reference loses the whole job on a coordinator crash
+    (SURVEY.md §5 checkpoint/resume); this is the distributed-mode half of
+    the in-process resume test in test_runtime.py."""
+    server1 = make_server(tmp_path, corpus)
+    addr = f"127.0.0.1:{server1.port}"
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="hello")
+
+    # one worker that dies right after its first successful map commit
+    committed = {"n": 0}
+
+    def die_after_first_commit():
+        committed["n"] += 1
+        if committed["n"] >= 2:  # first call = task 1's commit done
+            raise WorkerKilled()
+
+    def dying_worker():
+        loop = WorkerLoop(
+            HttpTransport(addr), app,
+            fault_hooks={"before_map_finished": die_after_first_commit},
+        )
+        try:
+            loop.run()
+        except WorkerKilled:
+            pass
+
+    t1 = threading.Thread(target=dying_worker)
+    t1.start()
+    t1.join(timeout=15.0)
+    status1 = server1.status()
+    assert not status1["done"]
+    n_committed = status1["map"]["completed"]
+    assert n_committed >= 1
+    # crash: tear the server down with the job incomplete (journal persists)
+    server1.shutdown(linger_s=0.0)
+
+    # restart on the same work dir with resume (the exact same config the
+    # journal was written under): replay skips committed maps
+    cfg = server1.config
+    server2 = CoordinatorServer(cfg, resume=True)
+    server2.start()
+    status2 = server2.status()
+    assert status2["map"]["completed"] == n_committed  # replayed, not re-run
+    t2 = threading.Thread(
+        target=lambda: WorkerLoop(
+            HttpTransport(f"127.0.0.1:{server2.port}"), app
+        ).run()
+    )
+    t2.start()
+    assert server2.wait_done(timeout=30.0)
+    t2.join(timeout=10.0)
+    # the resumed run assigned only the REMAINING maps (>=: a timeout
+    # sweep on a loaded CI box may legitimately re-assign one)
+    assigned = server2.scheduler.metrics.counters.get("map_assigned", 0)
+    assert len(cfg.input_files) - n_committed <= assigned < 2 * len(cfg.input_files)
+    assert server2.status()["map"]["completed"] == len(cfg.input_files)
+    assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+    server2.shutdown(linger_s=0.1)
