@@ -291,7 +291,7 @@ class _Parser:
         self.pos += 1
         return Char(self._fold(_mask_of(c)))
 
-    def _escape(self) -> int:
+    def _escape(self, in_class: bool = False) -> int:
         self.pos += 1  # consume backslash
         if self.pos >= len(self.src):
             raise RegexError("trailing backslash")
@@ -319,6 +319,31 @@ class _Parser:
                 raise RegexError("bad \\x escape")
             self.pos += 2
             return _mask_of(int(hexs, 16))
+        if ord("1") <= c <= ord("9"):
+            if in_class:
+                # inside a class, \1.. are octal escapes (re semantics):
+                # consume up to 3 octal digits
+                digs = chr(c)
+                while (len(digs) < 3 and self.pos < len(self.src)
+                       and ord("0") <= self.src[self.pos] <= ord("7")):
+                    digs += chr(self.src[self.pos])
+                    self.pos += 1
+                val = int(digs, 8)
+                if val > 0xFF:
+                    raise RegexError(f"octal escape \\{digs} out of range")
+                return _mask_of(val)
+            # \1..\9: a backreference, which no finite automaton expresses.
+            # Raising sends the engine to its host re fallback — silently
+            # treating it as a literal digit would drop matches.
+            raise RegexError(f"backreference \\{chr(c)} is not supported "
+                             "by the automaton subset")
+        if c == ord("b") and in_class:
+            return _mask_of(8)  # [\b] = backspace, like re
+        if c in (ord("b"), ord("B"), ord("A"), ord("Z"), ord("z"), ord("G")):
+            # zero-width assertions beyond ^: same story — defer to re
+            # (inside a class these are invalid in re too)
+            raise RegexError(f"\\{chr(c)} assertion is not supported "
+                             "by the automaton subset")
         return _mask_of(c)  # escaped literal (metachars, punctuation, ...)
 
     def _char_class(self) -> int:
@@ -340,7 +365,7 @@ class _Parser:
                 break
             first = False
             if c == ord("\\"):
-                m = self._escape()
+                m = self._escape(in_class=True)
             else:
                 self.pos += 1
                 m = _mask_of(c)
@@ -354,7 +379,7 @@ class _Parser:
                 self.pos += 1
                 hi_c = self._peek()
                 if hi_c == ord("\\"):
-                    hi_m = self._escape()
+                    hi_m = self._escape(in_class=True)
                 else:
                     self.pos += 1
                     hi_m = _mask_of(hi_c)

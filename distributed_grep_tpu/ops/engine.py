@@ -59,10 +59,7 @@ from distributed_grep_tpu.models.shift_and import (
     filtered_for_device,
     try_compile_shift_and,
 )
-from distributed_grep_tpu.ops import layout as layout_mod
 from distributed_grep_tpu.ops import lines as lines_mod
-from distributed_grep_tpu.ops import scan_jnp
-from distributed_grep_tpu.ops import sparse as sparse_mod
 from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("engine")
@@ -323,6 +320,7 @@ class GrepEngine:
                     res = self.scan(buf)
                     total += len(buf)
                     n_matches += res.n_matches
+                    nl_idx = None
                     if res.matched_lines.size:
                         if emit is not None:
                             nl_idx = lines_mod.newline_index(buf)
@@ -330,7 +328,12 @@ class GrepEngine:
                                 s, e = lines_mod.line_span(nl_idx, ln, len(buf))
                                 emit(lines_before + ln, buf[s:e])
                         matched.extend((res.matched_lines + lines_before).tolist())
-                    lines_before += lines_mod.count_lines(buf)
+                    if nl_idx is not None:
+                        # chunks are newline-terminated except possibly the
+                        # final one: reuse the index instead of re-counting
+                        lines_before += len(nl_idx) + (0 if buf.endswith(b"\n") else 1)
+                    else:
+                        lines_before += lines_mod.count_lines(buf)
                 if final:
                     break
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
@@ -347,11 +350,30 @@ class GrepEngine:
                 matched.append(i)
         return ScanResult(np.asarray(matched, dtype=np.int64), len(matched), len(data))
 
+    def _native_literal(self) -> bytes | None:
+        """The pattern as one exact byte string, when it is one (every
+        shift-and symbol a singleton class) — the memmem fast path."""
+        if self.shift_and is None:
+            return None
+        out = []
+        for ranges in self.shift_and.sym_ranges:
+            if len(ranges) != 1 or ranges[0][0] != ranges[0][1]:
+                return None
+            out.append(ranges[0][0])
+        return bytes(out)
+
     def _scan_native(self, data: bytes) -> ScanResult:
+        lit = self._native_literal()
         if self.approx is not None:
             # host oracle (python recurrence) — correct, not a perf path;
             # the device XLA/Pallas cores are the fast approx engines
             offsets = approx_scan_reference(self.approx, data)
+        elif lit is not None:
+            # pure literal: native memmem scan (GB/s) instead of the
+            # table-driven DFA walk (~0.3 GB/s single-thread)
+            from distributed_grep_tpu.utils import native as native_mod
+
+            offsets = native_mod.literal_scan(data, lit).astype(np.int64)
         elif self.tables:
             offsets = np.unique(np.concatenate(
                 [reference_scan(t, data) for t in self.tables]
@@ -428,6 +450,11 @@ class GrepEngine:
         boundaries: list[int] = []
         n_matches = 0
         seg = self.segment_bytes
+        # jax-importing modules stay out of the cpu/native path: a plain
+        # `--backend cpu` grep never pays the ~0.8 s jax import
+        from distributed_grep_tpu.ops import layout as layout_mod
+        from distributed_grep_tpu.ops import scan_jnp
+        from distributed_grep_tpu.ops import sparse as sparse_mod
         from distributed_grep_tpu.ops import (
             pallas_approx,
             pallas_fdr,

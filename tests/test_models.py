@@ -225,3 +225,23 @@ def test_aho_bank_single_when_capacity_allows():
 
     banks = compile_aho_corasick_banks(["he", "she"], max_states_per_bank=1 << 16)
     assert len(banks) == 1
+
+
+def test_backrefs_and_assertions_reject_to_re_fallback():
+    """\\1..\\9 and \\b-style assertions are beyond any finite automaton:
+    the parser must RAISE (routing the engine to its host re fallback),
+    never silently treat them as literal digits/letters — r'\\bword\\b'
+    used to scan for 'bwordb'."""
+    import pytest
+
+    from distributed_grep_tpu.models.dfa import RegexError, compile_dfa
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    for pat in (r"(ab)\1", r"\bword\b", r"a\Z", r"x\Bd"):
+        with pytest.raises(RegexError):
+            compile_dfa(pat)
+    eng = GrepEngine(r"\bword\b", backend="cpu")
+    assert eng.mode == "re"
+    assert eng.scan(b"a word x\nwords\nbwordb\n").matched_lines.tolist() == [1]
+    eng2 = GrepEngine(r"(ab)\1", backend="cpu")
+    assert eng2.scan(b"abab\nabcd\n").matched_lines.tolist() == [1]
