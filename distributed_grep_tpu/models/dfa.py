@@ -303,7 +303,6 @@ class _Parser:
             ord("r"): _mask_of(13),
             ord("f"): _mask_of(12),
             ord("v"): _mask_of(11),
-            ord("0"): _mask_of(0),
             ord("d"): _DIGIT,
             ord("D"): _ALL & ~_DIGIT & ~_mask_of(NL),
             ord("w"): _WORD,
@@ -319,8 +318,20 @@ class _Parser:
                 raise RegexError("bad \\x escape")
             self.pos += 2
             return _mask_of(int(hexs, 16))
+        if c == ord("0"):
+            # \0 plus up to 2 more octal digits (re semantics, both inside
+            # and outside classes): \011 is a tab, NOT NUL + "11"
+            digs = "0"
+            while (len(digs) < 3 and self.pos < len(self.src)
+                   and ord("0") <= self.src[self.pos] <= ord("7")):
+                digs += chr(self.src[self.pos])
+                self.pos += 1
+            return _mask_of(int(digs, 8))
         if ord("1") <= c <= ord("9"):
             if in_class:
+                if c > ord("7"):
+                    # re rejects [\8]/[\9] too ("bad escape")
+                    raise RegexError(f"bad escape \\{chr(c)} in class")
                 # inside a class, \1.. are octal escapes (re semantics):
                 # consume up to 3 octal digits
                 digs = chr(c)

@@ -307,3 +307,44 @@ def test_fuzz_word_line_modes(seed):
             for kv in app.map_fn("f", data)
         }
         assert got == want, f"seed={seed} app={app.__name__} pattern={pattern!r}"
+
+
+ESCAPE_ATOMS = [
+    r"\d", r"\w", r"\s", r"\D", r"\W", r"\S", r"\.", r"\*", r"\+", r"\?",
+    r"\x41", r"\x7a", r"[\b]", r"[\d]", r"[\w\s]", r"[^\d]", r"[\101]",
+    r"[\60-\71]", r"\t", r"\r", r"a", r"Z", r"0", r"-", r"_",
+    r"\011", r"\0", r"[\011]", r"[\0a]",
+]
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_escape_semantics(seed):
+    """Escape-heavy patterns vs re: every construct the parser ACCEPTS must
+    match re's byte semantics exactly (the \\b-as-literal bug class); what
+    it rejects must land on the exact re fallback."""
+    from distributed_grep_tpu.models.dfa import RegexError, compile_dfa
+
+    rng = np.random.default_rng(8000 + seed)
+    pattern = "".join(
+        ESCAPE_ATOMS[int(rng.integers(0, len(ESCAPE_ATOMS)))]
+        for _ in range(int(rng.integers(2, 6)))
+    )
+    try:
+        re.compile(pattern.encode())
+    except re.error:
+        pytest.skip("re itself rejects this combination")
+    try:
+        compile_dfa(pattern)
+    except RegexError:
+        # rejected constructs ride the re fallback — engine must agree too
+        pass
+    rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
+    data = _gen_corpus(rng, "binary", 24 << 10, [])
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == want, (
+            f"seed={seed} backend={backend} mode={eng.mode} pattern={pattern!r}: "
+            f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+        )

@@ -4,6 +4,8 @@ matches and anchored patterns across stripe boundaries (SURVEY.md §4)."""
 
 import re
 
+import os
+
 import numpy as np
 import pytest
 
@@ -548,3 +550,48 @@ def test_scan_file_pattern_set(tmp_path):
                             emit=lambda ln, line: emitted.append(ln))
     assert chunked.matched_lines.tolist() == whole.matched_lines.tolist()
     assert emitted == whole.matched_lines.tolist()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DGREP_SOAK"),
+    reason="soak: set DGREP_SOAK=1 to stream a ~1 GB corpus",
+)
+def test_soak_streaming_gigabyte(tmp_path):
+    """100 GB-readiness demonstrator at 1 GB scale: scan_file streams a
+    corpus much larger than its chunk budget with bounded RSS and exact
+    match accounting vs a memmem oracle."""
+    import resource
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    p = tmp_path / "big.bin"
+    rng = np.random.default_rng(0)
+    needle = b"soaktestneedle"
+    with open(p, "wb") as f:
+        for _ in range(16):  # 16 x 64 MB = 1 GB
+            block = rng.integers(32, 127, size=64_000_000, dtype=np.uint8)
+            block[rng.integers(0, block.size, size=block.size // 80)] = 0x0A
+            arr = block
+            for pos in rng.integers(0, arr.size - 64, size=40):
+                arr[pos : pos + len(needle)] = np.frombuffer(needle, np.uint8)
+            f.write(arr.tobytes())
+    data_oracle_count = 0
+    with open(p, "rb") as f:
+        prev_tail = b""
+        while True:
+            blk = f.read(1 << 26)
+            if not blk:
+                break
+            buf = prev_tail + blk
+            data_oracle_count += buf.count(needle)
+            prev_tail = buf[-(len(needle) - 1):]
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    eng = GrepEngine(needle.decode(), backend="cpu", segment_bytes=32 << 20)
+    res = eng.scan_file(p, chunk_bytes=32 << 20)
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # n_matches counts occurrences; the needle has no self-overlap, so the
+    # chunk-wise bytes.count above is an exact occurrence oracle
+    assert res.n_matches == data_oracle_count
+    # memory stayed bounded: well under half the corpus (chunk is 32 MB;
+    # allow slack for allocator noise and the oracle pass above)
+    assert rss_after - rss_before < 400_000  # KB
