@@ -126,6 +126,11 @@ class GrepEngine:
         self._fdr_broken = False
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
+        # Device-path observability (populated by _scan_device, empty for
+        # the re/native modes): filter candidates, host confirm seconds,
+        # scan wall seconds — the numbers behind the tuner's
+        # max(scan, confirm) overlap model.  Read with .get().
+        self.stats: dict = {}
 
         # Hyperscan-style literal decomposition: a regex that denotes a
         # finite literal set — alternations / small class products like
@@ -445,6 +450,10 @@ class GrepEngine:
 
     # --------------------------------------------------------- device engine
     def _scan_device(self, data: bytes) -> ScanResult:
+        import time as _time
+
+        t_wall0 = _time.perf_counter()
+        self.stats = {"candidates": 0, "confirm_seconds": 0.0}
         nl = lines_mod.newline_index(data)
         device_lines: set[int] = set()
         boundaries: list[int] = []
@@ -594,7 +603,10 @@ class GrepEngine:
                         # here so it overlaps the next segment's device scan.
                         # n_matches still reports pre-confirm candidates.
                         n_matches += int(offsets.size)
+                        t0 = _time.perf_counter()
                         keep = self._fdr_confirm.confirm(data, offsets + seg_start)
+                        self.stats["confirm_seconds"] += _time.perf_counter() - t0
+                        self.stats["candidates"] += int(offsets.size)
                         offsets = offsets[keep]
                 elif sparse_kind == "lane_bytes":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -730,7 +742,9 @@ class GrepEngine:
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
             self._fdr_broken = True
-            return self._scan_device(data)
+            result = self._scan_device(data)
+            self.stats["fdr_fallback"] = True  # rescan stats only
+            return result
 
         # FDR candidates were already confirmed offset-exactly in collect();
         # boundary lines (stripe/segment heads, where the filter's all-ones
@@ -738,6 +752,7 @@ class GrepEngine:
         stitched = lines_mod.stitch_lines(
             device_lines, data, nl, boundaries, self._host_line_matcher
         )
+        self.stats["scan_wall_seconds"] = _time.perf_counter() - t_wall0
         return ScanResult(
             np.asarray(sorted(stitched), dtype=np.int64), n_matches, len(data)
         )

@@ -228,8 +228,8 @@ class ConfirmSet:
 
     Native path: an L1-resident bloom bitmap rejects absent last-4-byte
     keys, survivors take a hash-table probe + full memcmp
-    (native/dgrep.cpp dgrep_confirm_*, ~4 ns/candidate at FDR candidate
-    densities) — the cost that lets the FDR tuner run a cheaper device
+    (native/dgrep.cpp dgrep_confirm_*, ~4 ns/candidate on random offsets,
+    ~8.6 ns on FDR-biased candidates) — the cost that lets the FDR tuner run a cheaper device
     filter and accept more candidates (models/fdr.py
     CONFIRM_PS_PER_CANDIDATE).  Fallback: a dict keyed the same way.
 

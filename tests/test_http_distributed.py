@@ -427,3 +427,19 @@ def test_http_coordinator_crash_resume(tmp_path, corpus):
     assert server2.status()["map"]["completed"] == len(cfg.input_files)
     assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
     server2.shutdown(linger_s=0.1)
+
+
+def test_http_worker_slots_parallel(tmp_path, corpus):
+    """--slots N: one worker process runs N task loops sharing the
+    transport (the multi-chip-per-host slot analogue); job completes with
+    oracle output."""
+    from distributed_grep_tpu.runtime.http_transport import run_http_worker
+
+    server = make_server(tmp_path, corpus)
+    addr = f"127.0.0.1:{server.port}"
+    t = threading.Thread(target=lambda: run_http_worker(addr=addr, n_parallel=3))
+    t.start()
+    assert server.wait_done(timeout=30.0)
+    t.join(timeout=15.0)
+    assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+    server.shutdown(linger_s=0.1)
