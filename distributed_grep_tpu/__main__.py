@@ -174,10 +174,22 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if not args.files:
         print("error: no input files", file=sys.stderr)
         return 2
-    missing = [f for f in args.files if not Path(f).exists()]
-    if missing:
-        print(f"error: no such file: {', '.join(missing)}", file=sys.stderr)
-        return 2
+    import os as _os
+
+    def _readable(f: str) -> bool:
+        p = Path(f)
+        return p.exists() and (p.is_dir() or _os.access(f, _os.R_OK))
+
+    good, bad = [], []
+    for f in args.files:
+        (good if _readable(f) else bad).append(f)
+    had_file_errors = bool(bad)
+    if bad:
+        if not args.no_messages:
+            print(f"error: cannot read: {', '.join(bad)}", file=sys.stderr)
+        args.files = good
+        if not args.files:
+            return 2  # nothing searchable, like grep
     if args.recursive:
         import fnmatch
 
@@ -203,10 +215,17 @@ def cmd_grep(args: argparse.Namespace) -> int:
     else:
         dirs = [f for f in args.files if Path(f).is_dir()]
         if dirs:
-            print(f"error: {', '.join(dirs)}: is a directory (use -r)",
-                  file=sys.stderr)
+            if not args.no_messages:
+                print(f"error: {', '.join(dirs)}: is a directory (use -r)",
+                      file=sys.stderr)
             return 2
 
+    if args.byte_offset and (
+        args.context is not None or args.before_context or args.after_context
+    ):
+        print("error: -b is not supported with context lines (-A/-B/-C)",
+              file=sys.stderr)
+        return 2
     if args.max_errors:
         if patterns:
             print("error: --max-errors applies to a single pattern, not -f",
@@ -280,16 +299,21 @@ def cmd_grep(args: argparse.Namespace) -> int:
         matched = {f: set(sorted(ln)[: args.max_count])
                    for f, ln in matched.items()}
     any_selected = any(matched[f] for f in cfg.input_files)
+    # grep exit conventions: -q reports selection (0) even after file
+    # errors; otherwise an error forces 2
+    rc_final = 0 if any_selected else 1
+    if had_file_errors:
+        rc_final = 2
 
     if args.quiet:
-        return 0 if any_selected else 1
+        return 0 if any_selected else rc_final
     if args.files_without_match:
         # grep -L: names of files with no selected lines, argv order;
         # exit 0 iff at least one file is listed (GNU grep -L semantics)
         listed = [f for f in cfg.input_files if not matched[f]]
         for f in listed:
             print(f)
-        exit_early = 0 if listed else 1
+        exit_early = 2 if had_file_errors else (0 if listed else 1)
         if args.metrics:
             print(json.dumps(res.metrics, indent=2, sort_keys=True),
                   file=sys.stderr)
@@ -302,39 +326,105 @@ def cmd_grep(args: argparse.Namespace) -> int:
     elif args.count:
         # grep -c: one "<file>:<count>" line per input, in argv order
         for f in cfg.input_files:
-            prefix = f"{f}:" if len(cfg.input_files) > 1 else ""
+            prefix = (f"{f}:" if len(cfg.input_files) > 1
+                      and not args.no_filename else "")
             print(f"{prefix}{len(matched[f])}")
     elif args.only_matching:
         # grep -o: each matched substring on its own line.  -v has no
         # matched substrings (grep prints nothing for -v -o).
         if not args.invert:
-            _print_only_matching(res, args, patterns, matched)
+            offsets = _line_offsets(matched) if args.byte_offset else None
+            _print_only_matching(res, args, patterns, matched, offsets)
     elif ctx_before or ctx_after:
         # the '--' group separator is global across input files, like grep
         printed_any = False
         for f in cfg.input_files:
             printed_any = _print_with_context(
-                f, matched[f], ctx_before, ctx_after, printed_any
+                f, matched[f], ctx_before, ctx_after, printed_any,
+                no_filename=args.no_filename,
             )
     else:
-        if args.max_count is None:
-            for line in res.sorted_lines():
-                print(line)
-        else:
-            # re-derive printable lines from the capped matched sets
-            from distributed_grep_tpu.runtime.job import grep_key_sort
+        from distributed_grep_tpu.runtime.job import grep_key_sort
 
-            for key, value in sorted(res.results.items(), key=grep_key_sort):
-                m = GREP_KEY_RE.match(key)
-                if m and int(m.group(2)) in matched.get(m.group(1), ()):
-                    print(f"{key} {value}")
+        offsets = _line_offsets(matched) if args.byte_offset else None
+        for key, value in sorted(res.results.items(), key=grep_key_sort):
+            m = GREP_KEY_RE.match(key)
+            if args.max_count is not None and m and \
+                    int(m.group(2)) not in matched.get(m.group(1), ()):
+                continue  # dropped by the -m cap
+            if m and (args.no_filename or offsets is not None):
+                path, ln = m.group(1), int(m.group(2))
+                head = "" if args.no_filename else f"{path} "
+                boff = (f"(byte #{offsets[path].get(ln, '?')}) "
+                        if offsets is not None else "")
+                print(f"{head}(line number #{ln}) {boff}{value}")
+            else:
+                print(f"{key} {value}")
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
-    # grep exit status: 0 = a line was selected, 1 = none were
-    return 0 if any_selected else 1
+    return rc_final
 
 
-def _print_only_matching(res, args, patterns, matched) -> None:
+def _line_offsets(matched: dict[str, set[int]]) -> dict[str, dict[int, int]]:
+    """Per file, the starting byte offset of each matched line (grep -b).
+
+    Streams each file in bounded blocks (a -b -v over a huge file must not
+    slurp it — the scan side keeps memory bounded, so this side does too):
+    per block, the native newline index gives the block's line-start
+    offsets; wanted line numbers resolve against the running line count."""
+    from distributed_grep_tpu.ops.lines import newline_index
+
+    out: dict[str, dict[int, int]] = {}
+    for path, lines in matched.items():
+        out[path] = {}
+        if not lines:
+            continue
+        want = sorted(lines)
+        wi = 0
+        line_no = 1  # number of the line starting at `base + next offset`
+        base = 0
+        with open(path, "rb") as f:
+            if want[0] == 1:
+                out[path][1] = 0
+                wi = 1
+            while wi < len(want):
+                block = f.read(1 << 24)
+                if not block:
+                    break
+                nl = newline_index(block)
+                # the line AFTER the k-th newline of this block is number
+                # line_no + k + 1 and starts at base + nl[k] + 1
+                while wi < len(want):
+                    k = want[wi] - line_no - 1
+                    if k < 0 or k >= len(nl):
+                        break
+                    out[path][want[wi]] = base + int(nl[k]) + 1
+                    wi += 1
+                line_no += len(nl)
+                base += len(block)
+    return out
+
+
+def _read_line_bytes(path: str, offset: int) -> bytes:
+    """The raw bytes of the line starting at ``offset`` (to the next
+    newline), read incrementally — grep -o -b needs byte-exact match
+    positions, which the replace-decoded display strings cannot give."""
+    chunks = []
+    with open(path, "rb") as f:
+        f.seek(offset)
+        while True:
+            block = f.read(1 << 16)
+            if not block:
+                break
+            cut = block.find(b"\n")
+            if cut >= 0:
+                chunks.append(block[:cut])
+                break
+            chunks.append(block)
+    return b"".join(chunks)
+
+
+def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
     import re
 
     from distributed_grep_tpu.runtime.job import GREP_KEY_RE, grep_key_sort
@@ -351,23 +441,43 @@ def _print_only_matching(res, args, patterns, matched) -> None:
     else:
         base = args.pattern
     # -w/-x constrain which substrings count as matches, not just which
-    # lines are selected — wrap before finditer (str-pattern variant of the
-    # apps' bytes wrapping)
-    rx = re.compile(wrap_mode(base.encode("utf-8", "surrogateescape"),
-                              mode).decode("utf-8", "surrogateescape"), flags)
+    # lines are selected — wrap before finditer.  With -b (offsets) the
+    # match runs over the RAW LINE BYTES (exact offsets on any encoding);
+    # otherwise over the display string.
+    wrapped = wrap_mode(base.encode("utf-8", "surrogateescape"), mode)
+    if offsets is not None:
+        rx_b = re.compile(wrapped, flags)
+    rx = re.compile(wrapped.decode("utf-8", "surrogateescape"), flags)
 
     for key, value in sorted(res.results.items(), key=grep_key_sort):
         m = GREP_KEY_RE.match(key)
         if m and int(m.group(2)) not in matched.get(m.group(1), ()):
             continue  # line dropped by the -m cap
-        prefix = f"{m.group(1)} (line number #{m.group(2)}) " if m else ""
+        prefix = ""
+        line_off = None
+        if m:
+            if not args.no_filename:
+                prefix = f"{m.group(1)} "
+            prefix += f"(line number #{m.group(2)}) "
+            if offsets is not None:
+                line_off = offsets.get(m.group(1), {}).get(int(m.group(2)))
+        if line_off is not None:
+            # GNU -o -b: offset of the MATCH, byte-exact — match on the
+            # raw line bytes, not the replace-decoded display string
+            raw = _read_line_bytes(m.group(1), line_off)
+            for hit in rx_b.finditer(raw):
+                if hit.group(0):
+                    print(f"{prefix}(byte #{line_off + hit.start()}) "
+                          f"{hit.group(0).decode('utf-8', 'replace')}")
+            continue
         for hit in rx.finditer(value):
             if hit.group(0):
                 print(f"{prefix}{hit.group(0)}")
 
 
 def _print_with_context(path: str, lines_set: set[int], before: int,
-                        after: int, printed_any: bool) -> bool:
+                        after: int, printed_any: bool,
+                        no_filename: bool = False) -> bool:
     """grep -A/-B/-C over one file, streaming (memory bounded by the
     context width).  Matched lines print in the usual key format; context
     lines use ')-' instead of ') ' and non-contiguous groups are separated
@@ -379,6 +489,7 @@ def _print_with_context(path: str, lines_set: set[int], before: int,
     prevq: collections.deque = collections.deque(maxlen=max(before, 0))
     pending_after = 0
     last_printed = 0
+    head = "" if no_filename else f"{path} "
     with open(path, "rb") as f:
         for n, raw in enumerate(f, 1):
             # errors="replace" matches the default output mode exactly: map
@@ -393,14 +504,14 @@ def _print_with_context(path: str, lines_set: set[int], before: int,
                     print("--")
                 for qn, qline in prevq:
                     if qn > last_printed:
-                        print(f"{path} (line number #{qn})- {qline}")
+                        print(f"{head}(line number #{qn})- {qline}")
                 prevq.clear()
-                print(f"{path} (line number #{n}) {line}")
+                print(f"{head}(line number #{n}) {line}")
                 printed_any = True
                 last_printed = n
                 pending_after = after
             elif pending_after > 0:
-                print(f"{path} (line number #{n})- {line}")
+                print(f"{head}(line number #{n})- {line}")
                 last_printed = n
                 pending_after -= 1
             elif before:
@@ -444,7 +555,12 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="distributed_grep_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("grep", help="distributed grep over input files")
+    # add_help=False frees -h for grep's no-filename flag (GNU grep -h);
+    # --help still works
+    p = sub.add_parser("grep", help="distributed grep over input files",
+                       add_help=False)
+    p.add_argument("--help", action="help",
+                   help="show this help message and exit")
     p.add_argument("pattern", nargs="?", default=None)
     p.add_argument("files", nargs="*")
     p.add_argument("-i", "--ignore-case", action="store_true")
@@ -491,6 +607,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="no output; exit 0 iff any line is selected (grep -q)")
     p.add_argument("-r", "--recursive", action="store_true",
                    help="descend into directory arguments (grep -r)")
+    p.add_argument("-b", "--byte-offset", action="store_true",
+                   help="print each line's starting byte offset (grep -b)")
+    p.add_argument("-h", "--no-filename", action="store_true",
+                   help="omit the file name prefix from output (grep -h)")
+    p.add_argument("-s", "--no-messages", action="store_true",
+                   help="suppress messages about missing/unreadable files "
+                        "(grep -s)")
     p.add_argument("--include", action="append", default=None, metavar="GLOB",
                    help="with -r: search only files whose basename matches "
                         "GLOB (repeatable)")

@@ -359,3 +359,41 @@ def test_review_fixes_round2_cli(tmp_path, capsys):
         capsys,
     )
     assert code == 1 and out == ""
+
+
+def test_byte_offset_no_filename_suppress(tmp_path, capsys):
+    t = tmp_path / "bo.txt"
+    t.write_text("one hello\nnope\nbye hello\n")
+    code, out, _ = run_cli(
+        ["grep", "-b", "hello", str(t), "--work-dir", str(tmp_path / "w")], capsys
+    )
+    assert code == 0
+    # offsets match grep -b: line 1 at 0, line 3 at 15
+    assert "(byte #0)" in out and "(byte #15)" in out
+    code, out, _ = run_cli(
+        ["grep", "-h", "hello", str(t), "--work-dir", str(tmp_path / "w2")], capsys
+    )
+    assert code == 0
+    assert str(t) not in out and "(line number #1)" in out
+    # -s: missing file message suppressed, remaining files searched,
+    # exit 2 records the error (GNU semantics)
+    code, out, err = run_cli(
+        ["grep", "-s", "hello", str(t), str(tmp_path / "missing.txt"),
+         "--work-dir", str(tmp_path / "w3")],
+        capsys,
+    )
+    assert code == 2 and "cannot read" not in err and "one hello" in out
+    # without -s the message appears, matches still print
+    code, out, err = run_cli(
+        ["grep", "hello", str(t), str(tmp_path / "missing.txt"),
+         "--work-dir", str(tmp_path / "w4")],
+        capsys,
+    )
+    assert code == 2 and "cannot read" in err and "one hello" in out
+    # -q with a match reports 0 even after file errors
+    code, out, _ = run_cli(
+        ["grep", "-q", "hello", str(t), str(tmp_path / "missing.txt"),
+         "--work-dir", str(tmp_path / "w5")],
+        capsys,
+    )
+    assert code == 0
