@@ -246,9 +246,11 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
         r2 = min(256, max(40, int(1.5e9 / max(len(data), 1))))
         r2 += r2 % 2
         r1 = max(8, r2 // 5 + (r2 // 5) % 2)
-        per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan, r1=r1, r2=r2)
+        per_pass, _ = slope_per_pass(
+            dev, chunk, pad_rows, scan, r1=r1, r2=r2, measurements=3
+        )
     else:
-        per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan)
+        per_pass, _ = slope_per_pass(dev, chunk, pad_rows, scan, measurements=3)
     return len(data) / 1e9 / per_pass, label
 
 

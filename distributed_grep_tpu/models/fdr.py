@@ -41,12 +41,12 @@ Design (v3 — round-2 final: per-check domains + cell-snapped clustering):
   (device gathers + expected confirm, overlapped), with expected candidate
   rates computed exactly from the built tables (``_fp_of_tables``).
 
-For the 10k-pattern config-5 set this lands on clustered@128 + 5×D512 =
-21 gathers/byte at fp ~1.4e-2 (measured ~10.1 GB/s/chip) — vs v2's 28
-gathers at fp 9e-3 (7.8 GB/s) — because the confirm side (native
-bloom-filtered suffix probe, utils/native.ConfirmSet) got cheap enough to
-absorb the higher candidate rate while staying hidden behind the device
-scan given the priced CONFIRM_THREADS host threads.
+For the 10k-pattern config-5 set this lands on clustered@128 + 3×D512 +
+2×D256 = 17 gathers/byte at analytic fp ~2.7e-2 (measured ~12.2
+GB/s/chip) — vs v2's 28 gathers at fp 9e-3 (7.8 GB/s) — because the
+confirm side (native bloom-filtered suffix probe, utils/native.ConfirmSet)
+got cheap enough to absorb the higher candidate rate while staying hidden
+behind the device scan given the priced CONFIRM_THREADS host threads.
 """
 
 from __future__ import annotations
@@ -65,10 +65,12 @@ CLUSTER_DOMAIN = 128  # the clustered check's domain: Σ-density 1 at 1 gather
 # one slot squares that slot's density (d -> d0*d1), which beats adding
 # banks for dense full-alphabet sets.
 HASHES = ((37, 101), (171, 59))
-# Sets whose best achievable candidate rate is still above this are not
-# worth filtering (the confirm would dominate): compile_fdr raises and the
-# engine keeps the exact DFA banks instead.
-FP_CEILING_PER_BYTE = 6e-2
+# Sets whose best achievable EXPECTED candidate rate (analytic x bias) is
+# still above this are not worth filtering: beyond ~0.1/byte the host-side
+# sparse decode + confirm legs stop hiding behind the device scan even
+# with the full thread fan, and the exact DFA banks win.  compile_fdr
+# raises and the engine keeps those instead.
+FP_CEILING_PER_BYTE = 1e-1
 
 # Total-cost model for the tuner, per scanned byte, calibrated on TPU v5e
 # (2026-07-30, probe in ops/pallas_fdr.py docstring): the 128-entry lane
@@ -94,17 +96,17 @@ CONFIRM_PS_PER_CANDIDATE = 8_600.0
 
 
 def _confirm_threads() -> int:
-    """Confirm threads the tuner prices against.  This is a DEPLOYMENT
-    assumption (default 4), not a measurement of the current host: the
-    runtime confirm fans over min(8, cpu) threads (utils/native.ConfirmSet),
-    so any >=4-core worker matches or beats the pricing.  Sub-4-core
-    workers should set DGREP_CONFIRM_THREADS (e.g. 1 on the 1-core build
-    VM), which shifts the tuner toward more device gathers / fewer
-    candidates."""
+    """Confirm threads the tuner prices against.  Defaults to 8 — the
+    runtime confirm fans candidates over min(8, cpu) threads
+    (utils/native.ConfirmSet), and every real TPU host has >=8 cores, so
+    the default prices exactly what will run in deployment.  Constrained
+    workers should set DGREP_CONFIRM_THREADS to their core count (e.g. 1
+    on the 1-core build VM), which shifts the tuner toward more device
+    gathers / fewer candidates so a weak host's confirm still keeps up."""
     try:
-        return max(1, int(os.environ.get("DGREP_CONFIRM_THREADS", "4")))
+        return max(1, int(os.environ.get("DGREP_CONFIRM_THREADS", "8")))
     except ValueError:
-        return 4
+        return 8
 
 
 CONFIRM_THREADS = _confirm_threads()
@@ -348,8 +350,11 @@ def _compile_group(
             fp = sum(b.fp_per_byte for b in banks)
             cost = sum(b.scan_cost_ps() for b in banks)
             # prefer configurations within budget; among those, min
-            # total cost; if none fits, min FP bounds the confirm
-            key = (0, total_ps(cost, fp)) if fp <= fp_budget else (1, fp, cost)
+            # total cost; if none fits, min FP bounds the confirm.  The
+            # budget bounds the EXPECTED rate (analytic x bias), the same
+            # quantity the compile_fdr ceiling gates on.
+            within = fp * EMPIRICAL_FP_BIAS <= fp_budget
+            key = (0, total_ps(cost, fp)) if within else (1, fp, cost)
             if best is None or key < best[0]:
                 best = (key, banks)
     assert best is not None

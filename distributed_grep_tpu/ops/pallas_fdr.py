@@ -32,7 +32,7 @@ Probed on TPU v5e (2026-07-30, unroll sweeps):
   9.5, 4 -> ~10.1, 8 -> 9.0-9.6, 16 -> 9.5 GB/s; at the old 28-gather
   plan unroll=8 beat 32 by ~20%.  unroll_for picks 4 for gather-heavy
   plans, 8 for small ones; the production 10k-set pick (clustered@128 +
-  5x512 = 21 gathers, models/fdr.py v3) measures ~10.1 GB/s.
+  3x512 + 2x256 = 17 gathers, models/fdr.py v3) measures ~12.2 GB/s.
 
 The V pipeline is seeded ALL-ONES at each stripe start: the first m
 positions of a stripe then over-report candidates instead of missing
