@@ -94,6 +94,11 @@ class GrepEngine:
         max_errors: int = 0,  # agrep: match within <= k edit errors
         devices: object = None,  # None = default device; "all" = every local
         # chip (segments round-robin across them); or an explicit list
+        mesh: object = None,  # jax.sharding.Mesh: each segment's lanes shard
+        # across the mesh and the SAME Pallas kernels run per device under
+        # shard_map with a psum'd candidate count (parallel/sharded_kernels)
+        mesh_axis: object = "data",
+        interpret: bool = False,  # force Pallas interpret mode (CI mesh tests)
         target_lanes: int = 1024,
         segment_bytes: int = 64 * 1024 * 1024,
         max_states: int = 4096,
@@ -105,6 +110,11 @@ class GrepEngine:
             raise ValueError("max_errors applies to a single pattern, not a set")
         self.backend = backend
         self.devices = devices
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self._interpret = interpret
+        if mesh is not None and devices is not None:
+            raise ValueError("mesh and devices are mutually exclusive")
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
         self.ignore_case = ignore_case
@@ -471,16 +481,22 @@ class GrepEngine:
             pallas_scan,
         )
 
+        # `_interpret` forces the Pallas kernels through interpret mode so
+        # the CI mesh (8 virtual CPU devices) exercises the production
+        # kernel path — the same gates a real TPU run takes.  The flag is
+        # passed to every kernel call below (None = wrapper auto-detect).
+        pallas_ok = pallas_scan.available() or self._interpret
+        interp_flag = True if self._interpret else None
         use_pallas_sa = (
             self.mode == "shift_and"
-            and pallas_scan.available()
+            and pallas_ok
             and pallas_scan.eligible(self.shift_and)
         )
         # NFA mode without a real TPU (or over budget) falls back to the XLA
         # DFA path — same tables, interpreter-free.
         use_pallas_nfa = (
             self.mode == "nfa"
-            and pallas_scan.available()
+            and pallas_ok
             and pallas_nfa.eligible(self.glushkov)
         )
         # FDR filter path: candidates on device, exact per-offset confirm on
@@ -488,11 +504,11 @@ class GrepEngine:
         # segment's device scan); without a TPU (or after a kernel failure)
         # the same engine falls back to the exact DFA banks below.
         use_fdr = (
-            self.mode == "fdr" and not self._fdr_broken and pallas_scan.available()
+            self.mode == "fdr" and not self._fdr_broken and pallas_ok
         )
         use_pallas_approx = (
             self.mode == "approx"
-            and pallas_scan.available()
+            and pallas_ok
             and pallas_approx.eligible(self.approx)
         )
         use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
@@ -519,6 +535,25 @@ class GrepEngine:
         else:
             devs = [None]
         max_inflight = 2 * len(devs)
+
+        # Mesh mode: each segment's lanes shard over the mesh and the SAME
+        # Pallas kernels run per device under shard_map (the multi-chip
+        # fast path — parallel/sharded_kernels).  The psum'd candidate
+        # count is kept per segment as the collective cross-check.
+        use_mesh = self.mesh is not None and (
+            use_pallas_sa or use_pallas_nfa or use_fdr
+        )
+        if self.mesh is not None and not use_mesh:
+            log.warning(
+                "mesh requested but mode %r has no sharded kernel "
+                "(pallas_ok=%s) — scanning on the default device",
+                self.mode, pallas_ok,
+            )
+        if use_mesh:
+            from distributed_grep_tpu.parallel import sharded_kernels as shk
+
+            mesh_mult = shk.mesh_lane_multiple(self.mesh, self.mesh_axis)
+            psum_totals: list = []
 
         # job: (sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev)
         pending: list[tuple] = []
@@ -644,11 +679,12 @@ class GrepEngine:
                 if seg_start > 0:
                     boundaries.append(seg_start)
                 if use_pallas:
+                    lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
                     lay = layout_mod.choose_layout(
                         len(seg_bytes),
-                        target_lanes=max(self.target_lanes, pallas_scan.LANES_PER_BLOCK),
+                        target_lanes=max(self.target_lanes, lane_mult),
                         min_chunk=512,
-                        lane_multiple=pallas_scan.LANES_PER_BLOCK,
+                        lane_multiple=lane_mult,
                         chunk_multiple=512,
                     )
                 else:
@@ -664,12 +700,23 @@ class GrepEngine:
                 short_offsets = None
                 with ctx:
                     if use_fdr:
-                        words = None
-                        for bank, dev_tab in zip(
-                            self.fdr.banks, self._fdr_device_tables(dev)
-                        ):
-                            w = pallas_fdr.fdr_scan_words(arr, bank, dev_tables=dev_tab)
-                            words = w if words is None else words | w
+                        if use_mesh:
+                            words, pt = shk.sharded_fdr_words(
+                                arr, self.fdr, self.mesh, self.mesh_axis,
+                                interpret=interp_flag,
+                                dev_tables=self._fdr_device_tables(None),
+                            )
+                            psum_totals.append(pt)
+                        else:
+                            words = None
+                            for bank, dev_tab in zip(
+                                self.fdr.banks, self._fdr_device_tables(dev)
+                            ):
+                                w = pallas_fdr.fdr_scan_words(
+                                    arr, bank, dev_tables=dev_tab,
+                                    interpret=interp_flag,
+                                )
+                                words = w if words is None else words | w
                         if self._fdr_short:
                             # len<2 literals: exact host scan now (native
                             # DFA, tiny sets) — keeps seg_bytes out of the job
@@ -683,16 +730,35 @@ class GrepEngine:
                             # coarse packing: a nonzero word = "a match ends
                             # in this 32-byte span" (~2x kernel throughput);
                             # the span's lines are confirmed in collect()
-                            words = pallas_scan.shift_and_scan_words(
-                                arr, sa_filtered or self.shift_and,
-                                coarse=True,
-                            )
+                            if use_mesh:
+                                words, pt = shk.sharded_shift_and_words(
+                                    arr, sa_filtered or self.shift_and,
+                                    self.mesh, self.mesh_axis,
+                                    coarse=True, interpret=interp_flag,
+                                )
+                                psum_totals.append(pt)
+                            else:
+                                words = pallas_scan.shift_and_scan_words(
+                                    arr, sa_filtered or self.shift_and,
+                                    coarse=True, interpret=interp_flag,
+                                )
                             kind = "span_words"
                         elif use_pallas_approx:
-                            words = pallas_approx.approx_scan_words(arr, self.approx)
+                            words = pallas_approx.approx_scan_words(
+                                arr, self.approx, interpret=interp_flag
+                            )
                             kind = "words"
                         else:
-                            words = pallas_nfa.nfa_scan_words(arr, self.glushkov)
+                            if use_mesh:
+                                words, pt = shk.sharded_nfa_words(
+                                    arr, self.glushkov, self.mesh,
+                                    self.mesh_axis, interpret=interp_flag,
+                                )
+                                psum_totals.append(pt)
+                            else:
+                                words = pallas_nfa.nfa_scan_words(
+                                    arr, self.glushkov, interpret=interp_flag
+                                )
                             kind = "words"
                         job = (kind, words, lay, seg_start, len(seg_bytes), None, dev)
                     elif self.mode == "shift_and":
@@ -752,6 +818,10 @@ class GrepEngine:
         stitched = lines_mod.stitch_lines(
             device_lines, data, nl, boundaries, self._host_line_matcher
         )
+        if use_mesh and psum_totals:
+            # ICI-collective candidate tally across all segments — the
+            # cross-check dryrun_multichip asserts against the host count.
+            self.stats["psum_candidates"] = sum(int(t) for t in psum_totals)
         self.stats["scan_wall_seconds"] = _time.perf_counter() - t_wall0
         return ScanResult(
             np.asarray(sorted(stitched), dtype=np.int64), n_matches, len(data)

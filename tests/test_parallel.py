@@ -207,3 +207,130 @@ def test_pattern_set_step_bank_padding():
         if any(p in line for p in (b"needle", b"volcano", b"quartz"))
     }
     assert got == expected
+
+
+# ----------------------------- production Pallas kernels under shard_map
+
+def _mesh_layout(data, mesh, axis="data"):
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    mult = sk.mesh_lane_multiple(mesh, axis)
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=mult, min_chunk=512,
+        lane_multiple=mult, chunk_multiple=512,
+    )
+    return lay, layout_mod.to_device_array(data, lay)
+
+
+def test_sharded_shift_and_bit_identical(mesh8):
+    """The shift-and Pallas kernel under shard_map must produce the exact
+    words a single-device run produces, with the psum count matching."""
+    from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+    from distributed_grep_tpu.ops import pallas_scan
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    data = make_text(500, inject=[(7, b"a needle here"), (420, b"needle!")])
+    model = try_compile_shift_and("needle")
+    lay, arr = _mesh_layout(data, mesh8)
+    words, total = sk.sharded_shift_and_words(
+        arr, model, mesh8, coarse=True, interpret=True
+    )
+    ref = pallas_scan.shift_and_scan_words(arr, model, interpret=True, coarse=True)
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+    # lanes really shard: every device holds 1/8 of the tile rows
+    shard_shapes = {s.data.shape for s in words.addressable_shards}
+    assert shard_shapes == {(lay.chunk // 32, lay.lanes // 128 // 8, 128)}
+
+
+def test_sharded_nfa_bit_identical(mesh8):
+    from distributed_grep_tpu.models.nfa import try_compile_glushkov
+    from distributed_grep_tpu.ops import pallas_nfa
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    data = make_text(400, inject=[(3, b"neeedle x"), (300, b"nedle")])
+    model = try_compile_glushkov("ne+dle")
+    assert model is not None
+    lay, arr = _mesh_layout(data, mesh8)
+    words, total = sk.sharded_nfa_words(arr, model, mesh8, interpret=True)
+    ref = pallas_nfa.nfa_scan_words(arr, model, interpret=True)
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+
+
+def test_sharded_fdr_bit_identical(mesh8):
+    from distributed_grep_tpu.models.fdr import compile_fdr
+    from distributed_grep_tpu.ops import pallas_fdr
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    rng = np.random.default_rng(17)
+    pats = [b"needle", b"zebra", b"volcano"] + [
+        bytes(rng.choice(list(b"abcdefgh"), size=6).tolist()) for _ in range(40)
+    ]
+    fdr = compile_fdr(pats)
+    data = make_text(400, inject=[(11, b"xx needle"), (200, pats[5])])
+    lay, arr = _mesh_layout(data, mesh8)
+    words, total = sk.sharded_fdr_words(arr, fdr, mesh8, interpret=True)
+    ref = None
+    for bank in fdr.banks:
+        w = pallas_fdr.fdr_scan_words(arr, bank, interpret=True)
+        ref = w if ref is None else ref | w
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+
+
+def test_engine_mesh_mode_exact(mesh8):
+    """GrepEngine(mesh=...) — the production multi-chip mode — must be exact
+    vs the line oracle for all three kernel families and record the psum'd
+    collective candidate count."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(23)
+    lines = []
+    for i in range(700):
+        n = int(rng.integers(0, 60))
+        lines.append(bytes(rng.choice(list(b"abcdefg h"), size=n).tolist()))
+        if i % 37 == 5:
+            lines[-1] = b"xx needle yy"
+        if i % 53 == 9:
+            lines[-1] = b"neeeedle and needles"
+    data = b"\n".join(lines) + b"\n"
+
+    def oracle(rx):
+        return {
+            i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+            if re.search(rx, ln)
+        }
+
+    engines = {
+        "shift_and": GrepEngine("needle", mesh=mesh8, interpret=True),
+        "nfa": GrepEngine("ne+dle", mesh=mesh8, interpret=True),
+        "fdr": GrepEngine(
+            patterns=["needle", "zebra", "volcano", "abcdef", "fedcba",
+                      "gabhcd", "hhfgab", "deadbe"],
+            mesh=mesh8, interpret=True,
+        ),
+    }
+    rxs = {"shift_and": b"needle", "nfa": b"ne+dle",
+           "fdr": b"needle|zebra|volcano|abcdef|fedcba|gabhcd|hhfgab|deadbe"}
+    for want_mode, eng in engines.items():
+        assert eng.mode == want_mode
+        res = eng.scan(data)
+        assert set(res.matched_lines.tolist()) == oracle(rxs[want_mode]), want_mode
+        assert eng.stats.get("psum_candidates", 0) >= 1, want_mode
+
+
+def test_engine_mesh_multi_segment(mesh8):
+    """Several segments through the mesh path: per-segment shard_map scans
+    with psum totals accumulated across segments."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    data = make_text(2000, inject=[(5, b"needle a"), (1990, b"z needle")])
+    eng = GrepEngine("needle", mesh=mesh8, interpret=True,
+                     segment_bytes=16 * 1024)
+    res = eng.scan(data)
+    expected = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if b"needle" in ln
+    }
+    assert set(res.matched_lines.tolist()) == expected
+    assert eng.stats.get("psum_candidates", 0) >= 2
