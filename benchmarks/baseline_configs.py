@@ -202,7 +202,8 @@ def slope_gbps(eng: GrepEngine, data: bytes) -> tuple[float, str] | None:
                  else f"pallas_shift_and_filt{n_checked}")
         dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, sa_model)
     elif use_pallas_nfa:
-        label = "pallas_nfa"
+        label = ("pallas_nfa_filt" if getattr(eng, "_nfa_filter", False)
+                 else "pallas_nfa")
         dev, chunk, pad_rows, scan = pallas_nfa_setup(data, eng.glushkov)
     elif use_pallas_fdr:
         label = f"pallas_fdr_x{len(eng.fdr.banks)}"

@@ -162,6 +162,33 @@ def _bits_to_words(bits: list[int], n_words: int) -> list[tuple[int, int]]:
     return [(w, m) for w, m in enumerate(words) if m]
 
 
+def _relax_bounded(node) -> tuple[object, bool]:
+    """Copy of the AST with every bounded repeat {m,n} (finite n > m)
+    widened to {m,} — a language SUPERSET whose Glushkov automaton spends
+    min+1 copies of the body instead of n.  The relaxed automaton is only
+    usable as a candidate FILTER: every exact match is also a relaxed
+    match at the same end offset, so candidate lines are a superset and a
+    host confirm of each candidate line restores exactness (the same
+    filter+confirm architecture the shift-and rare-class and FDR paths
+    use).  Returns (node, changed)."""
+    if isinstance(node, _dfa.Repeat):
+        inner, ch = _relax_bounded(node.node)
+        if node.max is not None and node.max > node.min:
+            return _dfa.Repeat(inner, node.min, None), True
+        return (_dfa.Repeat(inner, node.min, node.max), True) if ch else (node, False)
+    if isinstance(node, _dfa.Concat):
+        parts = [_relax_bounded(p) for p in node.parts]
+        if any(c for _, c in parts):
+            return _dfa.Concat([p for p, _ in parts]), True
+        return node, False
+    if isinstance(node, _dfa.Alt):
+        opts = [_relax_bounded(o) for o in node.options]
+        if any(c for _, c in opts):
+            return _dfa.Alt([o for o, _ in opts]), True
+        return node, False
+    return node, False
+
+
 def try_compile_glushkov(
     pattern: str, ignore_case: bool = False, max_positions: int = MAX_POSITIONS
 ) -> GlushkovModel | None:
@@ -171,6 +198,35 @@ def try_compile_glushkov(
     the supported syntax and line semantics are identical to compile_dfa;
     RegexError propagates (the caller's compile_dfa will surface it)."""
     ast = _dfa._Parser(pattern, ignore_case).parse()
+    return _compile_from_ast(ast, pattern, max_positions)
+
+
+def compile_scan_model(
+    pattern: str, ignore_case: bool = False, max_positions: int = MAX_POSITIONS
+) -> tuple[GlushkovModel | None, bool]:
+    """(model, is_filter) — the automaton the device scan should run.
+
+    Exact when that is also the cheapest; when relaxing bounded repeats
+    saves state WORDS (the kernel's per-byte cost is linear in words —
+    config 4's `{4,24}` is 33 positions = 2 words exact, 14 = 1 word
+    relaxed), or when only the relaxed form fits the position cap at all,
+    returns the filter model with is_filter=True: its match offsets are a
+    candidate superset and the engine must confirm candidate lines on
+    host (ops/engine.py `cand_words`)."""
+    ast = _dfa._Parser(pattern, ignore_case).parse()
+    exact = _compile_from_ast(ast, pattern, max_positions)
+    relaxed_ast, changed = _relax_bounded(ast)
+    if not changed:
+        return exact, False
+    filt = _compile_from_ast(relaxed_ast, pattern, max_positions)
+    if filt is None or (exact is not None and filt.n_words >= exact.n_words):
+        return exact, False
+    return filt, True
+
+
+def _compile_from_ast(
+    ast, pattern: str, max_positions: int
+) -> GlushkovModel | None:
     branches = _dfa._split_anchors(ast)
     if any(a_end for _, _, a_end in branches):
         return None  # '$' needs next-byte lookahead — DFA path handles it

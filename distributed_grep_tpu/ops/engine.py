@@ -53,7 +53,7 @@ from distributed_grep_tpu.models.approx import (
     scan_reference as approx_scan_reference,
     try_compile_approx,
 )
-from distributed_grep_tpu.models.nfa import GlushkovModel, try_compile_glushkov
+from distributed_grep_tpu.models.nfa import GlushkovModel, compile_scan_model
 from distributed_grep_tpu.models.shift_and import (
     ShiftAndModel,
     filtered_for_device,
@@ -122,6 +122,7 @@ class GrepEngine:
         self.shift_and: ShiftAndModel | None = None
         self._sa_filtered: ShiftAndModel | None = None  # rare-class device filter
         self.glushkov: GlushkovModel | None = None
+        self.glushkov_exact: GlushkovModel | None = None
         self.table: DfaTable | None = None
         # Pattern sets beyond one automaton's uint16 state space compile to
         # several independent banks (Hyperscan-style ruleset sharding); each
@@ -134,6 +135,7 @@ class GrepEngine:
         self._fdr_dev_tables: dict | None = None  # device -> reach tables
         self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
+        self._nfa_filter = False  # Glushkov model is a candidate superset
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
         # Device-path observability (populated by _scan_device, empty for
@@ -267,7 +269,25 @@ class GrepEngine:
                     # corpus defeats the byte prior (see collect()).
                     self._sa_filtered = filtered_for_device(self.shift_and)
                 else:
-                    self.glushkov = try_compile_glushkov(pattern, ignore_case=ignore_case)
+                    # compile_scan_model may return a bounded-repeat-relaxed
+                    # FILTER automaton (fewer state words — models/nfa.py);
+                    # its candidate lines then get the host confirm pass.
+                    self.glushkov, self._nfa_filter = compile_scan_model(
+                        pattern, ignore_case=ignore_case
+                    )
+                    if self._nfa_filter:
+                        # exact automaton (may be None if over the position
+                        # cap): the mid-scan fallback when a corpus defeats
+                        # the relaxed filter's selectivity
+                        from distributed_grep_tpu.models.nfa import (
+                            try_compile_glushkov,
+                        )
+
+                        self.glushkov_exact = try_compile_glushkov(
+                            pattern, ignore_case=ignore_case
+                        )
+                    else:
+                        self.glushkov_exact = self.glushkov
                     self.mode = "nfa" if self.glushkov is not None else "dfa"
             except RegexError as e:
                 # Outside the device subset (newline-consuming, state blowup,
@@ -555,8 +575,32 @@ class GrepEngine:
             mesh_mult = shk.mesh_lane_multiple(self.mesh, self.mesh_axis)
             psum_totals: list = []
 
+        # Scan-local NFA model state: the defeat guard below may swap the
+        # relaxed filter for the exact automaton mid-scan (this scan only).
+        nfa_model = self.glushkov
+        nfa_is_filter = self._nfa_filter
+
         # job: (sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev)
         pending: list[tuple] = []
+
+        def dense_native_confirm(seg_start: int, seg_len: int) -> int:
+            """Candidate-dense segment: one native DFA pass (C, ~GB/s)
+            resolves every line vectorized instead of per-line Python
+            confirm.  Returns the number of true matched lines found."""
+            from distributed_grep_tpu.utils.native import dfa_scan_mt
+
+            t = self.table
+            offs = dfa_scan_mt(
+                data[seg_start : seg_start + seg_len],
+                t.full_table(), t.accept, t.start,
+            )
+            if not offs.size:
+                return 0
+            uniq = np.unique(
+                lines_mod.line_of_offsets(offs.astype(np.int64) + seg_start, nl)
+            )
+            device_lines.update(uniq.tolist())
+            return int(uniq.size)
 
         def collect(job) -> None:
             nonlocal n_matches
@@ -588,25 +632,7 @@ class GrepEngine:
                         # see ScanResult)
                         n_matches += len(cand)
                         if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
-                            # dense pattern: per-line Python confirm would
-                            # crawl; one native DFA pass over the segment
-                            # (C, ~GB/s) resolves every line vectorized
-                            from distributed_grep_tpu.utils.native import dfa_scan_mt
-
-
-                            t = self.table
-                            offs = dfa_scan_mt(
-                                data[seg_start : seg_start + seg_len],
-                                t.full_table(), t.accept, t.start,
-                            )
-                            true_lines = 0
-                            if offs.size:
-                                seg_lines = lines_mod.line_of_offsets(
-                                    offs.astype(np.int64) + seg_start, nl
-                                )
-                                uniq = np.unique(seg_lines)
-                                true_lines = int(uniq.size)
-                                device_lines.update(uniq.tolist())
+                            true_lines = dense_native_confirm(seg_start, seg_len)
                             nonlocal sa_filtered
                             if sa_filtered is not None and true_lines * 4 < len(cand):
                                 # mostly-false candidates: the corpus defeats
@@ -627,6 +653,52 @@ class GrepEngine:
                                 start, end = lines_mod.line_span(nl, ln, len(data))
                                 if self._host_line_matcher(data[start:end]):
                                     device_lines.add(ln)
+                    return
+                if sparse_kind == "cand_words":
+                    # NFA filter path (models/nfa.compile_scan_model): the
+                    # device offsets are a candidate SUPERSET (bounded
+                    # repeats relaxed to save state words); confirm each
+                    # candidate line on host — overlapped with the next
+                    # segment's device scan.  n_matches counts candidates.
+                    idx, vals = scan_jnp.sparse_nonzero(payload)
+                    offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
+                    n_matches += int(offsets.size)
+                    self.stats["candidates"] += int(offsets.size)
+                    if offsets.size:
+                        t0 = _time.perf_counter()
+                        glines = lines_mod.line_of_offsets(offsets + seg_start, nl)
+                        cand = set(np.unique(glines).tolist()) - device_lines
+                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
+                            true_lines = dense_native_confirm(seg_start, seg_len)
+                            nonlocal nfa_model, nfa_is_filter
+                            if (
+                                nfa_is_filter
+                                and true_lines * 4 < len(cand)
+                                and self.glushkov_exact is not None
+                                and pallas_nfa.eligible(self.glushkov_exact)
+                            ):
+                                # mostly-false candidates: this corpus defeats
+                                # the relaxed filter — remaining segments of
+                                # THIS scan run the exact automaton.  (With
+                                # no eligible exact model, filter + native
+                                # rescan stays the best device plan: the XLA
+                                # DFA fallback is ~10x slower than even a
+                                # full native rescan per segment.)
+                                log.info(
+                                    "relaxed NFA filter mostly false on this "
+                                    "corpus (%d candidate lines, %d true) -> "
+                                    "exact automaton for this scan",
+                                    len(cand), true_lines,
+                                )
+                                nfa_model = self.glushkov_exact
+                                nfa_is_filter = False
+                                self.stats["nfa_filter_defeated"] = True
+                        else:
+                            for ln in cand:
+                                start, end = lines_mod.line_span(nl, ln, len(data))
+                                if self._host_line_matcher(data[start:end]):
+                                    device_lines.add(ln)
+                        self.stats["confirm_seconds"] += _time.perf_counter() - t0
                     return
                 if sparse_kind == "words":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -751,15 +823,15 @@ class GrepEngine:
                         else:
                             if use_mesh:
                                 words, pt = shk.sharded_nfa_words(
-                                    arr, self.glushkov, self.mesh,
+                                    arr, nfa_model, self.mesh,
                                     self.mesh_axis, interpret=interp_flag,
                                 )
                                 psum_totals.append(pt)
                             else:
                                 words = pallas_nfa.nfa_scan_words(
-                                    arr, self.glushkov, interpret=interp_flag
+                                    arr, nfa_model, interpret=interp_flag
                                 )
-                            kind = "words"
+                            kind = "cand_words" if nfa_is_filter else "words"
                         job = (kind, words, lay, seg_start, len(seg_bytes), None, dev)
                     elif self.mode == "shift_and":
                         packed = scan_jnp.shift_and_scan(arr, self.shift_and)

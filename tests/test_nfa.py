@@ -217,3 +217,110 @@ def test_wide_pattern_bounded_repeat():
     np.testing.assert_array_equal(
         nfa_mod.scan_reference(model, data), dfa_mod.reference_scan(table, data)
     )
+
+
+# ------------------------------- bounded-repeat filter relaxation (round 3)
+
+def test_compile_scan_model_relaxes_config4_shape():
+    """The config-4 pattern (33 positions = 2 words exact) must compile to
+    a 1-word filter; exact patterns without bounded repeats stay exact."""
+    pat = r"get /[a-z0-9/.-]{4,24}\.gif"
+    exact = nfa_mod.try_compile_glushkov(pat, ignore_case=True)
+    assert exact is not None and exact.n_words == 2
+    model, is_filter = nfa_mod.compile_scan_model(pat, ignore_case=True)
+    assert is_filter and model.n_words == 1
+
+    model2, f2 = nfa_mod.compile_scan_model("ne+dle")
+    assert not f2  # no bounded repeat: exact model
+    assert model2 is not None
+
+
+def test_compile_scan_model_keeps_exact_when_no_word_saving():
+    """{m,n} whose relaxation saves no state word keeps the exact model
+    (no pointless confirm pass)."""
+    model, is_filter = nfa_mod.compile_scan_model("a{1,3}b")
+    assert model is not None and not is_filter
+
+
+def test_filter_is_superset_of_exact():
+    """Every exact match offset must appear in the filter's offsets."""
+    pat = r"x[ab]{2,40}y"
+    exact = nfa_mod.try_compile_glushkov(pat)
+    model, is_filter = nfa_mod.compile_scan_model(pat)
+    assert is_filter
+    data = make_text(
+        300,
+        inject=[
+            (5, b"x" + b"ab" * 3 + b"y"),
+            (100, b"x" + b"a" * 60 + b"y end"),  # over the bound: filter-only
+            (200, b"xaby xy xab"),
+        ],
+    )
+    ex = set(nfa_mod.scan_reference(exact, data).tolist())
+    fi = set(nfa_mod.scan_reference(model, data).tolist())
+    assert ex <= fi
+    assert len(fi) > len(ex)  # the over-bound line is a false candidate
+
+
+def test_filter_rescues_over_cap_repeat():
+    """Bounded repeat whose exact expansion exceeds MAX_POSITIONS: exact
+    compile fails, the filter fits — NFA path instead of the DFA cliff."""
+    pat = r"q[ab]{10,200}z"
+    assert nfa_mod.try_compile_glushkov(pat) is None
+    model, is_filter = nfa_mod.compile_scan_model(pat)
+    assert is_filter and model is not None and model.n_words == 1
+
+
+def test_engine_filter_path_exact():
+    """Engine end-to-end with the filter model: false candidates must be
+    rejected by the host confirm on both the interpret-Pallas and the XLA
+    fallback paths."""
+    import re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    pat = r"get /[a-z0-9/.-]{4,24}\.gif"
+    rx = re.compile(pat.encode(), re.I)
+    data = make_text(
+        600,
+        inject=[
+            (3, b'GET /images/logo.gif HTTP/1.0'),
+            (90, b'GET /' + b'a/' * 30 + b'x.gif over-bound'),  # false cand
+            (300, b'get /ab.gif too-short'),                    # false cand
+            (450, b'GET /pix/a1-b.gif ok'),
+        ],
+    )
+    expected = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if rx.search(ln)
+    }
+    eng = GrepEngine(pat, ignore_case=True, interpret=True)
+    assert eng.mode == "nfa" and eng._nfa_filter
+    assert set(eng.scan(data).matched_lines.tolist()) == expected
+    eng2 = GrepEngine(pat, ignore_case=True)  # XLA DFA-bank fallback
+    assert set(eng2.scan(data).matched_lines.tolist()) == expected
+
+
+def test_filter_defeat_swaps_to_exact_automaton():
+    """A corpus that defeats the relaxed filter's selectivity (every line a
+    false candidate) must flip the scan to the exact automaton after the
+    first dense segment and still return the exact result."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    pat = r"x[ab]{2,40}y"
+    # >SPAN_CONFIRM_LINE_LIMIT lines, all matching the relaxed x[ab]{2,}y
+    # but not the exact pattern (runs of 60 'a's)
+    bad = b"x" + b"a" * 60 + b"y"
+    lines = [bad] * 6000 + [b"x" + b"ab" * 5 + b"y real match"]
+    data = b"\n".join(lines) + b"\n"
+    eng = GrepEngine(pat, interpret=True, segment_bytes=1 << 20)
+    assert eng.mode == "nfa" and eng._nfa_filter
+    assert eng.glushkov_exact is not None and eng.glushkov_exact.n_words == 2
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == {6001}
+    assert eng.stats.get("nfa_filter_defeated") is True
+    assert eng.stats.get("candidates", 0) > 4096
+    # a fresh scan of a friendly corpus uses the filter again (scan-local)
+    good = b"\n".join([b"no match here"] * 50 + [b"xababy hit"]) + b"\n"
+    res2 = eng.scan(good)
+    assert set(res2.matched_lines.tolist()) == {51}
+    assert "nfa_filter_defeated" not in eng.stats
