@@ -743,11 +743,6 @@ class GrepEngine:
         try:
             for i, seg_start in enumerate(range(0, max(len(data), 1), seg)):
                 seg_bytes = data[seg_start : seg_start + seg]
-                if use_fdr and self.ignore_case:
-                    # FDR hashes raw bytes; fold the haystack like the
-                    # patterns were folded (the exact DFA confirm is
-                    # case-aware either way)
-                    seg_bytes = seg_bytes.lower()
                 if seg_start > 0:
                     boundaries.append(seg_start)
                 if use_pallas:
@@ -777,6 +772,7 @@ class GrepEngine:
                                 arr, self.fdr, self.mesh, self.mesh_axis,
                                 interpret=interp_flag,
                                 dev_tables=self._fdr_device_tables(None),
+                                fold_case=self.ignore_case,
                             )
                             psum_totals.append(pt)
                         else:
@@ -784,9 +780,12 @@ class GrepEngine:
                             for bank, dev_tab in zip(
                                 self.fdr.banks, self._fdr_device_tables(dev)
                             ):
+                                # A-Z folds on device (pallas_fdr fold_case)
+                                # instead of a host .lower() pass per segment
                                 w = pallas_fdr.fdr_scan_words(
                                     arr, bank, dev_tables=dev_tab,
                                     interpret=interp_flag,
+                                    fold_case=self.ignore_case,
                                 )
                                 words = w if words is None else words | w
                         if self._fdr_short:

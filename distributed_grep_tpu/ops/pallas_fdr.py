@@ -104,7 +104,8 @@ def bank_device_tables(bank: FdrBank) -> np.ndarray:
     return np.ascontiguousarray(tiles)
 
 
-def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unroll):
+def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unroll,
+            fold_case=False):
     from jax.experimental import pallas as pl  # deferred: import cost
 
     validate_unroll(unroll)
@@ -134,6 +135,12 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unr
             prev_b, word, *V = inner
             for tt in range(unroll):
                 b = data_ref[w * 32 + s * unroll + tt].astype(jnp.int32)
+                if fold_case:
+                    # ASCII A-Z -> a-z on device (patterns are normalized
+                    # lowercase at compile, models/fdr._normalize): ~3 VPU
+                    # ops per byte instead of a host .lower() pass + copy
+                    # over every segment.  prev_b carries the folded byte.
+                    b = jnp.where((b >= 65) & (b <= 90), b + 32, b)
                 los, sels = {}, {}
                 for f in families:
                     ha, hb = HASHES[f]
@@ -190,10 +197,11 @@ def _kernel(data_ref, tabs_ref, out_ref, v_ref, prev_ref, *, m, plan, steps, unr
 
 @functools.partial(
     jax.jit,
-    static_argnames=("m", "plan", "chunk", "lane_blocks", "interpret", "unroll"),
+    static_argnames=("m", "plan", "chunk", "lane_blocks", "interpret", "unroll",
+                     "fold_case"),
 )
 def _fdr_pallas(data, tabs, *, m, plan, chunk, lane_blocks, interpret=False,
-                unroll=None):
+                unroll=None, fold_case=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -202,7 +210,8 @@ def _fdr_pallas(data, tabs, *, m, plan, chunk, lane_blocks, interpret=False,
     n_rows = sum(ns for _, _, ns in plan)
     if unroll is None:
         unroll = unroll_for(plan)
-    kernel = functools.partial(_kernel, m=m, plan=plan, steps=steps, unroll=unroll)
+    kernel = functools.partial(_kernel, m=m, plan=plan, steps=steps, unroll=unroll,
+                               fold_case=fold_case)
     return pl.pallas_call(
         kernel,
         grid=(lane_blocks, chunk_blocks),
@@ -239,6 +248,7 @@ def fdr_scan_words(
     bank: FdrBank,
     dev_tables=None,
     interpret: bool | None = None,
+    fold_case: bool = False,
 ) -> jnp.ndarray:
     """Run one bank's filter; returns time-packed candidate words as a
     DEVICE array in the shared Pallas convention ((chunk//32, S, 128)
@@ -271,6 +281,7 @@ def fdr_scan_words(
         chunk=chunk,
         lane_blocks=lane_blocks,
         interpret=interpret,
+        fold_case=fold_case,
     )
 
 

@@ -158,9 +158,11 @@ def sharded_shift_and_words(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ms", "plans", "chunk", "interpret", "mesh", "axes"),
+    static_argnames=("ms", "plans", "chunk", "interpret", "mesh", "axes",
+                     "fold_case"),
 )
-def _sharded_fdr(tiles, *tabs, ms, plans, chunk, interpret, mesh, axes):
+def _sharded_fdr(tiles, *tabs, ms, plans, chunk, interpret, mesh, axes,
+                 fold_case=False):
     def body(blk, *cs):
         words = None
         for m, plan, tab in zip(ms, plans, cs):
@@ -172,6 +174,7 @@ def _sharded_fdr(tiles, *tabs, ms, plans, chunk, interpret, mesh, axes):
                 chunk=chunk,
                 lane_blocks=blk.shape[1] // SUBLANES,
                 interpret=interpret,
+                fold_case=fold_case,
             )
             words = w if words is None else words | w
         return words
@@ -186,6 +189,7 @@ def sharded_fdr_words(
     axis="data",
     interpret: bool | None = None,
     dev_tables: list | None = None,
+    fold_case: bool = False,
 ):
     """FDR filter over the mesh: every bank's kernel runs per device on its
     lane block (tables replicated — they are KBs; the data is the big
@@ -210,6 +214,7 @@ def sharded_fdr_words(
         interpret=interpret,
         mesh=mesh,
         axes=axes,
+        fold_case=fold_case,
     )
 
 
