@@ -253,7 +253,25 @@ class GrepEngine:
                         )
                         self.mode = "fdr"
                     except FdrError as e:
-                        log.info("pattern set -> DFA banks (FDR: %s)", e)
+                        log.info("pattern set FDR-ineligible: %s", e)
+                # FDR-ineligible sets (all-short members, density over the
+                # candidate ceiling) must not silently fall onto the XLA
+                # DFA-bank device path (~0.1 GB/s — ~100x slower than the
+                # host's native MT scanner).  Route to the native scanner
+                # loudly; keep the device path only when the native lib is
+                # unavailable.
+                if self.mode == "dfa":
+                    from distributed_grep_tpu.utils.native import (
+                        native_available,
+                    )
+
+                    if native_available():
+                        log.warning(
+                            "pattern set ineligible for the FDR device "
+                            "filter -> native MT host scanner (the XLA "
+                            "DFA-bank device path would run ~100x slower)"
+                        )
+                        self.mode = "native"
         else:
             self.pattern = pattern
             try:
@@ -879,7 +897,15 @@ class GrepEngine:
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
             self._fdr_broken = True
-            result = self._scan_device(data)
+            from distributed_grep_tpu.utils.native import native_available
+
+            if native_available():
+                # same policy as the compile-time FDR rejection: the native
+                # MT scanner beats the XLA DFA-bank device path ~100x
+                self.mode = "native"
+                result = self._scan_native(data)
+            else:
+                result = self._scan_device(data)
             self.stats["fdr_fallback"] = True  # rescan stats only
             return result
 
