@@ -758,26 +758,57 @@ class GrepEngine:
                 base = int(np.searchsorted(nl, seg_start))  # lines before segment
                 device_lines.update((seg_lines + base).tolist())
 
+        # Double-buffered device feed (VERDICT r2 item 4): a one-slot
+        # prepare thread builds segment i+1's stripe layout (host pad +
+        # transpose copy) and enqueues its device upload while segment i's
+        # kernels dispatch and its results confirm — the upload rides the
+        # async transfer engine instead of serializing the dispatch loop.
+        # stats["feed_wait_seconds"] is the residual stall: ~0 when compute
+        # hides the feed, ~upload time when the scan is feed-bound.
+        from concurrent.futures import ThreadPoolExecutor
+
+        seg_starts = list(range(0, max(len(data), 1), seg))
+
+        def prepare(i: int, seg_start: int):
+            seg_bytes = data[seg_start : seg_start + seg]
+            if use_pallas:
+                lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
+                lay = layout_mod.choose_layout(
+                    len(seg_bytes),
+                    target_lanes=max(self.target_lanes, lane_mult),
+                    min_chunk=512,
+                    lane_multiple=lane_mult,
+                    chunk_multiple=512,
+                )
+            else:
+                lay = layout_mod.choose_layout(
+                    len(seg_bytes), target_lanes=self.target_lanes
+                )
+            arr = layout_mod.to_device_array(seg_bytes, lay)
+            dev = devs[i % len(devs)]
+            if not use_mesh:
+                # enqueue the host->device copy now (async on real
+                # backends); mesh mode uploads inside the sharded step
+                # (device_put with a NamedSharding straight from host)
+                pctx = jax.default_device(dev) if dev is not None else nullcontext()
+                with pctx:
+                    import jax.numpy as jnp
+
+                    arr = jnp.asarray(arr)
+            return seg_bytes, lay, arr, dev
+
+        pool = ThreadPoolExecutor(1) if len(seg_starts) > 1 else None
+        self.stats["feed_wait_seconds"] = 0.0
+        nxt = prepare(0, seg_starts[0]) if seg_starts else None
         try:
-            for i, seg_start in enumerate(range(0, max(len(data), 1), seg)):
-                seg_bytes = data[seg_start : seg_start + seg]
+            for i, seg_start in enumerate(seg_starts):
+                seg_bytes, lay, arr, dev = nxt
+                nxt_future = (
+                    pool.submit(prepare, i + 1, seg_starts[i + 1])
+                    if i + 1 < len(seg_starts) else None
+                )
                 if seg_start > 0:
                     boundaries.append(seg_start)
-                if use_pallas:
-                    lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
-                    lay = layout_mod.choose_layout(
-                        len(seg_bytes),
-                        target_lanes=max(self.target_lanes, lane_mult),
-                        min_chunk=512,
-                        lane_multiple=lane_mult,
-                        chunk_multiple=512,
-                    )
-                else:
-                    lay = layout_mod.choose_layout(
-                        len(seg_bytes), target_lanes=self.target_lanes
-                    )
-                arr = layout_mod.to_device_array(seg_bytes, lay)
-                dev = devs[i % len(devs)]
                 ctx = jax.default_device(dev) if dev is not None else nullcontext()
                 # Dispatch the device scan; the sparse fetch (a 4-byte count
                 # round-trip plus O(matches) coordinates — never the dense
@@ -879,6 +910,10 @@ class GrepEngine:
                 pending.append(job)
                 if len(pending) >= max_inflight:
                     collect(pending.pop(0))
+                if nxt_future is not None:
+                    t0 = _time.perf_counter()
+                    nxt = nxt_future.result()
+                    self.stats["feed_wait_seconds"] += _time.perf_counter() - t0
             for job in pending:
                 collect(job)
         except Exception as e:
@@ -908,6 +943,9 @@ class GrepEngine:
                 result = self._scan_device(data)
             self.stats["fdr_fallback"] = True  # rescan stats only
             return result
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
 
         # FDR candidates were already confirmed offset-exactly in collect();
         # boundary lines (stripe/segment heads, where the filter's all-ones

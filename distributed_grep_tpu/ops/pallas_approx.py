@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_grep_tpu.models.approx import MAX_ERRORS, ApproxModel
+from distributed_grep_tpu.ops import pallas_scan
 from distributed_grep_tpu.ops.pallas_scan import (
     CHUNK_BLOCK_WORDS,
     LANE_COLS,
@@ -154,13 +155,11 @@ def approx_scan_words(
     if not eligible(model):
         raise ValueError("model exceeds the pallas approx budget")
     lane_blocks = lanes // LANES_PER_BLOCK
-    data = np.ascontiguousarray(
-        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
-    )
+    data = pallas_scan.as_tiles(arr_cl, lane_blocks)
     if interpret is None:
         interpret = not available()
     return _approx_pallas(
-        jnp.asarray(data),
+        data,
         sym_ranges=tuple(tuple(r) for r in model.base.sym_ranges),
         match_bit=int(model.match_bit),
         k=model.k,

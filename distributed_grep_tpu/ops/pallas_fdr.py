@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_grep_tpu.models.fdr import HASHES, MAX_GATHERS, FdrBank
+from distributed_grep_tpu.ops import pallas_scan
 from distributed_grep_tpu.ops.pallas_scan import (
     CHUNK_BLOCK_WORDS,
     LANE_COLS,
@@ -266,15 +267,13 @@ def fdr_scan_words(
     if not eligible(bank):
         raise ValueError("bank outside the kernel's check/domain budget")
     lane_blocks = lanes // LANES_PER_BLOCK
-    data = np.ascontiguousarray(
-        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
-    )
+    data = pallas_scan.as_tiles(arr_cl, lane_blocks)
     if dev_tables is None:
         dev_tables = jnp.asarray(bank_device_tables(bank))
     if interpret is None:
         interpret = not available()
     return _fdr_pallas(
-        jnp.asarray(data),
+        data,
         dev_tables,
         m=bank.m,
         plan=kernel_plan(bank),

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_grep_tpu.models.nfa import GlushkovModel
+from distributed_grep_tpu.ops import pallas_scan
 from distributed_grep_tpu.ops.pallas_scan import (
     CHUNK_BLOCK_WORDS,
     LANE_COLS,
@@ -267,14 +268,12 @@ def nfa_scan_words(
     if not eligible(model):
         raise ValueError("pattern exceeds the pallas NFA cost budget")
     lane_blocks = lanes // LANES_PER_BLOCK
-    data = np.ascontiguousarray(
-        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
-    )
+    data = pallas_scan.as_tiles(arr_cl, lane_blocks)
     if interpret is None:
         interpret = not available()
     gather_b = use_gather_b(model)
     return _nfa_pallas(
-        jnp.asarray(data),
+        data,
         jnp.asarray(build_b_tables(model)) if gather_b else None,
         plan=model.kernel_plan(),
         chunk=chunk,

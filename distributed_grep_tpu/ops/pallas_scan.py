@@ -39,6 +39,21 @@ CHUNK_BLOCK_WORDS = 16  # byte-steps per grid block = 32 * this
 MAX_TOTAL_RANGES = 48  # compare budget per byte step
 
 
+def as_tiles(arr_cl, lane_blocks: int) -> jnp.ndarray:
+    """(chunk, lanes) -> (chunk, lane_blocks*32, 128) kernel tiles.
+
+    Accepts a host ndarray (copied contiguous, uploaded by the caller's
+    jnp.asarray) OR an already-device-resident jnp array — the engine's
+    double-buffered feed uploads segment i+1 while segment i scans, and the
+    reshape is then a free on-device bitcast (row-major contiguous)."""
+    chunk = arr_cl.shape[0]
+    if isinstance(arr_cl, jnp.ndarray):
+        return arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
+    return jnp.asarray(np.ascontiguousarray(
+        arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS)
+    ))
+
+
 def validate_unroll(unroll: int) -> None:
     """Kernels unroll byte steps in sub-blocks of a 32-step word; a factor
     that does not divide 32 would silently skip the tail bytes of every
@@ -221,11 +236,11 @@ def shift_and_scan_words(
     if not eligible(model):
         raise ValueError("pattern exceeds the pallas compare budget")
     lane_blocks = lanes // LANES_PER_BLOCK
-    data = np.ascontiguousarray(arr_cl.reshape(chunk, lane_blocks * SUBLANES, LANE_COLS))
+    data = as_tiles(arr_cl, lane_blocks)
     if interpret is None:
         interpret = not available()
     return _shift_and_pallas(
-        jnp.asarray(data),
+        data,
         sym_ranges=tuple(tuple(r) for r in model.sym_ranges),
         match_bit=int(model.match_bit),
         chunk=chunk,
