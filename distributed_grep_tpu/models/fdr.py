@@ -124,6 +124,64 @@ OVERLAP_RESIDUE = 0.2  # fraction of the smaller leg that fails to overlap
 MAX_GATHERS = 40
 
 
+@dataclass(frozen=True)
+class Pricing:
+    """The tuner's cost constants as one value, so the runtime can replace
+    the compile-time assumptions with MEASURED numbers (self-calibration,
+    VERDICT r2 item 3): the engine probes ConfirmSet at init (catching e.g.
+    the ~100x-slower Python-fallback confirm on hosts without the native
+    lib) and retunes from real engine.stats after the first scan."""
+
+    confirm_ps_per_candidate: float  # single-thread wall, ps
+    confirm_threads: int
+    fp_bias: float  # measured/analytic candidate-rate ratio
+    overlap_residue: float
+
+    def confirm_wall_ps(self, fp_per_byte: float) -> float:
+        """Expected per-byte confirm wall given an analytic fp rate."""
+        return (
+            fp_per_byte * self.fp_bias
+            * self.confirm_ps_per_candidate / self.confirm_threads
+        )
+
+    def total_ps(self, scan_ps: float, fp_per_byte: float) -> float:
+        confirm = self.confirm_wall_ps(fp_per_byte)
+        return max(scan_ps, confirm) + self.overlap_residue * min(scan_ps, confirm)
+
+
+def default_pricing() -> Pricing:
+    """Current module constants (reads globals at call time so tests can
+    monkeypatch them)."""
+    return Pricing(
+        confirm_ps_per_candidate=CONFIRM_PS_PER_CANDIDATE,
+        confirm_threads=CONFIRM_THREADS,
+        fp_bias=EMPIRICAL_FP_BIAS,
+        overlap_residue=OVERLAP_RESIDUE,
+    )
+
+
+def probe_confirm_ps(confirm_set, n: int = 1 << 15, seed: int = 0) -> float:
+    """Measured single-thread wall ps/candidate of THIS host's ConfirmSet
+    on synthetic random candidates (~ms; run once per engine init).
+
+    Random offsets under-represent the bloom-pass bias of real FDR
+    candidates (~2x, see CONFIRM_PS_PER_CANDIDATE), so callers should gate
+    retuning on a wide ratio — the probe exists to catch order-of-magnitude
+    mispricing (missing native lib, exotic hosts), and the post-scan stats
+    retune handles the fine constants."""
+    import time
+
+    rng = np.random.default_rng(seed)
+    buf = rng.integers(0, 256, size=1 << 20, dtype=np.uint8).tobytes()
+    ends = np.sort(rng.integers(8, len(buf), size=n)).astype(np.uint64)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        confirm_set.confirm(buf, ends, n_threads=1)
+        best = min(best, time.perf_counter() - t0)
+    return best / n * 1e12
+
+
 def pair_hash(b0: np.ndarray | int, b1: np.ndarray | int, domain: int, which: int = 0):
     """The kernel's pair-domain hash — shared host/device definition.
 
@@ -314,15 +372,14 @@ def _plans(m: int):
 
 
 def _compile_group(
-    group: list[bytes], m: int, fp_budget: float, max_banks: int = 4
+    group: list[bytes], m: int, fp_budget: float, max_banks: int = 4,
+    pricing: Pricing | None = None,
 ) -> list[FdrBank]:
     """Pick (fill domain, n_lookups, n_banks) for one window group by
     minimizing the total-cost model (scan + expected confirm, overlapped),
     preferring budget-satisfying configurations when any exists."""
-
-    def total_ps(cost_ps: float, fp: float) -> float:
-        confirm = fp * EMPIRICAL_FP_BIAS * CONFIRM_PS_PER_CANDIDATE / CONFIRM_THREADS
-        return max(cost_ps, confirm) + OVERLAP_RESIDUE * min(cost_ps, confirm)
+    pricing = pricing or default_pricing()
+    total_ps = pricing.total_ps
 
     best: tuple[tuple, list[FdrBank]] | None = None
     for n_banks in (1, 2, 4):
@@ -353,7 +410,7 @@ def _compile_group(
             # total cost; if none fits, min FP bounds the confirm.  The
             # budget bounds the EXPECTED rate (analytic x bias), the same
             # quantity the compile_fdr ceiling gates on.
-            within = fp * EMPIRICAL_FP_BIAS <= fp_budget
+            within = fp * pricing.fp_bias <= fp_budget
             key = (0, total_ps(cost, fp)) if within else (1, fp, cost)
             if best is None or key < best[0]:
                 best = (key, banks)
@@ -367,6 +424,7 @@ def compile_fdr(
     ignore_case: bool = False,
     fp_budget_per_byte: float = FP_CEILING_PER_BYTE,
     max_banks: int = 4,
+    pricing: Pricing | None = None,
 ) -> FdrModel:
     """Compile a literal set (every literal >= 2 bytes) into filter banks.
 
@@ -377,6 +435,7 @@ def compile_fdr(
     split against the single-bank compile by total cost.  Raises FdrError
     for sets this filter cannot host (the engine routes those to the exact
     DFA-bank path instead)."""
+    pricing = pricing or default_pricing()
     norm = _normalize(patterns, ignore_case)
     if not norm:
         raise FdrError("empty pattern set")
@@ -388,13 +447,11 @@ def compile_fdr(
 
     def group_cost(banks: list[FdrBank]) -> float:
         scan = sum(b.scan_cost_ps() for b in banks)
-        confirm = (EMPIRICAL_FP_BIAS * CONFIRM_PS_PER_CANDIDATE / CONFIRM_THREADS
-                   * sum(b.fp_per_byte for b in banks))
-        return max(scan, confirm) + OVERLAP_RESIDUE * min(scan, confirm)
+        return pricing.total_ps(scan, sum(b.fp_per_byte for b in banks))
 
     candidates: list[list[FdrBank]] = []
     single = _compile_group(
-        norm, window_of(norm) - 1, fp_budget_per_byte, max_banks
+        norm, window_of(norm) - 1, fp_budget_per_byte, max_banks, pricing
     )
     candidates.append(single)
     lengths = sorted({min(len(p), MAX_DEPTHS + 1) for p in norm})
@@ -404,19 +461,21 @@ def compile_fdr(
         if len(short) < N_BUCKETS or len(long_) < N_BUCKETS:
             continue
         candidates.append(
-            _compile_group(short, window_of(short) - 1, fp_budget_per_byte / 2, max_banks)
-            + _compile_group(long_, window_of(long_) - 1, fp_budget_per_byte / 2, max_banks)
+            _compile_group(short, window_of(short) - 1, fp_budget_per_byte / 2,
+                           max_banks, pricing)
+            + _compile_group(long_, window_of(long_) - 1, fp_budget_per_byte / 2,
+                             max_banks, pricing)
         )
     banks = min(candidates, key=group_cost)
     model = FdrModel(banks=banks, ignore_case=ignore_case, n_patterns=len(norm))
     # gate on the EXPECTED REAL rate (analytic x measured bias), like the
     # cost model — an analytic-only gate would admit sets whose true
     # candidate rate is in the confirm-dominates regime
-    if model.fp_per_byte * EMPIRICAL_FP_BIAS > FP_CEILING_PER_BYTE:
+    if model.fp_per_byte * pricing.fp_bias > FP_CEILING_PER_BYTE:
         raise FdrError(
             f"set too dense to filter: expected candidate rate "
-            f"{model.fp_per_byte * EMPIRICAL_FP_BIAS:.3g}/byte "
-            f"(analytic x{EMPIRICAL_FP_BIAS:g} bias) > {FP_CEILING_PER_BYTE:g}"
+            f"{model.fp_per_byte * pricing.fp_bias:.3g}/byte "
+            f"(analytic x{pricing.fp_bias:g} bias) > {FP_CEILING_PER_BYTE:g}"
         )
     return model
 

@@ -368,3 +368,93 @@ def test_all_short_pattern_set_routes_to_native():
     assert eng.mode == "native"
     got = set(eng.scan(b"xyz\nqab\nccc\nBa\n").matched_lines.tolist())
     assert got == {2, 4}
+
+
+# ------------------------------------ tuner self-calibration (round 3)
+
+def test_probe_recovers_from_poisoned_confirm_constant(monkeypatch):
+    """Inject an absurd priced confirm cost ('confirm is free'); the init
+    probe must measure the real cost and retune the plan back toward the
+    honestly-priced one (VERDICT r2 item 3 done-criterion)."""
+    import distributed_grep_tpu.models.fdr as fdr_mod
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(5)
+    alphabet = list(b"abcdefghijklmnopqrstuvwxyz0123456789")
+    pats = sorted({
+        bytes(rng.choice(alphabet, size=int(rng.integers(5, 9))).tolist())
+        for _ in range(3000)
+    })
+    spats = [p.decode() for p in pats]
+
+    monkeypatch.setattr(fdr_mod, "CONFIRM_PS_PER_CANDIDATE", 1.0)
+    monkeypatch.setenv("DGREP_NO_CALIBRATE", "1")
+    eng_bad = GrepEngine(patterns=spats)
+    g_bad = sum(b.total_gathers for b in eng_bad.fdr.banks)
+
+    monkeypatch.delenv("DGREP_NO_CALIBRATE")
+    # pin the probe's measurement (real timing is load-dependent; the
+    # wiring probe->mismatch->retune is what's under test)
+    import distributed_grep_tpu.ops.engine as engine_mod  # noqa: F401
+    monkeypatch.setattr(fdr_mod, "probe_confirm_ps", lambda cs, **kw: 8600.0)
+    eng_fix = GrepEngine(patterns=spats)
+    g_fix = sum(b.total_gathers for b in eng_fix.fdr.banks)
+    assert eng_fix.calibration["confirm_probe_ps"] == 8600.0
+    # probe-calibrated plan buys more device gathers than the 'free
+    # confirm' plan, converging toward the honest plan
+    assert g_fix > g_bad
+    # and it equals a plan compiled directly under the measured pricing
+    from dataclasses import replace
+
+    pricing = replace(
+        fdr_mod.default_pricing(), confirm_ps_per_candidate=8600.0
+    )
+    direct = fdr_mod.compile_fdr(spats, pricing=pricing)
+    assert [(b.m, b.checks) for b in eng_fix.fdr.banks] == \
+        [(b.m, b.checks) for b in direct.banks]
+
+
+def test_post_scan_retune_from_measured_stats():
+    """Stage-2 retune: measured candidate rate and confirm wall far off the
+    priced constants must swap in a plan compiled under measured pricing."""
+    import os
+
+    import distributed_grep_tpu.models.fdr as fdr_mod
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(6)
+    alphabet = list(b"abcdefghijklmnopqrstuvwxyz0123456789")
+    pats = sorted({
+        bytes(rng.choice(alphabet, size=int(rng.integers(5, 9))).tolist())
+        for _ in range(3000)
+    })
+    eng = GrepEngine(patterns=[p.decode() for p in pats])
+    g0 = sum(b.total_gathers for b in eng.fdr.banks)
+    # manufacture evidence: candidates 20x the plan's expectation and a
+    # very slow confirm -> the retune must buy more gathers
+    n_bytes = 64 * 1024 * 1024
+    fake_cands = int(eng.fdr.fp_per_byte * 20 * n_bytes)
+    actual_threads = min(8, os.cpu_count() or 1)
+    eng.stats = {
+        "candidates": fake_cands,
+        # 400 ns wall per candidate through the actual fan
+        "confirm_seconds": fake_cands * 400e-9,
+    }
+    eng._maybe_retune_fdr(n_bytes)
+    assert eng._fdr_retuned
+    g1 = sum(b.total_gathers for b in eng.fdr.banks)
+    assert g1 > g0  # slow+dense confirm evidence -> more filtering on device
+    assert eng.calibration["measured_fp_bias"] == pytest.approx(20.0, rel=0.01)
+
+    # within-tolerance evidence must NOT retune (runs-once flag aside)
+    eng2 = GrepEngine(patterns=[p.decode() for p in pats])
+    plan2 = [(b.m, b.checks) for b in eng2.fdr.banks]
+    pr = eng2._fdr_pricing
+    cands2 = int(eng2.fdr.fp_per_byte * pr.fp_bias * n_bytes)
+    eng2.stats = {
+        "candidates": cands2,
+        "confirm_seconds": cands2 * (pr.confirm_ps_per_candidate / 1e12)
+        / actual_threads * actual_threads,
+    }
+    eng2._maybe_retune_fdr(n_bytes)
+    assert [(b.m, b.checks) for b in eng2.fdr.banks] == plan2
