@@ -288,17 +288,37 @@ def cmd_grep(args: argparse.Namespace) -> int:
     res = run_job(cfg, n_workers=args.workers)
     # Parse matched (file, line number) pairs from the result KEYS (the
     # shared end-anchored grep-key shape — a value may itself contain
-    # " (line number #"), not the joined lines.
-    matched: dict[str, set[int]] = {f: set() for f in cfg.input_files}
-    for key in res.results:
-        m = GREP_KEY_RE.match(key)
-        if m and m.group(1) in matched:
-            matched[m.group(1)].add(int(m.group(2)))
-    if args.max_count is not None:
-        # grep -m: keep only the first NUM selected lines per file
-        matched = {f: set(sorted(ln)[: args.max_count])
-                   for f, ln in matched.items()}
-    any_selected = any(matched[f] for f in cfg.input_files)
+    # " (line number #"), not the joined lines.  Only the modes that
+    # re-read the input files (-o, context, -b) need full per-file line
+    # SETS; the default/-c/-l/-L/-q modes stream the job output with
+    # per-file counters so a match-dense job keeps flat RSS (the reduce
+    # side already spills to disk; collation must not un-do that).
+    need_sets = bool(
+        args.only_matching or ctx_before or ctx_after or args.byte_offset
+    )
+    matched: dict[str, set[int]] | None = None
+    counts: dict[str, int] = {f: 0 for f in cfg.input_files}
+    if need_sets:
+        matched = {f: set() for f in cfg.input_files}
+        for key, _v in res.iter_results():
+            m = GREP_KEY_RE.match(key)
+            if m and m.group(1) in matched:
+                matched[m.group(1)].add(int(m.group(2)))
+        if args.max_count is not None:
+            # grep -m: keep only the first NUM selected lines per file
+            matched = {f: set(sorted(ln)[: args.max_count])
+                       for f, ln in matched.items()}
+        counts = {f: len(matched[f]) for f in cfg.input_files}
+    else:
+        for key, _v in res.iter_results():
+            m = GREP_KEY_RE.match(key)
+            if m and m.group(1) in counts:
+                counts[m.group(1)] += 1
+                if args.quiet:
+                    break  # -q: one selected line settles the answer
+        if args.max_count is not None:
+            counts = {f: min(c, args.max_count) for f, c in counts.items()}
+    any_selected = any(counts[f] for f in cfg.input_files)
     # grep exit conventions: -q reports selection (0) even after file
     # errors; otherwise an error forces 2
     rc_final = 0 if any_selected else 1
@@ -310,7 +330,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if args.files_without_match:
         # grep -L: names of files with no selected lines, argv order;
         # exit 0 iff at least one file is listed (GNU grep -L semantics)
-        listed = [f for f in cfg.input_files if not matched[f]]
+        listed = [f for f in cfg.input_files if not counts[f]]
         for f in listed:
             print(f)
         exit_early = 2 if had_file_errors else (0 if listed else 1)
@@ -321,14 +341,14 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if args.files_with_matches:
         # grep -l: names only, argv order, each file once
         for f in cfg.input_files:
-            if matched[f]:
+            if counts[f]:
                 print(f)
     elif args.count:
         # grep -c: one "<file>:<count>" line per input, in argv order
         for f in cfg.input_files:
             prefix = (f"{f}:" if len(cfg.input_files) > 1
                       and not args.no_filename else "")
-            print(f"{prefix}{len(matched[f])}")
+            print(f"{prefix}{counts[f]}")
     elif args.only_matching:
         # grep -o: each matched substring on its own line.  -v has no
         # matched substrings (grep prints nothing for -v -o).
@@ -344,14 +364,17 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 no_filename=args.no_filename,
             )
     else:
-        from distributed_grep_tpu.runtime.job import grep_key_sort
-
+        # default print: stream in (file, line) order with bounded memory
+        # (external re-sort — runtime/job.iter_results_sorted); -m caps
+        # per file as lines stream past
         offsets = _line_offsets(matched) if args.byte_offset else None
-        for key, value in sorted(res.results.items(), key=grep_key_sort):
+        emitted: dict[str, int] = {f: 0 for f in cfg.input_files}
+        for key, value in res.iter_results_sorted():
             m = GREP_KEY_RE.match(key)
-            if args.max_count is not None and m and \
-                    int(m.group(2)) not in matched.get(m.group(1), ()):
-                continue  # dropped by the -m cap
+            if args.max_count is not None and m and m.group(1) in emitted:
+                if emitted[m.group(1)] >= args.max_count:
+                    continue  # dropped by the -m cap
+                emitted[m.group(1)] += 1
             if m and (args.no_filename or offsets is not None):
                 path, ln = m.group(1), int(m.group(2))
                 head = "" if args.no_filename else f"{path} "
@@ -427,7 +450,7 @@ def _read_line_bytes(path: str, offset: int) -> bytes:
 def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
     import re
 
-    from distributed_grep_tpu.runtime.job import GREP_KEY_RE, grep_key_sort
+    from distributed_grep_tpu.runtime.job import GREP_KEY_RE
 
     from distributed_grep_tpu.apps.grep import wrap_mode
 
@@ -449,7 +472,7 @@ def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
         rx_b = re.compile(wrapped, flags)
     rx = re.compile(wrapped.decode("utf-8", "surrogateescape"), flags)
 
-    for key, value in sorted(res.results.items(), key=grep_key_sort):
+    for key, value in res.iter_results_sorted():
         m = GREP_KEY_RE.match(key)
         if m and int(m.group(2)) not in matched.get(m.group(1), ()):
             continue  # line dropped by the -m cap

@@ -19,11 +19,13 @@ def test_fresh_job_clears_stale_outputs(tmp_path, corpus):
     files = [str(p) for p in corpus.values()]
     cfg1 = JobConfig(input_files=files, app_options={"pattern": "hello"}, n_reduce=8, work_dir=wd)
     res1 = run_job(cfg1, n_workers=2)
+    assert res1.results  # job 1 did find matches (results live in the
+    # workdir's mr-out files — read before reusing the workdir, like the
+    # reference's on-disk outputs)
     cfg2 = JobConfig(input_files=files, app_options={"pattern": "zzz_nomatch"}, n_reduce=2, work_dir=wd)
     res2 = run_job(cfg2, n_workers=2)
     assert res2.results == {}  # nothing matches; stale job-1 outputs must be gone
     assert len(res2.output_files) == 2
-    assert res1.results  # job 1 did find matches
 
 
 def test_journal_replay_rejects_changed_file(tmp_path):
