@@ -73,10 +73,13 @@ SPAN_CONFIRM_LINE_LIMIT = 4096
 @dataclass
 class ScanResult:
     matched_lines: np.ndarray  # sorted 1-based line numbers (always exact)
-    # device candidate count — end offsets on the exact paths, pre-confirm
-    # candidates in FDR mode, candidate LINES on the coarse shift-and span
-    # path (span granularity hides exact end-offset counts).  A telemetry
-    # figure, not a match count; matched_lines is the exact result.
+    # EXACT matched-line count — always equals matched_lines.size, on every
+    # mode/backend (unified in round 3: it used to mean end offsets on
+    # exact paths, pre-confirm candidates on the filter paths, making
+    # cross-mode numbers non-comparable).  Kept as a field so scan_file can
+    # sum it across chunks.  Telemetry lives in engine.stats instead:
+    # "candidates" (pre-confirm filter hits), "end_offsets" (exact match
+    # end offsets where a path computes them).
     n_matches: int
     bytes_scanned: int
 
@@ -477,6 +480,7 @@ class GrepEngine:
         matched: list[int] = []
         n_matches = 0
         total = 0
+        end_offsets = 0  # summed across chunks (per-chunk stats reset)
         lines_before = 0
         carry = b""
         with open(path, "rb") as f:
@@ -496,6 +500,7 @@ class GrepEngine:
                     res = self.scan(buf)
                     total += len(buf)
                     n_matches += res.n_matches
+                    end_offsets += self.stats.get("end_offsets", 0)
                     nl_idx = None
                     if res.matched_lines.size:
                         if emit is not None:
@@ -512,6 +517,7 @@ class GrepEngine:
                         lines_before += lines_mod.count_lines(buf)
                 if final:
                     break
+        self.stats["end_offsets"] = end_offsets
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
 
     # ---------------------------------------------------------- host engines
@@ -559,7 +565,8 @@ class GrepEngine:
         nl = lines_mod.newline_index(data)
         lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
             np.zeros(0, dtype=np.int64)
-        return ScanResult(lns.astype(np.int64), int(offsets.size), len(data))
+        self.stats = {"end_offsets": int(offsets.size)}
+        return ScanResult(lns.astype(np.int64), int(lns.size), len(data))
 
     def _host_line_matcher(self, line: bytes) -> bool:
         if self.approx is not None:
@@ -624,11 +631,10 @@ class GrepEngine:
         import time as _time
 
         t_wall0 = _time.perf_counter()
-        self.stats = {"candidates": 0, "confirm_seconds": 0.0}
+        self.stats = {"candidates": 0, "confirm_seconds": 0.0, "end_offsets": 0}
         nl = lines_mod.newline_index(data)
         device_lines: set[int] = set()
         boundaries: list[int] = []
-        n_matches = 0
         seg = self.segment_bytes
         # jax-importing modules stay out of the cpu/native path: a plain
         # `--backend cpu` grep never pays the ~0.8 s jax import
@@ -744,7 +750,6 @@ class GrepEngine:
             return int(uniq.size)
 
         def collect(job) -> None:
-            nonlocal n_matches
             sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev = job
             # Fetch under the job's device context so the decode runs where
             # the plane lives instead of copying it to the default device.
@@ -768,10 +773,7 @@ class GrepEngine:
                         for a, b in zip(l0.tolist(), l1.tolist()):
                             cand.update(range(a, b + 1))
                         cand -= device_lines  # already confirmed earlier
-                        # n_matches on this path counts candidate lines
-                        # (span granularity hides exact end-offset counts;
-                        # see ScanResult)
-                        n_matches += len(cand)
+                        self.stats["candidates"] += len(cand)
                         if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
                             true_lines = dense_native_confirm(seg_start, seg_len)
                             nonlocal sa_filtered
@@ -800,10 +802,9 @@ class GrepEngine:
                     # device offsets are a candidate SUPERSET (bounded
                     # repeats relaxed to save state words); confirm each
                     # candidate line on host — overlapped with the next
-                    # segment's device scan.  n_matches counts candidates.
+                    # segment's device scan.
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-                    n_matches += int(offsets.size)
                     self.stats["candidates"] += int(offsets.size)
                     if offsets.size:
                         t0 = _time.perf_counter()
@@ -849,8 +850,6 @@ class GrepEngine:
                         # against the WHOLE document, so a window reaching
                         # back across the segment start still confirms; runs
                         # here so it overlaps the next segment's device scan.
-                        # n_matches still reports pre-confirm candidates.
-                        n_matches += int(offsets.size)
                         t0 = _time.perf_counter()
                         keep = self._fdr_confirm.confirm(data, offsets + seg_start)
                         self.stats["confirm_seconds"] += _time.perf_counter() - t0
@@ -870,9 +869,7 @@ class GrepEngine:
                         np.zeros(0, dtype=np.int64)
             if short_offsets is not None:
                 offsets = np.union1d(offsets, short_offsets)
-                n_matches += int(short_offsets.size)
-            if not use_fdr:  # FDR counted its pre-confirm candidates above
-                n_matches += int(offsets.size)
+            self.stats["end_offsets"] += int(offsets.size)
             if offsets.size:
                 # transient slice: jobs hold (start, len), not segment copies
                 seg_view = data[seg_start : seg_start + seg_len]
@@ -1082,9 +1079,8 @@ class GrepEngine:
             self.stats["psum_candidates"] = sum(int(t) for t in psum_totals)
         self.stats["scan_wall_seconds"] = _time.perf_counter() - t_wall0
         self._maybe_retune_fdr(len(data))
-        return ScanResult(
-            np.asarray(sorted(stitched), dtype=np.int64), n_matches, len(data)
-        )
+        lines_arr = np.asarray(sorted(stitched), dtype=np.int64)
+        return ScanResult(lines_arr, int(lines_arr.size), len(data))
 
 def make_engine(
     pattern: str | None = None, patterns: list[str] | None = None, **kw

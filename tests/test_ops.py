@@ -589,9 +589,33 @@ def test_soak_streaming_gigabyte(tmp_path):
     eng = GrepEngine(needle.decode(), backend="cpu", segment_bytes=32 << 20)
     res = eng.scan_file(p, chunk_bytes=32 << 20)
     rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-    # n_matches counts occurrences; the needle has no self-overlap, so the
-    # chunk-wise bytes.count above is an exact occurrence oracle
-    assert res.n_matches == data_oracle_count
+    # stats[end_offsets] counts occurrences (n_matches is the exact
+    # matched-LINE count since round 3); the needle has no self-overlap,
+    # so the chunk-wise bytes.count above is an exact occurrence oracle
+    assert eng.stats["end_offsets"] == data_oracle_count
+    assert res.n_matches == res.matched_lines.size
     # memory stayed bounded: well under half the corpus (chunk is 32 MB;
     # allow slack for allocator noise and the oracle pass above)
     assert rss_after - rss_before < 400_000  # KB
+
+
+def test_n_matches_equals_matched_lines_across_modes():
+    """Round-3 invariant: n_matches is the exact matched-line count on
+    EVERY mode/backend — cross-mode numbers are comparable (VERDICT r2
+    item 9)."""
+    data = make_text(900, inject=[(3, b"a needle b needle"),  # 2 hits, 1 line
+                                  (400, b"needle"), (871, b"xx needle")])
+    expected = sum(1 for l in data.split(b"\n") if b"needle" in l)
+    engines = {
+        "shift_and": GrepEngine("needle", segment_bytes=8192, target_lanes=16),
+        "shift_and_pallas": GrepEngine("needle", interpret=True),
+        "native": GrepEngine("needle", backend="cpu"),
+        "fdr": GrepEngine(patterns=["needle", "zebraqq"], interpret=True),
+        "dfa_set": GrepEngine(patterns=["needle", "zebraqq"]),
+    }
+    for name, eng in engines.items():
+        res = eng.scan(data)
+        assert res.n_matches == res.matched_lines.size, name
+        assert res.n_matches == expected, name
+    # occurrence telemetry still available where computed exactly
+    assert engines["native"].stats["end_offsets"] == data.count(b"needle")
