@@ -328,12 +328,14 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if args.quiet:
         return 0 if any_selected else rc_final
     if args.files_without_match:
-        # grep -L: names of files with no selected lines, argv order;
-        # exit 0 iff at least one file is listed (GNU grep -L semantics)
+        # grep -L: names of files with no selected lines, argv order.
+        # Exit code follows MATCH presence (0 iff any line selected
+        # anywhere), not listing presence — differentially verified
+        # against GNU grep 3.8 (tests/test_fuzz_cli.py)
         listed = [f for f in cfg.input_files if not counts[f]]
         for f in listed:
             print(f)
-        exit_early = 2 if had_file_errors else (0 if listed else 1)
+        exit_early = 2 if had_file_errors else (0 if any_selected else 1)
         if args.metrics:
             print(json.dumps(res.metrics, indent=2, sort_keys=True),
                   file=sys.stderr)

@@ -348,17 +348,19 @@ def test_review_fixes_round2_cli(tmp_path, capsys):
         capsys,
     )
     assert code == 0 and len(out.splitlines()) == 1
-    # -L exit status: 0 when a file is listed, 1 when none are
+    # -L exit status follows MATCH presence (GNU grep 3.8, verified
+    # differentially in test_fuzz_cli.py): file listed, nothing matched
+    # anywhere -> exit 1
     code, out, _ = run_cli(
         ["grep", "-L", "nothinghere", str(t), "--work-dir", str(tmp_path / "w5")],
         capsys,
     )
-    assert code == 0 and out.strip() == str(t)
+    assert code == 1 and out.strip() == str(t)
     code, out, _ = run_cli(
         ["grep", "-L", "cat", str(t), "--work-dir", str(tmp_path / "w6")],
         capsys,
     )
-    assert code == 1 and out == ""
+    assert code == 0 and out == ""  # matches exist -> 0, nothing listed
 
 
 def test_byte_offset_no_filename_suppress(tmp_path, capsys):

@@ -1,0 +1,182 @@
+"""CLI-level differential fuzz vs the system GNU grep binary.
+
+The engine-level fuzz (test_fuzz_recall.py) pins line SELECTION against a
+re oracle; this suite pins the whole CLI surface — flag parsing, per-file
+prefixes, -m capping, -o match extraction, -b byte offsets, exit codes —
+against real GNU grep over random corpora and flag combos.  Every failure
+reproduces from the printed seed.
+
+Output normalization: our format is `<path> (line number #N) [(byte #K)]
+<text>`; GNU's is `path:N[:K]:text` (with -n/-b).  Both sides parse into
+tuples before comparison.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.__main__ import main
+
+GNU_GREP = shutil.which("grep")
+pytestmark = pytest.mark.skipif(GNU_GREP is None, reason="no system grep")
+
+WORDS = ["the", "fox", "Fox", "hello", "foo", "foobar", "barfoo", "x", "dog",
+         "a.b", "end", "foofoo"]
+
+OUR_LINE = re.compile(r"^(?P<path>.*) \(line number #(?P<ln>\d+)\)"
+                      r"( \(byte #(?P<boff>\d+)\))? (?P<text>.*)$")
+
+
+def _make_files(rng, tmp_path, n_files=2):
+    paths = []
+    for fi in range(n_files):
+        lines = []
+        for _ in range(int(rng.integers(30, 120))):
+            k = int(rng.integers(0, 8))
+            lines.append(" ".join(
+                WORDS[int(i)] for i in rng.integers(0, len(WORDS), k)
+            ))
+        p = tmp_path / f"f{fi}.txt"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def _run_ours(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr().out
+    return rc, [l for l in out.split("\n") if l]
+
+
+def _run_gnu(argv):
+    p = subprocess.run([GNU_GREP, *argv], capture_output=True, text=True,
+                       env={"LC_ALL": "C"})
+    return p.returncode, [l for l in p.stdout.split("\n") if l]
+
+
+def _parse_ours(lines, with_boff=False):
+    out = []
+    for l in lines:
+        m = OUR_LINE.match(l)
+        assert m, f"unparseable CLI line: {l!r}"
+        rec = [m.group("path"), int(m.group("ln")), m.group("text")]
+        if with_boff:
+            rec.insert(2, int(m.group("boff")))
+        out.append(tuple(rec))
+    return out
+
+
+def _parse_gnu(lines, paths, n_fields):
+    """Split GNU `path:field:...:text` lines.  Path may contain ':' so
+    match against the known path list first."""
+    out = []
+    for l in lines:
+        for p in paths:
+            if l.startswith(p + ":"):
+                rest = l[len(p) + 1:]
+                break
+        else:
+            raise AssertionError(f"no known path prefix: {l!r}")
+        parts = rest.split(":", n_fields)
+        out.append((p, *[int(x) for x in parts[:-1]], parts[-1]))
+    return out
+
+
+FLAG_SETS = [
+    ([], []),
+    (["-v"], ["-v"]),
+    (["-w"], ["-w"]),
+    (["-x"], ["-x"]),
+    (["-i"], ["-i"]),
+    (["-i", "-v"], ["-i", "-v"]),
+    (["-m", "2"], ["-m", "2"]),
+]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_cli_selection_flags(seed, tmp_path, capsys):
+    """Default-print selection across flag combos: (path, line, text)
+    streams must match GNU grep -n exactly, including order."""
+    rng = np.random.default_rng(11000 + seed)
+    paths = _make_files(rng, tmp_path)
+    pattern = WORDS[int(rng.integers(0, len(WORDS)))]
+    ours_f, gnu_f = FLAG_SETS[seed % len(FLAG_SETS)]
+    rc, out = _run_ours(["grep", pattern, *paths, *ours_f], capsys)
+    grc, gout = _run_gnu(["-n", *gnu_f, pattern, *paths])
+    got = _parse_ours(out)
+    want = _parse_gnu(gout, paths, 2)
+    assert got == want, (
+        f"seed={seed} flags={ours_f} pattern={pattern!r}: "
+        f"ours={got[:3]} gnu={want[:3]}"
+    )
+    assert rc == grc, f"seed={seed} flags={ours_f}: rc {rc} vs {grc}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_cli_count_and_list(seed, tmp_path, capsys):
+    rng = np.random.default_rng(12000 + seed)
+    paths = _make_files(rng, tmp_path, n_files=3)
+    pattern = WORDS[int(rng.integers(0, len(WORDS)))]
+    rc, out = _run_ours(["grep", pattern, *paths, "-c"], capsys)
+    grc, gout = _run_gnu(["-c", pattern, *paths])
+    assert out == gout, f"seed={seed} -c: {out} vs {gout}"
+    assert rc == grc
+    for flag in ("-l", "-L"):
+        rc, out = _run_ours(["grep", pattern, *paths, flag], capsys)
+        grc, gout = _run_gnu([flag, pattern, *paths])
+        assert out == gout, f"seed={seed} {flag}: {out} vs {gout}"
+        assert rc == grc, f"seed={seed} {flag}: rc {rc} vs {grc}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_cli_only_matching(seed, tmp_path, capsys):
+    """-o: per-match extraction (multiset + order per line) vs grep -on."""
+    rng = np.random.default_rng(13000 + seed)
+    paths = _make_files(rng, tmp_path)
+    pattern = ["foo", "fox", "o", "foofoo"][seed % 4]
+    rc, out = _run_ours(["grep", pattern, *paths, "-o"], capsys)
+    grc, gout = _run_gnu(["-o", "-n", pattern, *paths])
+    got = _parse_ours(out)
+    want = _parse_gnu(gout, paths, 2)
+    assert got == want, f"seed={seed} -o pattern={pattern!r}"
+    assert rc == grc
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_cli_byte_offsets(seed, tmp_path, capsys):
+    """-b (line-start offsets) and -o -b (match offsets) vs GNU."""
+    rng = np.random.default_rng(14000 + seed)
+    paths = _make_files(rng, tmp_path)
+    pattern = WORDS[int(rng.integers(0, len(WORDS)))]
+    rc, out = _run_ours(["grep", pattern, *paths, "-b"], capsys)
+    grc, gout = _run_gnu(["-b", "-n", pattern, *paths])
+    got = _parse_ours(out, with_boff=True)
+    want = [(p, ln, b, t) for p, ln, b, t in _parse_gnu(gout, paths, 3)]
+    assert got == want, f"seed={seed} -b pattern={pattern!r}"
+    assert rc == grc
+
+    rc, out = _run_ours(["grep", pattern, *paths, "-o", "-b"], capsys)
+    grc, gout = _run_gnu(["-o", "-b", "-n", pattern, *paths])
+    got = _parse_ours(out, with_boff=True)
+    want = _parse_gnu(gout, paths, 3)
+    assert got == want, f"seed={seed} -o -b pattern={pattern!r}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_cli_ere_patterns(seed, tmp_path, capsys):
+    """Random simple ERE alternations through -E vs GNU grep -E -n."""
+    rng = np.random.default_rng(15000 + seed)
+    paths = _make_files(rng, tmp_path)
+    k = int(rng.integers(2, 5))
+    pattern = "|".join(WORDS[int(i)] for i in rng.integers(0, len(WORDS), k))
+    rc, out = _run_ours(["grep", "-E", pattern, *paths], capsys)
+    grc, gout = _run_gnu(["-E", "-n", pattern, *paths])
+    got = _parse_ours(out)
+    want = _parse_gnu(gout, paths, 2)
+    assert got == want, f"seed={seed} -E pattern={pattern!r}"
+    assert rc == grc

@@ -348,3 +348,78 @@ def test_fuzz_escape_semantics(seed):
             f"seed={seed} backend={backend} mode={eng.mode} pattern={pattern!r}: "
             f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
         )
+
+
+# -------------------- interpret-mode Pallas kernels, every seed (round 3)
+
+@pytest.mark.parametrize("seed", range(30))
+def test_fuzz_pallas_kernels_every_seed(seed):
+    """Every regex fuzz seed ALSO runs through interpret-mode Pallas for
+    the mode the engine would really use on a TPU (shift-and coarse spans,
+    NFA exact/filter, FDR filter; dfa/re modes have no kernel and skip).
+    The engine's interpret=True flag drives the same gates a real TPU run
+    takes (VERDICT r2 item 8).  Corpus is a smaller slice (interpret mode
+    is ~1000x slower than compiled)."""
+    rng = np.random.default_rng(1000 + seed)  # SAME stream as the XLA test
+    pattern = _gen_pattern(rng)
+    rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
+    needle = _sample_match(rng, pattern)
+    kind = "words" if seed % 2 else "binary"
+    data = _gen_corpus(rng, kind, 12 << 10, [needle] if needle else [])
+    eng = GrepEngine(pattern, interpret=True, target_lanes=4096,
+                     segment_bytes=1 << 20)
+    if eng.mode not in ("shift_and", "nfa", "fdr"):
+        pytest.skip(f"mode {eng.mode} has no Pallas kernel")
+    want = _oracle_lines(rx, data)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == want, (
+        f"seed={seed} mode={eng.mode} pattern={pattern!r}: "
+        f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+    )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_pallas_literal_sets_every_seed(seed):
+    """Every literal-set fuzz seed through the interpret-mode FDR kernel
+    (or shift-and for sets the decomposition collapses)."""
+    rng = np.random.default_rng(3000 + seed)  # SAME stream as the XLA test
+    n = int(rng.integers(2, 120))
+    pats = []
+    for _ in range(n):
+        k = int(rng.integers(1, 9))
+        pats.append(bytes(int(b) for b in rng.integers(1, 256, size=k)
+                          ).replace(b"\n", b"*"))
+    pats = sorted(set(pats))
+    data = _gen_corpus(rng, "binary", 12 << 10, pats[:10])
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    want = {i for i, ln in enumerate(lines, 1) if any(p in ln for p in pats)}
+    eng = GrepEngine(
+        patterns=[p.decode("utf-8", "surrogateescape") for p in pats],
+        interpret=True, target_lanes=4096, segment_bytes=1 << 20,
+    )
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == want, f"seed={seed} mode={eng.mode} n={n}"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_pallas_approx_every_seed(seed):
+    """Every approx fuzz seed through the interpret-mode approx kernel."""
+    from distributed_grep_tpu.models.approx import line_matches, try_compile_approx
+
+    rng = np.random.default_rng(4000 + seed)  # SAME stream as the XLA test
+    plen = int(rng.integers(3, 12))
+    pattern = "".join(chr(c) for c in rng.integers(97, 110, size=plen))
+    k = int(rng.integers(1, min(3, plen - 1) + 1))
+    model = try_compile_approx(pattern, k)
+    assert model is not None
+    data = _gen_corpus(rng, "words", 8 << 10, [pattern.encode()])
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    want = {i for i, ln in enumerate(lines, 1) if line_matches(model, ln)}
+    eng = GrepEngine(pattern, max_errors=k, interpret=True,
+                     target_lanes=4096, segment_bytes=1 << 20)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == want, f"seed={seed} pattern={pattern!r} k={k} mode={eng.mode}"
