@@ -190,23 +190,39 @@ def cmd_grep(args: argparse.Namespace) -> int:
         args.files = good
         if not args.files:
             return 2  # nothing searchable, like grep
-    if args.recursive:
-        import fnmatch
+    import fnmatch
 
+    def _included(name: str) -> bool:
+        # GNU applies --include to explicitly listed files too (with or
+        # without -r) — probed against grep 3.8
+        return not args.include or any(
+            fnmatch.fnmatch(name, g) for g in args.include
+        )
+
+    if args.recursive:
         expanded: list[str] = []
+        walk_bad: list[str] = []
         for f in args.files:
             pf = Path(f)
             if pf.is_dir():
                 for sub in sorted(pf.rglob("*")):
-                    if not sub.is_file():
+                    if not sub.is_file() or not _included(sub.name):
                         continue
-                    if args.include and not any(
-                        fnmatch.fnmatch(sub.name, g) for g in args.include
-                    ):
+                    sp = str(sub)
+                    if not _os.access(sp, _os.R_OK):
+                        # unreadable files found in the tree get the same
+                        # -s / exit-2 semantics as explicit arguments
+                        # instead of failing a map task (GNU grep -r)
+                        walk_bad.append(sp)
                         continue
-                    expanded.append(str(sub))
-            else:
-                expanded.append(f)  # explicit files are always searched
+                    expanded.append(sp)
+            elif _included(pf.name):
+                expanded.append(f)
+        if walk_bad:
+            had_file_errors = True
+            if not args.no_messages:
+                print(f"error: cannot read: {', '.join(walk_bad)}",
+                      file=sys.stderr)
         if not expanded:
             print("error: no files matched under the given directories",
                   file=sys.stderr)
@@ -219,13 +235,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 print(f"error: {', '.join(dirs)}: is a directory (use -r)",
                       file=sys.stderr)
             return 2
+        args.files = [f for f in args.files if _included(Path(f).name)]
+        if not args.files:
+            return 2 if had_file_errors else 1  # everything --include-filtered
 
-    if args.byte_offset and (
-        args.context is not None or args.before_context or args.after_context
-    ):
-        print("error: -b is not supported with context lines (-A/-B/-C)",
-              file=sys.stderr)
-        return 2
     if args.max_errors:
         if patterns:
             print("error: --max-errors applies to a single pattern, not -f",
@@ -364,6 +377,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
             printed_any = _print_with_context(
                 f, matched[f], ctx_before, ctx_after, printed_any,
                 no_filename=args.no_filename,
+                byte_offset=args.byte_offset,
             )
     else:
         # default print: stream in (file, line) order with bounded memory
@@ -430,22 +444,23 @@ def _line_offsets(matched: dict[str, set[int]]) -> dict[str, dict[int, int]]:
     return out
 
 
-def _read_line_bytes(path: str, offset: int) -> bytes:
+def _read_line_bytes(f, offset: int) -> bytes:
     """The raw bytes of the line starting at ``offset`` (to the next
-    newline), read incrementally — grep -o -b needs byte-exact match
-    positions, which the replace-decoded display strings cannot give."""
+    newline), read incrementally from an OPEN handle — grep -o -b needs
+    byte-exact match positions, which the replace-decoded display strings
+    cannot give.  Callers keep one handle per path (match-dense files
+    would otherwise pay an open() per matched line)."""
     chunks = []
-    with open(path, "rb") as f:
-        f.seek(offset)
-        while True:
-            block = f.read(1 << 16)
-            if not block:
-                break
-            cut = block.find(b"\n")
-            if cut >= 0:
-                chunks.append(block[:cut])
-                break
-            chunks.append(block)
+    f.seek(offset)
+    while True:
+        block = f.read(1 << 16)
+        if not block:
+            break
+        cut = block.find(b"\n")
+        if cut >= 0:
+            chunks.append(block[:cut])
+            break
+        chunks.append(block)
     return b"".join(chunks)
 
 
@@ -474,49 +489,70 @@ def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
         rx_b = re.compile(wrapped, flags)
     rx = re.compile(wrapped.decode("utf-8", "surrogateescape"), flags)
 
-    for key, value in res.iter_results_sorted():
-        m = GREP_KEY_RE.match(key)
-        if m and int(m.group(2)) not in matched.get(m.group(1), ()):
-            continue  # line dropped by the -m cap
-        prefix = ""
-        line_off = None
-        if m:
-            if not args.no_filename:
-                prefix = f"{m.group(1)} "
-            prefix += f"(line number #{m.group(2)}) "
-            if offsets is not None:
-                line_off = offsets.get(m.group(1), {}).get(int(m.group(2)))
-        if line_off is not None:
-            # GNU -o -b: offset of the MATCH, byte-exact — match on the
-            # raw line bytes, not the replace-decoded display string
-            raw = _read_line_bytes(m.group(1), line_off)
-            for hit in rx_b.finditer(raw):
+    handles: dict[str, object] = {}  # -b: one open handle per path
+    try:
+        for key, value in res.iter_results_sorted():
+            m = GREP_KEY_RE.match(key)
+            if m and int(m.group(2)) not in matched.get(m.group(1), ()):
+                continue  # line dropped by the -m cap
+            prefix = ""
+            line_off = None
+            if m:
+                if not args.no_filename:
+                    prefix = f"{m.group(1)} "
+                prefix += f"(line number #{m.group(2)}) "
+                if offsets is not None:
+                    line_off = offsets.get(m.group(1), {}).get(int(m.group(2)))
+            if line_off is not None:
+                # GNU -o -b: offset of the MATCH, byte-exact — match on the
+                # raw line bytes, not the replace-decoded display string
+                path = m.group(1)
+                f = handles.get(path)
+                if f is None:
+                    f = handles[path] = open(path, "rb")
+                raw = _read_line_bytes(f, line_off)
+                for hit in rx_b.finditer(raw):
+                    if hit.group(0):
+                        print(f"{prefix}(byte #{line_off + hit.start()}) "
+                              f"{hit.group(0).decode('utf-8', 'replace')}")
+                continue
+            for hit in rx.finditer(value):
                 if hit.group(0):
-                    print(f"{prefix}(byte #{line_off + hit.start()}) "
-                          f"{hit.group(0).decode('utf-8', 'replace')}")
-            continue
-        for hit in rx.finditer(value):
-            if hit.group(0):
-                print(f"{prefix}{hit.group(0)}")
+                    print(f"{prefix}{hit.group(0)}")
+    finally:
+        for f in handles.values():
+            f.close()
 
 
 def _print_with_context(path: str, lines_set: set[int], before: int,
                         after: int, printed_any: bool,
-                        no_filename: bool = False) -> bool:
+                        no_filename: bool = False,
+                        byte_offset: bool = False) -> bool:
     """grep -A/-B/-C over one file, streaming (memory bounded by the
     context width).  Matched lines print in the usual key format; context
     lines use ')-' instead of ') ' and non-contiguous groups are separated
-    by '--', mirroring grep's match/context markers.  ``printed_any``
-    carries across files so the separator is global like grep's; returns
-    the updated flag."""
+    by '--', mirroring grep's match/context markers.  With ``byte_offset``
+    (-b) each line also carries its line-start offset — '(byte #K) ' on
+    matches, '(byte #K)- ' on context, mirroring GNU's ':' vs '-'
+    separators.  ``printed_any`` carries across files so the separator is
+    global like grep's; returns the updated flag."""
     import collections
 
     prevq: collections.deque = collections.deque(maxlen=max(before, 0))
     pending_after = 0
     last_printed = 0
     head = "" if no_filename else f"{path} "
+
+    def fmt(n: int, off: int, ctx: bool) -> str:
+        sep = "-" if ctx else ""
+        b = f" (byte #{off}){sep}" if byte_offset else ""
+        return f"{head}(line number #{n}){sep}{b} "
+
+    pos = 0
     with open(path, "rb") as f:
         for n, raw in enumerate(f, 1):
+            off = pos
+            pos += len(raw)
             # errors="replace" matches the default output mode exactly: map
             # values are replace-decoded at emit time (apps/grep.py), so the
             # same matched line must print identically under -C.  (Lone
@@ -527,20 +563,20 @@ def _print_with_context(path: str, lines_set: set[int], before: int,
                     last_printed == 0 or n - last_printed > len(prevq) + 1
                 ):
                     print("--")
-                for qn, qline in prevq:
+                for qn, qoff, qline in prevq:
                     if qn > last_printed:
-                        print(f"{head}(line number #{qn})- {qline}")
+                        print(f"{fmt(qn, qoff, ctx=True)}{qline}")
                 prevq.clear()
-                print(f"{head}(line number #{n}) {line}")
+                print(f"{fmt(n, off, ctx=False)}{line}")
                 printed_any = True
                 last_printed = n
                 pending_after = after
             elif pending_after > 0:
-                print(f"{head}(line number #{n})- {line}")
+                print(f"{fmt(n, off, ctx=True)}{line}")
                 last_printed = n
                 pending_after -= 1
             elif before:
-                prevq.append((n, line))
+                prevq.append((n, off, line))
     return printed_any
 
 

@@ -89,7 +89,7 @@ COST_PS_PER_GATHER = 4.7
 # random-offset microbench because filtered candidates pass the bloom and
 # walk the probe path more often.  The engine's ConfirmSet fans the
 # candidate array over min(8, cpu) threads; the tuner prices against
-# CONFIRM_THREADS of them (default 4 — any real TPU host has that; set
+# CONFIRM_THREADS of them (default 8 — any real TPU host has that; set
 # DGREP_CONFIRM_THREADS for constrained hosts, e.g. 1 on this 1-core
 # build VM, which shifts the tuner toward more device gathers).
 CONFIRM_PS_PER_CANDIDATE = 8_600.0

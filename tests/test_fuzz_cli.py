@@ -180,3 +180,80 @@ def test_fuzz_cli_ere_patterns(seed, tmp_path, capsys):
     want = _parse_gnu(gout, paths, 2)
     assert got == want, f"seed={seed} -E pattern={pattern!r}"
     assert rc == grc
+
+
+def test_byte_offset_with_context_matches_gnu(tmp_path, capsys):
+    """-b with -A/-C (round-3 polish: was rejected): line-start offsets on
+    matches AND context lines, ':' vs '-' separators mirrored."""
+    p = tmp_path / "c.txt"
+    p.write_text("foo\nbar\nfoo2\nbaz\nqux\nfoo3\n")
+    rc, out = _run_ours(["grep", "foo", str(p), "-b", "-A", "1"], capsys)
+    grc, gout = _run_gnu(["-b", "-n", "-A", "1", "foo", str(p)])
+    ours = []
+    for l in out:
+        if l == "--":
+            ours.append("--")
+            continue
+        m = re.match(r"^.* \(line number #(\d+)\)(-?) \(byte #(\d+)\)-? (.*)$", l)
+        assert m, l
+        ours.append((int(m.group(1)), m.group(2) == "-", int(m.group(3)),
+                     m.group(4)))
+    want = []
+    for l in gout:
+        if l == "--":
+            want.append("--")
+            continue
+        m = re.match(r"^(\d+)([:-])(\d+)[:-](.*)$", l)
+        assert m, l
+        want.append((int(m.group(1)), m.group(2) == "-", int(m.group(3)),
+                     m.group(4)))
+    assert ours == want
+    assert rc == grc
+
+
+def test_include_applies_without_recursive(tmp_path, capsys):
+    """--include filters explicitly listed files like GNU grep (round-3
+    polish: it used to be silently ignored without -r)."""
+    c = tmp_path / "a.c"
+    c.write_text("foo\n")
+    t = tmp_path / "a.txt"
+    t.write_text("foo\n")
+    rc, out = _run_ours(
+        ["grep", "foo", str(c), str(t), "--include", "*.c"], capsys)
+    grc, gout = _run_gnu(["-n", "--include", "*.c", "foo", str(c), str(t)])
+    assert _parse_ours(out) == _parse_gnu(gout, [str(c)], 2)
+    assert rc == grc == 0
+    # everything filtered out -> no matches, exit 1 like GNU
+    rc, out = _run_ours(
+        ["grep", "foo", str(t), "--include", "*.c"], capsys)
+    grc, gout = _run_gnu(["--include", "*.c", "foo", str(t)])
+    assert out == gout == []
+    assert rc == grc == 1
+
+
+def test_recursive_skips_unreadable_files(tmp_path, capsys):
+    """-r over a tree with an unreadable file: skip it with a message and
+    exit 2, like explicit unreadable arguments (ADVICE r2)."""
+    import os
+
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "ok.txt").write_text("needle here\n")
+    blocked = d / "blocked.txt"
+    blocked.write_text("needle too\n")
+    os.chmod(blocked, 0)
+    if os.access(str(blocked), os.R_OK):
+        pytest.skip("running as privileged user; chmod 0 still readable")
+    try:
+        rc = main(["grep", "-r", "needle", str(d)])
+        cap = capsys.readouterr()
+        out = [l for l in cap.out.split("\n") if l]
+        assert rc == 2  # file errors force exit 2 (matches still printed)
+        assert len(out) == 1 and "ok.txt" in out[0]
+        assert "cannot read" in cap.err and "blocked.txt" in cap.err
+        # -s suppresses the message but keeps the exit code
+        rc2 = main(["grep", "-r", "-s", "needle", str(d)])
+        cap2 = capsys.readouterr()
+        assert rc2 == 2 and "cannot read" not in cap2.err
+    finally:
+        os.chmod(blocked, 0o644)
