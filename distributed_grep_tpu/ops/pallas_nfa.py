@@ -83,6 +83,15 @@ def kernel_cost(model: GlushkovModel) -> int:
     return b_cost + special_cost + 4 * model.n_words
 
 
+def unroll_for(model: GlushkovModel) -> int:
+    """Byte-steps per fori sub-block.  v5e probe (2026-07-30, config-4
+    1-word filter model, slope-timed): full unroll wins for single-word
+    compare-B models (65/71/69/73 GB/s at 4/8/16/32) — their live state is
+    a couple of vregs, like the shift-and kernel.  Multi-word and gather-B
+    models keep the round-2 probed 16 (register pressure)."""
+    return 32 if (model.n_words == 1 and not use_gather_b(model)) else 16
+
+
 def eligible(model: GlushkovModel) -> bool:
     return kernel_cost(model) <= MAX_COST
 
@@ -280,6 +289,7 @@ def nfa_scan_words(
         lane_blocks=lane_blocks,
         gather_b=gather_b,
         interpret=interpret,
+        unroll=unroll_for(model),
     )
 
 

@@ -220,9 +220,11 @@ def sharded_fdr_words(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("plan", "gather_b", "chunk", "interpret", "mesh", "axes"),
+    static_argnames=("plan", "gather_b", "chunk", "interpret", "mesh", "axes",
+                     "unroll"),
 )
-def _sharded_nfa(tiles, *b_tabs, plan, gather_b, chunk, interpret, mesh, axes):
+def _sharded_nfa(tiles, *b_tabs, plan, gather_b, chunk, interpret, mesh, axes,
+                 unroll=16):
     def body(blk, *cs):
         return pallas_nfa._nfa_pallas(
             blk,
@@ -232,6 +234,7 @@ def _sharded_nfa(tiles, *b_tabs, plan, gather_b, chunk, interpret, mesh, axes):
             lane_blocks=blk.shape[1] // SUBLANES,
             gather_b=gather_b,
             interpret=interpret,
+            unroll=unroll,
         )
 
     return _shard_shell(body, mesh, axes, len(b_tabs))(tiles, *b_tabs)
@@ -264,4 +267,115 @@ def sharded_nfa_words(
         interpret=interpret,
         mesh=mesh,
         axes=axes,
+        unroll=pallas_nfa.unroll_for(model),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "plan", "chunk", "interpret", "mesh",
+                     "data_axes", "pattern_axes", "fold_case"),
+)
+def _sharded_fdr_pattern(tiles, tabs, *, m, plan, chunk, interpret, mesh,
+                         data_axes, pattern_axes, fold_case=False):
+    from jax.experimental.shard_map import shard_map
+
+    def body(blk, tab_blk):
+        words = None
+        for i in range(tab_blk.shape[0]):  # local banks (static count)
+            w = pallas_fdr._fdr_pallas(
+                blk,
+                tab_blk[i],
+                m=m,
+                plan=plan,
+                chunk=chunk,
+                lane_blocks=blk.shape[1] // SUBLANES,
+                interpret=interpret,
+                fold_case=fold_case,
+            )
+            words = w if words is None else words | w
+        # candidate words must OR bitwise across the pattern axis (psum
+        # would add colliding bits, pmax would drop them): all_gather the
+        # small per-device words and reduce locally — the EP combine.
+        gathered = jax.lax.all_gather(words, pattern_axes)
+        all_words = jax.lax.reduce(
+            gathered, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+        )
+        total = jax.lax.psum(
+            jnp.count_nonzero(all_words), data_axes + pattern_axes
+        ) // np.prod([mesh.shape[a] for a in pattern_axes])
+        return all_words, total
+
+    spec = P(None, data_axes, None)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, P(pattern_axes)),
+        out_specs=(spec, P()),
+        check_rep=False,
+    )(tiles, tabs)
+
+
+def sharded_fdr_pattern_step(
+    arr_cl: np.ndarray,
+    fdr_model,
+    mesh: Mesh,
+    data_axis="data",
+    pattern_axis="seq",
+    interpret: bool | None = None,
+    fold_case: bool = False,
+):
+    """Pattern-parallel FDR: filter BANKS shard over ``pattern_axis`` while
+    document lanes shard over ``data_axis`` — the expert-parallel analogue
+    (SURVEY.md §2) on the PRODUCTION kernel rather than the XLA DFA banks
+    (`sharded_scan.sharded_pattern_set_step`).
+
+    Same-plan banks (what `models/fdr._compile_group` emits when it shards
+    one group 2/4-way) differ only in table VALUES, so the whole bank
+    dimension is a shardable operand: every device runs the identical
+    kernel program on its lane block with its local table shard, per-chip
+    gather cost drops by the pattern-axis width, and candidate words OR
+    across ICI (all_gather + bitwise-or — candidates must stay a bitwise
+    union for the host confirm to decode).  Returns (words, total) in the
+    usual convention; `words` is bit-identical to a single-device OR over
+    all banks.  Bank count pads to the axis width with all-zero tables
+    (zero reach = no candidates)."""
+    if interpret is None:
+        interpret = not pallas_scan.available()
+    banks = fdr_model.banks
+    plans = {(b.m, pallas_fdr.kernel_plan(b)) for b in banks}
+    if len(plans) != 1:
+        raise ValueError(
+            "pattern-parallel FDR needs same-plan banks (mixed-window "
+            "models keep the lane-sharded step)"
+        )
+    (m, plan), = plans
+    for b in banks:
+        if not pallas_fdr.eligible(b):
+            raise ValueError("bank outside the kernel's check/domain budget")
+    data_axes = _axes_tuple(data_axis)
+    pattern_axes = _axes_tuple(pattern_axis)
+    n_pat = int(np.prod([mesh.shape[a] for a in pattern_axes]))
+    tiles = _to_tiles(arr_cl, mesh, data_axis)
+    tabs = [pallas_fdr.bank_device_tables(b) for b in banks]
+    pad = -len(tabs) % n_pat
+    tabs += [np.zeros_like(tabs[0])] * pad
+    stacked = np.stack(tabs)  # (B, rows, SUBLANES, LANE_COLS)
+    tabs_dev = jax.device_put(
+        stacked, NamedSharding(mesh, P(pattern_axes))
+    )
+    tiles_dev = jax.device_put(
+        tiles, NamedSharding(mesh, P(None, data_axes, None))
+    )
+    return _sharded_fdr_pattern(
+        tiles_dev,
+        tabs_dev,
+        m=m,
+        plan=plan,
+        chunk=arr_cl.shape[0],
+        interpret=interpret,
+        mesh=mesh,
+        data_axes=data_axes,
+        pattern_axes=pattern_axes,
+        fold_case=fold_case,
     )

@@ -334,3 +334,43 @@ def test_engine_mesh_multi_segment(mesh8):
     }
     assert set(res.matched_lines.tolist()) == expected
     assert eng.stats.get("psum_candidates", 0) >= 2
+
+
+def test_sharded_fdr_pattern_parallel_bit_identical():
+    """EP on the production kernel: same-plan FDR banks shard over the
+    pattern axis (tables are the sharded operand), candidate words OR over
+    ICI — output must be bit-identical to a single-device OR over all
+    banks, including zero-table padding banks."""
+    from distributed_grep_tpu.models.fdr import FdrModel, compile_fdr
+    from distributed_grep_tpu.ops import pallas_fdr
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    rng = np.random.default_rng(7)
+    pats = sorted({
+        bytes(rng.choice(list(b"abcdefghijklmnop"), size=6).tolist())
+        for _ in range(600)
+    })
+    h1, h2 = pats[::2], pats[1::2]
+    m1, m2 = compile_fdr(h1), compile_fdr(h2)
+    plans = {(b.m, b.checks) for b in (*m1.banks, *m2.banks)}
+    assert len(plans) == 1, "same-distribution halves should share a plan"
+    model = FdrModel(banks=list(m1.banks) + list(m2.banks),
+                     ignore_case=False, n_patterns=len(pats))
+
+    data = make_text(500, inject=[(7, b"xx " + pats[3]), (420, pats[11])])
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    lay, arr = _mesh_layout(data, mesh, axis="data")
+    words, total = sk.sharded_fdr_pattern_step(
+        arr, model, mesh, data_axis="data", pattern_axis="seq",
+        interpret=True,
+    )
+    ref = None
+    for bank in model.banks:
+        w = pallas_fdr.fdr_scan_words(arr, bank, interpret=True)
+        ref = w if ref is None else ref | w
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+    # lanes shard over data only; every device holds 1/4 of the tiles
+    shard_shapes = {s.data.shape for s in words.addressable_shards}
+    assert shard_shapes == {(lay.chunk // 32, lay.lanes // 128 // 4, 128)}
+
