@@ -327,6 +327,33 @@ class GrepEngine:
                     if isinstance(pattern, str) else pattern, flags
                 )
                 self.mode = "re"
+                if backend == "device":
+                    # Rescue: a bounded repeat past the DFA expansion cap
+                    # (e.g. {4,1000}) still compiles as a relaxed Glushkov
+                    # FILTER (models/nfa.compile_scan_model widens {m,n}
+                    # before building) — run it on device and confirm
+                    # candidate lines with the exact re fallback (there is
+                    # no DFA table to confirm with).  Without a Pallas
+                    # backend the scan falls back to the per-line re loop.
+                    try:
+                        filt, is_filter = compile_scan_model(
+                            pattern, ignore_case=ignore_case
+                        )
+                    except RegexError:
+                        filt = None
+                    if filt is not None:
+                        log.info(
+                            "pattern %r rescued onto the device NFA filter "
+                            "(%d positions, re-confirmed lines)",
+                            pattern, filt.n_pos,
+                        )
+                        self.glushkov = filt
+                        self.glushkov_exact = None
+                        # always confirm: with no DFA oracle, even an
+                        # "exact" Glushkov's stripe-boundary behavior is
+                        # re-checked per line
+                        self._nfa_filter = True
+                        self.mode = "nfa"
         if backend == "cpu" and self.mode != "re":
             self.mode = "native"  # host C scanner, same tables
 
@@ -459,6 +486,18 @@ class GrepEngine:
             return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
         if self.mode == "native":
             return self._scan_native(data)
+        if self.mode == "nfa" and not self.tables:
+            # DFA-less rescue (expansion-cap bounded repeats): the only
+            # device engine is the Pallas NFA filter — without it (no TPU,
+            # over budget) there are no DFA banks to fall back on, so the
+            # scan is the per-line re loop, like the un-rescued mode.
+            from distributed_grep_tpu.ops import pallas_nfa, pallas_scan
+
+            if not (
+                (pallas_scan.available() or self._interpret)
+                and pallas_nfa.eligible(self.glushkov)
+            ):
+                return self._scan_re(data)
         return self._scan_device(data)
 
     def scan_file(self, path, chunk_bytes: int | None = None, emit=None) -> ScanResult:
@@ -571,6 +610,9 @@ class GrepEngine:
     def _host_line_matcher(self, line: bytes) -> bool:
         if self.approx is not None:
             return approx_line_matches(self.approx, line)
+        if not self.tables and self._re_fallback is not None:
+            # DFA-less NFA rescue (expansion-cap patterns): re is the oracle
+            return self._re_fallback.search(line) is not None
         return any(reference_scan(t, line).size > 0 for t in self.tables)
 
     def _device_tables(self, dev=None) -> list[tuple]:
@@ -810,7 +852,8 @@ class GrepEngine:
                         t0 = _time.perf_counter()
                         glines = lines_mod.line_of_offsets(offsets + seg_start, nl)
                         cand = set(np.unique(glines).tolist()) - device_lines
-                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
+                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT and \
+                                self.table is not None:
                             true_lines = dense_native_confirm(seg_start, seg_len)
                             nonlocal nfa_model, nfa_is_filter
                             if (

@@ -324,3 +324,30 @@ def test_filter_defeat_swaps_to_exact_automaton():
     res2 = eng.scan(good)
     assert set(res2.matched_lines.tolist()) == {51}
     assert "nfa_filter_defeated" not in eng.stats
+
+
+def test_expansion_cap_repeat_rescued_to_device_filter():
+    """{m,n} past the DFA expansion cap (512) used to fall to the host re
+    loop on --backend device; the relaxed Glushkov filter now runs it on
+    the device with re-confirmed candidate lines (round 3)."""
+    import re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    pat = r"q[ab]{10,900}z"
+    rx = re.compile(pat.encode())
+    data = make_text(
+        400,
+        inject=[
+            (5, b"q" + b"ab" * 30 + b"z hit"),
+            (100, b"q" + b"a" * 950 + b"z over-bound"),  # false candidate
+            (300, b"qabz too-short"),
+        ],
+    )
+    want = {i for i, l in enumerate(data.split(b"\n")[:-1], 1) if rx.search(l)}
+    eng = GrepEngine(pat, interpret=True)
+    assert eng.mode == "nfa" and eng._nfa_filter and not eng.tables
+    assert set(eng.scan(data).matched_lines.tolist()) == want
+    # no Pallas -> per-line re loop, still exact
+    eng2 = GrepEngine(pat)
+    assert set(eng2.scan(data).matched_lines.tolist()) == want
