@@ -287,6 +287,11 @@ def cmd_grep(args: argparse.Namespace) -> int:
         },
         n_reduce=args.n_reduce or 10,
     )
+    if args.backend == "tpu" or args.max_errors:
+        # the first device compile through a cold backend can take 20-40 s
+        # (CLAUDE/verify notes) — the reference-derived 10 s task timeout
+        # would re-enqueue the task mid-compile and run every split twice
+        cfg.task_timeout_s = max(cfg.task_timeout_s, 120.0)
     if args.work_dir:
         cfg.work_dir = args.work_dir
     else:
