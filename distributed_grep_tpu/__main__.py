@@ -224,9 +224,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 print(f"error: cannot read: {', '.join(walk_bad)}",
                       file=sys.stderr)
         if not expanded:
-            print("error: no files matched under the given directories",
-                  file=sys.stderr)
-            return 2
+            # GNU grep -r exits 1 silently when nothing is searchable
+            # (empty tree, or everything --include-filtered) — probed
+            return 2 if had_file_errors else 1
         args.files = expanded
     else:
         dirs = [f for f in args.files if Path(f).is_dir()]
@@ -287,8 +287,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
         },
         n_reduce=args.n_reduce or 10,
     )
-    if args.backend == "tpu" or args.max_errors:
-        # the first device compile through a cold backend can take 20-40 s
+    if cfg.app_options.get("backend") != "cpu":
+        # device backend (explicit tpu, auto, or --max-errors): the first
+        # device compile through a cold backend can take 20-40 s
         # (CLAUDE/verify notes) — the reference-derived 10 s task timeout
         # would re-enqueue the task mid-compile and run every split twice
         cfg.task_timeout_s = max(cfg.task_timeout_s, 120.0)

@@ -364,11 +364,8 @@ def sharded_fdr_pattern_step(
     tabs_dev = jax.device_put(
         stacked, NamedSharding(mesh, P(pattern_axes))
     )
-    tiles_dev = jax.device_put(
-        tiles, NamedSharding(mesh, P(None, data_axes, None))
-    )
     return _sharded_fdr_pattern(
-        tiles_dev,
+        _put_sharded(tiles, mesh, data_axes),
         tabs_dev,
         m=m,
         plan=plan,

@@ -229,6 +229,12 @@ def test_include_applies_without_recursive(tmp_path, capsys):
     grc, gout = _run_gnu(["--include", "*.c", "foo", str(t)])
     assert out == gout == []
     assert rc == grc == 1
+    # same under -r (explicit file filtered): silent exit 1, not error 2
+    rc, out = _run_ours(
+        ["grep", "-r", "foo", str(t), "--include", "*.c"], capsys)
+    grc, gout = _run_gnu(["-r", "--include", "*.c", "foo", str(t)])
+    assert out == gout == []
+    assert rc == grc == 1
 
 
 def test_recursive_skips_unreadable_files(tmp_path, capsys):
