@@ -193,8 +193,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
     import fnmatch
 
     def _included(name: str) -> bool:
-        # GNU applies --include to explicitly listed files too (with or
-        # without -r) — probed against grep 3.8
+        # GNU applies --include/--exclude to explicitly listed files too
+        # (with or without -r), and --exclude wins — probed against grep 3.8
+        if args.exclude and any(fnmatch.fnmatch(name, g) for g in args.exclude):
+            return False
         return not args.include or any(
             fnmatch.fnmatch(name, g) for g in args.include
         )
@@ -682,8 +684,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="suppress messages about missing/unreadable files "
                         "(grep -s)")
     p.add_argument("--include", action="append", default=None, metavar="GLOB",
-                   help="with -r: search only files whose basename matches "
-                        "GLOB (repeatable)")
+                   help="search only files whose basename matches GLOB "
+                        "(repeatable; applies to explicit files too, like "
+                        "GNU grep)")
+    p.add_argument("--exclude", action="append", default=None, metavar="GLOB",
+                   help="skip files whose basename matches GLOB (repeatable; "
+                        "takes priority over --include, like GNU grep)")
     _add_common(p)
     p.set_defaults(fn=cmd_grep)
 

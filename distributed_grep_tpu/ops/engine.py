@@ -750,7 +750,7 @@ class GrepEngine:
         # fast path — parallel/sharded_kernels).  The psum'd candidate
         # count is kept per segment as the collective cross-check.
         use_mesh = self.mesh is not None and (
-            use_pallas_sa or use_pallas_nfa or use_fdr
+            use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
         )
         if self.mesh is not None and not use_mesh:
             log.warning(
@@ -1027,9 +1027,16 @@ class GrepEngine:
                                 )
                             kind = "span_words"
                         elif use_pallas_approx:
-                            words = pallas_approx.approx_scan_words(
-                                arr, self.approx, interpret=interp_flag
-                            )
+                            if use_mesh:
+                                words, pt = shk.sharded_approx_words(
+                                    arr, self.approx, self.mesh,
+                                    self.mesh_axis, interpret=interp_flag,
+                                )
+                                psum_totals.append(pt)
+                            else:
+                                words = pallas_approx.approx_scan_words(
+                                    arr, self.approx, interpret=interp_flag
+                                )
                             kind = "words"
                         else:
                             if use_mesh:

@@ -376,3 +376,56 @@ def sharded_fdr_pattern_step(
         pattern_axes=pattern_axes,
         fold_case=fold_case,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sym_ranges", "match_bit", "k", "chunk", "interpret",
+                     "mesh", "axes"),
+)
+def _sharded_approx(tiles, *, sym_ranges, match_bit, k, chunk, interpret,
+                    mesh, axes):
+    from distributed_grep_tpu.ops import pallas_approx
+
+    def body(blk):
+        return pallas_approx._approx_pallas(
+            blk,
+            sym_ranges=sym_ranges,
+            match_bit=match_bit,
+            k=k,
+            chunk=chunk,
+            lane_blocks=blk.shape[1] // SUBLANES,
+            interpret=interpret,
+        )
+
+    return _shard_shell(body, mesh, axes, 0)(tiles)
+
+
+def sharded_approx_words(
+    arr_cl: np.ndarray,
+    model,
+    mesh: Mesh,
+    axis="data",
+    interpret: bool | None = None,
+):
+    """Approx (agrep <=k errors) kernel over the mesh; (words, total) in
+    the shared convention — completes the set: every Pallas engine the
+    single-chip bench runs has a shard_map'd multi-chip form."""
+    from distributed_grep_tpu.ops import pallas_approx
+
+    if interpret is None:
+        interpret = not pallas_scan.available()
+    if not pallas_approx.eligible(model):
+        raise ValueError("model exceeds the pallas approx budget")
+    axes = _axes_tuple(axis)
+    tiles = _to_tiles(arr_cl, mesh, axis)
+    return _sharded_approx(
+        _put_sharded(tiles, mesh, axes),
+        sym_ranges=tuple(tuple(r) for r in model.base.sym_ranges),
+        match_bit=int(model.match_bit),
+        k=model.k,
+        chunk=arr_cl.shape[0],
+        interpret=interpret,
+        mesh=mesh,
+        axes=axes,
+    )

@@ -263,3 +263,25 @@ def test_recursive_skips_unreadable_files(tmp_path, capsys):
         assert rc2 == 2 and "cannot read" not in cap2.err
     finally:
         os.chmod(blocked, 0o644)
+
+
+def test_exclude_glob_matches_gnu(tmp_path, capsys):
+    """--exclude skips basename-matching files, beats --include, applies to
+    explicit files — all probed GNU 3.8 semantics."""
+    c = tmp_path / "a.c"
+    c.write_text("foo\n")
+    t = tmp_path / "a.txt"
+    t.write_text("foo\n")
+    rc, out = _run_ours(
+        ["grep", "foo", str(c), str(t), "--exclude", "*.txt"], capsys)
+    grc, gout = _run_gnu(["-n", "--exclude", "*.txt", "foo", str(c), str(t)])
+    assert _parse_ours(out) == _parse_gnu(gout, [str(c)], 2)
+    assert rc == grc == 0
+    # exclude beats include
+    rc, out = _run_ours(
+        ["grep", "-r", "foo", str(tmp_path), "--include", "*.c",
+         "--exclude", "a*"], capsys)
+    grc, gout = _run_gnu(["-r", "--include", "*.c", "--exclude", "a*",
+                          "foo", str(tmp_path)])
+    assert out == gout == []
+    assert rc == grc == 1

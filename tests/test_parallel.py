@@ -374,3 +374,31 @@ def test_sharded_fdr_pattern_parallel_bit_identical():
     shard_shapes = {s.data.shape for s in words.addressable_shards}
     assert shard_shapes == {(lay.chunk // 32, lay.lanes // 128 // 4, 128)}
 
+
+
+def test_sharded_approx_bit_identical_and_engine_mesh(mesh8):
+    """The approx (agrep) kernel under shard_map: bit-identical words, and
+    the engine's mesh mode is exact for max_errors scans."""
+    from distributed_grep_tpu.models.approx import line_matches, try_compile_approx
+    from distributed_grep_tpu.ops import pallas_approx
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+
+    model = try_compile_approx("needle", 1)
+    assert model is not None
+    data = make_text(400, inject=[(5, b"a needle"), (300, b"nedle x"),
+                                  (350, b"nXedle")])
+    lay, arr = _mesh_layout(data, mesh8)
+    words, total = sk.sharded_approx_words(arr, model, mesh8, interpret=True)
+    ref = pallas_approx.approx_scan_words(arr, model, interpret=True)
+    np.testing.assert_array_equal(np.asarray(words), np.asarray(ref))
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+
+    eng = GrepEngine("needle", max_errors=1, mesh=mesh8, interpret=True)
+    res = eng.scan(data)
+    want = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+        if line_matches(model, ln)
+    }
+    assert set(res.matched_lines.tolist()) == want
+    assert eng.stats.get("psum_candidates", 0) >= 1
