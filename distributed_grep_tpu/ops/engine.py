@@ -793,6 +793,11 @@ class GrepEngine:
 
         def collect(job) -> None:
             sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev = job
+            with trace_mod.annotate(f"collect:{sparse_kind}@{seg_start}"):
+                return _collect(job)
+
+        def _collect(job) -> None:
+            sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev = job
             # Fetch under the job's device context so the decode runs where
             # the plane lives instead of copying it to the default device.
             ctx = jax.default_device(dev) if dev is not None else nullcontext()
@@ -932,7 +937,15 @@ class GrepEngine:
 
         seg_starts = list(range(0, max(len(data), 1), seg))
 
+        from distributed_grep_tpu.utils import trace as trace_mod
+
         def prepare(i: int, seg_start: int):
+            # feed leg: visible as its own row in the profiler timeline so
+            # the upload/compute overlap is inspectable (DGREP_TRACE_DIR)
+            with trace_mod.annotate(f"feed:seg{i}"):
+                return _prepare(i, seg_start)
+
+        def _prepare(i: int, seg_start: int):
             seg_bytes = data[seg_start : seg_start + seg]
             if use_pallas:
                 lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
