@@ -30,6 +30,7 @@ positions whose lines get exact host re-scans (ops/lines.py).
 
 from __future__ import annotations
 
+import os as _os
 import re as _re
 from dataclasses import dataclass
 
@@ -364,11 +365,9 @@ class GrepEngine:
         (either way), recompile the filter plan under measured pricing.
         Random-offset probes under-read the FDR-candidate bias ~2x, hence
         the wide gate — the post-scan retune handles fine constants."""
-        import os as _os
         from dataclasses import replace as _replace
 
         from distributed_grep_tpu.models.fdr import (
-            FdrError,
             default_pricing,
             probe_confirm_ps,
         )
@@ -393,8 +392,6 @@ class GrepEngine:
     def _swap_fdr_plan(self, pricing, reason: str) -> None:
         """Recompile the FDR model under `pricing`; adopt it if the check
         plan actually changed (device tables re-upload lazily)."""
-        from distributed_grep_tpu.models.fdr import FdrError, compile_fdr
-
         try:
             model = compile_fdr(
                 self._fdr_pats, ignore_case=self.ignore_case, pricing=pricing
@@ -432,7 +429,6 @@ class GrepEngine:
         retune the plan if the constants were >2.5x off.  Runs at most once
         per engine; the measured constants subsume OVERLAP_RESIDUE's role
         for plan choice (both legs are observed, not modeled)."""
-        import os as _os
         from dataclasses import replace as _replace
 
         if (
@@ -772,6 +768,14 @@ class GrepEngine:
         # job: (sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev)
         pending: list[tuple] = []
 
+        def confirm_lines(cand) -> None:
+            """Per-line host confirm for a sparse candidate-line set (the
+            shared tail of the span/cand filter paths)."""
+            for ln in cand:
+                start, end = lines_mod.line_span(nl, ln, len(data))
+                if self._host_line_matcher(data[start:end]):
+                    device_lines.add(ln)
+
         def dense_native_confirm(seg_start: int, seg_len: int) -> int:
             """Candidate-dense segment: one native DFA pass (C, ~GB/s)
             resolves every line vectorized instead of per-line Python
@@ -792,8 +796,7 @@ class GrepEngine:
             return int(uniq.size)
 
         def collect(job) -> None:
-            sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev = job
-            with trace_mod.annotate(f"collect:{sparse_kind}@{seg_start}"):
+            with trace_mod.annotate(f"collect:{job[0]}@{job[3]}"):
                 return _collect(job)
 
         def _collect(job) -> None:
@@ -839,10 +842,7 @@ class GrepEngine:
                                 )
                                 sa_filtered = None
                         else:
-                            for ln in cand:
-                                start, end = lines_mod.line_span(nl, ln, len(data))
-                                if self._host_line_matcher(data[start:end]):
-                                    device_lines.add(ln)
+                            confirm_lines(cand)
                     return
                 if sparse_kind == "cand_words":
                     # NFA filter path (models/nfa.compile_scan_model): the
@@ -884,10 +884,7 @@ class GrepEngine:
                                 nfa_is_filter = False
                                 self.stats["nfa_filter_defeated"] = True
                         else:
-                            for ln in cand:
-                                start, end = lines_mod.line_span(nl, ln, len(data))
-                                if self._host_line_matcher(data[start:end]):
-                                    device_lines.add(ln)
+                            confirm_lines(cand)
                         self.stats["confirm_seconds"] += _time.perf_counter() - t0
                     return
                 if sparse_kind == "words":

@@ -11,6 +11,7 @@ therefore compatible across the native and fallback paths.
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 
@@ -279,8 +280,6 @@ class ConfirmSet:
         if ends.size == 0:
             return np.zeros(0, dtype=bool)
         if self._handle is not None:
-            import os
-
             lib = _try_load()
             out = np.zeros(ends.size, dtype=np.uint8)
             lib.dgrep_confirm_scan(
@@ -327,8 +326,6 @@ def dfa_scan_mt(
 ) -> np.ndarray:
     """Multithreaded DFA scan (accept end-offsets only; no final state —
     chunked scans have no single sequential final state)."""
-    import os
-
     lib = _try_load()
     if lib is None or not hasattr(lib, "dgrep_dfa_scan_mt"):
         offsets, _ = dfa_scan(data, table, accept, start_state)
