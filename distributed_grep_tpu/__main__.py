@@ -315,7 +315,8 @@ def cmd_grep(args: argparse.Namespace) -> int:
     # per-file counters so a match-dense job keeps flat RSS (the reduce
     # side already spills to disk; collation must not un-do that).
     need_sets = bool(
-        args.only_matching or ctx_before or ctx_after or args.byte_offset
+        ctx_before or ctx_after or args.byte_offset
+        or (args.only_matching and args.max_count is not None)
     )
     matched: dict[str, set[int]] | None = None
     counts: dict[str, int] = {f: 0 for f in cfg.input_files}
@@ -501,7 +502,8 @@ def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
     try:
         for key, value in res.iter_results_sorted():
             m = GREP_KEY_RE.match(key)
-            if m and int(m.group(2)) not in matched.get(m.group(1), ()):
+            if m and matched is not None and \
+                    int(m.group(2)) not in matched.get(m.group(1), ()):
                 continue  # line dropped by the -m cap
             prefix = ""
             line_off = None
