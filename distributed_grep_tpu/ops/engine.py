@@ -102,6 +102,9 @@ class GrepEngine:
         # across the mesh and the SAME Pallas kernels run per device under
         # shard_map with a psum'd candidate count (parallel/sharded_kernels)
         mesh_axis: object = "data",
+        pattern_axis: object = None,  # FDR mode on a 2D mesh: shard
+        # same-plan filter banks over this axis (EP — tables are the
+        # sharded operand) while lanes shard over mesh_axis
         interpret: bool = False,  # force Pallas interpret mode (CI mesh tests)
         target_lanes: int = 1024,
         segment_bytes: int = 64 * 1024 * 1024,
@@ -116,6 +119,7 @@ class GrepEngine:
         self.devices = devices
         self.mesh = mesh
         self.mesh_axis = mesh_axis
+        self.pattern_axis = pattern_axis
         self._interpret = interpret
         if mesh is not None and devices is not None:
             raise ValueError("mesh and devices are mutually exclusive")
@@ -759,6 +763,16 @@ class GrepEngine:
 
             mesh_mult = shk.mesh_lane_multiple(self.mesh, self.mesh_axis)
             psum_totals: list = []
+        ep_axis = self.pattern_axis
+        if use_mesh and use_fdr and ep_axis is not None:
+            from distributed_grep_tpu.ops import pallas_fdr as _pfdr
+
+            if len({(b.m, _pfdr.kernel_plan(b)) for b in self.fdr.banks}) != 1:
+                log.info(
+                    "mixed-plan FDR banks: pattern-parallel sharding "
+                    "unavailable — lanes shard over the full mesh instead"
+                )
+                ep_axis = None
 
         # Scan-local NFA model state: the defeat guard below may swap the
         # relaxed filter for the exact automaton mid-scan (this scan only).
@@ -989,7 +1003,18 @@ class GrepEngine:
                 short_offsets = None
                 with ctx:
                     if use_fdr:
-                        if use_mesh:
+                        if use_mesh and ep_axis is not None:
+                            # EP: same-plan banks shard their tables over
+                            # pattern_axis, lanes over mesh_axis
+                            words, pt = shk.sharded_fdr_pattern_step(
+                                arr, self.fdr, self.mesh,
+                                data_axis=self.mesh_axis,
+                                pattern_axis=ep_axis,
+                                interpret=interp_flag,
+                                fold_case=self.ignore_case,
+                            )
+                            psum_totals.append(pt)
+                        elif use_mesh:
                             words, pt = shk.sharded_fdr_words(
                                 arr, self.fdr, self.mesh, self.mesh_axis,
                                 interpret=interp_flag,

@@ -402,3 +402,31 @@ def test_sharded_approx_bit_identical_and_engine_mesh(mesh8):
     }
     assert set(res.matched_lines.tolist()) == want
     assert eng.stats.get("psum_candidates", 0) >= 1
+
+
+def test_engine_pattern_axis_ep_exact():
+    """GrepEngine(mesh=2D, pattern_axis=...): same-plan FDR banks shard
+    over the pattern axis inside the engine — exact output, psum recorded;
+    mixed-plan models silently keep the lane-sharded step."""
+    from distributed_grep_tpu.models.fdr import FdrModel, compile_fdr
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(9)
+    pats = sorted({
+        bytes(rng.choice(list(b"abcdefghijklmnop"), size=6).tolist())
+        for _ in range(400)
+    })
+    data = make_text(700, inject=[(11, b"xx " + pats[5]), (600, pats[17])])
+    expected = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+        if any(p in ln for p in pats)
+    }
+    mesh = make_mesh((4, 2), ("data", "seq"))
+    eng = GrepEngine(
+        patterns=[p.decode() for p in pats],
+        mesh=mesh, mesh_axis="data", pattern_axis="seq", interpret=True,
+    )
+    assert eng.mode == "fdr"
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == expected
+    assert eng.stats.get("psum_candidates", 0) >= 1
