@@ -143,6 +143,7 @@ class GrepEngine:
         self._fdr_dev_tables: dict | None = None  # device -> reach tables
         self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
+        self._pallas_broken = False  # any Pallas kernel failed at runtime
         self._nfa_filter = False  # Glushkov model is a candidate superset
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
@@ -489,12 +490,14 @@ class GrepEngine:
         if self.mode == "nfa" and not self.tables:
             # DFA-less rescue (expansion-cap bounded repeats): the only
             # device engine is the Pallas NFA filter — without it (no TPU,
-            # over budget) there are no DFA banks to fall back on, so the
-            # scan is the per-line re loop, like the un-rescued mode.
+            # over budget, broken at runtime) there are no DFA banks to
+            # fall back on, so the scan is the per-line re loop, like the
+            # un-rescued mode.
             from distributed_grep_tpu.ops import pallas_nfa, pallas_scan
 
             if not (
                 (pallas_scan.available() or self._interpret)
+                and not self._pallas_broken
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._scan_re(data)
@@ -694,7 +697,10 @@ class GrepEngine:
         # the CI mesh (8 virtual CPU devices) exercises the production
         # kernel path — the same gates a real TPU run takes.  The flag is
         # passed to every kernel call below (None = wrapper auto-detect).
-        pallas_ok = pallas_scan.available() or self._interpret
+        pallas_ok = (
+            (pallas_scan.available() or self._interpret)
+            and not self._pallas_broken
+        )
         interp_flag = True if self._interpret else None
         use_pallas_sa = (
             self.mode == "shift_and"
@@ -1134,6 +1140,17 @@ class GrepEngine:
             if isinstance(e, (MemoryError, UnicodeError)):
                 raise
             if not use_fdr:
+                if use_pallas and not self._pallas_broken:
+                    # same policy as the FDR net: a Mosaic/runtime kernel
+                    # failure flips this engine to its non-Pallas engine
+                    # (XLA scan / DFA banks / re) and rescans — exactness
+                    # is preserved, speed degrades loudly.
+                    log.warning(
+                        "pallas %s kernel failed (%s) -> non-Pallas fallback",
+                        self.mode, e,
+                    )
+                    self._pallas_broken = True
+                    return self.scan(data)
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
             self._fdr_broken = True

@@ -619,3 +619,27 @@ def test_n_matches_equals_matched_lines_across_modes():
         assert res.n_matches == expected, name
     # occurrence telemetry still available where computed exactly
     assert engines["native"].stats["end_offsets"] == data.count(b"needle")
+
+
+def test_pallas_kernel_failure_falls_back(monkeypatch):
+    """A runtime Pallas kernel failure in a non-FDR mode must flip the
+    engine to its non-Pallas fallback and rescan exactly (round 3 — the
+    net used to protect only FDR)."""
+    from distributed_grep_tpu.ops import pallas_scan
+
+    data = make_text(300, inject=[(3, b"a needle"), (200, b"needle b")])
+    expected = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if b"needle" in ln
+    }
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic Mosaic failure")
+
+    monkeypatch.setattr(pallas_scan, "shift_and_scan_words", boom)
+    eng = GrepEngine("needle", interpret=True)
+    assert eng.mode == "shift_and"
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == expected
+    assert eng._pallas_broken  # flipped; later scans skip the kernel
+    res2 = eng.scan(data)
+    assert set(res2.matched_lines.tolist()) == expected
