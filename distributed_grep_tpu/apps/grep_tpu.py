@@ -35,6 +35,11 @@ def configure(
     word_regexp: bool = False,
     line_regexp: bool = False,
     devices: object = "all",  # worker drives every local chip by default
+    mesh_shape: object = None,  # e.g. [4, 2]: shard each segment's lanes
+    # over a device mesh instead of round-robining segments (JSON-friendly
+    # mirror of JobConfig.mesh_shape — the long-context configuration)
+    mesh_axes: object = ("data",),
+    pattern_axis: object = None,  # with a 2D mesh: EP-shard FDR banks
     **engine_opts: object,
 ) -> None:
     global _engine, _invert, _confirm, _configured_with
@@ -42,7 +47,19 @@ def configure(
         pattern = pattern.decode("utf-8", "surrogateescape")
     _invert = bool(invert)
     mode = "line" if line_regexp else ("word" if word_regexp else "search")
-    if backend == "device":
+    if backend == "device" and mesh_shape:
+        from distributed_grep_tpu.parallel.mesh import make_mesh
+
+        axes = tuple(mesh_axes)
+        engine_opts["mesh"] = make_mesh(tuple(mesh_shape), axes)
+        # lanes shard over every axis not reserved for pattern banks
+        lane_axes = tuple(a for a in axes if a != pattern_axis)
+        engine_opts["mesh_axis"] = (
+            lane_axes[0] if len(lane_axes) == 1 else lane_axes
+        )
+        if pattern_axis is not None:
+            engine_opts["pattern_axis"] = pattern_axis
+    elif backend == "device":
         engine_opts["devices"] = devices
     key = (pattern, ignore_case, backend, tuple(patterns or ()), _invert, mode,
            tuple(sorted(engine_opts.items())))

@@ -57,3 +57,29 @@ def test_full_job_with_tpu_app(tmp_path, corpus):
     res_cpu = run_job(cfg2, n_workers=2)
     assert res_tpu.results == res_cpu.results
     assert res_tpu.results  # non-empty
+
+
+def test_app_mesh_shape_option(tmp_path):
+    """mesh_shape/mesh_axes/pattern_axis flow from app_options through
+    configure into the engine's mesh mode — a full job on the virtual mesh
+    stays exact (wires the JobConfig.mesh_shape knob end-to-end)."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    f = tmp_path / "in.txt"
+    f.write_text("hay\nxx needle yy\nzz\nneedle end\nnothing\n")
+    cfg = JobConfig(
+        input_files=[str(f)],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={
+            "pattern": "needle",
+            "mesh_shape": [4, 2],
+            "mesh_axes": ["data", "seq"],
+            "interpret": True,
+        },
+        n_reduce=2,
+        work_dir=str(tmp_path / "w"),
+    )
+    res = run_job(cfg, n_workers=2)
+    keys = sorted(res.results)
+    assert [k.rsplit("#", 1)[1].rstrip(")") for k in keys] == ["2", "4"]
