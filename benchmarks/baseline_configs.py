@@ -26,6 +26,16 @@ import argparse
 import json
 import re
 import sys
+from pathlib import Path
+
+# runnable as `python benchmarks/...` / `python bench.py` from anywhere:
+# the repo root (this file's parent[s]) joins sys.path if the package
+# isn't already importable
+_root = Path(__file__).resolve().parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+elif (_root.parent / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root.parent))
 import time
 
 import numpy as np
