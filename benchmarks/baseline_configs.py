@@ -28,14 +28,17 @@ import re
 import sys
 from pathlib import Path
 
-# runnable as `python benchmarks/...` / `python bench.py` from anywhere:
-# the repo root (this file's parent[s]) joins sys.path if the package
-# isn't already importable
-_root = Path(__file__).resolve().parent
-if (_root / "distributed_grep_tpu").is_dir():
+# Runnable as `python benchmarks/...` / `python bench.py` from anywhere:
+# join the repo root to sys.path when the package isn't already
+# importable.  (Repeated per script by necessity — a shared helper could
+# not be imported before the path is fixed.)
+import importlib.util as _ilu
+
+if _ilu.find_spec("distributed_grep_tpu") is None:
+    _root = Path(__file__).resolve().parent
+    if not (_root / "distributed_grep_tpu").is_dir():
+        _root = _root.parent
     sys.path.insert(0, str(_root))
-elif (_root.parent / "distributed_grep_tpu").is_dir():
-    sys.path.insert(0, str(_root.parent))
 import time
 
 import numpy as np

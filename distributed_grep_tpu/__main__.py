@@ -192,14 +192,21 @@ def cmd_grep(args: argparse.Namespace) -> int:
             return 2  # nothing searchable, like grep
     import fnmatch
 
+    filters = getattr(args, "glob_filters", None) or []
+
     def _included(name: str) -> bool:
         # GNU applies --include/--exclude to explicitly listed files too
-        # (with or without -r), and --exclude wins — probed against grep 3.8
-        if args.exclude and any(fnmatch.fnmatch(name, g) for g in args.exclude):
-            return False
-        return not args.include or any(
-            fnmatch.fnmatch(name, g) for g in args.include
-        )
+        # (with or without -r), and treats them as ONE ordered list: the
+        # LAST glob matching the basename decides; a file matching no glob
+        # defaults to included iff the list starts with an exclude (or is
+        # empty) — probed against grep 3.8 (tests/test_fuzz_cli.py)
+        decision = None
+        for kind, g in filters:
+            if fnmatch.fnmatch(name, g):
+                decision = kind
+        if decision is None:
+            return not filters or filters[0][0] == "exclude"
+        return decision == "include"
 
     if args.recursive:
         expanded: list[str] = []
@@ -622,6 +629,17 @@ def cmd_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+class _GlobFilterAction(argparse.Action):
+    """--include/--exclude share one ORDERED filter list (GNU grep decides
+    by the last matching glob, so relative option order is semantic)."""
+
+    def __call__(self, parser, namespace, value, option_string=None):
+        lst = getattr(namespace, "glob_filters", None) or []
+        kind = "include" if "include" in option_string else "exclude"
+        lst.append((kind, value))
+        namespace.glob_filters = lst
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="distributed_grep_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -685,13 +703,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-s", "--no-messages", action="store_true",
                    help="suppress messages about missing/unreadable files "
                         "(grep -s)")
-    p.add_argument("--include", action="append", default=None, metavar="GLOB",
+    p.add_argument("--include", action=_GlobFilterAction, dest="glob_filters",
+                   default=None, metavar="GLOB",
                    help="search only files whose basename matches GLOB "
-                        "(repeatable; applies to explicit files too, like "
-                        "GNU grep)")
-    p.add_argument("--exclude", action="append", default=None, metavar="GLOB",
+                        "(repeatable; applies to explicit files too; ordered "
+                        "with --exclude, last matching glob wins, like GNU "
+                        "grep)")
+    p.add_argument("--exclude", action=_GlobFilterAction, dest="glob_filters",
+                   default=None, metavar="GLOB",
                    help="skip files whose basename matches GLOB (repeatable; "
-                        "takes priority over --include, like GNU grep)")
+                        "ordered with --include, last matching glob wins, "
+                        "like GNU grep)")
     _add_common(p)
     p.set_defaults(fn=cmd_grep)
 

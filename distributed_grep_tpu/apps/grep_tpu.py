@@ -54,6 +54,11 @@ def configure(
         engine_opts["mesh"] = make_mesh(tuple(mesh_shape), axes)
         # lanes shard over every axis not reserved for pattern banks
         lane_axes = tuple(a for a in axes if a != pattern_axis)
+        if not lane_axes:
+            raise ValueError(
+                f"pattern_axis {pattern_axis!r} consumes every mesh axis "
+                f"{axes}: no axis left for document lanes"
+            )
         engine_opts["mesh_axis"] = (
             lane_axes[0] if len(lane_axes) == 1 else lane_axes
         )

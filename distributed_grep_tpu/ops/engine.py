@@ -123,6 +123,25 @@ class GrepEngine:
         self._interpret = interpret
         if mesh is not None and devices is not None:
             raise ValueError("mesh and devices are mutually exclusive")
+        if mesh is not None:
+            # fail at construction, not inside the scan's kernel-failure
+            # net (a bad axis name there would masquerade as a Mosaic
+            # failure and silently demote the engine to its slow path)
+            known = set(mesh.shape)
+            lane_axes = (
+                (mesh_axis,) if isinstance(mesh_axis, str) else tuple(mesh_axis)
+            )
+            if not lane_axes or not set(lane_axes) <= known:
+                raise ValueError(
+                    f"mesh_axis {mesh_axis!r} not in mesh axes {sorted(known)}"
+                )
+            if pattern_axis is not None and (
+                pattern_axis not in known or pattern_axis in lane_axes
+            ):
+                raise ValueError(
+                    f"pattern_axis {pattern_axis!r} must name a mesh axis "
+                    f"outside mesh_axis {lane_axes}"
+                )
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
         self.ignore_case = ignore_case
@@ -564,6 +583,8 @@ class GrepEngine:
 
     # ---------------------------------------------------------- host engines
     def _scan_re(self, data: bytes) -> ScanResult:
+        self.stats = {}  # no device/telemetry legs on the re loop; also
+        # clears a failed Pallas attempt's partial counters on rescan
         matched = []
         lines = data.split(b"\n")
         if lines and lines[-1] == b"":

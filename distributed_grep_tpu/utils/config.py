@@ -61,6 +61,12 @@ class JobConfig:
             raise ValueError(f"n_reduce must be positive, got {self.n_reduce}")
         self.mesh_shape = tuple(self.mesh_shape)
         self.mesh_axes = tuple(self.mesh_axes)
+        # The mesh knobs reach the application through its configure()
+        # options (apps/grep_tpu.py builds the engine mesh from them);
+        # explicit app_options win over the top-level fields.
+        if self.mesh_shape:
+            self.app_options.setdefault("mesh_shape", list(self.mesh_shape))
+            self.app_options.setdefault("mesh_axes", list(self.mesh_axes))
 
     # --- (De)serialization -------------------------------------------------
     def to_json(self) -> str:

@@ -285,3 +285,30 @@ def test_exclude_glob_matches_gnu(tmp_path, capsys):
                           "foo", str(tmp_path)])
     assert out == gout == []
     assert rc == grc == 1
+
+
+def test_include_exclude_order_semantics(tmp_path, capsys):
+    """GNU treats --include/--exclude as one ordered list: the LAST
+    matching glob decides, and unmatched files default to included iff the
+    list starts with an exclude — probed grep 3.8 semantics."""
+    c = tmp_path / "a.c"
+    c.write_text("foo\n")
+    t = tmp_path / "a.txt"
+    t.write_text("foo\n")
+    cases = [
+        ["--exclude", "*.txt", "--include", "*.txt"],  # include wins on .txt;
+                                                       # unmatched .c default-in
+        ["--include", "*.txt", "--exclude", "*.txt"],  # exclude wins; .c
+                                                       # default-out
+        ["--exclude", "*.c", "--include", "a.*"],      # both match include last
+        ["--include", "a.*", "--exclude", "*.c"],      # .c excluded last
+    ]
+    for flags in cases:
+        rc, out = _run_ours(["grep", "-r", "foo", str(tmp_path), *flags],
+                            capsys)
+        grc, gout = _run_gnu(["-r", "-n", *flags, "foo", str(tmp_path)])
+        assert sorted(out) == sorted(
+            f"{p} (line number #{ln}) {txt}"
+            for p, ln, txt in _parse_gnu(gout, [str(c), str(t)], 2)
+        ), flags
+        assert rc == grc, flags

@@ -71,15 +71,13 @@ def test_app_mesh_shape_option(tmp_path):
     cfg = JobConfig(
         input_files=[str(f)],
         application="distributed_grep_tpu.apps.grep_tpu",
-        app_options={
-            "pattern": "needle",
-            "mesh_shape": [4, 2],
-            "mesh_axes": ["data", "seq"],
-            "interpret": True,
-        },
+        app_options={"pattern": "needle", "interpret": True},
+        mesh_shape=(4, 2),
+        mesh_axes=("data", "seq"),
         n_reduce=2,
         work_dir=str(tmp_path / "w"),
     )
+    assert cfg.app_options["mesh_shape"] == [4, 2]  # post_init wiring
     res = run_job(cfg, n_workers=2)
     keys = sorted(res.results)
     assert [k.rsplit("#", 1)[1].rstrip(")") for k in keys] == ["2", "4"]

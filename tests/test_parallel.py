@@ -430,3 +430,19 @@ def test_engine_pattern_axis_ep_exact():
     res = eng.scan(data)
     assert set(res.matched_lines.tolist()) == expected
     assert eng.stats.get("psum_candidates", 0) >= 1
+
+
+def test_engine_mesh_axis_validation(mesh8):
+    """Bad axis names fail at construction, not inside the scan's
+    kernel-failure net (which would demote the engine silently)."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    with pytest.raises(ValueError, match="mesh_axis"):
+        GrepEngine("needle", mesh=mesh8, mesh_axis="bogus")
+    mesh2d = make_mesh((4, 2), ("data", "seq"))
+    with pytest.raises(ValueError, match="pattern_axis"):
+        GrepEngine(patterns=["aa", "bb"], mesh=mesh2d, mesh_axis="data",
+                   pattern_axis="typo")
+    with pytest.raises(ValueError, match="pattern_axis"):
+        GrepEngine(patterns=["aa", "bb"], mesh=mesh2d,
+                   mesh_axis=("data", "seq"), pattern_axis="seq")
