@@ -423,3 +423,74 @@ def test_fuzz_pallas_approx_every_seed(seed):
                      target_lanes=4096, segment_bytes=1 << 20)
     got = set(eng.scan(data).matched_lines.tolist())
     assert got == want, f"seed={seed} pattern={pattern!r} k={k} mode={eng.mode}"
+
+
+# ----------------------- bounded-repeat relaxation fuzz (round 3)
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_bounded_repeat_relaxation(seed):
+    """Patterns with large {m,n} repeats — the shapes that trigger the
+    word-saving filter relaxation (and, past the 512-copy cap, the
+    DFA-less rescue).  Engine output must stay exactly re's on both
+    backends and on the interpret-Pallas path."""
+    rng = np.random.default_rng(16000 + seed)
+    cls = ["[ab]", "[a-f]", "[a-z0-9]", "x", "[^q]"][int(rng.integers(0, 5))]
+    lo = int(rng.integers(0, 12))
+    hi = lo + int(rng.integers(8, 120)) if seed % 3 else lo + int(rng.integers(300, 700))
+    head = _gen_literal(rng, int(rng.integers(1, 4)))
+    tail = _gen_literal(rng, int(rng.integers(1, 4)))
+    pattern = f"{head}{cls}{{{lo},{hi}}}{tail}"
+    rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
+    # corpus: random lines plus injected exact matches, over-bound runs
+    # (false candidates for the relaxed filter), and under-bound runs
+    import re as _re_mod
+
+    inner = {"[ab]": b"ab", "[a-f]": b"cd", "[a-z0-9]": b"m3",
+             "x": b"xx", "[^q]": b"zx"}[cls]
+    fill = (inner * ((hi + 2) // 2))
+    h = head.encode().replace(b"\\", b"")
+    t = tail.encode().replace(b"\\", b"")
+    injections = [
+        h + fill[: max(lo, 1)] + t,              # near the low bound
+        h + fill[: (lo + min(hi, lo + 20)) // 2] + t,  # mid
+    ]
+    if hi + 40 <= 256:  # _gen_corpus injects near line ends; keep it short
+        injections.append(h + fill[: hi + 40] + t)  # over the bound
+    data = _gen_corpus(rng, "words", 24 << 10, injections)
+    want = _oracle_lines(rx, data)
+    for kw in ({"backend": "device"}, {"backend": "cpu"}, {"interpret": True}):
+        eng = GrepEngine(pattern, **kw)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == want, (
+            f"seed={seed} {kw} mode={eng.mode} filt={eng._nfa_filter} "
+            f"pattern={pattern!r}: +{sorted(got - want)[:4]} "
+            f"-{sorted(want - got)[:4]}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_fuzz_filter_superset_invariant(seed):
+    """Property: the relaxed scan model's match offsets are a SUPERSET of
+    the exact automaton's, on random repeat-bearing patterns (the
+    soundness invariant behind the cand_words confirm path)."""
+    from distributed_grep_tpu.models import nfa as nfa_mod
+
+    rng = np.random.default_rng(17000 + seed)
+    # unanchored body (an appended repeat would make a drawn anchor
+    # mid-pattern) + a bounded repeat so relaxation has work to do
+    k = int(rng.integers(1, 4))
+    pattern = "".join(_gen_piece(rng, 1) for _ in range(k))
+    pattern += ["a{2,40}", "[ab]{3,50}b", "(ab){2,30}"][int(rng.integers(0, 3))]
+    from distributed_grep_tpu.models.dfa import RegexError
+
+    try:
+        exact = nfa_mod.try_compile_glushkov(pattern)
+        model, is_filter = nfa_mod.compile_scan_model(pattern)
+    except RegexError:
+        pytest.skip("appended repeat made a drawn anchor mid-pattern")
+    if exact is None or model is None or not is_filter:
+        pytest.skip("no exact/filter pair for this draw")
+    data = _gen_corpus(rng, "words", 16 << 10, [])
+    ex = set(nfa_mod.scan_reference(exact, data).tolist())
+    fi = set(nfa_mod.scan_reference(model, data).tolist())
+    assert ex <= fi, f"seed={seed} pattern={pattern!r} missing {sorted(ex - fi)[:5]}"
