@@ -16,15 +16,14 @@ import sys
 from pathlib import Path
 
 # Runnable as `python benchmarks/...` / `python bench.py` from anywhere:
-# join the repo root to sys.path when the package isn't already
-# importable.  (Repeated per script by necessity — a shared helper could
-# not be imported before the path is fixed.)
-import importlib.util as _ilu
-
-if _ilu.find_spec("distributed_grep_tpu") is None:
-    _root = Path(__file__).resolve().parent
-    if not (_root / "distributed_grep_tpu").is_dir():
-        _root = _root.parent
+# the repo root joins the FRONT of sys.path unconditionally, so the
+# checkout being benchmarked always wins over any installed copy of the
+# package.  (Repeated per script by necessity — a shared helper could not
+# be imported before the path is fixed.)
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
     sys.path.insert(0, str(_root))
 
 import numpy as np

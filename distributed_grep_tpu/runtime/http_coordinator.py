@@ -80,7 +80,7 @@ class CoordinatorServer:
             n_reduce=config.n_reduce,
             task_timeout_s=config.task_timeout_s,
             sweep_interval_s=config.sweep_interval_s,
-            app_options=config.app_options,
+            app_options=config.effective_app_options(),
             journal=journal,
             resume_entries=resume_entries,
             metrics=self.metrics,

@@ -131,7 +131,7 @@ def run_job(
 ) -> JobResult:
     workdir = WorkDir(config.work_dir)
     if app is None:
-        app = load_application(config.application, **config.app_options)
+        app = load_application(config.application, **config.effective_app_options())
 
     journal = None
     resume_entries = None
@@ -153,7 +153,7 @@ def run_job(
         n_reduce=config.n_reduce,
         task_timeout_s=config.task_timeout_s,
         sweep_interval_s=config.sweep_interval_s,
-        app_options=config.app_options,
+        app_options=config.effective_app_options(),
         journal=journal,
         resume_entries=resume_entries,
         metrics=metrics,

@@ -61,12 +61,18 @@ class JobConfig:
             raise ValueError(f"n_reduce must be positive, got {self.n_reduce}")
         self.mesh_shape = tuple(self.mesh_shape)
         self.mesh_axes = tuple(self.mesh_axes)
-        # The mesh knobs reach the application through its configure()
-        # options (apps/grep_tpu.py builds the engine mesh from them);
-        # explicit app_options win over the top-level fields.
+
+    def effective_app_options(self) -> dict:
+        """app_options with the top-level mesh knobs merged in (explicit
+        app_options win) — the options the runtime actually hands to the
+        application's configure() (apps/grep_tpu.py builds its engine mesh
+        from them).  Computed at call time on a fresh dict, so later edits
+        to the mesh fields are honored and configs never alias options."""
+        out = dict(self.app_options)
         if self.mesh_shape:
-            self.app_options.setdefault("mesh_shape", list(self.mesh_shape))
-            self.app_options.setdefault("mesh_axes", list(self.mesh_axes))
+            out.setdefault("mesh_shape", list(self.mesh_shape))
+            out.setdefault("mesh_axes", list(self.mesh_axes))
+        return out
 
     # --- (De)serialization -------------------------------------------------
     def to_json(self) -> str:

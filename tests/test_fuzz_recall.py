@@ -443,8 +443,6 @@ def test_fuzz_bounded_repeat_relaxation(seed):
     rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
     # corpus: random lines plus injected exact matches, over-bound runs
     # (false candidates for the relaxed filter), and under-bound runs
-    import re as _re_mod
-
     inner = {"[ab]": b"ab", "[a-f]": b"cd", "[a-z0-9]": b"m3",
              "x": b"xx", "[^q]": b"zx"}[cls]
     fill = (inner * ((hi + 2) // 2))

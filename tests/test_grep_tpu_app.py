@@ -77,7 +77,7 @@ def test_app_mesh_shape_option(tmp_path):
         n_reduce=2,
         work_dir=str(tmp_path / "w"),
     )
-    assert cfg.app_options["mesh_shape"] == [4, 2]  # post_init wiring
+    assert cfg.effective_app_options()["mesh_shape"] == [4, 2]
     res = run_job(cfg, n_workers=2)
     keys = sorted(res.results)
     assert [k.rsplit("#", 1)[1].rstrip(")") for k in keys] == ["2", "4"]
