@@ -458,3 +458,44 @@ def test_post_scan_retune_from_measured_stats():
     }
     eng2._maybe_retune_fdr(n_bytes)
     assert [(b.m, b.checks) for b in eng2.fdr.banks] == plan2
+
+
+def test_scan_stays_exact_after_retune_swap():
+    """After the stage-2 retune swaps the FDR plan, the next scan must
+    re-upload the new bank tables and stay exact (pins the
+    _fdr_dev_tables reset path)."""
+    import os
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(12)
+    alphabet = list(b"abcdefghijklmnopqrstuvwxyz0123456789")
+    pats = sorted({
+        bytes(rng.choice(alphabet, size=int(rng.integers(5, 9))).tolist())
+        for _ in range(2000)
+    })
+    eng = GrepEngine(patterns=[p.decode() for p in pats], interpret=True)
+    assert eng.mode == "fdr"
+    plan0 = [(b.m, b.checks) for b in eng.fdr.banks]
+
+    n_bytes = 64 * 1024 * 1024
+    fake = int(eng.fdr.fp_per_byte * 20 * n_bytes)
+    eng.stats = {"candidates": fake, "confirm_seconds": fake * 400e-9}
+    eng._maybe_retune_fdr(n_bytes)
+    assert eng._fdr_retuned
+    assert [(b.m, b.checks) for b in eng.fdr.banks] != plan0  # plan swapped
+    assert eng._fdr_dev_tables is None  # tables re-upload lazily
+
+    lines = []
+    for i in range(400):
+        n = int(rng.integers(0, 50))
+        lines.append(bytes(rng.choice(alphabet + [32], size=n).tolist()))
+        if i % 37 == 3:
+            lines[-1] = b"xx " + pats[int(rng.integers(0, len(pats)))] + b" yy"
+    data = b"\n".join(lines) + b"\n"
+    expected = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+        if any(p in ln for p in pats)
+    }
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == expected
