@@ -443,3 +443,50 @@ def test_http_worker_slots_parallel(tmp_path, corpus):
     t.join(timeout=15.0)
     assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
     server.shutdown(linger_s=0.1)
+
+
+def test_multiprocess_device_backend_mesh_job(tmp_path, corpus):
+    """Real worker processes running the DEVICE engine (interpret-mode
+    Pallas kernels on an 8-virtual-device mesh) under the HTTP runtime —
+    the full distributed TPU-path wiring: config -> worker jax init ->
+    engine mesh mode -> exact collated output."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        app_options={"pattern": "hello", "backend": "device",
+                     "interpret": True},
+        mesh_shape=(4, 2),
+        mesh_axes=("data", "seq"),
+        n_reduce=2,
+        work_dir=str(tmp_path / "job"),
+        coordinator_port=port,
+        task_timeout_s=60.0,  # first interpret compile in the worker is slow
+    )
+    cfg_path = tmp_path / "job.json"
+    cfg_path.write_text(cfg.to_json())
+    repo = str(Path(__file__).resolve().parents[1])
+    env = {"PYTHONPATH": repo, "PATH": "/usr/bin:/bin", "DGREP_LOG": "WARNING",
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "distributed_grep_tpu", "coordinator",
+         "--config", str(cfg_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+    )
+    worker = None
+    try:
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "distributed_grep_tpu", "worker",
+             "--addr", f"127.0.0.1:{port}"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        )
+        out, err = coord.communicate(timeout=180)
+        assert coord.returncode == 0, f"coordinator failed: {err[-2000:]}"
+        assert output_lines(tmp_path / "job") == expected_grep_lines(corpus)
+        worker.wait(timeout=30)
+    finally:
+        for p in [coord, worker]:
+            if p is not None and p.poll() is None:
+                p.kill()
