@@ -55,6 +55,8 @@ def make_corpus(n: int) -> bytes:
 
 
 def bench_tpu(data: bytes) -> float:
+    import statistics
+
     from distributed_grep_tpu.models.shift_and import try_compile_shift_and
     from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
 
@@ -64,16 +66,25 @@ def bench_tpu(data: bytes) -> float:
     # Odd windows drop each stripe's first 512 bytes, losing ~512/chunk of
     # the 1000 planted needles, hence the count band below.
     dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, model)
-    # The tunneled device adds ~100 ms of run-to-run jitter; short chains
-    # produce 120-190 GB/s draws for the same kernel.  Longer chains +
-    # median of 3 timed sections (one compile; utils/slope measurements=3).
-    per_pass, per_count = slope_per_pass(
-        dev, chunk, pad_rows, scan, r1=8, r2=40, count_range=(900, 1100),
-        measurements=3,
-    )
+    # The tunneled device adds ~100 ms of run-to-run jitter.  Two defenses
+    # (VERDICT r3 item 5 — BENCH_r03 underquoted the measured kernel 28%):
+    # chains long enough that the rep delta dominates the jitter (r2=104 is
+    # ~105 ms of extra chain at 234 GB/s, vs ~35 ms at the old r2=40), and
+    # the median of 3 INDEPENDENT slope draws (each itself a median of 3
+    # timed sections) — one compile, so draws 2-3 cost only their run time.
+    draws = []
+    for i in range(3):
+        per_pass, per_count = slope_per_pass(
+            dev, chunk, pad_rows, scan, r1=8, r2=104, count_range=(900, 1100),
+            measurements=3,
+        )
+        print(f"bench: draw {i}: {len(data)/1e9/per_pass:.2f} GB/s "
+              f"({per_pass*1e3:.2f} ms/pass, {per_count:.0f} matches/pass)",
+              file=sys.stderr)
+        draws.append(per_pass)
+    per_pass = statistics.median(draws)
     print(f"bench: tpu pallas shift-and {len(data)/1e9/per_pass:.2f} GB/s "
-          f"({per_pass*1e3:.1f} ms/pass, {per_count:.0f} matches/pass)",
-          file=sys.stderr)
+          f"(median of {len(draws)} slope draws)", file=sys.stderr)
     return len(data) / 1e9 / per_pass
 
 
