@@ -136,12 +136,23 @@ class Pricing:
     confirm_threads: int
     fp_bias: float  # measured/analytic candidate-rate ratio
     overlap_residue: float
+    # Active chips sharing this host's confirm thread fan (VERDICT r3 item
+    # 1).  The scan leg scales with chips (each chip scans its own byte
+    # stream / lane shard) while the confirm stream rides ONE host's
+    # threads, so per scanned byte the confirm leg costs n_chips/threads —
+    # on a 4-chip host a plan whose confirm hid behind the scan at 8
+    # threads stops hiding at the 2-thread-per-chip share, and the tuner
+    # should buy more device gathers instead.
+    n_chips: int = 1
 
     def confirm_wall_ps(self, fp_per_byte: float) -> float:
-        """Expected per-byte confirm wall given an analytic fp rate."""
+        """Expected per-byte confirm wall given an analytic fp rate,
+        relative to one chip's scan timeline (threads are shared across
+        the host's active chips)."""
         return (
             fp_per_byte * self.fp_bias
-            * self.confirm_ps_per_candidate / self.confirm_threads
+            * self.confirm_ps_per_candidate
+            * self.n_chips / self.confirm_threads
         )
 
     def total_ps(self, scan_ps: float, fp_per_byte: float) -> float:
@@ -160,9 +171,12 @@ def default_pricing() -> Pricing:
     )
 
 
-def probe_confirm_ps(confirm_set, n: int = 1 << 15, seed: int = 0) -> float:
-    """Measured single-thread wall ps/candidate of THIS host's ConfirmSet
-    on synthetic random candidates (~ms; run once per engine init).
+def probe_confirm_ps(confirm_set, n: int = 1 << 15, seed: int = 0,
+                     n_threads: int = 1) -> float:
+    """Measured wall ps/candidate of THIS host's ConfirmSet at the given
+    thread fan on synthetic random candidates (~ms; run once per engine
+    init at n_threads=1; the post-scan retune probes again at the actual
+    fan to measure parallel efficiency instead of assuming ideal scaling).
 
     Random offsets under-represent the bloom-pass bias of real FDR
     candidates (~2x, see CONFIRM_PS_PER_CANDIDATE), so callers should gate
@@ -177,7 +191,7 @@ def probe_confirm_ps(confirm_set, n: int = 1 << 15, seed: int = 0) -> float:
     best = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        confirm_set.confirm(buf, ends, n_threads=1)
+        confirm_set.confirm(buf, ends, n_threads=n_threads)
         best = min(best, time.perf_counter() - t0)
     return best / n * 1e12
 

@@ -160,6 +160,7 @@ class GrepEngine:
         self.fdr: FdrModel | None = None
         self._fdr_short: list[DfaTable] = []
         self._fdr_dev_tables: dict | None = None  # device -> reach tables
+        self._fdr_ep_dev_tables = None  # stacked pattern-axis-sharded tables
         self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
         self._pallas_broken = False  # any Pallas kernel failed at runtime
@@ -255,10 +256,18 @@ class GrepEngine:
                 short_pats = [p for p in patterns if _blen(p) < 2]
                 if long_pats:
                     try:
-                        # a routed literal set was already compiled by the
-                        # decomposition probe (short_pats empty by its guard)
+                        # Chip-aware pricing (VERDICT r3 item 1): the host
+                        # confirm threads are shared across every chip this
+                        # engine drives, so the tuner prices the confirm leg
+                        # at the per-chip share from the start.  The routed
+                        # decomposition probe compiled at n_chips=1; recompile
+                        # it only when the chip count actually changes plans.
+                        base_pricing = self._fdr_base_pricing()
+                        if routed_fdr is not None and base_pricing.n_chips > 1:
+                            routed_fdr = None
                         self.fdr = routed_fdr or compile_fdr(
-                            long_pats, ignore_case=ignore_case
+                            long_pats, ignore_case=ignore_case,
+                            pricing=base_pricing,
                         )
                         if short_pats:
                             self._fdr_short = compile_aho_corasick_banks(
@@ -383,6 +392,49 @@ class GrepEngine:
             self.mode = "native"  # host C scanner, same tables
 
     # ------------------------------------------------- FDR self-calibration
+    def _active_chip_count(self) -> int:
+        """Chips whose scan streams share this host's confirm threads.
+
+        Mesh mode: every device in the lane axes (plus the EP pattern axis
+        when set — EP divides per-chip gather cost, so the scan leg scales
+        with the full product) scans concurrently against ONE host confirm
+        stream.  devices="all": every local chip round-robins segments.
+        The reference's analogue is the per-worker fan-out cost model
+        (coordinator.go:329-333) — one coordinator, many scanning workers."""
+        if self.mesh is not None:
+            axes = (
+                (self.mesh_axis,) if isinstance(self.mesh_axis, str)
+                else tuple(self.mesh_axis)
+            )
+            if self.pattern_axis is not None:
+                axes = axes + (self.pattern_axis,)
+            n = 1
+            for a in axes:
+                n *= int(self.mesh.shape[a])
+            return n
+        if self.devices == "all":
+            try:
+                import jax
+
+                return max(1, len(jax.local_devices()))
+            except Exception:  # noqa: BLE001 — no backend: single stream
+                return 1
+        if self.devices:
+            return max(1, len(list(self.devices)))
+        return 1
+
+    def _fdr_base_pricing(self):
+        """default_pricing() with this engine's active chip count."""
+        from dataclasses import replace as _replace
+
+        from distributed_grep_tpu.models.fdr import default_pricing
+
+        pricing = default_pricing()
+        n_chips = self._active_chip_count()
+        if n_chips > 1:
+            pricing = _replace(pricing, n_chips=n_chips)
+        return pricing
+
     def _calibrate_fdr_confirm(self) -> None:
         """Init-time probe: measure this host's single-thread ConfirmSet
         cost on synthetic candidates; if it is >4x off the priced constant
@@ -391,12 +443,9 @@ class GrepEngine:
         the wide gate — the post-scan retune handles fine constants."""
         from dataclasses import replace as _replace
 
-        from distributed_grep_tpu.models.fdr import (
-            default_pricing,
-            probe_confirm_ps,
-        )
+        from distributed_grep_tpu.models.fdr import probe_confirm_ps
 
-        self._fdr_pricing = default_pricing()
+        self._fdr_pricing = self._fdr_base_pricing()
         self._fdr_retuned = False
         if _os.environ.get("DGREP_NO_CALIBRATE"):
             return
@@ -444,6 +493,7 @@ class GrepEngine:
             )
             self.fdr = model
             self._fdr_dev_tables = None
+            self._fdr_ep_dev_tables = None
         self._fdr_pricing = pricing
 
     def _maybe_retune_fdr(self, n_bytes: int) -> None:
@@ -469,9 +519,24 @@ class GrepEngine:
         measured_bias = (cands / n_bytes) / max(self.fdr.fp_per_byte, 1e-12)
         # confirm_seconds is wall through the ACTUAL thread fan of this
         # host (min(8, cpu)); convert to the single-thread constant, keep
-        # pricing against the DECLARED deployment thread count.
+        # pricing against the DECLARED deployment thread count.  The
+        # memory-bound confirm scales sublinearly with threads, so ideal
+        # x actual_threads would overestimate the single-thread cost and
+        # bias the retune toward extra device gathers — measure the real
+        # speedup with a second ConfirmSet probe at the actual fan and use
+        # probe_1t/probe_Nt (== measured speedup <= N) as the factor.
         actual_threads = min(8, _os.cpu_count() or 1)
-        measured_ps = conf_s / cands * 1e12 * actual_threads
+        speedup = float(actual_threads)
+        probe_1t = getattr(self, "calibration", {}).get("confirm_probe_ps")
+        if actual_threads > 1 and probe_1t and self._fdr_confirm is not None:
+            from distributed_grep_tpu.models.fdr import probe_confirm_ps
+
+            probe_nt = probe_confirm_ps(
+                self._fdr_confirm, n_threads=actual_threads
+            )
+            if probe_nt > 0:
+                speedup = min(speedup, max(1.0, probe_1t / probe_nt))
+        measured_ps = conf_s / cands * 1e12 * speedup
         pr = self._fdr_pricing
         bias_off = measured_bias / pr.fp_bias
         ps_off = measured_ps / pr.confirm_ps_per_candidate
@@ -494,7 +559,11 @@ class GrepEngine:
         ))
 
     # ------------------------------------------------------------------ scan
-    def scan(self, data: bytes) -> ScanResult:
+    def scan(self, data: bytes, progress=None) -> ScanResult:
+        """Scan one in-memory document.  ``progress`` (optional, no-arg
+        callable) is invoked at segment milestones on the device path so a
+        runtime failure detector can keep a tight liveness window over
+        long scans (runtime/worker.py wires it to the heartbeat RPC)."""
         if self.mode == "re":
             return self._scan_re(data)
         if self._approx_all_lines or (
@@ -520,9 +589,10 @@ class GrepEngine:
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._scan_re(data)
-        return self._scan_device(data)
+        return self._scan_device(data, progress=progress)
 
-    def scan_file(self, path, chunk_bytes: int | None = None, emit=None) -> ScanResult:
+    def scan_file(self, path, chunk_bytes: int | None = None, emit=None,
+                  progress=None) -> ScanResult:
         """Stream a file of any size through the scanner: chunks are cut at
         newline boundaries (partial tail lines carry into the next chunk),
         so no line — and hence no grep match — ever spans a chunk, and host
@@ -536,20 +606,36 @@ class GrepEngine:
         a second pass.  Line numbers in the result are file-global.  A
         single line longer than chunk_bytes is accumulated whole (a line
         must fit in memory; grep semantics need the full line anyway).
+
+        Disk reads are pipelined (VERDICT r3 item 4): a one-slot reader
+        thread fetches chunk i+1 while chunk i scans — the same shape as
+        the device-feed double-buffer, one level up — so a disk-bound
+        corpus pays max(read, scan) per chunk instead of their sum.
+        Residual stall is recorded in stats["read_wait_seconds"] (~0 when
+        the scan hides the read); host memory stays bounded by TWO chunks.
         """
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
         chunk_target = chunk_bytes or max(self.segment_bytes, 1 << 26)
         matched: list[int] = []
         n_matches = 0
         total = 0
         end_offsets = 0  # summed across chunks (per-chunk stats reset)
+        read_wait = 0.0
         lines_before = 0
         carry = b""
-        with open(path, "rb") as f:
+        rpool = ThreadPoolExecutor(1)  # all reads run here, in file order
+        try:
+            f = open(path, "rb")
+            nxt = rpool.submit(f.read, chunk_target)
             while True:
-                block = f.read(chunk_target)
-                if not block:
-                    buf, carry, final = carry, b"", True
-                else:
+                t0 = _time.perf_counter()
+                block = nxt.result()
+                read_wait += _time.perf_counter() - t0
+                if block:
+                    # enqueue the NEXT read now; it overlaps this chunk's scan
+                    nxt = rpool.submit(f.read, chunk_target)
                     buf = carry + block
                     cut = buf.rfind(b"\n")
                     if cut < 0:
@@ -557,8 +643,10 @@ class GrepEngine:
                         continue
                     carry, buf = buf[cut + 1 :], buf[: cut + 1]
                     final = False
+                else:
+                    buf, carry, final = carry, b"", True
                 if buf:
-                    res = self.scan(buf)
+                    res = self.scan(buf, progress=progress)
                     total += len(buf)
                     n_matches += res.n_matches
                     end_offsets += self.stats.get("end_offsets", 0)
@@ -576,9 +664,19 @@ class GrepEngine:
                         lines_before += len(nl_idx) + (0 if buf.endswith(b"\n") else 1)
                     else:
                         lines_before += lines_mod.count_lines(buf)
+                    if progress is not None:
+                        progress()  # one work milestone per streamed chunk
                 if final:
                     break
+        finally:
+            # the in-flight read must not outlive the file handle
+            rpool.shutdown(wait=True, cancel_futures=True)
+            try:
+                f.close()
+            except NameError:
+                pass  # open() itself failed
         self.stats["end_offsets"] = end_offsets
+        self.stats["read_wait_seconds"] = read_wait
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
 
     # ---------------------------------------------------------- host engines
@@ -692,8 +790,20 @@ class GrepEngine:
             ]
         return self._fdr_dev_tables[dev]
 
+    def _fdr_ep_tables(self, pattern_axis):
+        """Stacked pattern-axis-sharded FDR tables, built + uploaded once
+        per plan (reset alongside _fdr_dev_tables on retune) — the EP
+        analogue of _fdr_device_tables."""
+        if self._fdr_ep_dev_tables is None:
+            from distributed_grep_tpu.parallel import sharded_kernels as shk
+
+            self._fdr_ep_dev_tables = shk.fdr_pattern_tables(
+                self.fdr, self.mesh, pattern_axis
+            )
+        return self._fdr_ep_dev_tables
+
     # --------------------------------------------------------- device engine
-    def _scan_device(self, data: bytes) -> ScanResult:
+    def _scan_device(self, data: bytes, progress=None) -> ScanResult:
         import time as _time
 
         t_wall0 = _time.perf_counter()
@@ -806,16 +916,38 @@ class GrepEngine:
         nfa_model = self.glushkov
         nfa_is_filter = self._nfa_filter
 
-        # job: (sparse_kind, payload, lay, seg_start, seg_len, short_offsets, dev)
-        pending: list[tuple] = []
+        # Collects run on a small pool so confirms from different devices'
+        # segments overlap each other AND the dispatch loop (VERDICT r3
+        # item 1: with devices="all" the scan leg scales xN chips while a
+        # dispatch-thread confirm stream doesn't).  Shared state below
+        # (device_lines, stats, the mid-scan defeat guards) mutates under
+        # one lock; the heavy legs — ConfirmSet probes, per-line matchers,
+        # the native dense rescan — run outside it.
+        import threading
+
+        state_lock = threading.Lock()
+        confirm_active = [0]  # live confirm legs; peak recorded in stats
+
+        def _confirm_enter() -> None:
+            with state_lock:
+                confirm_active[0] += 1
+                if confirm_active[0] > self.stats.get("confirm_concurrency_peak", 0):
+                    self.stats["confirm_concurrency_peak"] = confirm_active[0]
+
+        def _confirm_exit() -> None:
+            with state_lock:
+                confirm_active[0] -= 1
 
         def confirm_lines(cand) -> None:
             """Per-line host confirm for a sparse candidate-line set (the
             shared tail of the span/cand filter paths)."""
+            good = []
             for ln in cand:
                 start, end = lines_mod.line_span(nl, ln, len(data))
                 if self._host_line_matcher(data[start:end]):
-                    device_lines.add(ln)
+                    good.append(ln)
+            with state_lock:
+                device_lines.update(good)
 
         def dense_native_confirm(seg_start: int, seg_len: int) -> int:
             """Candidate-dense segment: one native DFA pass (C, ~GB/s)
@@ -833,7 +965,8 @@ class GrepEngine:
             uniq = np.unique(
                 lines_mod.line_of_offsets(offs.astype(np.int64) + seg_start, nl)
             )
-            device_lines.update(uniq.tolist())
+            with state_lock:
+                device_lines.update(uniq.tolist())
             return int(uniq.size)
 
         def collect(job) -> None:
@@ -863,10 +996,15 @@ class GrepEngine:
                         cand = set()
                         for a, b in zip(l0.tolist(), l1.tolist()):
                             cand.update(range(a, b + 1))
-                        cand -= device_lines  # already confirmed earlier
-                        self.stats["candidates"] += len(cand)
+                        with state_lock:
+                            cand -= device_lines  # already confirmed earlier
+                            self.stats["candidates"] += len(cand)
                         if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
-                            true_lines = dense_native_confirm(seg_start, seg_len)
+                            _confirm_enter()
+                            try:
+                                true_lines = dense_native_confirm(seg_start, seg_len)
+                            finally:
+                                _confirm_exit()
                             nonlocal sa_filtered
                             if sa_filtered is not None and true_lines * 4 < len(cand):
                                 # mostly-false candidates: the corpus defeats
@@ -881,9 +1019,14 @@ class GrepEngine:
                                     "full model for this scan",
                                     len(cand), true_lines,
                                 )
-                                sa_filtered = None
+                                with state_lock:
+                                    sa_filtered = None
                         else:
-                            confirm_lines(cand)
+                            _confirm_enter()
+                            try:
+                                confirm_lines(cand)
+                            finally:
+                                _confirm_exit()
                     return
                 if sparse_kind == "cand_words":
                     # NFA filter path (models/nfa.compile_scan_model): the
@@ -893,14 +1036,21 @@ class GrepEngine:
                     # segment's device scan.
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-                    self.stats["candidates"] += int(offsets.size)
+                    with state_lock:
+                        self.stats["candidates"] += int(offsets.size)
                     if offsets.size:
                         t0 = _time.perf_counter()
                         glines = lines_mod.line_of_offsets(offsets + seg_start, nl)
-                        cand = set(np.unique(glines).tolist()) - device_lines
+                        cand = set(np.unique(glines).tolist())
+                        with state_lock:
+                            cand -= device_lines
                         if len(cand) > SPAN_CONFIRM_LINE_LIMIT and \
                                 self.table is not None:
-                            true_lines = dense_native_confirm(seg_start, seg_len)
+                            _confirm_enter()
+                            try:
+                                true_lines = dense_native_confirm(seg_start, seg_len)
+                            finally:
+                                _confirm_exit()
                             nonlocal nfa_model, nfa_is_filter
                             if (
                                 nfa_is_filter
@@ -921,12 +1071,18 @@ class GrepEngine:
                                     "exact automaton for this scan",
                                     len(cand), true_lines,
                                 )
-                                nfa_model = self.glushkov_exact
-                                nfa_is_filter = False
-                                self.stats["nfa_filter_defeated"] = True
+                                with state_lock:
+                                    nfa_model = self.glushkov_exact
+                                    nfa_is_filter = False
+                                    self.stats["nfa_filter_defeated"] = True
                         else:
-                            confirm_lines(cand)
-                        self.stats["confirm_seconds"] += _time.perf_counter() - t0
+                            _confirm_enter()
+                            try:
+                                confirm_lines(cand)
+                            finally:
+                                _confirm_exit()
+                        with state_lock:
+                            self.stats["confirm_seconds"] += _time.perf_counter() - t0
                     return
                 if sparse_kind == "words":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -937,9 +1093,18 @@ class GrepEngine:
                         # back across the segment start still confirms; runs
                         # here so it overlaps the next segment's device scan.
                         t0 = _time.perf_counter()
-                        keep = self._fdr_confirm.confirm(data, offsets + seg_start)
-                        self.stats["confirm_seconds"] += _time.perf_counter() - t0
-                        self.stats["candidates"] += int(offsets.size)
+                        _confirm_enter()
+                        try:
+                            keep = self._fdr_confirm.confirm(
+                                data, offsets + seg_start
+                            )
+                        finally:
+                            _confirm_exit()
+                        with state_lock:
+                            self.stats["confirm_seconds"] += (
+                                _time.perf_counter() - t0
+                            )
+                            self.stats["candidates"] += int(offsets.size)
                         offsets = offsets[keep]
                 elif sparse_kind == "lane_bytes":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -955,14 +1120,16 @@ class GrepEngine:
                         np.zeros(0, dtype=np.int64)
             if short_offsets is not None:
                 offsets = np.union1d(offsets, short_offsets)
-            self.stats["end_offsets"] += int(offsets.size)
+            with state_lock:
+                self.stats["end_offsets"] += int(offsets.size)
             if offsets.size:
                 # transient slice: jobs hold (start, len), not segment copies
                 seg_view = data[seg_start : seg_start + seg_len]
                 seg_nl = lines_mod.newline_index(seg_view)
                 seg_lines = np.unique(lines_mod.line_of_offsets(offsets, seg_nl))
                 base = int(np.searchsorted(nl, seg_start))  # lines before segment
-                device_lines.update((seg_lines + base).tolist())
+                with state_lock:
+                    device_lines.update((seg_lines + base).tolist())
 
         # Double-buffered device feed (VERDICT r2 item 4): a one-slot
         # prepare thread builds segment i+1's stripe layout (host pad +
@@ -1000,10 +1167,17 @@ class GrepEngine:
                 )
             arr = layout_mod.to_device_array(seg_bytes, lay)
             dev = devs[i % len(devs)]
-            if not use_mesh:
-                # enqueue the host->device copy now (async on real
-                # backends); mesh mode uploads inside the sharded step
-                # (device_put with a NamedSharding straight from host)
+            if use_mesh:
+                # the tile reshape/copy and the NamedSharding device_put
+                # need no kernel state — running them HERE (prepare thread)
+                # is what makes the double-buffer real in mesh mode: the
+                # sharded upload of segment i+1 rides the transfer engine
+                # while segment i's shard_map dispatch runs (round-3 advisor
+                # finding: doing this inside the dispatch loop kept the mesh
+                # path feed-serialized and under-reported feed_wait_seconds)
+                arr = shk.prepare_tiles(arr, self.mesh, self.mesh_axis)
+            else:
+                # enqueue the host->device copy now (async on real backends)
                 pctx = jax.default_device(dev) if dev is not None else nullcontext()
                 with pctx:
                     import jax.numpy as jnp
@@ -1012,6 +1186,20 @@ class GrepEngine:
             return seg_bytes, lay, arr, dev
 
         pool = ThreadPoolExecutor(1) if len(seg_starts) > 1 else None
+        # Collect pool (VERDICT r3 item 1): sparse decode + host confirm of
+        # finished segments runs here, so confirms from different devices'
+        # segments overlap each other and the dispatch loop instead of
+        # serializing on it.  Mesh mode has one sharded stream — two workers
+        # cover decode/confirm pipelining; round-robin mode sizes to the
+        # device fan.  Single-segment scans collect inline (nothing to
+        # overlap).
+        from collections import deque as _deque
+
+        n_collect = 2 if use_mesh else min(4, max(1, len(devs)))
+        collect_pool = (
+            ThreadPoolExecutor(n_collect) if len(seg_starts) > 1 else None
+        )
+        collect_futs: _deque = _deque()
         self.stats["feed_wait_seconds"] = 0.0
         nxt = prepare(0, seg_starts[0]) if seg_starts else None
         try:
@@ -1039,6 +1227,7 @@ class GrepEngine:
                                 pattern_axis=ep_axis,
                                 interpret=interp_flag,
                                 fold_case=self.ignore_case,
+                                tabs_dev=self._fdr_ep_tables(ep_axis),
                             )
                             psum_totals.append(pt)
                         elif use_mesh:
@@ -1101,17 +1290,23 @@ class GrepEngine:
                                 )
                             kind = "words"
                         else:
+                            # snapshot model+kind together: the defeat guard
+                            # swaps them from a collect-pool thread, and a
+                            # torn read (filter model + kind "words") would
+                            # skip the confirm pass filter planes require
+                            with state_lock:
+                                nfa_now, nfa_filter_now = nfa_model, nfa_is_filter
                             if use_mesh:
                                 words, pt = shk.sharded_nfa_words(
-                                    arr, nfa_model, self.mesh,
+                                    arr, nfa_now, self.mesh,
                                     self.mesh_axis, interpret=interp_flag,
                                 )
                                 psum_totals.append(pt)
                             else:
                                 words = pallas_nfa.nfa_scan_words(
-                                    arr, nfa_model, interpret=interp_flag
+                                    arr, nfa_now, interpret=interp_flag
                                 )
-                            kind = "cand_words" if nfa_is_filter else "words"
+                            kind = "cand_words" if nfa_filter_now else "words"
                         job = (kind, words, lay, seg_start, len(seg_bytes), None, dev)
                     elif self.mode == "shift_and":
                         packed = scan_jnp.shift_and_scan(arr, self.shift_and)
@@ -1139,15 +1334,24 @@ class GrepEngine:
                         job = ("bank_list", planes, lay, seg_start, len(seg_bytes),
                                None, dev)
                 boundaries.extend((seg_start + lay.stripe_starts()).tolist())
-                pending.append(job)
-                if len(pending) >= max_inflight:
-                    collect(pending.pop(0))
+                if collect_pool is not None:
+                    collect_futs.append(collect_pool.submit(collect, job))
+                    if len(collect_futs) >= max_inflight:
+                        # bound resident result planes, like the old pending
+                        # list: wait out the oldest in-flight collect
+                        collect_futs.popleft().result()
+                else:
+                    collect(job)
+                if progress is not None:
+                    progress()  # one milestone per dispatched segment
                 if nxt_future is not None:
                     t0 = _time.perf_counter()
                     nxt = nxt_future.result()
                     self.stats["feed_wait_seconds"] += _time.perf_counter() - t0
-            for job in pending:
-                collect(job)
+            while collect_futs:
+                collect_futs.popleft().result()
+                if progress is not None:
+                    progress()
         except Exception as e:
             # Dispatch is async: a kernel can fail at execution time (first
             # consumed in collect) as well as at compile time.  Mosaic
@@ -1160,6 +1364,11 @@ class GrepEngine:
             # occur inside jax on version skew, so they stay in the net.
             if isinstance(e, (MemoryError, UnicodeError)):
                 raise
+            if collect_pool is not None:
+                # running collects mutate self.stats/device_lines — let them
+                # drain before any fallback rescan resets those under them
+                # (their un-awaited exceptions, if any, mirror this one)
+                collect_pool.shutdown(wait=True, cancel_futures=True)
             if not use_fdr:
                 if use_pallas and not self._pallas_broken:
                     # same policy as the FDR net: a Mosaic/runtime kernel
@@ -1171,7 +1380,7 @@ class GrepEngine:
                         self.mode, e,
                     )
                     self._pallas_broken = True
-                    return self.scan(data)
+                    return self.scan(data, progress=progress)
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
             self._fdr_broken = True
@@ -1183,12 +1392,14 @@ class GrepEngine:
                 self.mode = "native"
                 result = self._scan_native(data)
             else:
-                result = self._scan_device(data)
+                result = self._scan_device(data, progress=progress)
             self.stats["fdr_fallback"] = True  # rescan stats only
             return result
         finally:
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
+            if collect_pool is not None:
+                collect_pool.shutdown(wait=False, cancel_futures=True)
 
         # FDR candidates were already confirmed offset-exactly in collect();
         # boundary lines (stripe/segment heads, where the filter's all-ones

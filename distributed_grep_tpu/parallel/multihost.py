@@ -13,7 +13,11 @@ hosts, SURVEY.md §5 distributed-backend mapping):
   meshes may span hosts; collectives then ride ICI within a slice and DCN
   across slices — standard JAX SPMD.  The MapReduce layer is agnostic:
   a "worker" is whoever called AssignTask, whether it owns 1 chip or a
-  4x4 slice.
+  4x4 slice.  The segment feed honors the multi-process contract: when
+  process_count > 1 each process materializes only its local lane blocks
+  and assembles the global array from single-device shards
+  (parallel/sharded_kernels._put_spec) — device_put of a full host array
+  onto a cross-host mesh would try to address remote chips.
 """
 
 from __future__ import annotations

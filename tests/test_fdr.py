@@ -499,3 +499,65 @@ def test_scan_stays_exact_after_retune_swap():
     }
     got = set(eng.scan(data).matched_lines.tolist())
     assert got == expected
+
+
+def test_chip_aware_pricing_buys_more_filtering():
+    """VERDICT r3 item 1: the confirm threads are shared across a host's
+    active chips, so Pricing.n_chips must shift the tuner toward more
+    device gathers / lower candidate rates as the chip count grows —
+    monotonically, and with a strict flip by 4 chips on a config-5-shaped
+    set."""
+    from dataclasses import replace
+
+    pats = _rand_literals(
+        3000, 5, 8, seed=21, alphabet=b"abcdefghijklmnopqrstuvwxyz0123456789"
+    )
+    base = replace(
+        fdr_mod.default_pricing(),
+        confirm_ps_per_candidate=8600.0, confirm_threads=8, n_chips=1,
+    )
+    models = {
+        nc: fdr_mod.compile_fdr(pats, pricing=replace(base, n_chips=nc))
+        for nc in (1, 4)
+    }
+    g1 = sum(b.total_gathers for b in models[1].banks)
+    g4 = sum(b.total_gathers for b in models[4].banks)
+    assert g4 > g1  # 4 chips -> confirm share quartered -> buy filtering
+    assert models[4].fp_per_byte < models[1].fp_per_byte
+    # and the wall model itself scales: same plan, 4x the confirm wall
+    pr4 = replace(base, n_chips=4)
+    assert pr4.confirm_wall_ps(0.01) == pytest.approx(
+        4 * base.confirm_wall_ps(0.01)
+    )
+
+
+def test_engine_mesh_chip_count_pricing(monkeypatch):
+    """An engine driving an 8-device mesh must price the FDR confirm leg
+    at the 8-chip share from construction (not only after a retune)."""
+    from dataclasses import replace
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("DGREP_NO_CALIBRATE", "1")  # pin: no probe swap
+    pats = _rand_literals(
+        3000, 5, 8, seed=21, alphabet=b"abcdefghijklmnopqrstuvwxyz0123456789"
+    )
+    spats = [p.decode() for p in pats]
+    eng1 = GrepEngine(patterns=spats, interpret=True)
+    mesh = make_mesh((8,), ("data",))
+    eng8 = GrepEngine(patterns=spats, mesh=mesh, interpret=True)
+    assert eng1._fdr_pricing.n_chips == 1
+    assert eng8._fdr_pricing.n_chips == 8
+    direct = fdr_mod.compile_fdr(
+        spats, pricing=replace(fdr_mod.default_pricing(), n_chips=8)
+    )
+    assert [(b.m, b.checks) for b in eng8.fdr.banks] == \
+        [(b.m, b.checks) for b in direct.banks]
+    # EP on a 2D mesh: the pattern axis scans concurrently too
+    mesh2 = make_mesh((4, 2), ("data", "seq"))
+    eng_ep = GrepEngine(
+        patterns=spats, mesh=mesh2, mesh_axis="data", pattern_axis="seq",
+        interpret=True,
+    )
+    assert eng_ep._fdr_pricing.n_chips == 8

@@ -643,3 +643,24 @@ def test_pallas_kernel_failure_falls_back(monkeypatch):
     assert eng._pallas_broken  # flipped; later scans skip the kernel
     res2 = eng.scan(data)
     assert set(res2.matched_lines.tolist()) == expected
+
+
+def test_scan_file_pipelined_read_exact_and_stats(tmp_path):
+    """VERDICT r3 item 4: the read-ahead thread must leave scan_file
+    byte-exact across many chunk boundaries and record the residual
+    read stall."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    data = b"".join(
+        (b"hello %d\n" % i if i % 3 == 0 else b"line %d\n" % i)
+        for i in range(5000)
+    )
+    f = tmp_path / "f.txt"
+    f.write_bytes(data)
+    eng = GrepEngine("hello", backend="cpu")
+    res = eng.scan_file(str(f), chunk_bytes=4096)  # ~12 chunks
+    want = [i + 1 for i in range(5000) if i % 3 == 0]
+    assert res.matched_lines.tolist() == want
+    assert res.n_matches == len(want)
+    assert res.bytes_scanned == len(data)
+    assert eng.stats["read_wait_seconds"] >= 0.0
