@@ -47,6 +47,19 @@ class LoadedApplication:
         if hook is not None:
             hook(**options)
 
+    def set_progress(self, fn: Any) -> bool:
+        """Install (or clear, fn=None) a progress callback for the current
+        task — apps that support it call fn() at work milestones (per
+        chunk/segment) and fn(grace_s=N) ahead of a known-silent phase;
+        the worker wires it to the coordinator heartbeat so the failure
+        detector can run a tight window over long maps.  Returns whether
+        the application supports progress reporting."""
+        hook = getattr(self.module, "set_progress", None)
+        if hook is None:
+            return False
+        hook(fn)
+        return True
+
 
 _instance_counter = itertools.count()
 

@@ -132,6 +132,12 @@ class CoordinatorServer:
             reply = self.scheduler.reduce_next_file(
                 rpc.ReduceNextFileArgs(**payload), timeout=window
             )
+        elif verb == rpc.Verb.HEARTBEAT:
+            args = rpc.HeartbeatArgs(**payload)
+            self.scheduler.heartbeat(
+                args.task_type, args.task_id, grace_s=args.grace_s
+            )
+            reply = rpc.HeartbeatReply()
         else:
             raise KeyError(f"unknown RPC verb: {verb}")
         return asdict(reply)

