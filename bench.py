@@ -3,8 +3,9 @@
 Prints exactly ONE JSON line on stdout:
     {"metric": "...", "value": N, "unit": "GB/s", "vs_baseline": N}
 
-Measures the flagship path — the Pallas shift-and literal scan — on a
-synthetic ~80-byte-line corpus resident in HBM (the north star's framing:
+Measures the flagship path — BASELINE.md config 1: the Pallas shift-and
+literal scan with the engine's rare-class device filter, on an
+enwik8-shaped words corpus resident in HBM (the north star's framing:
 ">= 10 GB/s/chip regex scan over HBM-resident file shards", BASELINE.json).
 vs_baseline is value / 10.0, the ratio against that 10 GB/s target (the
 reference itself publishes no numbers — BASELINE.md).
@@ -38,16 +39,44 @@ import time
 
 import numpy as np
 
-CORPUS_BYTES = 256 * 1024 * 1024
-PATTERN = "needle"
+CORPUS_BYTES = 64 * 1000 * 1000  # == the baseline_configs suite size:
+# BASELINE.md row 1 (218-261 GB/s band) was measured at this working-set size,
+# and the rate is size-dependent (~250 at 32 MB, ~175-195 at 256 MB), so the
+# headline must match the methodology it is compared against
+PATTERN = "volcano"  # BASELINE.md config 1's pattern (the flagship row)
 TARGET_GBPS = 10.0  # north-star baseline (BASELINE.json)
 TPU_WATCHDOG_S = int(__import__("os").environ.get("BENCH_WATCHDOG_S", "900"))
+
+# English-like filler (enwik/WET-shaped words+spaces+newlines — the same
+# text family as benchmarks/baseline_configs config 1, so the headline and
+# the config suite measure the same workload).  PATTERN is deliberately
+# absent from the vocabulary; occurrences are injected, keeping the match
+# count a calibrated sanity band.
+_WORDS = (
+    "the of and to in a is that for it as was with be by on not he his but "
+    "at are this have from or had they you which one were her all she there "
+    "would their we him been has when who will more no if out so said what "
+    "up its about into than them can only other new some could time these "
+    "two may then do first any my now such like our over man me even most "
+    "made after also did many before must through years where much your way "
+    "well down should because each just those people how too little state "
+    "good very make world still own see men work long get here between both "
+    "life being under never day same another know while last might us great "
+    "old year off come since against go came right used take three"
+).split()
 
 
 def make_corpus(n: int) -> bytes:
     rng = np.random.default_rng(0)
-    data = rng.integers(32, 127, size=n, dtype=np.uint8)
-    data[rng.integers(0, n, size=n // 80)] = 0x0A  # ~80-byte lines
+    out, size = [], 0
+    while size < n:
+        k = int(rng.integers(3, 24))
+        line = b" ".join(
+            _WORDS[i].encode() for i in rng.integers(0, len(_WORDS), k)
+        )
+        out.append(line)
+        size += len(line) + 1
+    data = np.frombuffer(b"\n".join(out)[:n], dtype=np.uint8).copy()
     needle = np.frombuffer(PATTERN.encode(), np.uint8)
     for p in rng.integers(0, n - 16, size=1000):
         data[p : p + len(needle)] = needle
@@ -57,23 +86,35 @@ def make_corpus(n: int) -> bytes:
 def bench_tpu(data: bytes) -> float:
     import statistics
 
-    from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+    from distributed_grep_tpu.models.shift_and import (
+        filtered_for_device,
+        try_compile_shift_and,
+    )
     from distributed_grep_tpu.utils.slope import pallas_shift_and_setup, slope_per_pass
 
     model = try_compile_shift_and(PATTERN)
+    # Measure the kernel the ENGINE actually dispatches for this workload:
+    # the rare-class device filter (fewer compares — the kernel's ALU
+    # bottleneck) when the pattern has rare byte classes, with the host
+    # span-confirm pass restoring exactness downstream (ops/engine.py
+    # _sa_filtered).  For 'volcano' this is the 3-check filter of
+    # BASELINE.md config 1.
+    kernel_model = filtered_for_device(model) or model
+    print(f"bench: kernel checks {sum(1 for r in kernel_model.sym_ranges if r)}"
+          f"/{len(model.sym_ranges)} symbol classes", file=sys.stderr)
     # The 512 '\n' pad rows let each chained pass scan an i-dependent window —
     # required by the slope harness's anti-hoisting scheme (utils/slope.py).
     # Odd windows drop each stripe's first 512 bytes, losing ~512/chunk of
     # the 1000 planted needles, hence the count band below.
-    dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, model)
+    dev, chunk, pad_rows, scan = pallas_shift_and_setup(data, kernel_model)
     # The tunneled device adds ~100 ms of run-to-run jitter.  Two defenses
     # (VERDICT r3 item 5 — BENCH_r03 underquoted the measured kernel 28%):
-    # chains long enough that the rep delta dominates the jitter (r2=104 is
-    # ~105 ms of extra chain at 234 GB/s, vs ~35 ms at the old r2=40), and
-    # the median of 3 INDEPENDENT slope draws (each itself a median of 3
-    # timed sections) — one compile, so draws 2-3 cost only their run time.
+    # chains long enough that the rep delta dominates the jitter (the
+    # harness auto-escalates r2 until it does), and the median of 5
+    # INDEPENDENT slope draws (each itself a median of 3 timed sections) —
+    # one compile, so later draws cost only their run time.
     draws = []
-    for i in range(3):
+    for i in range(5):
         per_pass, per_count = slope_per_pass(
             dev, chunk, pad_rows, scan, r1=8, r2=104, count_range=(900, 1100),
             measurements=3,

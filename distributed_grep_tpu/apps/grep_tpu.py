@@ -36,7 +36,11 @@ _progress = _threading.local()
 # through a tunneled TPU) with no observable progress — declare it as a
 # bounded grace window so a tight failure-detector timeout tolerates it.
 COMPILE_GRACE_S = float(__import__("os").environ.get("DGREP_COMPILE_GRACE_S", "90"))
-_compile_seen = False
+# Set only after a device scan COMPLETES: every task that starts before
+# then declares grace (a concurrent worker slot blocks on the SAME shared
+# jit compile as the first, so gating on who declares first would leave it
+# stampless mid-compile and spuriously swept).
+_compile_done = False
 
 
 def set_progress(fn) -> None:
@@ -51,17 +55,20 @@ def _progress_fn():
 
 def _begin_scan_progress():
     """The per-scan progress callback, declaring compile grace ahead of
-    this process's first device scan."""
-    global _compile_seen
+    any device scan that may block on this process's cold jit compile."""
     fn = _progress_fn()
     if fn is None:
         return None
-    if _engine is not None and _engine.backend == "device" and not _compile_seen:
-        _compile_seen = True  # benign race: worst case two grace stamps
+    if _engine is not None and _engine.backend == "device" and not _compile_done:
         fn(grace_s=COMPILE_GRACE_S)
     else:
         fn()
     return lambda: fn()
+
+
+def _scan_completed() -> None:
+    global _compile_done
+    _compile_done = True
 
 
 def configure(
@@ -143,6 +150,7 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
     result = _engine.scan(contents, progress=_begin_scan_progress())
+    _scan_completed()
     emit = result.matched_lines.tolist()
     nl = None
     if _confirm is not None and emit:
@@ -198,6 +206,7 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
         )
 
     _engine.scan_file(path, emit=emit, progress=_begin_scan_progress())
+    _scan_completed()
     return out
 
 

@@ -94,21 +94,28 @@ class HttpTransport:
         )
 
     def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
-        """Advisory single-shot stamp: no retry loop (a missed heartbeat
-        costs at most one sweep window; a 15 s retry budget inside the
-        map's progress callback would stall the very work being stamped)
-        and never raises — transport failure surfaces through the task's
-        own RPCs."""
-        try:
-            body = json.dumps(rpc.to_dict(args)).encode("utf-8")
-            req = urllib.request.Request(
-                f"{self.base}/rpc/{rpc.Verb.HEARTBEAT}", data=body, method="POST"
-            )
-            req.add_header("Content-Type", "application/json")
-            with urllib.request.urlopen(req, timeout=5.0):
-                pass
-        except Exception:  # noqa: BLE001 — advisory by contract
-            pass
+        """Advisory stamp; never raises — transport failure surfaces
+        through the task's own RPCs.  Plain stamps are single-shot (a
+        missed one costs at most one sweep window, and a retry budget
+        inside the progress callback would stall the very work being
+        stamped); GRACE stamps get a short bounded retry, because a lost
+        grace declaration costs the whole silent phase it covers — the
+        caller is about to block on a compile anyway, so a few seconds of
+        retry cannot stall anything the compile wasn't already stalling."""
+        attempts = 3 if args.grace_s > 0 else 1
+        body = json.dumps(rpc.to_dict(args)).encode("utf-8")
+        for i in range(attempts):
+            try:
+                req = urllib.request.Request(
+                    f"{self.base}/rpc/{rpc.Verb.HEARTBEAT}", data=body,
+                    method="POST",
+                )
+                req.add_header("Content-Type", "application/json")
+                with urllib.request.urlopen(req, timeout=5.0):
+                    return
+            except Exception:  # noqa: BLE001 — advisory by contract
+                if i + 1 < attempts:
+                    time.sleep(0.5)
 
     # ---------------------------------------------------------- data plane
     def read_input(self, filename: str) -> bytes:
