@@ -161,6 +161,8 @@ class GrepEngine:
         self._fdr_short: list[DfaTable] = []
         self._fdr_dev_tables: dict | None = None  # device -> reach tables
         self._fdr_ep_dev_tables = None  # stacked pattern-axis-sharded tables
+        self.pairset = None  # exact short-set model (models/pairset.py)
+        self._pairset_dev_tables: dict | None = None
         self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
         self._pallas_broken = False  # any Pallas kernel failed at runtime
@@ -254,7 +256,27 @@ class GrepEngine:
 
                 long_pats = [p for p in patterns if _blen(p) >= 2]
                 short_pats = [p for p in patterns if _blen(p) < 2]
-                if long_pats:
+                # All members 1-2 bytes: the exact row-partition pair
+                # kernel (models/pairset.py) beats BOTH alternatives —
+                # FDR would filter 2-byte windows and pay a confirm
+                # stream; the native route leaves the device idle.  Exact
+                # on device, so it is tried first (round-4 closure of the
+                # MXU question: the gather factorization wins the
+                # shared-contraction formulation's ceiling).
+                if max(_blen(p) for p in patterns) <= 2:
+                    from distributed_grep_tpu.models.pairset import (
+                        PairsetError,
+                        compile_pairset,
+                    )
+
+                    try:
+                        self.pairset = compile_pairset(
+                            patterns, ignore_case=ignore_case
+                        )
+                        self.mode = "pairset"
+                    except PairsetError as e:
+                        log.info("short set not pairset-representable: %s", e)
+                if self.mode != "pairset" and long_pats:
                     try:
                         # Chip-aware pricing (VERDICT r3 item 1): the host
                         # confirm threads are shared across every chip this
@@ -298,12 +320,12 @@ class GrepEngine:
                         self._calibrate_fdr_confirm()
                     except FdrError as e:
                         log.info("pattern set FDR-ineligible: %s", e)
-                # FDR-ineligible sets (all-short members, density over the
-                # candidate ceiling) must not silently fall onto the XLA
-                # DFA-bank device path (~0.1 GB/s — ~100x slower than the
-                # host's native MT scanner).  Route to the native scanner
-                # loudly; keep the device path only when the native lib is
-                # unavailable.
+                # FDR-ineligible sets (density over the candidate ceiling,
+                # short sets past the pairset class budget) must not
+                # silently fall onto the XLA DFA-bank device path
+                # (~0.1 GB/s — ~100x slower than the host's native MT
+                # scanner).  Route to the native scanner loudly; keep the
+                # device path only when the native lib is unavailable.
                 if self.mode == "dfa":
                     from distributed_grep_tpu.utils.native import (
                         native_available,
@@ -575,6 +597,16 @@ class GrepEngine:
             return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
         if self.mode == "native":
             return self._scan_native(data)
+        if self.mode == "pairset":
+            from distributed_grep_tpu.ops import pallas_scan
+
+            if not (
+                (pallas_scan.available() or self._interpret)
+                and not self._pallas_broken
+            ):
+                # no kernel backend: the exact AC banks are the same
+                # answer on host (native MT scanner when available)
+                return self._scan_native(data)
         if self.mode == "nfa" and not self.tables:
             # DFA-less rescue (expansion-cap bounded repeats): the only
             # device engine is the Pallas NFA filter — without it (no TPU,
@@ -790,6 +822,20 @@ class GrepEngine:
             ]
         return self._fdr_dev_tables[dev]
 
+    def _pairset_device_tables(self, dev=None):
+        """Pairset scan tables, uploaded once per engine per device."""
+        if self._pairset_dev_tables is None:
+            self._pairset_dev_tables = {}
+        if dev not in self._pairset_dev_tables:
+            import jax.numpy as jnp
+
+            from distributed_grep_tpu.ops import pallas_pairset
+
+            self._pairset_dev_tables[dev] = jnp.asarray(
+                pallas_pairset.device_tables(self.pairset)
+            )
+        return self._pairset_dev_tables[dev]
+
     def _fdr_ep_tables(self, pattern_axis):
         """Stacked pattern-axis-sharded FDR tables, built + uploaded once
         per plan (reset alongside _fdr_dev_tables on retune) — the EP
@@ -857,7 +903,16 @@ class GrepEngine:
             and pallas_ok
             and pallas_approx.eligible(self.approx)
         )
-        use_pallas = use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
+        # Exact short-set pair kernel: match words straight off the device
+        # (kind "words", no confirm) — scan() already routed to the native
+        # host path when no kernel backend exists.
+        use_pairset = self.mode == "pairset" and pallas_ok
+        if use_pairset:
+            from distributed_grep_tpu.ops import pallas_pairset
+        use_pallas = (
+            use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
+            or use_pairset
+        )
         # Scan-local rare-class filter state: the dense-candidate guard in
         # collect() drops it for the REST OF THIS SCAN only (a dense corpus
         # says nothing about the next file this engine greps).
@@ -888,6 +943,7 @@ class GrepEngine:
         # count is kept per segment as the collective cross-check.
         use_mesh = self.mesh is not None and (
             use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
+            or use_pairset
         )
         if self.mesh is not None and not use_mesh:
             log.warning(
@@ -1287,6 +1343,21 @@ class GrepEngine:
                             else:
                                 words = pallas_approx.approx_scan_words(
                                     arr, self.approx, interpret=interp_flag
+                                )
+                            kind = "words"
+                        elif use_pairset:
+                            if use_mesh:
+                                words, pt = shk.sharded_pairset_words(
+                                    arr, self.pairset, self.mesh,
+                                    self.mesh_axis, interpret=interp_flag,
+                                    dev_tables=self._pairset_device_tables(None),
+                                )
+                                psum_totals.append(pt)
+                            else:
+                                words = pallas_pairset.pairset_scan_words(
+                                    arr, self.pairset,
+                                    dev_tables=self._pairset_device_tables(dev),
+                                    interpret=interp_flag,
                                 )
                             kind = "words"
                         else:

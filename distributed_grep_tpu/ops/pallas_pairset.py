@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: exact 1-2-byte literal-set scan (models/pairset.py).
+
+Same shell as ops/pallas_fdr.py (lanes x chunk tiles, time-packed uint32
+match words, VMEM carry across chunk blocks), but the per-byte step is the
+exact row-partition pair check — no bucket pipeline, no confirm:
+
+    rc  = rowcls[cls_byte]        256-domain lane lookup (2 gathers)
+    w   = words[word_byte]        256-domain lane lookup (2 gathers)
+    hit = (w >> rc) & 1           exact pair/single membership
+
+(cls_byte, word_byte) = (prev, cur) or (cur, prev) per the model's
+orientation.  The prev carry is seeded '\\n' at stripe starts: members
+never contain newlines, so stripe heads can only UNDER-report (engine
+boundary stitching restores boundary-spanning pairs) — the output words
+are otherwise EXACT match-end offsets, decoded with the standard
+ops/sparse helpers.
+
+4 gathers/byte puts this in the same measured class as a small FDR plan
+(~40-60 GB/s/chip; kernel_compare.py `pairset` entry) — the device
+engine for the all-short sets the engine previously had to route to the
+native host scanner.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_grep_tpu.models.pairset import NL, PairsetModel
+from distributed_grep_tpu.ops import pallas_scan
+from distributed_grep_tpu.ops.pallas_scan import (
+    CHUNK_BLOCK_WORDS,
+    LANE_COLS,
+    LANES_PER_BLOCK,
+    SUBLANES,
+    available,
+    validate_unroll,
+)
+
+UNROLL = 8  # small-gather kernels amortize pipeline carries best at 8
+# (the pallas_fdr unroll sweep: 5-gather plans ran 42 GB/s at 8 vs 35 at 32)
+
+
+def eligible(model: PairsetModel) -> bool:
+    return model.n_classes <= 32  # construction guarantees it; guard anyway
+
+
+def device_tables(model: PairsetModel) -> np.ndarray:
+    """(4, SUBLANES, LANE_COLS) uint32: [rowcls_lo, rowcls_hi, words_lo,
+    words_hi] — each 256-entry table split into two 128-lane subtables
+    broadcast across sublanes (the kernel's lane-gather unit)."""
+    rows = [
+        model.rowcls[:128], model.rowcls[128:],
+        model.words[:128], model.words[128:],
+    ]
+    sub = np.stack([r.astype(np.uint32) for r in rows])
+    tiles = np.broadcast_to(sub[:, None, :], (4, SUBLANES, LANE_COLS))
+    return np.ascontiguousarray(tiles)
+
+
+def _kernel(data_ref, tabs_ref, out_ref, prev_ref, *, steps, transposed,
+            fold_case, unroll):
+    from jax.experimental import pallas as pl  # deferred: import cost
+
+    validate_unroll(unroll)
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        # '\n' seed: stripe heads under-report boundary-spanning pairs
+        # (stitched on host) and never false-positive
+        prev_ref[...] = jnp.full_like(prev_ref, jnp.uint32(NL))
+
+    zero = jnp.uint32(0)
+    n_inner = 32 // unroll
+
+    def lookup(tab_lo, tab_hi, idx):
+        lo = idx & (LANE_COLS - 1)
+        hi = idx >> 7
+        g0 = jnp.take_along_axis(tab_lo, lo, axis=1)
+        g1 = jnp.take_along_axis(tab_hi, lo, axis=1)
+        m1 = zero - hi.astype(jnp.uint32)  # all-ones where idx >= 128
+        return (g0 & ~m1) | (g1 & m1)
+
+    def word_body(w, carry):
+        def sub_body(s, inner):
+            prev_b, word = inner
+            for tt in range(unroll):
+                b = data_ref[w * 32 + s * unroll + tt].astype(jnp.int32)
+                if fold_case:
+                    b = jnp.where((b >= 65) & (b <= 90), b + 32, b)
+                cls_idx, word_idx = (
+                    (b, prev_b) if transposed else (prev_b, b)
+                )
+                rc = lookup(tabs_ref[0], tabs_ref[1], cls_idx)
+                wv = lookup(tabs_ref[2], tabs_ref[3], word_idx)
+                hit = (wv >> rc) & jnp.uint32(1)
+                bit = jnp.uint32(1 << tt) << (s * jnp.uint32(unroll))
+                word = word | jnp.where(hit != 0, bit, zero)
+                prev_b = b
+            return (prev_b, word)
+
+        prev_b = carry
+        word0 = jnp.zeros((SUBLANES, LANE_COLS), dtype=jnp.uint32)
+        if n_inner == 1:
+            prev_b, word = sub_body(0, (prev_b, word0))
+        else:
+            prev_b, word = jax.lax.fori_loop(0, n_inner, sub_body, (prev_b, word0))
+        out_ref[w] = word
+        return prev_b
+
+    final = jax.lax.fori_loop(
+        0, steps // 32, word_body, prev_ref[...].astype(jnp.int32)
+    )
+    prev_ref[...] = final.astype(jnp.uint32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk", "lane_blocks", "transposed", "fold_case",
+                     "interpret", "unroll"),
+)
+def _pairset_pallas(data, tabs, *, chunk, lane_blocks, transposed,
+                    fold_case=False, interpret=False, unroll=UNROLL):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    steps = 32 * CHUNK_BLOCK_WORDS
+    chunk_blocks = chunk // steps
+    kernel = functools.partial(
+        _kernel, steps=steps, transposed=transposed, fold_case=fold_case,
+        unroll=unroll,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (steps, SUBLANES, LANE_COLS),
+                lambda li, ci: (ci, li, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (4, SUBLANES, LANE_COLS),
+                lambda li, ci: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+            lambda li, ci: (ci, li, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), jnp.uint32
+        ),
+        scratch_shapes=[pltpu.VMEM((SUBLANES, LANE_COLS), jnp.uint32)],
+        interpret=interpret,
+    )(data, tabs)
+
+
+def pairset_scan_words(
+    arr_cl: np.ndarray,
+    model: PairsetModel,
+    dev_tables=None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Run the exact short-set scan; returns time-packed MATCH words (not
+    candidates) in the shared device convention — decode end offsets via
+    ops/sparse.offsets_from_sparse_words.  ``dev_tables`` lets the engine
+    upload device_tables(model) once and reuse across segments."""
+    chunk, lanes = arr_cl.shape
+    steps = 32 * CHUNK_BLOCK_WORDS
+    if lanes % LANES_PER_BLOCK or chunk % steps:
+        raise ValueError(
+            f"pallas layout needs lanes%{LANES_PER_BLOCK}==0, chunk%{steps}==0"
+        )
+    if not eligible(model):
+        raise ValueError("pairset model outside the kernel budget")
+    lane_blocks = lanes // LANES_PER_BLOCK
+    data = pallas_scan.as_tiles(arr_cl, lane_blocks)
+    if dev_tables is None:
+        dev_tables = jnp.asarray(device_tables(model))
+    if interpret is None:
+        interpret = not available()
+    return _pairset_pallas(
+        data,
+        dev_tables,
+        chunk=chunk,
+        lane_blocks=lane_blocks,
+        transposed=model.transposed,
+        fold_case=model.ignore_case,
+        interpret=interpret,
+    )

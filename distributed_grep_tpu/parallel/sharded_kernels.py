@@ -444,6 +444,64 @@ def sharded_fdr_pattern_step(
 
 @functools.partial(
     jax.jit,
+    static_argnames=("chunk", "transposed", "fold_case", "interpret",
+                     "mesh", "axes"),
+)
+def _sharded_pairset(tiles, tabs, *, chunk, transposed, fold_case, interpret,
+                     mesh, axes):
+    from distributed_grep_tpu.ops import pallas_pairset
+
+    def body(blk, tab):
+        return pallas_pairset._pairset_pallas(
+            blk,
+            tab,
+            chunk=chunk,
+            lane_blocks=blk.shape[1] // SUBLANES,
+            transposed=transposed,
+            fold_case=fold_case,
+            interpret=interpret,
+        )
+
+    return _shard_shell(body, mesh, axes, 1)(tiles, tabs)
+
+
+def sharded_pairset_words(
+    arr_cl: np.ndarray,
+    model,
+    mesh: Mesh,
+    axis="data",
+    interpret: bool | None = None,
+    dev_tables=None,
+):
+    """Exact short-set pair kernel over the mesh; (words, total) in the
+    shared convention — the words are exact match ends, so the psum total
+    counts matches, not candidates.  ``dev_tables`` lets the engine upload
+    the table array once and reuse across segments (like
+    sharded_fdr_words)."""
+    from distributed_grep_tpu.ops import pallas_pairset
+
+    if interpret is None:
+        interpret = not pallas_scan.available()
+    if not pallas_pairset.eligible(model):
+        raise ValueError("pairset model outside the kernel budget")
+    axes = _axes_tuple(axis)
+    tiles = _tiles_for(arr_cl, mesh, axis)
+    if dev_tables is None:
+        dev_tables = jnp.asarray(pallas_pairset.device_tables(model))
+    return _sharded_pairset(
+        tiles,
+        dev_tables,
+        chunk=int(arr_cl.shape[0]),
+        transposed=model.transposed,
+        fold_case=model.ignore_case,
+        interpret=interpret,
+        mesh=mesh,
+        axes=axes,
+    )
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("sym_ranges", "match_bit", "k", "chunk", "interpret",
                      "mesh", "axes"),
 )

@@ -182,6 +182,26 @@ def pallas_fdr_setup(data: bytes, model, *, target_lanes: int = 8192):
     return dev, lay.chunk, pad_rows, scan
 
 
+def pallas_pairset_setup(data: bytes, model, *, target_lanes: int = 8192):
+    """Device array + scan closure for slope-timing the exact short-set
+    pair kernel (ops/pallas_pairset.py)."""
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import pallas_pairset
+
+    dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, target_lanes)
+    tabs = jnp.asarray(pallas_pairset.device_tables(model))
+
+    def scan(win):
+        return pallas_pairset._pairset_pallas(
+            win, tabs, chunk=lay.chunk, lane_blocks=lane_blocks,
+            transposed=model.transposed, fold_case=model.ignore_case,
+            interpret=False,
+        )
+
+    return dev, lay.chunk, pad_rows, scan
+
+
 def pallas_nfa_setup(data: bytes, model, *, target_lanes: int = 8192):
     """Device array + scan closure for slope-timing the Pallas Glushkov NFA
     kernel (ops/pallas_nfa.py) — same layout contract as the shift-and

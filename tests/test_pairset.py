@@ -1,0 +1,213 @@
+"""Exact short-literal-set engine (models/pairset.py + ops/pallas_pairset):
+model factorization, kernel-vs-oracle exactness (interpret mode), engine
+end-to-end, and the sharded mesh form.  The pairset path's contract is
+stronger than the filter engines': device words are EXACT match ends (no
+confirm pass), with under-report confined to stripe heads (stitched)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models import pairset as ps
+from distributed_grep_tpu.ops import layout as layout_mod
+
+
+def _corpus(rng, n, pats, plant=200):
+    data = bytearray(rng.integers(32, 127, size=n, dtype=np.uint8).tobytes()
+                     .replace(b"\n", b" "))
+    for p in rng.integers(16, n - 16, size=plant):
+        pat = pats[int(rng.integers(0, len(pats)))]
+        data[p : p + len(pat)] = pat
+    # sprinkle newlines for line structure (never inside planted pats? a
+    # clobbered plant is fine — the oracle sees the same bytes)
+    for p in rng.integers(0, n, size=n // 90):
+        data[p] = 0x0A
+    return bytes(data)
+
+
+# ------------------------------------------------------------------- model
+
+def test_factorization_exact_on_pair_matrix():
+    rng = np.random.default_rng(0)
+    # structured set: products of two small groups + singles
+    pats = [bytes([a, b]) for a in b"abcde" for b in b"XYZ"] + [b"q", b"7"]
+    m = ps.compile_pairset(pats)
+    assert m.n_classes <= 32
+    # oracle the factorization against brute force membership
+    for _ in range(2000):
+        b0, b1 = int(rng.integers(0, 256)), int(rng.integers(0, 256))
+        want = bytes([b0, b1]) in set(pats) or bytes([b1]) in set(pats)
+        if m.transposed:
+            got = bool((m.words[b0] >> m.rowcls[b1]) & 1)
+        else:
+            got = bool((m.words[b1] >> m.rowcls[b0]) & 1)
+        assert got == want, (b0, b1)
+
+
+def test_transpose_orientation_rescues_column_structure():
+    # >32 distinct ROW patterns (each b0 pairs with a distinct subset of 6
+    # second bytes) but only ~7 distinct COLUMN patterns: the row
+    # orientation fails, the transpose factorizes
+    b1s = b"uvwxyz"
+    pats = []
+    for i in range(40):
+        for j in range(6):
+            if (i + 1) >> j & 1:
+                pats.append(bytes([100 + i, b1s[j]]))
+    m = ps.compile_pairset(pats)
+    assert m.transposed
+    sp = set(pats)
+    for p in pats:
+        assert (m.words[p[0]] >> m.rowcls[p[1]]) & 1
+    # and a non-member pair stays False
+    assert not (m.words[100] >> m.rowcls[ord("u")]) & 1 or \
+        bytes([100, ord("u")]) in sp
+
+
+def test_rejects_unrepresentable_and_bad_literals():
+    rng = np.random.default_rng(2)
+    dense = sorted({bytes(rng.integers(32, 127, size=2).tolist())
+                    for _ in range(3000)})
+    with pytest.raises(ps.PairsetError):
+        ps.compile_pairset(dense)
+    with pytest.raises(ps.PairsetError):
+        ps.compile_pairset([b"abc"])  # too long
+    with pytest.raises(ps.PairsetError):
+        ps.compile_pairset([b"a\nb"[1:3]])  # contains newline
+    with pytest.raises(ps.PairsetError):
+        ps.compile_pairset([])
+
+
+def test_ignore_case_folds_members_and_oracle():
+    m = ps.compile_pairset(["AB", "c"], ignore_case=True)
+    ends = ps.reference_ends(m, b"xAbY cC")
+    # 'Ab' folds to 'ab' (end 3); 'c'/'C' both match (ends 6, 7)
+    assert ends.tolist() == [3, 6, 7]
+
+
+# ------------------------------------------------------------------ kernel
+
+def _scan_offsets(data, model, lay):
+    from distributed_grep_tpu.ops import pallas_pairset, scan_jnp
+    from distributed_grep_tpu.ops import sparse as sparse_mod
+
+    arr = layout_mod.to_device_array(data, lay)
+    words = pallas_pairset.pairset_scan_words(arr, model, interpret=True)
+    idx, vals = scan_jnp.sparse_nonzero(words)
+    return np.unique(sparse_mod.offsets_from_sparse_words(
+        np.asarray(idx), np.asarray(vals), lay
+    ))
+
+
+@pytest.mark.parametrize("ignore_case", [False, True])
+def test_kernel_matches_stripe_oracle(ignore_case):
+    rng = np.random.default_rng(3)
+    pats = [b"ab", b"zq", b"9!", b"x", bytes([200, 13])]
+    if ignore_case:
+        pats = [b"AB", b"zQ", b"9!", b"X", bytes([200, 13])]
+    m = ps.compile_pairset(pats, ignore_case=ignore_case)
+    data = _corpus(rng, 3_000_000, [p.lower() if ignore_case else p
+                                    for p in pats])
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512,
+        lane_multiple=4096, chunk_multiple=512,
+    )
+    got = _scan_offsets(data, m, lay)
+    want = []
+    for s0 in [0] + lay.stripe_starts().tolist():
+        s1 = min(s0 + lay.chunk, len(data))
+        want.extend((ps.reference_ends(m, data[s0:s1]) + s0).tolist())
+    want = np.unique(np.asarray(want, dtype=np.int64))
+    assert np.array_equal(got, want)
+
+
+# ------------------------------------------------------------------ engine
+
+def test_engine_pairset_end_to_end_exact():
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(4)
+    pats = [b"ab", b"cd", b"Zq", b"!", b"9"]
+    eng = GrepEngine(patterns=[p.decode() for p in pats], interpret=True)
+    assert eng.mode == "pairset"
+    data = _corpus(rng, 400_000, pats)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == ps.exact_match_lines(eng.pairset, data)
+    # stats carry exact end offsets, no candidates (nothing to confirm)
+    assert eng.stats["end_offsets"] >= 1
+    assert eng.stats.get("candidates", 0) == 0
+
+
+def test_engine_pairset_ignore_case_exact():
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(5)
+    pats = [b"AB", b"cD", b"Q"]
+    eng = GrepEngine(patterns=[p.decode() for p in pats], ignore_case=True,
+                     interpret=True)
+    assert eng.mode == "pairset"
+    data = _corpus(rng, 200_000, [p.lower() for p in pats])
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == ps.exact_match_lines(eng.pairset, data)
+
+
+def test_engine_pairset_multi_segment_streams():
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(6)
+    pats = [b"ab", b"x"]
+    eng = GrepEngine(patterns=["ab", "x"], interpret=True,
+                     segment_bytes=64 * 1024)
+    data = _corpus(rng, 300_000, pats)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == ps.exact_match_lines(eng.pairset, data)
+
+
+def test_engine_pairset_cpu_fallback_matches():
+    """Without a kernel backend the same engine answers from the host
+    path, identically."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(7)
+    pats = [b"ab", b"x"]
+    data = _corpus(rng, 100_000, pats)
+    dev = GrepEngine(patterns=["ab", "x"], interpret=True)
+    host = GrepEngine(patterns=["ab", "x"], backend="cpu")
+    assert dev.mode == "pairset" and host.mode == "native"
+    assert dev.scan(data).matched_lines.tolist() == \
+        host.scan(data).matched_lines.tolist()
+
+
+# -------------------------------------------------------------------- mesh
+
+def test_sharded_pairset_bit_identical_and_engine_mesh():
+    import jax
+
+    from distributed_grep_tpu.ops import pallas_pairset
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.parallel import sharded_kernels as sk
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    mesh8 = make_mesh((8,), ("data",))
+    rng = np.random.default_rng(8)
+    pats = [b"ab", b"zq", b"x"]
+    m = ps.compile_pairset(pats)
+    mult = sk.mesh_lane_multiple(mesh8, "data")
+    data = _corpus(rng, 2 * mult * 512, pats)
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=mult, min_chunk=512,
+        lane_multiple=mult, chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    words, total = sk.sharded_pairset_words(arr, m, mesh8, interpret=True)
+    ref = pallas_pairset.pairset_scan_words(arr, m, interpret=True)
+    assert (np.asarray(words) == np.asarray(ref)).all()
+    assert int(total) == int(np.count_nonzero(np.asarray(ref)))
+    jax.block_until_ready(words)
+
+    eng = GrepEngine(patterns=["ab", "zq", "x"], mesh=mesh8, interpret=True)
+    assert eng.mode == "pairset"
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == ps.exact_match_lines(eng.pairset, data)
+    assert eng.stats.get("psum_candidates", 0) >= 1
