@@ -40,8 +40,10 @@ from distributed_grep_tpu.ops.pallas_scan import (
     validate_unroll,
 )
 
-UNROLL = 8  # small-gather kernels amortize pipeline carries best at 8
-# (the pallas_fdr unroll sweep: 5-gather plans ran 42 GB/s at 8 vs 35 at 32)
+UNROLL = 32  # probed on v5e (2026-07-30, interleaved A/B x3): full unroll
+# wins at every trial (49.5-54.7 GB/s vs 45.6-53.5 at unroll=8) — this
+# kernel has no pipeline carries to pressure registers (unlike the
+# gather-heavy FDR plans that prefer 4), so the word loop flattens best
 
 
 def eligible(model: PairsetModel) -> bool:

@@ -99,9 +99,12 @@ class ExternalReducer:
                     k, v = json.loads(line)
                     yield k, v
 
-    def _merged(self) -> Iterator[tuple[str, str]]:
+    def merged(self) -> Iterator[tuple[str, str]]:
         """All records in (key, run index, sequence) order — i.e. key-sorted,
-        arrival-stable within a key."""
+        arrival-stable within a key.  Public seam: ``reduce()`` groups over
+        it, and JobResult.iter_results_sorted re-sorts collation output
+        through it (the sorter doubles as a general bounded-memory
+        external sort)."""
         def tagged(stream, idx):
             # idx must bind per-stream (a bare generator expression would
             # late-bind the loop variable and break the run tie-break)
@@ -121,7 +124,7 @@ class ExternalReducer:
         one — is preferred over ``reduce_fn(key, values_list)``: it never
         materializes a hot key's value list.
         """
-        for k, grp in groupby(self._merged(), key=lambda t: t[0]):
+        for k, grp in groupby(self.merged(), key=lambda t: t[0]):
             vals = (v for _, v in grp)
             yield (k, stream_fn(k, vals)) if stream_fn is not None else (
                 k, reduce_fn(k, list(vals))

@@ -211,3 +211,43 @@ def test_sharded_pairset_bit_identical_and_engine_mesh():
     got = set(eng.scan(data).matched_lines.tolist())
     assert got == ps.exact_match_lines(eng.pairset, data)
     assert eng.stats.get("psum_candidates", 0) >= 1
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_pairset_fuzz_engine_vs_oracle(seed):
+    """Random structured short sets (second bytes from <= 5 values keeps
+    the row partition within 32 classes by construction) through the full
+    engine in interpret mode — exact vs the line oracle every draw."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(100 + seed)
+    ic = bool(seed % 2)
+    cols = rng.choice(
+        [c for c in range(33, 127) if c != 0x0A], size=5, replace=False
+    )
+    pats = sorted({
+        bytes([int(rng.integers(33, 127)), int(cols[rng.integers(0, 5)])])
+        for _ in range(int(rng.integers(3, 40)))
+    } | {bytes([int(cols[0])])})
+    eng = GrepEngine(patterns=pats, ignore_case=ic, interpret=True,
+                     segment_bytes=1 << 17)
+    assert eng.mode == "pairset", [p for p in pats]
+    data = _corpus(rng, 300_000, eng.pairset.patterns)
+    got = set(eng.scan(data).matched_lines.tolist())
+    assert got == ps.exact_match_lines(eng.pairset, data), (seed, pats)
+
+
+def test_results_materialize_guard(tmp_path):
+    """JobResult.results refuses to materialize past the limit (the
+    100 GB-path attractive-nuisance fix); streaming still works."""
+    from distributed_grep_tpu.runtime.job import JobResult
+
+    p = tmp_path / "mr-out-0"
+    p.write_text("k\tv\n" * 1000)
+    res = JobResult(output_files=[p])
+    assert res.results == {"k": "v"}
+    small = JobResult(output_files=[p])
+    small.RESULTS_MATERIALIZE_LIMIT = 100
+    with pytest.raises(RuntimeError, match="stream via iter_results"):
+        _ = small.results
+    assert sum(1 for _ in small.iter_results()) == 1000
