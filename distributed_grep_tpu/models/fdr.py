@@ -385,6 +385,38 @@ def _plans(m: int):
             )
 
 
+def _compress_banks(banks: list[FdrBank]) -> list[FdrBank]:
+    """Drop pipeline slots no check probes (a small plan on a long window
+    checks only shallow depths — e.g. the 8-word config-2 plan probes
+    depths {0,1} but inherited m=6 from the members' length): slots with
+    no check are pure shift-through (V_k(t) = V_{k-1}(t-1)), so remapping
+    every check to slot m'-1-depth with m' = max depth + 1 yields a
+    candidate stream identical except for LESS stripe-head over-report
+    (the all-ones seed covers m' positions instead of m) while the kernel
+    carries m' registers instead of m.  Tables are depth-keyed
+    (d = m-1-slot) and therefore reused unchanged.  Probed on v5e
+    (2026-07-30, config-2 A/B): throughput-neutral — Mosaic already
+    sinks the dead shift-throughs — so this is kept for the smaller VMEM
+    scratch, the shorter over-report window (fewer boundary confirms),
+    and plan-shape honesty (a 2-depth plan now SAYS m=2)."""
+    out = []
+    for b in banks:
+        depths = [b.m - 1 - slot for slot, _, _ in b.checks]
+        m_eff = max(depths) + 1
+        if m_eff == b.m:
+            out.append(b)
+            continue
+        checks = tuple(
+            (m_eff - 1 - d, fam, dom)
+            for d, (_, fam, dom) in zip(depths, b.checks)
+        )
+        out.append(FdrBank(
+            m=m_eff, checks=checks, tables=b.tables,
+            patterns=b.patterns, fp_per_byte=b.fp_per_byte,
+        ))
+    return out
+
+
 def _compile_group(
     group: list[bytes], m: int, fp_budget: float, max_banks: int = 4,
     pricing: Pricing | None = None,
@@ -440,7 +472,7 @@ def _compile_group(
             if best is None or key < best[0]:
                 best = (key, banks)
     assert best is not None
-    return best[1]
+    return _compress_banks(best[1])
 
 
 def compile_fdr(

@@ -572,3 +572,36 @@ def test_engine_mesh_chip_count_pricing(monkeypatch):
         interpret=True,
     )
     assert eng_ep._fdr_pricing.n_chips == 8
+
+
+def test_pipeline_compression_preserves_candidates():
+    """Round-4 m-compression: a plan probing only shallow depths drops its
+    dead pipeline slots; the candidate stream is unchanged except for
+    LESS stripe-head over-report (the all-ones seed shrinks)."""
+    words = [b"volcano", b"anarchism", b"needleqq", b"breadth",
+             b"journal", b"mineral", b"quantum", b"physics"]
+    model = fdr_mod.compile_fdr(words)
+    bank = model.banks[0]
+    depths = [bank.m - 1 - s for s, _, _ in bank.checks]
+    assert bank.m == max(depths) + 1  # compressed to the used depth range
+    assert bank.m < min(len(w) for w in words) - 1  # actually shrank
+    # reconstruct the uncompressed form and compare candidate streams
+    m_old = min(len(w) for w in words) - 1
+    checks_old = tuple(
+        (m_old - 1 - d, fam, dom)
+        for d, (_, fam, dom) in zip(depths, bank.checks)
+    )
+    b_old = fdr_mod.FdrBank(
+        m=m_old, checks=checks_old, tables=bank.tables,
+        patterns=bank.patterns, fp_per_byte=bank.fp_per_byte,
+    )
+    data = make_text(300, inject=[(4, b"xx volcano yy"),
+                                  (150, b"physics anarchism"),
+                                  (299, b"tail quantum")])
+    got = set(fdr_mod.reference_candidates(bank, data).tolist())
+    old = set(fdr_mod.reference_candidates(b_old, data).tolist())
+    # identical beyond the old seed window; inside it only over-report may
+    # differ (compressed seeds fewer positions) — never a lost candidate
+    assert {e for e in got if e > m_old} == {e for e in old if e > m_old}
+    assert got <= old
+    assert _true_ends(words, data) <= got

@@ -312,3 +312,25 @@ def test_include_exclude_order_semantics(tmp_path, capsys):
             for p, ln, txt in _parse_gnu(gout, [str(c), str(t)], 2)
         ), flags
         assert rc == grc, flags
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_cli_short_pattern_sets(seed, tmp_path, capsys):
+    """grep -f with 1-2-char literal sets (the round-4 pairset family)
+    differential vs GNU grep -F -f: stream, order, counts, exit codes."""
+    rng = np.random.default_rng(15000 + seed)
+    paths = _make_files(rng, tmp_path)
+    alphabet = "abcdefghijklmnopqrstuvwxyz"
+    pats = sorted({
+        "".join(alphabet[int(i)] for i in
+                rng.integers(0, len(alphabet), int(rng.integers(1, 3))))
+        for _ in range(int(rng.integers(2, 10)))
+    })
+    pf = tmp_path / "pats.txt"
+    pf.write_text("\n".join(pats) + "\n")
+    flags = ["-i"] if seed % 2 else []
+    rc, out = _run_ours(["grep", "-f", str(pf), *paths, *flags], capsys)
+    grc, gout = _run_gnu(["-n", "-F", "-f", str(pf), *flags, *paths])
+    assert _parse_ours(out) == _parse_gnu(gout, paths, 2), \
+        f"seed={seed} pats={pats}"
+    assert rc == grc

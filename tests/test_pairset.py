@@ -236,18 +236,3 @@ def test_pairset_fuzz_engine_vs_oracle(seed):
     got = set(eng.scan(data).matched_lines.tolist())
     assert got == ps.exact_match_lines(eng.pairset, data), (seed, pats)
 
-
-def test_results_materialize_guard(tmp_path):
-    """JobResult.results refuses to materialize past the limit (the
-    100 GB-path attractive-nuisance fix); streaming still works."""
-    from distributed_grep_tpu.runtime.job import JobResult
-
-    p = tmp_path / "mr-out-0"
-    p.write_text("k\tv\n" * 1000)
-    res = JobResult(output_files=[p])
-    assert res.results == {"k": "v"}
-    small = JobResult(output_files=[p])
-    small.RESULTS_MATERIALIZE_LIMIT = 100
-    with pytest.raises(RuntimeError, match="stream via iter_results"):
-        _ = small.results
-    assert sum(1 for _ in small.iter_results()) == 1000

@@ -317,3 +317,19 @@ def test_hung_map_still_swept_under_tight_timeout(tmp_path):
     counters = res.metrics["counters"]
     assert counters.get("map_retries", 0) >= 1  # swept at ~0.4 s, retried
     assert counters["map_completed"] == 1  # late duplicate absorbed
+
+
+def test_results_materialize_guard(tmp_path):
+    """JobResult.results refuses to materialize past the limit (the
+    100 GB-path attractive-nuisance fix); streaming still works."""
+    from distributed_grep_tpu.runtime.job import JobResult
+
+    p = tmp_path / "mr-out-0"
+    p.write_text("k\tv\n" * 1000)
+    res = JobResult(output_files=[p])
+    assert res.results == {"k": "v"}
+    small = JobResult(output_files=[p])
+    small.RESULTS_MATERIALIZE_LIMIT = 100
+    with pytest.raises(RuntimeError, match="stream via iter_results"):
+        _ = small.results
+    assert sum(1 for _ in small.iter_results()) == 1000
