@@ -108,11 +108,15 @@ class WorkerLoop:
 
     def _pumping(self, task_type: str, task_id: int, interval_s: float = 2.0):
         """Context manager: stamp heartbeats from a side thread while the
-        body runs.  Used ONLY around transport downloads — there the worker
-        is actively exchanging bytes with the coordinator's data plane
-        (which has its own 15 s liveness budget, http_transport.py), so the
-        pump cannot mask an application hang the way a whole-task pump
-        would."""
+        body runs — coarse process-alive liveness.  Two call sites:
+        transport downloads (always — the data plane has its own 15 s
+        liveness budget, http_transport.py, so no app hang can hide
+        there), and the map COMPUTE leg of apps without set_progress
+        support (there it genuinely cannot distinguish a slow map from a
+        hung one — the accepted tradeoff, documented at the call site,
+        because the alternative is spuriously re-executing every map
+        longer than the sweep window; progress-capable apps keep
+        fine-grained hang detection instead)."""
         import contextlib
         import threading
 
@@ -163,11 +167,24 @@ class WorkerLoop:
         # stamps the coordinator per chunk/segment (throttled), so the
         # failure detector keeps a tight window even over maps that
         # legitimately run long; downloads are covered by the pump thread
-        # (they progress against the coordinator's own data plane).
+        # (they progress against the coordinator's own data plane).  Apps
+        # WITHOUT progress support (wordcount over a big split) get the
+        # pump over their compute leg too: coarse liveness (process alive)
+        # beats the alternative — spurious re-execution of every map
+        # longer than the window, forever.  Progress-capable apps rely on
+        # their own stamps there, which unlike the pump also catch
+        # app-level hangs.
         has_progress = self.app.set_progress(
             self._progress_fn("map", a.task_id, a.task_timeout_s)
         )
         pump_s = min(2.0, self._hb_interval(a.task_timeout_s))
+        import contextlib
+
+        def compute_guard():
+            if has_progress:
+                return contextlib.nullcontext()
+            return self._pumping("map", a.task_id, pump_s)
+
         try:
             if use_path:
                 import os
@@ -179,7 +196,8 @@ class WorkerLoop:
                     self._fault("after_map_read")
                     n_bytes = os.path.getsize(path)
                     with self.metrics.timer("map_compute"), \
-                            trace.annotate(f"map_compute:{a.task_id}"):
+                            trace.annotate(f"map_compute:{a.task_id}"), \
+                            compute_guard():
                         records = self.app.map_path_fn(a.filename, str(path))
                 finally:
                     if is_temp:
@@ -191,7 +209,8 @@ class WorkerLoop:
                     contents = self.transport.read_input(a.filename)
                 self._fault("after_map_read")
                 with self.metrics.timer("map_compute"), \
-                        trace.annotate(f"map_compute:{a.task_id}"):
+                        trace.annotate(f"map_compute:{a.task_id}"), \
+                        compute_guard():
                     records = self.app.map_fn(a.filename, contents)
                 self.metrics.record_scan(len(contents), time.perf_counter() - t0)
         finally:

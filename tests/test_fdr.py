@@ -605,3 +605,41 @@ def test_pipeline_compression_preserves_candidates():
     assert {e for e in got if e > m_old} == {e for e in old if e > m_old}
     assert got <= old
     assert _true_ends(words, data) <= got
+
+
+def test_kernel_failure_mid_multisegment_scan_with_collect_pool(monkeypatch):
+    """Round-4 regression: with collects on a pool, a kernel that fails on
+    a LATER segment (first consumed inside a collect future) must still
+    trip the fallback net and produce the exact result — the failure
+    surfaces via future.result() instead of an inline call now."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    pats = _rand_literals(60, 4, 8, seed=13)
+    data = make_text(
+        3000,
+        inject=[(5, pats[0] + b" head"), (1500, b"mid " + pats[1]),
+                (2999, b"tail " + pats[2])],
+    )
+    monkeypatch.setattr(pallas_scan, "available", lambda: True)
+    calls = {"n": 0}
+    real = pallas_fdr.fdr_scan_words
+
+    def flaky(arr, bank, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise RuntimeError("mosaic says no, mid-scan")
+        kw["interpret"] = True
+        return real(arr, bank, **kw)
+
+    monkeypatch.setattr(pallas_fdr, "fdr_scan_words", flaky)
+    eng = engine_mod.GrepEngine(
+        patterns=[p.decode("latin-1") for p in pats], segment_bytes=16 * 1024
+    )
+    assert eng.mode == "fdr"
+    assert len(data) // (16 * 1024) >= 4
+    res = eng.scan(data)
+    assert eng._fdr_broken
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )

@@ -260,3 +260,23 @@ def test_multihost_feed_path_bit_identical(monkeypatch):
     )
     assert int(mh_total) == int(ref_total)
     assert (np.asarray(mh_words) == np.asarray(ref_words)).all()
+
+
+def test_engine_mesh_scan_under_forced_multihost(monkeypatch):
+    """Whole-engine scan with the multi-process feed branch forced on the
+    virtual mesh: segment tiles AND (for FDR) table arrays go through the
+    per-process shard assembly, and the output stays oracle-exact."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    mesh8 = make_mesh((8,), ("data",))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    data = (b"a needle here\n" + b"no hit line\n" * 6) * 300
+    eng = GrepEngine("needle", mesh=mesh8, interpret=True)
+    got = set(eng.scan(data).matched_lines.tolist())
+    want = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if b"needle" in ln
+    }
+    assert got == want
+    assert eng.stats.get("psum_candidates", 0) >= 1

@@ -333,3 +333,32 @@ def test_results_materialize_guard(tmp_path):
     with pytest.raises(RuntimeError, match="stream via iter_results"):
         _ = small.results
     assert sum(1 for _ in small.iter_results()) == 1000
+
+
+def test_progressless_app_survives_via_compute_pump(tmp_path):
+    """Apps without set_progress (wordcount-shaped) must not be swept
+    mid-compute under a tight window: the worker pumps coarse liveness
+    over their compute leg (process-alive semantics — the best available
+    signal when the app cannot report progress)."""
+    app_py = tmp_path / "mute_app.py"
+    app_py.write_text(
+        "import time\n"
+        "def configure(**kw): pass\n"
+        "def map_fn(filename, contents):\n"
+        "    time.sleep(1.0)\n"
+        "    return []\n"
+        "def reduce_fn(key, values):\n"
+        "    return ''\n"
+    )
+    f = tmp_path / "in.txt"
+    f.write_text("x\n")
+    cfg = JobConfig(
+        input_files=[str(f)], application=str(app_py), app_options={},
+        n_reduce=1, work_dir=str(tmp_path / "job"),
+        task_timeout_s=0.4, sweep_interval_s=0.05,
+    )
+    res = run_job(cfg, n_workers=1)
+    counters = res.metrics["counters"]
+    assert counters.get("map_retries", 0) == 0
+    assert counters.get("heartbeats", 0) >= 1
+    assert counters["map_completed"] == 1
