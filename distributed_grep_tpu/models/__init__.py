@@ -10,6 +10,12 @@ A pattern compiles to one of three automaton models, in order of preference:
 * ``aho``        — Aho-Corasick automaton for multi-literal pattern sets,
                    emitted in the same DFA table format.
 
+Pattern-SET models beyond the automata: ``fdr`` (bucketed pair-hash
+filter for large literal sets, Hyperscan's architecture on the lane-gather
+primitive), ``pairset`` (exact row-partition factorization for all-1-2-byte
+sets — the family FDR cannot host), and ``approx`` (agrep k-error
+Shift-And rows).
+
 All models share the *newline-reset* property: the scan state after a '\\n'
 byte is a fixed state independent of prior state.  That property is what
 makes the TPU scan embarrassingly lane-parallel (state at any byte depends
@@ -25,6 +31,12 @@ from distributed_grep_tpu.models.dfa import (
 )
 from distributed_grep_tpu.models.shift_and import ShiftAndModel, try_compile_shift_and
 from distributed_grep_tpu.models.aho import compile_aho_corasick
+from distributed_grep_tpu.models.fdr import FdrError, FdrModel, compile_fdr
+from distributed_grep_tpu.models.pairset import (
+    PairsetError,
+    PairsetModel,
+    compile_pairset,
+)
 
 __all__ = [
     "DfaTable",
@@ -34,4 +46,10 @@ __all__ = [
     "ShiftAndModel",
     "try_compile_shift_and",
     "compile_aho_corasick",
+    "FdrError",
+    "FdrModel",
+    "compile_fdr",
+    "PairsetError",
+    "PairsetModel",
+    "compile_pairset",
 ]

@@ -19,8 +19,10 @@ interface):
    (application/grep.go:20-30), kept as the escape hatch.
 
 Orthogonal modes: ``fdr`` (large literal sets — Pallas bucket filter +
-exact host confirm, models/fdr.py) and ``approx`` (``max_errors=k`` agrep
-matching — k+1-row bit-parallel recurrence, models/approx.py).
+exact host confirm, models/fdr.py), ``pairset`` (all-1-2-byte sets —
+exact row-partition pair kernel, no confirm, models/pairset.py), and
+``approx`` (``max_errors=k`` agrep matching — k+1-row bit-parallel
+recurrence, models/approx.py).
 
 Large documents are scanned in segments (bounded device memory — the
 reference instead reads whole files and cannot handle files larger than

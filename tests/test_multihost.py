@@ -274,9 +274,28 @@ def test_engine_mesh_scan_under_forced_multihost(monkeypatch):
     monkeypatch.setattr(jax, "process_index", lambda: 0)
     data = (b"a needle here\n" + b"no hit line\n" * 6) * 300
     eng = GrepEngine("needle", mesh=mesh8, interpret=True)
+    assert eng.mode == "shift_and"
     got = set(eng.scan(data).matched_lines.tolist())
     want = {
         i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if b"needle" in ln
     }
     assert got == want
     assert eng.stats.get("psum_candidates", 0) >= 1
+
+    # FDR under the same forced topology: segment tiles AND the EP table
+    # stack (pattern_axis on a 2D mesh) go through the per-process shard
+    # assembly (_put_spec)
+    mesh2d = make_mesh((4, 2), ("data", "seq"))
+    fdr_pats = ["needle", "volcano", "abcdef", "fedcba",
+                "zzebra", "gabhcd", "hhfgab", "deadbe"]
+    eng_fdr = GrepEngine(patterns=fdr_pats, mesh=mesh2d, mesh_axis="data",
+                         pattern_axis="seq", interpret=True)
+    assert eng_fdr.mode == "fdr"
+    got2 = set(eng_fdr.scan(data).matched_lines.tolist())
+    sp = {p.encode() for p in fdr_pats}
+    want2 = {
+        i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+        if any(p in ln for p in sp)
+    }
+    assert got2 == want2
+    assert eng_fdr.stats.get("psum_candidates", 0) >= 1
