@@ -643,3 +643,51 @@ def test_kernel_failure_mid_multisegment_scan_with_collect_pool(monkeypatch):
     assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
         pats, data, ignore_case=False
     )
+
+
+def test_mixed_set_short_members_ride_the_device():
+    """A set mixing long literals with 1-byte members: the shorts run the
+    exact pairset kernel OR'd into the FDR candidate words (round 4 — the
+    old host AC scan serialized the dispatch loop ~40x the device leg),
+    and the extended ConfirmSet keeps the union exact."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    pats = _rand_literals(60, 4, 8, seed=14) + [b"!", b"~"]
+    data = make_text(
+        2500,
+        inject=[(3, pats[0] + b" head"), (1200, b"bang ! mid"),
+                (2499, b"tilde ~ tail " + pats[1])],
+    )
+    eng = engine_mod.GrepEngine(
+        patterns=[p.decode("latin-1") for p in pats], interpret=True,
+        segment_bytes=16 * 1024,
+    )
+    assert eng.mode == "fdr"
+    assert eng._fdr_pairset is not None
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )
+
+    # and through the mesh path (lane-sharded FDR + lane-sharded pairset)
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    eng_m = engine_mod.GrepEngine(
+        patterns=[p.decode("latin-1") for p in pats], interpret=True,
+        mesh=make_mesh((8,), ("data",)),
+    )
+    assert eng_m.mode == "fdr" and eng_m._fdr_pairset is not None
+    res_m = eng_m.scan(data)
+    assert set(res_m.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )
+    # the MESH kernels actually ran (a silent fallback to the exact host
+    # path would still pass the oracle check)
+    assert eng_m.stats.get("psum_candidates", 0) >= 1
+    assert not eng_m._fdr_broken and not eng_m._pallas_broken
+    # and the stats-based retune is disabled for mixed sets (exact pairset
+    # matches pollute the candidate-rate measurement)
+    eng_m.stats["candidates"] = 10_000_000
+    eng_m.stats["confirm_seconds"] = 1.0
+    eng_m._maybe_retune_fdr(1 << 26)
+    assert not eng_m._fdr_retuned
