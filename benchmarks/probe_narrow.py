@@ -1,0 +1,174 @@
+"""Probe: do 16-bit (and signed 8-bit) VECTOR ops compile in Mosaic on this
+chip, and how fast is a 16-bit shift-and step vs the production 32-bit one?
+
+Motivation: the shift-and kernel (ops/pallas_scan.py) is ALU-bound at ~240
+GB/s with every per-byte op running on i32-widened (32,128) tiles = 4 vregs
+per array op.  Short patterns (<= 15 positions + match bit) fit their state
+and B-masks in 16 bits; if Mosaic compiles i16 compares/selects/shifts, the
+whole per-byte loop halves its vreg traffic -> ~2x ceiling.  u8 compares are
+KNOWN to crash Mosaic (CLAUDE.md, probed 2026-07-30); i16 is unprobed.
+
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/probe_narrow.py compile16
+    ... probe_narrow.py slope      # i32 vs i16 kernel GB/s, 64 MB
+    ... probe_narrow.py compile8   # signed-i8 compare (expected to crash)
+
+Each probe prints one JSON line; run under a subprocess guard — a Mosaic
+internal error can abort the process.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+from pathlib import Path
+
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+
+import numpy as np
+
+SUBLANES = 32
+LANE_COLS = 128
+CHUNK_BLOCK_WORDS = 16
+
+
+def _mini_kernel(data_ref, out_ref, state_ref, *, dt_name: str, steps: int):
+    """Shift-and-shaped loop at a chosen element width.
+
+    3 compare classes (the config-1 rare-class filter shape), coarse word
+    accumulation, state carried in scratch at the narrow width."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    dt = dict(i32=jnp.int32, i16=jnp.int16, i8=jnp.int8)[dt_name]
+    ut = dict(i32=jnp.uint32, i16=jnp.uint16, i8=jnp.uint8)[dt_name]
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        state_ref[:] = jnp.zeros_like(state_ref)
+
+    classes = ((ord("v"), 0b0000001), (ord("o"), 0b0100010),
+               (ord("l"), 0b0000100), (ord("c"), 0b0001000),
+               (ord("a"), 0b0010000), (ord("n"), 0b1000000))
+    match_bit = 1 << 6
+    wildcard = 0
+
+    def word_body(w, s):
+        word = jnp.zeros((SUBLANES, LANE_COLS), dtype=ut)
+        for tt in range(32):
+            b = data_ref[w * 32 + tt].astype(dt)
+            bmask = jnp.full((SUBLANES, LANE_COLS), ut(wildcard))
+            for val, mask in classes:
+                hit = b == val
+                bmask = bmask | jnp.where(hit, ut(mask), ut(0))
+            s = ((s << ut(1)) | ut(1)) & bmask
+            word = word | s
+        out_ref[w] = (word & ut(match_bit)).astype(jnp.uint32)
+        return s
+
+    final = jax.lax.fori_loop(0, steps // 32, word_body, state_ref[:])
+    state_ref[:] = final
+
+
+@functools.partial(
+    __import__("jax").jit, static_argnames=("dt_name", "chunk", "lane_blocks")
+)
+def _run(data, *, dt_name, chunk, lane_blocks):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ut = dict(i32=jnp.uint32, i16=jnp.uint16, i8=jnp.uint8)[dt_name]
+    steps = 32 * CHUNK_BLOCK_WORDS
+    kernel = functools.partial(_mini_kernel, dt_name=dt_name, steps=steps)
+    return pl.pallas_call(
+        kernel,
+        grid=(lane_blocks, chunk // steps),
+        in_specs=[pl.BlockSpec((steps, SUBLANES, LANE_COLS),
+                               lambda li, ci: (ci, li, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((CHUNK_BLOCK_WORDS, SUBLANES, LANE_COLS),
+                               lambda li, ci: (ci, li, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=__import__("jax").ShapeDtypeStruct(
+            (chunk // 32, lane_blocks * SUBLANES, LANE_COLS), np.uint32),
+        scratch_shapes=[pltpu.VMEM((SUBLANES, LANE_COLS), ut)],
+    )(data)
+
+
+def _corpus(n):
+    rng = np.random.default_rng(0)
+    data = rng.integers(32, 127, size=n, dtype=np.uint8)
+    data[rng.integers(0, n, size=n // 80)] = 0x0A
+    needle = np.frombuffer(b"volcano", np.uint8)
+    for p in rng.integers(0, n - 16, size=1000):
+        data[p : p + len(needle)] = needle
+    return data.tobytes()
+
+
+def _setup(data: bytes):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import layout as layout_mod
+
+    lay = layout_mod.choose_layout(len(data), target_lanes=8192, min_chunk=512,
+                                   lane_multiple=4096, chunk_multiple=512)
+    arr = layout_mod.to_device_array(data, lay)
+    pad_rows = 512
+    pad = np.full((pad_rows, arr.shape[1]), 0x0A, dtype=np.uint8)
+    full = np.concatenate([arr, pad], axis=0)
+    lane_blocks = lay.lanes // 4096
+    dev = jax.device_put(jnp.asarray(np.ascontiguousarray(
+        full.reshape(full.shape[0], lane_blocks * SUBLANES, LANE_COLS))))
+    return dev, lay, lane_blocks, pad_rows
+
+
+def probe_compile(dt_name: str) -> None:
+    data = _corpus(1 << 20)
+    dev, lay, lane_blocks, _ = _setup(data)
+    win = dev[: lay.chunk]
+    out = _run(win, dt_name=dt_name, chunk=lay.chunk, lane_blocks=lane_blocks)
+    n = int(np.count_nonzero(np.asarray(out)))
+    print(json.dumps({"probe": f"compile_{dt_name}", "ok": True,
+                      "nonzero_words": n}))
+
+
+def probe_slope() -> None:
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    data = _corpus(64 << 20)
+    dev, lay, lane_blocks, pad_rows = _setup(data)
+    for dt_name in ("i32", "i16"):
+        def scan(win, dt_name=dt_name):
+            out = _run(win, dt_name=dt_name, chunk=lay.chunk,
+                       lane_blocks=lane_blocks)
+            return jnp.count_nonzero(out)
+
+        per_pass, cnt = slope_per_pass(dev, lay.chunk, pad_rows, scan,
+                                       r1=2, r2=10, measurements=3)
+        gbs = len(data) / per_pass / 1e9
+        print(json.dumps({"probe": f"slope_{dt_name}", "gbs": round(gbs, 1),
+                          "per_pass_ms": round(per_pass * 1e3, 2),
+                          "count": int(cnt)}))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "compile16"
+    if which == "compile16":
+        probe_compile("i16")
+    elif which == "compile8":
+        probe_compile("i8")
+    elif which == "slope":
+        probe_slope()
+    else:
+        raise SystemExit(f"unknown probe {which}")
