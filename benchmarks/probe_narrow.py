@@ -53,9 +53,9 @@ def _mini_kernel(data_ref, out_ref, state_ref, *, dt_name: str, steps: int):
     def _init():
         state_ref[:] = jnp.zeros_like(state_ref)
 
-    classes = ((ord("v"), 0b0000001), (ord("o"), 0b0100010),
+    classes = ((ord("v"), 0b0000001), (ord("o"), 0b1000010),
                (ord("l"), 0b0000100), (ord("c"), 0b0001000),
-               (ord("a"), 0b0010000), (ord("n"), 0b1000000))
+               (ord("a"), 0b0010000), (ord("n"), 0b0100000))
     match_bit = 1 << 6
     wildcard = 0
 

@@ -94,6 +94,48 @@ def _normalize(patterns, ignore_case: bool) -> list[bytes]:
     return out
 
 
+def expected_match_density(patterns, *, ignore_case: bool = False) -> float:
+    """Expected matches per scanned byte under the static byte-frequency
+    prior (models/shift_and._byte_prior — English-prose letter frequencies
+    with a uniform floor).
+
+    The pairset kernel is EXACT, so its device words are matches, not
+    candidates — but the host still pays O(matches) for the sparse
+    coordinate fetch and per-line reporting.  A member like ``" "`` or
+    ``"e"`` makes that ~0.1+ matches/byte: the device pass then buys
+    nothing over the native host scanner while the offset fetch pays a
+    device->host transfer the host path never needed.  The engine gates
+    both pairset routes (pure-short mode and the mixed-set 1-byte
+    sidecar) on this estimate against models/fdr.FP_CEILING_PER_BYTE —
+    the same ceiling that keeps over-dense sets off the FDR filter.
+    The estimate is the MAX over two corpus models — the uniform-floored
+    prior (binary corpora) and the prose-conditional `_text_prior` (text,
+    where ' ' really is ~15% of bytes) — so a dense member is caught
+    under whichever model makes it dense.  Like the shift-and rare-class
+    prior, a corpus can still defeat the estimate; that affects only
+    throughput, never exactness."""
+    from distributed_grep_tpu.models.shift_and import _byte_prior, _text_prior
+
+    norm = _normalize(patterns, ignore_case)
+    M = np.zeros((256, 256), dtype=np.float64)
+    for p in norm:
+        if len(p) == 2:
+            M[p[0], p[1]] = 1.0
+        else:  # 1-byte member: any previous byte
+            M[:, p[0]] = 1.0
+    dens = 0.0
+    for q in (_byte_prior(), _text_prior()):
+        q = np.asarray(q, dtype=np.float64).copy()
+        if ignore_case:
+            # members are stored folded and the kernel folds corpus bytes:
+            # a lowercase byte's effective frequency absorbs its uppercase
+            for c in range(ord("a"), ord("z") + 1):
+                q[c] += q[c - 32]
+                q[c - 32] = 0.0
+        dens = max(dens, float(q @ M @ q))
+    return dens
+
+
 def _factorize(M: np.ndarray) -> tuple[np.ndarray, np.ndarray, int] | None:
     """Partition the 256 rows of a (256, 256) bool matrix by identical
     pattern; return (rowcls, words, n_classes) or None if > 32 classes."""

@@ -117,6 +117,36 @@ def _byte_prior() -> np.ndarray:
 
 _PRIOR = _byte_prior()
 
+
+def _text_prior() -> np.ndarray:
+    """Prose-conditional byte prior: the `_byte_prior` weights renormalized
+    over printable ASCII + whitespace only.
+
+    `_byte_prior`'s uniform 1/256 floor over all 256 byte values divides
+    its mass ~2.25x below real prose frequencies (' ' is ~15% of text
+    bytes, but the normalized prior says 6.7%) — right for ranking classes
+    by rarity (its original job), but an underestimate when a DENSITY gate
+    needs an absolute matches-per-byte number for a text corpus
+    (models/pairset.expected_match_density).  Gates take the max of the
+    two priors' estimates: this one models text, the floored one models
+    binary corpora.
+
+    `_LETTER_FREQ` is conditioned on letters only (sums to ~1), so the
+    weights here rescale it by the letter share of prose characters
+    (~70% lowercase, ~1/15 of that uppercase) around space at ~17% —
+    the standard all-character English distribution."""
+    w = np.zeros(256, dtype=np.float64)
+    w[9] = 0.002  # tab
+    w[10] = 0.02  # newline (members never contain it; mass only)
+    w[33:127] = 0.0015  # punctuation floor
+    for ch, f in _LETTER_FREQ.items():
+        w[ord(ch)] = f * 0.70
+        w[ord(ch.upper())] = f * 0.70 / 15
+    w[ord(" ")] = 0.17
+    for d in b"0123456789":
+        w[d] = 0.006
+    return w / w.sum()
+
 # Keep adding checked classes until the modeled false-candidate rate drops
 # below this.  Economics: a span candidate costs ~1 us of host line confirm,
 # the full-class device scan ~5 ps/byte — at 2e-6/byte the confirm is ~2 ps

@@ -267,18 +267,40 @@ class GrepEngine:
                 # MXU question: the gather factorization wins the
                 # shared-contraction formulation's ceiling).
                 if max(_blen(p) for p in patterns) <= 2:
+                    from distributed_grep_tpu.models.fdr import (
+                        FP_CEILING_PER_BYTE,
+                    )
                     from distributed_grep_tpu.models.pairset import (
                         PairsetError,
                         compile_pairset,
+                        expected_match_density,
                     )
 
-                    try:
-                        self.pairset = compile_pairset(
-                            patterns, ignore_case=ignore_case
+                    # Exact kernel or not, matches are fetched O(matches)
+                    # from the device: a set expected to match at ~0.1+/byte
+                    # (a member like " " or "e") makes the sparse fetch the
+                    # bottleneck and the device pass pointless — the same
+                    # ceiling that keeps over-dense sets off the FDR filter
+                    # routes these to the native host scanner.
+                    dens = expected_match_density(
+                        patterns, ignore_case=ignore_case
+                    )
+                    if dens > FP_CEILING_PER_BYTE:
+                        log.warning(
+                            "short set expected match density %.3g/byte is "
+                            "over the device ceiling %.2g -> native host "
+                            "scanner", dens, FP_CEILING_PER_BYTE,
                         )
-                        self.mode = "pairset"
-                    except PairsetError as e:
-                        log.info("short set not pairset-representable: %s", e)
+                    else:
+                        try:
+                            self.pairset = compile_pairset(
+                                patterns, ignore_case=ignore_case
+                            )
+                            self.mode = "pairset"
+                        except PairsetError as e:
+                            log.info(
+                                "short set not pairset-representable: %s", e
+                            )
                 if self.mode != "pairset" and long_pats:
                     try:
                         # Chip-aware pricing (VERDICT r3 item 1): the host
@@ -287,6 +309,35 @@ class GrepEngine:
                         # at the per-chip share from the start.  The routed
                         # decomposition probe compiled at n_chips=1; recompile
                         # it only when the chip count actually changes plans.
+                        if short_pats:
+                            # A dense 1-byte member ("e", " ") defeats the
+                            # filter architecture outright: the pairset
+                            # sidecar would turn every occurrence into a
+                            # device-reported candidate, so the collect
+                            # path's O(candidates) coordinate fetch +
+                            # confirm stream swamps the scan it was meant
+                            # to hide behind.  Same ceiling as the FDR
+                            # plan's own candidate-rate gate; the whole set
+                            # then routes loudly to the native scanner
+                            # below (the retune that might notice later is
+                            # disabled for mixed sets by design).
+                            from distributed_grep_tpu.models.fdr import (
+                                FP_CEILING_PER_BYTE,
+                            )
+                            from distributed_grep_tpu.models.pairset import (
+                                expected_match_density,
+                            )
+
+                            short_dens = expected_match_density(
+                                short_pats, ignore_case=ignore_case
+                            )
+                            if short_dens > FP_CEILING_PER_BYTE:
+                                raise FdrError(
+                                    f"mixed set's 1-byte members expect "
+                                    f"{short_dens:.3g} matches/byte — over "
+                                    f"the {FP_CEILING_PER_BYTE:.2g} device "
+                                    f"candidate ceiling"
+                                )
                         base_pricing = self._fdr_base_pricing()
                         if routed_fdr is not None and base_pricing.n_chips > 1:
                             routed_fdr = None

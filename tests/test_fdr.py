@@ -691,3 +691,25 @@ def test_mixed_set_short_members_ride_the_device():
     eng_m.stats["confirm_seconds"] = 1.0
     eng_m._maybe_retune_fdr(1 << 26)
     assert not eng_m._fdr_retuned
+
+
+def test_mixed_set_dense_short_member_routes_to_native():
+    """A mixed set whose 1-byte member is expected-dense (' ') must not
+    attach the pairset sidecar: every occurrence would become a
+    device-reported candidate and the collect path's O(candidates)
+    coordinate fetch + confirm would swamp the scan (round-4 review
+    finding).  The whole set keeps the loud native route, exact."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    pats = _rand_literals(40, 4, 8, seed=77) + [b" "]
+    eng = engine_mod.GrepEngine(
+        patterns=[p.decode("latin-1") for p in pats], interpret=True,
+    )
+    assert eng.mode in ("native", "dfa")
+    assert eng._fdr_pairset is None and eng.fdr is None
+    data = make_text(400, inject=[(3, pats[0] + b"-x"), (200, b"nospacehere")])
+    res = eng.scan(data)
+    import distributed_grep_tpu.models.fdr as fdr_mod
+    assert set(res.matched_lines.tolist()) == fdr_mod.exact_match_lines(
+        pats, data, ignore_case=False
+    )

@@ -236,3 +236,30 @@ def test_pairset_fuzz_engine_vs_oracle(seed):
     got = set(eng.scan(data).matched_lines.tolist())
     assert got == ps.exact_match_lines(eng.pairset, data), (seed, pats)
 
+
+
+# ------------------------------------------------------- density gate (r4)
+
+def test_expected_match_density_models_text_and_binary():
+    """The estimator takes the max over the uniform-floored and the
+    prose-conditional priors: ' ' is dense under the text model (~16% of
+    prose bytes) even though the floored prior dilutes it below the
+    ceiling; a rare digraph is ~0 under both."""
+    assert ps.expected_match_density([" "]) > 0.15
+    assert ps.expected_match_density(["zq"]) < 1e-4
+    # ignore_case folds uppercase mass into the folded member
+    assert (ps.expected_match_density(["a"], ignore_case=True)
+            > ps.expected_match_density(["a"]))
+
+
+def test_dense_short_set_routes_to_native_not_pairset():
+    """A short set with an over-ceiling expected match density (' ' is
+    ~16% of prose bytes) must not ride the device kernel: the O(matches)
+    sparse coordinate fetch would swamp the scan it feeds (round-4 review
+    finding).  It keeps the loud native-host route and stays exact."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    eng = GrepEngine(patterns=[" ", "ab"], interpret=True)
+    assert eng.mode in ("native", "dfa")
+    got = set(eng.scan(b"a b\nxyz\nqab\ncc c\n").matched_lines.tolist())
+    assert got == {1, 3, 4}
