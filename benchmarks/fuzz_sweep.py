@@ -13,6 +13,7 @@ kernels), no TPU required.
 from __future__ import annotations
 
 import argparse
+import inspect
 import os
 import sys
 import traceback
@@ -66,8 +67,6 @@ def main() -> int:
 
     failures = 0
     for name, fn in sorted(fams.items()):
-        import inspect
-
         params = list(inspect.signature(fn).parameters)
         if params != ["seed"]:
             print(f"{name}: skipped (needs fixtures: {params})")

@@ -39,7 +39,12 @@ from dataclasses import dataclass
 import numpy as np
 
 from distributed_grep_tpu.models.aho import compile_aho_corasick_banks
-from distributed_grep_tpu.models.fdr import FdrError, FdrModel, compile_fdr
+from distributed_grep_tpu.models.fdr import (
+    FP_CEILING_PER_BYTE,
+    FdrError,
+    FdrModel,
+    compile_fdr,
+)
 from distributed_grep_tpu.models.dfa import (
     DfaTable,
     RegexError,
@@ -266,16 +271,13 @@ class GrepEngine:
                 # on device, so it is tried first (round-4 closure of the
                 # MXU question: the gather factorization wins the
                 # shared-contraction formulation's ceiling).
-                if max(_blen(p) for p in patterns) <= 2:
-                    from distributed_grep_tpu.models.fdr import (
-                        FP_CEILING_PER_BYTE,
-                    )
-                    from distributed_grep_tpu.models.pairset import (
-                        PairsetError,
-                        compile_pairset,
-                        expected_match_density,
-                    )
+                from distributed_grep_tpu.models.pairset import (
+                    PairsetError,
+                    compile_pairset,
+                    expected_match_density,
+                )
 
+                if max(_blen(p) for p in patterns) <= 2:
                     # Exact kernel or not, matches are fetched O(matches)
                     # from the device: a set expected to match at ~0.1+/byte
                     # (a member like " " or "e") makes the sparse fetch the
@@ -321,13 +323,6 @@ class GrepEngine:
                             # then routes loudly to the native scanner
                             # below (the retune that might notice later is
                             # disabled for mixed sets by design).
-                            from distributed_grep_tpu.models.fdr import (
-                                FP_CEILING_PER_BYTE,
-                            )
-                            from distributed_grep_tpu.models.pairset import (
-                                expected_match_density,
-                            )
-
                             short_dens = expected_match_density(
                                 short_pats, ignore_case=ignore_case
                             )
@@ -358,10 +353,6 @@ class GrepEngine:
                             # (0.2 s vs 5 ms per 64 MB segment); without
                             # a kernel backend the engine's DFA-bank/native
                             # fallback already covers the whole set.
-                            from distributed_grep_tpu.models.pairset import (
-                                compile_pairset,
-                            )
-
                             self._fdr_pairset = compile_pairset(
                                 short_pats, ignore_case=ignore_case
                             )
