@@ -109,7 +109,7 @@ LITERAL_SET_CAP = 256
 
 
 def enumerate_literal_set(
-    pattern: str, cap: int = LITERAL_SET_CAP
+    pattern: str, cap: int = LITERAL_SET_CAP, *, ignore_case: bool = False
 ) -> list[bytes] | None:
     """The byte strings matched by ``pattern`` when it denotes a finite
     literal set — an alternation / concatenation / small-class product with
@@ -123,11 +123,15 @@ def enumerate_literal_set(
     regex.  Parsing is always case-SENSITIVE: for a case-insensitive grep
     the caller must forward ignore_case to the downstream set engine (the
     engines fold natively; enumerating folded masks here would blow the
-    cap at 2^len).  Newline-containing expansions return
+    cap at 2^len) — but it must ALSO pass ``ignore_case`` here so negated
+    classes fold their members before complementing (otherwise the
+    enumeration of ``[^x]`` contains ``X``, which the set engine folds
+    back to the excluded ``x``).  Newline-containing expansions return
     None (a literal with '\n' can never match within a line; the regex
     paths own that semantics)."""
     try:
-        ast = _Parser(pattern, ignore_case=False).parse()
+        ast = _Parser(pattern, ignore_case=False,
+                      fold_negated_classes=ignore_case).parse()
     except RegexError:
         return None
 
@@ -168,14 +172,33 @@ def enumerate_literal_set(
     return out
 
 
+def _fold_mask(mask: int) -> int:
+    """Case-close a 256-bit byte-class mask (ASCII letters only)."""
+    folded = mask
+    for lo, up in zip(range(ord("a"), ord("z") + 1), range(ord("A"), ord("Z") + 1)):
+        if mask >> lo & 1:
+            folded |= 1 << up
+        if mask >> up & 1:
+            folded |= 1 << lo
+    return folded
+
+
 class _Parser:
     """Recursive-descent parser for the grep -E subset."""
 
-    def __init__(self, pattern: str, ignore_case: bool):
+    def __init__(self, pattern: str, ignore_case: bool,
+                 fold_negated_classes: bool = False):
         self.src = (pattern.encode("utf-8", "surrogateescape")
                     if isinstance(pattern, str) else bytes(pattern))
         self.pos = 0
         self.ignore_case = ignore_case
+        # enumerate_literal_set parses case-SENSITIVELY (the set engines
+        # fold members natively, and pre-folded masks would blow the
+        # enumeration cap) — but a NEGATED class must still fold its
+        # members before complementing, or the downstream per-member fold
+        # re-adds the excluded letter via its case partner ([^x] -i
+        # enumerates 'X', which the set engine folds back to 'x').
+        self.fold_negated_classes = fold_negated_classes
 
     def parse(self):
         node = self._alt()
@@ -404,20 +427,22 @@ class _Parser:
                     mask |= 1 << b
             else:
                 mask |= m
+        # Fold BEFORE complementing: [^x] under -i must exclude both 'x'
+        # and 'X' (re/grep semantics).  Folding after would re-add the
+        # excluded letter — the complement contains its case partner, and
+        # expanding that partner puts the letter back (every engine path
+        # shares this class mask, so the old order over-matched them all).
+        # The complement of a case-closed set is itself case-closed, so no
+        # second fold is needed.
+        mask = self._fold(mask)
         if negate:
+            if self.fold_negated_classes:
+                mask = _fold_mask(mask)
             mask = _ALL & ~mask & ~_mask_of(NL)  # grep: negated classes skip \n
-        return self._fold(mask)
+        return mask
 
     def _fold(self, mask: int) -> int:
-        if not self.ignore_case:
-            return mask
-        folded = mask
-        for lo, up in zip(range(ord("a"), ord("z") + 1), range(ord("A"), ord("Z") + 1)):
-            if mask >> lo & 1:
-                folded |= 1 << up
-            if mask >> up & 1:
-                folded |= 1 << lo
-        return folded
+        return _fold_mask(mask) if self.ignore_case else mask
 
     def _peek(self) -> int | None:
         return self.src[self.pos] if self.pos < len(self.src) else None

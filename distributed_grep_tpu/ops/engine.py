@@ -196,7 +196,7 @@ class GrepEngine:
         if pattern is not None and patterns is None and not max_errors:
             sa_model = try_compile_shift_and(pattern, ignore_case=ignore_case)
             if sa_model is None:
-                lits = enumerate_literal_set(pattern)
+                lits = enumerate_literal_set(pattern, ignore_case=ignore_case)
                 route = lits is not None and len(lits) >= 2
                 if route and backend == "device":
                     # Only reroute when the FDR filter actually hosts the

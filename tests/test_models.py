@@ -245,3 +245,28 @@ def test_backrefs_and_assertions_reject_to_re_fallback():
     assert eng.scan(b"a word x\nwords\nbwordb\n").matched_lines.tolist() == [1]
     eng2 = GrepEngine(r"(ab)\1", backend="cpu")
     assert eng2.scan(b"abab\nabcd\n").matched_lines.tolist() == [1]
+
+
+def test_negated_class_ignore_case_excludes_both_cases():
+    """[^x] under -i must reject 'x' AND 'X' (re/grep semantics): the
+    parser folds class members BEFORE complementing — folding after
+    re-adds the excluded letter via its case partner (round-4 wide-fuzz
+    find, seed 1111; the bad mask was shared by every engine path)."""
+    import re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    for pat, data in (
+        (r"[^x]$", b"fox\n"), (r"a[^x]", b"aX\n"), (r"[^x]", b"X\n"),
+        (r"[^a-c]", b"B\n"), (r"[^a-c]", b"d\n"), (r"[^\d]", b"5\n"),
+        # literal-set decomposition route (enumerate_literal_set parses
+        # case-sensitively; negated classes must still fold-then-complement
+        # or the per-member downstream fold re-adds the excluded letter)
+        (r"([^x]|zz)", b"x\n"), (r"(q[^x]|qq)", b"qX\n"),
+        (r"([^x]|zz)", b"a\n"),
+    ):
+        want = bool(re.search(pat.encode(), data.rstrip(b"\n"), re.IGNORECASE))
+        for backend in ("cpu", "device"):
+            eng = GrepEngine(pat, backend=backend, ignore_case=True)
+            got = bool(eng.scan(data).matched_lines.size)
+            assert got == want, (pat, data, backend, eng.mode)
