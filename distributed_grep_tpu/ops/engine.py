@@ -658,6 +658,29 @@ class GrepEngine:
         callable) is invoked at segment milestones on the device path so a
         runtime failure detector can keep a tight liveness window over
         long scans (runtime/worker.py wires it to the heartbeat RPC)."""
+        res = self._scan_impl(data, progress)
+        # Nullable-at-'$' patterns (accept_eol at the line-start state,
+        # e.g. '^$', '^ *$', 'x?$'): the empty match is valid at every
+        # line's EOL — including EMPTY lines, which contain no byte for
+        # the byte-level scanners to report on.  Scans attribute the
+        # empty-line match to the '\n' PRECEDING the line, so they miss an
+        # empty line at offset 0, and their padded trailing '\n'
+        # symmetrically manufactures a match for a line that does not
+        # exist when the data ends at a newline.  Post-processing owns
+        # both edges for every backend: union in the empty lines, drop
+        # anything past the last real line.  (Found by the round-4 wide
+        # fuzz sweep, seed 3116.)
+        if self.tables and any(bool(t.accept_eol[t.start]) for t in self.tables):
+            nl = lines_mod.newline_index(data)  # one pass serves both legs
+            n_lines = nl.size + (0 if not data or data.endswith(b"\n") else 1)
+            ml = res.matched_lines[res.matched_lines <= n_lines]
+            ml = np.union1d(ml, lines_mod.empty_line_numbers(data, nl))
+            res = ScanResult(
+                ml.astype(np.int64), int(ml.size), res.bytes_scanned
+            )
+        return res
+
+    def _scan_impl(self, data: bytes, progress=None) -> ScanResult:
         if self.mode == "re":
             return self._scan_re(data)
         if self._approx_all_lines or (

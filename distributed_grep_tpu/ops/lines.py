@@ -86,6 +86,23 @@ def newline_index(data: bytes) -> np.ndarray:
     return native.newline_index(data).astype(np.int64)
 
 
+def empty_line_numbers(data: bytes, nl_index: np.ndarray | None = None) -> np.ndarray:
+    """Sorted 1-based numbers of zero-length lines.
+
+    A line is empty iff its '\\n' sits at the line's start offset —
+    position 0 for line 1, or immediately after the previous '\\n'.  The
+    fragment after the last '\\n' is a line only when non-empty
+    (count_lines semantics), so it is never reported here.  Pass an
+    already-computed ``newline_index(data)`` to skip the native pass."""
+    nl = newline_index(data) if nl_index is None else nl_index
+    if nl.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    out = (np.nonzero(np.diff(nl) == 1)[0] + 2).astype(np.int64)
+    if nl[0] == 0:
+        out = np.concatenate([np.ones(1, np.int64), out])
+    return out
+
+
 def count_lines(data: bytes) -> int:
     """Line count with grep -n semantics: a trailing '\\n' closes the last
     line rather than opening an empty one; empty input has zero lines."""

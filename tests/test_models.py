@@ -270,3 +270,29 @@ def test_negated_class_ignore_case_excludes_both_cases():
             eng = GrepEngine(pat, backend=backend, ignore_case=True)
             got = bool(eng.scan(data).matched_lines.size)
             assert got == want, (pat, data, backend, eng.mode)
+
+
+def test_nullable_at_eol_matches_empty_lines_exactly():
+    """Patterns whose empty match is valid at '$' ('^$', '^ *$', 'x?$')
+    must match empty lines — including an empty FIRST line, which no
+    byte-level scan position covers — and must not report a phantom line
+    past the final newline (round-4 wide-fuzz find, seed 3116; the
+    engine post-processes both edges for every backend)."""
+    import re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    cases = [
+        (r"^$", b"a\n\nbb\n\n\ncc\n"), (r"^$", b"\nx\n"),
+        (r"^ *$", b"a\n  \n\nz"), (r"x?$", b"a\n\nbb\n"),
+        (r"(a|b?)$", b"\n\n"), (r"a$", b"a\n\na\n"), (r"^$", b"\n"),
+        (r"^$", b""),
+    ]
+    for pat, data in cases:
+        rx = re.compile(pat.encode())
+        lines = data.split(b"\n")[:-1] if data.endswith(b"\n") else data.split(b"\n")
+        want = [i for i, ln in enumerate(lines, 1) if rx.search(ln)] if data else []
+        for backend in ("cpu", "device"):
+            eng = GrepEngine(pat, backend=backend)
+            got = sorted(eng.scan(data).matched_lines.tolist())
+            assert got == want, (pat, data, backend, eng.mode, got, want)
