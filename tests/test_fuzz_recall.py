@@ -104,6 +104,12 @@ def _gen_corpus(rng, kind: str, size: int, needles: list[bytes]) -> bytes:
         for pos in rng.integers(0, max(1, len(arr) - 64), size=min(8, len(needles) * 2)):
             nd = needles[int(rng.integers(0, len(needles)))]
             nd = nd.replace(b"\n", b"x")
+            if len(nd) > len(arr):
+                continue
+            # sampled bounded-repeat matches can exceed the 64-byte margin
+            # the position draw assumes — clamp so the write always fits
+            # (a no-op for every draw that fit before)
+            pos = min(int(pos), len(arr) - len(nd))
             arr[pos : pos + len(nd)] = np.frombuffer(nd, dtype=np.uint8)
         data = arr.tobytes()
     return data
