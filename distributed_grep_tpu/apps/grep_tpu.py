@@ -32,15 +32,12 @@ _configured_with: tuple | None = None
 import threading as _threading
 
 _progress = _threading.local()
-# First device scan in this process pays the cold jit compile (~20-40 s
-# through a tunneled TPU) with no observable progress — declare it as a
-# bounded grace window so a tight failure-detector timeout tolerates it.
-COMPILE_GRACE_S = float(__import__("os").environ.get("DGREP_COMPILE_GRACE_S", "90"))
-# Set only after a device scan COMPLETES: every task that starts before
-# then declares grace (a concurrent worker slot blocks on the SAME shared
-# jit compile as the first, so gating on who declares first would leave it
-# stampless mid-compile and spuriously swept).
-_compile_done = False
+# Compile-grace windows are declared by the ENGINE, per fresh kernel/layout
+# shape (ops/engine.py COMPILE_GRACE_S): a one-shot app-level flag missed
+# the later jit specializations every new segment-layout shape triggers
+# (round-4 review finding) — the engine knows exactly when it is about to
+# dispatch a shape it has not compiled yet.
+from distributed_grep_tpu.ops.engine import COMPILE_GRACE_S  # noqa: F401  (re-export)
 
 
 def set_progress(fn) -> None:
@@ -50,25 +47,9 @@ def set_progress(fn) -> None:
 
 
 def _progress_fn():
+    """The installed progress callback, handed to the engine as-is — the
+    engine stamps work milestones and declares compile grace itself."""
     return getattr(_progress, "fn", None)
-
-
-def _begin_scan_progress():
-    """The per-scan progress callback, declaring compile grace ahead of
-    any device scan that may block on this process's cold jit compile."""
-    fn = _progress_fn()
-    if fn is None:
-        return None
-    if _engine is not None and _engine.backend == "device" and not _compile_done:
-        fn(grace_s=COMPILE_GRACE_S)
-    else:
-        fn()
-    return lambda: fn()
-
-
-def _scan_completed() -> None:
-    global _compile_done
-    _compile_done = True
 
 
 def configure(
@@ -149,8 +130,7 @@ def configure(
 def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
-    result = _engine.scan(contents, progress=_begin_scan_progress())
-    _scan_completed()
+    result = _engine.scan(contents, progress=_progress_fn())
     emit = result.matched_lines.tolist()
     nl = None
     if _confirm is not None and emit:
@@ -205,8 +185,7 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
             )
         )
 
-    _engine.scan_file(path, emit=emit, progress=_begin_scan_progress())
-    _scan_completed()
+    _engine.scan_file(path, emit=emit, progress=_progress_fn())
     return out
 
 

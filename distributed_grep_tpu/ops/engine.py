@@ -70,6 +70,12 @@ from distributed_grep_tpu.models.shift_and import (
 from distributed_grep_tpu.ops import lines as lines_mod
 from distributed_grep_tpu.utils.logging import get_logger
 
+# A cold XLA/Mosaic compile through a tunneled TPU runs ~20-40 s with no
+# observable progress; the scan declares it as a bounded grace window on
+# its progress callback (per fresh layout shape) so a tight
+# failure-detector window tolerates compiles without being blind to hangs.
+COMPILE_GRACE_S = float(_os.environ.get("DGREP_COMPILE_GRACE_S", "90"))
+
 log = get_logger("engine")
 
 # Coarse span path: above this many candidate lines per segment, per-line
@@ -174,6 +180,18 @@ class GrepEngine:
         self._fdr_confirm = None  # utils/native.ConfirmSet (FDR mode only)
         self._fdr_broken = False
         self._pallas_broken = False  # any Pallas kernel failed at runtime
+        # Compile-grace bookkeeping: every (kernel, layout shape) this
+        # engine has COMPLETED a dispatch for.  A dispatch whose key is not
+        # in here may block on a fresh XLA/Mosaic compile (~20-40 s through
+        # a tunneled TPU), so it declares a grace window on the progress
+        # callback first — per SHAPE, not once per process: a job over
+        # differently-sized files jit-specializes per distinct tail layout
+        # (round-4 review finding).  Keys are added only after the kernel
+        # call returns (compile done), so concurrent scans blocked on the
+        # same compile each declare their own grace.
+        self._compiled_keys: set = set()
+        self._model_gen = 0  # bumped when a retune swaps kernel constants
+        self._nl_stash: tuple[int, object] | None = None
         self._nfa_filter = False  # Glushkov model is a candidate superset
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
@@ -580,6 +598,7 @@ class GrepEngine:
             self.fdr = model
             self._fdr_dev_tables = None
             self._fdr_ep_dev_tables = None
+            self._model_gen += 1  # new plan = new kernel compile: re-grace
         self._fdr_pricing = pricing
 
     def _maybe_retune_fdr(self, n_bytes: int) -> None:
@@ -653,11 +672,24 @@ class GrepEngine:
         ))
 
     # ------------------------------------------------------------------ scan
+    def _kernel_backend_ok(self) -> bool:
+        """One gate for "a Pallas kernel can actually run here": a backend
+        exists (real TPU, or interpret mode in CI) and no kernel has failed
+        at runtime this engine.  Shared by every routing branch so the
+        gates cannot silently diverge."""
+        from distributed_grep_tpu.ops import pallas_scan
+
+        return (
+            pallas_scan.available() or self._interpret
+        ) and not self._pallas_broken
+
     def scan(self, data: bytes, progress=None) -> ScanResult:
-        """Scan one in-memory document.  ``progress`` (optional, no-arg
-        callable) is invoked at segment milestones on the device path so a
-        runtime failure detector can keep a tight liveness window over
-        long scans (runtime/worker.py wires it to the heartbeat RPC)."""
+        """Scan one in-memory document.  ``progress`` (optional callable,
+        called as ``progress()`` at work milestones and
+        ``progress(grace_s=N)`` ahead of a possible silent compile) is how
+        a runtime failure detector keeps a tight liveness window over long
+        scans (runtime/worker.py wires it to the heartbeat RPC)."""
+        self._nl_stash: tuple[int, object] | None = None
         res = self._scan_impl(data, progress)
         # Nullable-at-'$' patterns (accept_eol at the line-start state,
         # e.g. '^$', '^ *$', 'x?$'): the empty match is valid at every
@@ -671,7 +703,13 @@ class GrepEngine:
         # anything past the last real line.  (Found by the round-4 wide
         # fuzz sweep, seed 3116.)
         if self.tables and any(bool(t.accept_eol[t.start]) for t in self.tables):
-            nl = lines_mod.newline_index(data)  # one pass serves both legs
+            stash = self._nl_stash
+            nl = (
+                stash[1] if stash is not None and stash[0] == len(data)
+                # chunked scans stash per-piece indexes (wrong length) —
+                # recompute over the full buffer then
+                else lines_mod.newline_index(data)
+            )
             n_lines = nl.size + (0 if not data or data.endswith(b"\n") else 1)
             ml = res.matched_lines[res.matched_lines <= n_lines]
             ml = np.union1d(ml, lines_mod.empty_line_numbers(data, nl))
@@ -682,7 +720,7 @@ class GrepEngine:
 
     def _scan_impl(self, data: bytes, progress=None) -> ScanResult:
         if self.mode == "re":
-            return self._scan_re(data)
+            return self._host_scan(self._scan_re, data, progress)
         if self._approx_all_lines or (
             self.tables and any(t.accept[t.start] for t in self.tables)
         ):
@@ -691,32 +729,70 @@ class GrepEngine:
             n_lines = lines_mod.count_lines(data)
             return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
         if self.mode == "native":
-            return self._scan_native(data)
-        if self.mode == "pairset":
-            from distributed_grep_tpu.ops import pallas_scan
-
-            if not (
-                (pallas_scan.available() or self._interpret)
-                and not self._pallas_broken
-            ):
-                # no kernel backend: the exact AC banks are the same
-                # answer on host (native MT scanner when available)
-                return self._scan_native(data)
+            return self._host_scan(self._scan_native, data, progress)
+        if self.mode == "pairset" and not self._kernel_backend_ok():
+            # no kernel backend: the exact AC banks are the same
+            # answer on host (native MT scanner when available)
+            return self._host_scan(self._scan_native, data, progress)
         if self.mode == "nfa" and not self.tables:
             # DFA-less rescue (expansion-cap bounded repeats): the only
             # device engine is the Pallas NFA filter — without it (no TPU,
             # over budget, broken at runtime) there are no DFA banks to
             # fall back on, so the scan is the per-line re loop, like the
             # un-rescued mode.
-            from distributed_grep_tpu.ops import pallas_nfa, pallas_scan
+            from distributed_grep_tpu.ops import pallas_nfa
 
             if not (
-                (pallas_scan.available() or self._interpret)
-                and not self._pallas_broken
+                self._kernel_backend_ok()
                 and pallas_nfa.eligible(self.glushkov)
             ):
-                return self._scan_re(data)
+                return self._host_scan(self._scan_re, data, progress)
         return self._scan_device(data, progress=progress)
+
+    # A host-routed scan of a large in-memory split proceeds in
+    # newline-aligned pieces with a progress stamp between pieces — the
+    # same per-chunk exactness scan_file relies on (every engine mode is
+    # exact over a chunk that starts at a line start), and what keeps a
+    # tight failure-detector window honest over maps the device never
+    # sees (native MT / re fallback routes, round-4 review finding: these
+    # paths previously emitted no heartbeats at all, so a multi-GB
+    # whole-bytes map was swept and re-executed forever).
+    _HOST_CHUNK = 1 << 26
+
+    def _host_scan(self, scanner, data: bytes, progress=None) -> ScanResult:
+        if progress is None or len(data) <= int(1.5 * self._HOST_CHUNK):
+            res = scanner(data)
+            if progress is not None:
+                progress()
+            return res
+        matched: list = []
+        n_matches = 0
+        end_offsets = 0
+        lines_before = 0
+        pos = 0
+        while pos < len(data):
+            end = min(pos + self._HOST_CHUNK, len(data))
+            if end < len(data):
+                cut = data.rfind(b"\n", pos, end)
+                if cut >= pos:
+                    end = cut + 1
+                else:  # one line longer than the chunk: extend to its end
+                    nxt = data.find(b"\n", end)
+                    end = len(data) if nxt < 0 else nxt + 1
+            piece = data[pos:end]
+            res = scanner(piece)
+            if res.matched_lines.size:
+                matched.append(res.matched_lines + lines_before)
+            n_matches += res.n_matches
+            end_offsets += int(self.stats.get("end_offsets", 0))
+            lines_before += lines_mod.count_lines(piece)
+            pos = end
+            progress()
+        ml = (
+            np.concatenate(matched) if matched else np.zeros(0, dtype=np.int64)
+        )
+        self.stats = {"end_offsets": end_offsets}
+        return ScanResult(ml, n_matches, len(data))
 
     def scan_file(self, path, chunk_bytes: int | None = None, emit=None,
                   progress=None) -> ScanResult:
@@ -851,6 +927,7 @@ class GrepEngine:
         else:
             offsets = np.zeros(0, dtype=np.int64)
         nl = lines_mod.newline_index(data)
+        self._nl_stash = (len(data), nl)  # reused by scan()'s EOL leg
         lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
             np.zeros(0, dtype=np.int64)
         self.stats = {"end_offsets": int(offsets.size)}
@@ -953,6 +1030,7 @@ class GrepEngine:
         t_wall0 = _time.perf_counter()
         self.stats = {"candidates": 0, "confirm_seconds": 0.0, "end_offsets": 0}
         nl = lines_mod.newline_index(data)
+        self._nl_stash = (len(data), nl)  # reused by scan()'s EOL leg
         device_lines: set[int] = set()
         boundaries: list[int] = []
         seg = self.segment_bytes
@@ -972,10 +1050,7 @@ class GrepEngine:
         # the CI mesh (8 virtual CPU devices) exercises the production
         # kernel path — the same gates a real TPU run takes.  The flag is
         # passed to every kernel call below (None = wrapper auto-detect).
-        pallas_ok = (
-            (pallas_scan.available() or self._interpret)
-            and not self._pallas_broken
-        )
+        pallas_ok = self._kernel_backend_ok()
         interp_flag = True if self._interpret else None
         use_pallas_sa = (
             self.mode == "shift_and"
@@ -1312,10 +1387,13 @@ class GrepEngine:
                     min_chunk=512,
                     lane_multiple=lane_mult,
                     chunk_multiple=512,
+                    quantize_chunk=True,  # bound jit compiles over
+                    # arbitrarily-sized tails (full segments are unchanged)
                 )
             else:
                 lay = layout_mod.choose_layout(
-                    len(seg_bytes), target_lanes=self.target_lanes
+                    len(seg_bytes), target_lanes=self.target_lanes,
+                    quantize_chunk=True,
                 )
             arr = layout_mod.to_device_array(seg_bytes, lay)
             dev = devs[i % len(devs)]
@@ -1363,6 +1441,24 @@ class GrepEngine:
                 )
                 if seg_start > 0:
                     boundaries.append(seg_start)
+                # Every kernel below jit-specializes on the padded layout
+                # shape (+ the plan constants, _model_gen): a key this
+                # engine has not completed a dispatch for may block on a
+                # fresh ~20-40 s compile with no observable progress, so
+                # declare a grace window first.  Marked done only AFTER the
+                # kernel call returns — a concurrent scan blocked on the
+                # same compile still declares its own grace.  (The mid-scan
+                # defeat guards swap models without bumping _model_gen;
+                # their rare recompile risks one spurious sweep, accepted.)
+                compile_key = (
+                    self.mode, use_mesh, self._model_gen,
+                    getattr(arr, "shape", None),
+                )
+                if progress is not None and compile_key not in self._compiled_keys:
+                    try:
+                        progress(grace_s=COMPILE_GRACE_S)
+                    except TypeError:  # callbacks without the grace kwarg
+                        progress()
                 ctx = jax.default_device(dev) if dev is not None else nullcontext()
                 # Dispatch the device scan; the sparse fetch (a 4-byte count
                 # round-trip plus O(matches) coordinates — never the dense
@@ -1514,6 +1610,7 @@ class GrepEngine:
                                 planes.append(scan_jnp._dfa_scan_core(arr_dev, *bank))
                         job = ("bank_list", planes, lay, seg_start, len(seg_bytes),
                                dev)
+                self._compiled_keys.add(compile_key)
                 boundaries.extend((seg_start + lay.stripe_starts()).tolist())
                 if collect_pool is not None:
                     collect_futs.append(collect_pool.submit(collect, job))

@@ -49,17 +49,29 @@ def choose_layout(
     min_chunk: int = 256,
     lane_multiple: int = 8,
     chunk_multiple: int = 8,
+    quantize_chunk: bool = False,
 ) -> Layout:
     """Pick (lanes, chunk) for a document: enough lanes to fill the VPU,
     chunks long enough that the sequential scan amortizes its step cost.
     lane_multiple/chunk_multiple let kernels impose tile shapes (the Pallas
-    path needs lanes % 4096 == 0 and chunk % 512 == 0)."""
+    path needs lanes % 4096 == 0 and chunk % 512 == 0).
+
+    ``quantize_chunk`` rounds the chunk UP to a 4-mantissa-bit grid, so a
+    job over arbitrarily-sized splits produces O(log) distinct padded
+    shapes instead of one per ``chunk_multiple``-byte size step — every
+    distinct shape jit-specializes the scan kernel (~20-40 s through a
+    tunneled TPU), so the engine bounds compiles at the cost of <= 1/8
+    extra '\\n' padding on tail segments (scanned at kernel speed, and
+    full 64 MB segments land exactly on the grid unchanged)."""
     if n_bytes <= 0:
         return Layout(lanes=lane_multiple, chunk=chunk_multiple, n_real=max(0, n_bytes))
     lanes = max(lane_multiple, target_lanes // lane_multiple * lane_multiple)
     while lanes > lane_multiple and (n_bytes + lanes - 1) // lanes < min_chunk:
         lanes = max(lane_multiple, lanes // 2 // lane_multiple * lane_multiple)
     chunk = (n_bytes + lanes - 1) // lanes
+    if quantize_chunk:
+        q = 1 << max(0, chunk.bit_length() - 4)
+        chunk = (chunk + q - 1) // q * q
     chunk = (chunk + chunk_multiple - 1) // chunk_multiple * chunk_multiple
     return Layout(lanes=lanes, chunk=chunk, n_real=n_bytes)
 

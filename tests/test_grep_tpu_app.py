@@ -85,8 +85,10 @@ def test_app_mesh_shape_option(tmp_path):
 
 def test_progress_wiring_and_compile_grace(tmp_path):
     """The worker's progress callback reaches the engine (stamps per scan/
-    chunk), and the FIRST device scan declares a compile-grace window while
-    later scans stamp plainly (VERDICT r3 item 3 wiring)."""
+    chunk), and a device scan declares a compile-grace window per FRESH
+    kernel/layout shape — first scan, and again when a differently-sized
+    split jit-specializes anew — while warm-shape scans stamp plainly
+    (VERDICT r3 item 3 wiring + the round-4 per-shape review finding)."""
     from distributed_grep_tpu.apps.loader import load_application
 
     f = tmp_path / "f.txt"
@@ -110,5 +112,15 @@ def test_progress_wiring_and_compile_grace(tmp_path):
     calls_dev.clear()
     app_dev.map_path_fn(str(f), str(f))
     assert calls_dev and set(calls_dev) == {0.0}  # warm cache: plain stamps
+    # a differently-sized split -> a new padded layout -> fresh jit
+    # specialization: grace is re-declared for the new shape
+    g = tmp_path / "g.txt"
+    g.write_bytes(b"hello c\nword word word\n" * 20000)
+    calls_dev.clear()
+    app_dev.map_path_fn(str(g), str(g))
+    assert calls_dev and any(c > 0 for c in calls_dev)
+    calls_dev.clear()
+    app_dev.map_path_fn(str(g), str(g))  # now warm too
+    assert calls_dev and set(calls_dev) == {0.0}
     app_dev.set_progress(None)
     app.set_progress(None)

@@ -664,3 +664,60 @@ def test_scan_file_pipelined_read_exact_and_stats(tmp_path):
     assert res.n_matches == len(want)
     assert res.bytes_scanned == len(data)
     assert eng.stats["read_wait_seconds"] >= 0.0
+
+
+def test_host_scan_chunked_progress_and_exact():
+    """Host-routed modes (native memmem, table walk, re fallback) stamp
+    progress per newline-aligned piece on large in-memory splits — these
+    paths previously emitted no heartbeats at all, so a multi-GB
+    whole-bytes map was swept and re-executed forever (round-4 review) —
+    and the chunked result is identical to the unchunked scan."""
+    data = (b"alpha volcano beta\n" + b"filler line one\n" * 11) * 900
+    for pattern in [
+        "volcano",          # native memmem route
+        "vol[cd]ano",       # native DFA table walk
+        r"vol(ca)\1no|volcano",  # backreference -> host re fallback
+    ]:
+        eng = GrepEngine(pattern, backend="cpu")
+        assert eng.mode in ("native", "re")
+        ref = eng.scan(data)
+        assert ref.n_matches == 900
+        stamps: list = []
+        eng._HOST_CHUNK = 1 << 15  # shrink pieces so the test corpus chunks
+        res = eng.scan(data, progress=lambda grace_s=0.0: stamps.append(grace_s))
+        assert res.matched_lines.tolist() == ref.matched_lines.tolist()
+        assert res.n_matches == ref.n_matches
+        assert len(stamps) >= 4 and set(stamps) == {0.0}, pattern
+
+
+def test_host_scan_chunked_nullable_eol_exact():
+    """The chunked host path composes with scan()'s nullable-at-$ empty-line
+    post-processing (the per-piece newline stash must not poison the
+    full-buffer recompute)."""
+    blk = b"\nx q\n\nq tail\nnoq\n" * 2000
+    eng = GrepEngine("q*$", backend="cpu")
+    ref = eng.scan(blk)
+    eng._HOST_CHUNK = 1 << 13
+    stamps: list = []
+    res = eng.scan(blk, progress=lambda grace_s=0.0: stamps.append(grace_s))
+    assert res.matched_lines.tolist() == ref.matched_lines.tolist()
+    assert len(stamps) >= 3
+
+
+def test_choose_layout_quantized_shapes_bounded():
+    """quantize_chunk bounds the number of distinct padded shapes a sweep
+    of arbitrary sizes can produce (each distinct shape = one jit compile),
+    keeps full 64 MB segments on the grid unchanged, and never pads a tail
+    by more than ~1/8 + one chunk_multiple."""
+    full = layout_mod.choose_layout(
+        64 << 20, min_chunk=512, chunk_multiple=512, quantize_chunk=True)
+    assert (full.lanes, full.chunk) == (1024, 65536)  # same as unquantized
+    rng = np.random.default_rng(7)
+    shapes = set()
+    for n in rng.integers(1, 64 << 20, size=500).tolist():
+        lay = layout_mod.choose_layout(
+            int(n), min_chunk=512, chunk_multiple=512, quantize_chunk=True)
+        assert lay.padded >= n
+        assert lay.padded <= (n * 9) // 8 + lay.lanes * 512 + lay.lanes
+        shapes.add((lay.lanes, lay.chunk))
+    assert len(shapes) <= 60  # vs ~hundreds at 512-byte chunk steps
