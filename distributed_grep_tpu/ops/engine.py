@@ -191,7 +191,14 @@ class GrepEngine:
         # same compile each declare their own grace.
         self._compiled_keys: set = set()
         self._model_gen = 0  # bumped when a retune swaps kernel constants
-        self._nl_stash: tuple[int, object] | None = None
+        # THREAD-LOCAL: one engine is scanned concurrently by worker slots
+        # sharing the app module (grep_tpu), and a shared stash would let
+        # thread A consume thread B's newline index whenever their splits
+        # happen to be the same length — silently wrong nullable-at-$
+        # results.  Each scan() call owns its thread's slot.
+        import threading as _threading
+
+        self._nl_local = _threading.local()
         self._nfa_filter = False  # Glushkov model is a candidate superset
         self.approx: ApproxModel | None = None
         self._approx_all_lines = False
@@ -199,7 +206,13 @@ class GrepEngine:
         # the re/native modes): filter candidates, host confirm seconds,
         # scan wall seconds — the numbers behind the tuner's
         # max(scan, confirm) overlap model.  Read with .get().
-        self.stats: dict = {}
+        # Backed per-THREAD (the stats property below): concurrent worker
+        # slots each see the counters of their own scan; a shared dict let
+        # one slot's `self.stats = {}` reset land under another slot's
+        # in-flight `+=` (crash or cross-scan telemetry bleed into the
+        # retune).  Within one scan, _scan_device captures its dict once
+        # so collect-pool threads mutate the owning scan's counters.
+        self._stats_local = _threading.local()
 
         # Hyperscan-style literal decomposition: a regex that denotes a
         # finite literal set — alternations / small class products like
@@ -672,6 +685,21 @@ class GrepEngine:
         ))
 
     # ------------------------------------------------------------------ scan
+    @property
+    def stats(self) -> dict:
+        """Per-thread scan counters: the thread that ran scan() reads its
+        own scan's numbers (bench/CLI/retune all read from the scanning
+        thread), and concurrent worker slots cannot clobber each other."""
+        d = getattr(self._stats_local, "d", None)
+        if d is None:
+            d = {}
+            self._stats_local.d = d
+        return d
+
+    @stats.setter
+    def stats(self, value: dict) -> None:
+        self._stats_local.d = value
+
     def _kernel_backend_ok(self) -> bool:
         """One gate for "a Pallas kernel can actually run here": a backend
         exists (real TPU, or interpret mode in CI) and no kernel has failed
@@ -689,7 +717,7 @@ class GrepEngine:
         ``progress(grace_s=N)`` ahead of a possible silent compile) is how
         a runtime failure detector keeps a tight liveness window over long
         scans (runtime/worker.py wires it to the heartbeat RPC)."""
-        self._nl_stash: tuple[int, object] | None = None
+        self._nl_local.stash = None
         res = self._scan_impl(data, progress)
         # Nullable-at-'$' patterns (accept_eol at the line-start state,
         # e.g. '^$', '^ *$', 'x?$'): the empty match is valid at every
@@ -703,7 +731,7 @@ class GrepEngine:
         # anything past the last real line.  (Found by the round-4 wide
         # fuzz sweep, seed 3116.)
         if self.tables and any(bool(t.accept_eol[t.start]) for t in self.tables):
-            stash = self._nl_stash
+            stash = getattr(self._nl_local, "stash", None)
             nl = (
                 stash[1] if stash is not None and stash[0] == len(data)
                 # chunked scans stash per-piece indexes (wrong length) —
@@ -927,7 +955,7 @@ class GrepEngine:
         else:
             offsets = np.zeros(0, dtype=np.int64)
         nl = lines_mod.newline_index(data)
-        self._nl_stash = (len(data), nl)  # reused by scan()'s EOL leg
+        self._nl_local.stash = (len(data), nl)  # reused by scan()'s EOL leg
         lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
             np.zeros(0, dtype=np.int64)
         self.stats = {"end_offsets": int(offsets.size)}
@@ -1029,8 +1057,14 @@ class GrepEngine:
 
         t_wall0 = _time.perf_counter()
         self.stats = {"candidates": 0, "confirm_seconds": 0.0, "end_offsets": 0}
+        # the ONE dict for this scan: collect()/prepare() run in pool
+        # threads, where `self.stats` would resolve to the POOL thread's
+        # slot — references below go through this capture (except after a
+        # fallback RESCAN, which replaces the thread's dict and makes this
+        # capture stale)
+        st = self.stats
         nl = lines_mod.newline_index(data)
-        self._nl_stash = (len(data), nl)  # reused by scan()'s EOL leg
+        self._nl_local.stash = (len(data), nl)  # reused by scan()'s EOL leg
         device_lines: set[int] = set()
         boundaries: list[int] = []
         seg = self.segment_bytes
@@ -1160,8 +1194,8 @@ class GrepEngine:
         def _confirm_enter() -> None:
             with state_lock:
                 confirm_active[0] += 1
-                if confirm_active[0] > self.stats.get("confirm_concurrency_peak", 0):
-                    self.stats["confirm_concurrency_peak"] = confirm_active[0]
+                if confirm_active[0] > st.get("confirm_concurrency_peak", 0):
+                    st["confirm_concurrency_peak"] = confirm_active[0]
 
         def _confirm_exit() -> None:
             with state_lock:
@@ -1227,7 +1261,7 @@ class GrepEngine:
                             cand.update(range(a, b + 1))
                         with state_lock:
                             cand -= device_lines  # already confirmed earlier
-                            self.stats["candidates"] += len(cand)
+                            st["candidates"] += len(cand)
                         if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
                             _confirm_enter()
                             try:
@@ -1266,7 +1300,7 @@ class GrepEngine:
                     idx, vals = scan_jnp.sparse_nonzero(payload)
                     offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
                     with state_lock:
-                        self.stats["candidates"] += int(offsets.size)
+                        st["candidates"] += int(offsets.size)
                     if offsets.size:
                         t0 = _time.perf_counter()
                         glines = lines_mod.line_of_offsets(offsets + seg_start, nl)
@@ -1303,7 +1337,7 @@ class GrepEngine:
                                 with state_lock:
                                     nfa_model = self.glushkov_exact
                                     nfa_is_filter = False
-                                    self.stats["nfa_filter_defeated"] = True
+                                    st["nfa_filter_defeated"] = True
                         else:
                             _confirm_enter()
                             try:
@@ -1311,7 +1345,7 @@ class GrepEngine:
                             finally:
                                 _confirm_exit()
                         with state_lock:
-                            self.stats["confirm_seconds"] += _time.perf_counter() - t0
+                            st["confirm_seconds"] += _time.perf_counter() - t0
                     return
                 if sparse_kind == "words":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -1330,10 +1364,10 @@ class GrepEngine:
                         finally:
                             _confirm_exit()
                         with state_lock:
-                            self.stats["confirm_seconds"] += (
+                            st["confirm_seconds"] += (
                                 _time.perf_counter() - t0
                             )
-                            self.stats["candidates"] += int(offsets.size)
+                            st["candidates"] += int(offsets.size)
                         offsets = offsets[keep]
                 elif sparse_kind == "lane_bytes":
                     idx, vals = scan_jnp.sparse_nonzero(payload)
@@ -1348,7 +1382,7 @@ class GrepEngine:
                     offsets = np.unique(np.concatenate(per_bank)) if per_bank else \
                         np.zeros(0, dtype=np.int64)
             with state_lock:
-                self.stats["end_offsets"] += int(offsets.size)
+                st["end_offsets"] += int(offsets.size)
             if offsets.size:
                 # transient slice: jobs hold (start, len), not segment copies
                 seg_view = data[seg_start : seg_start + seg_len]
@@ -1430,7 +1464,7 @@ class GrepEngine:
             ThreadPoolExecutor(n_collect) if len(seg_starts) > 1 else None
         )
         collect_futs: _deque = _deque()
-        self.stats["feed_wait_seconds"] = 0.0
+        st["feed_wait_seconds"] = 0.0
         nxt = prepare(0, seg_starts[0]) if seg_starts else None
         try:
             for i, seg_start in enumerate(seg_starts):
@@ -1625,7 +1659,7 @@ class GrepEngine:
                 if nxt_future is not None:
                     t0 = _time.perf_counter()
                     nxt = nxt_future.result()
-                    self.stats["feed_wait_seconds"] += _time.perf_counter() - t0
+                    st["feed_wait_seconds"] += _time.perf_counter() - t0
             while collect_futs:
                 collect_futs.popleft().result()
                 if progress is not None:
@@ -1643,7 +1677,7 @@ class GrepEngine:
             if isinstance(e, (MemoryError, UnicodeError)):
                 raise
             if collect_pool is not None:
-                # running collects mutate self.stats/device_lines — let them
+                # running collects mutate st/device_lines — let them
                 # drain before any fallback rescan resets those under them
                 # (their un-awaited exceptions, if any, mirror this one)
                 collect_pool.shutdown(wait=True, cancel_futures=True)
@@ -1671,7 +1705,10 @@ class GrepEngine:
                 result = self._scan_native(data)
             else:
                 result = self._scan_device(data, progress=progress)
-            self.stats["fdr_fallback"] = True  # rescan stats only
+            # rescan stats only — the rescan REPLACED this thread's stats
+            # dict, so write through the property (scanning thread), not
+            # the pre-fallback `st` capture (now orphaned)
+            self.stats["fdr_fallback"] = True
             return result
         finally:
             if pool is not None:
@@ -1688,8 +1725,8 @@ class GrepEngine:
         if use_mesh and psum_totals:
             # ICI-collective candidate tally across all segments — the
             # cross-check dryrun_multichip asserts against the host count.
-            self.stats["psum_candidates"] = sum(int(t) for t in psum_totals)
-        self.stats["scan_wall_seconds"] = _time.perf_counter() - t_wall0
+            st["psum_candidates"] = sum(int(t) for t in psum_totals)
+        st["scan_wall_seconds"] = _time.perf_counter() - t_wall0
         self._maybe_retune_fdr(len(data))
         lines_arr = np.asarray(sorted(stitched), dtype=np.int64)
         return ScanResult(lines_arr, int(lines_arr.size), len(data))

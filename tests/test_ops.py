@@ -721,3 +721,49 @@ def test_choose_layout_quantized_shapes_bounded():
         assert lay.padded <= (n * 9) // 8 + lay.lanes * 512 + lay.lanes
         shapes.add((lay.lanes, lay.chunk))
     assert len(shapes) <= 60  # vs ~hundreds at 512-byte chunk steps
+
+
+def test_concurrent_scans_nullable_eol_thread_safe():
+    """One engine is scanned concurrently by worker slots sharing the app
+    module; the nullable-at-$ newline-index stash must be per-thread — a
+    shared slot would let thread A consume thread B's index whenever the
+    two splits happen to be the same byte length (same-size splits are the
+    COMMON case), silently mis-numbering lines."""
+    import sys
+    import threading
+
+    N = 400
+    a = b"\nq z\n" * N      # 2N lines, N of them empty
+    b = b"aaaq\n" * N       # N lines, none empty — same byte length
+    assert len(a) == len(b)
+    eng = GrepEngine("q*$", backend="cpu")  # nullable at EOL: all lines match
+    errs: list = []
+    go = threading.Barrier(2)
+
+    def scan_loop(data, want_lines):
+        go.wait()
+        try:
+            for _ in range(120):
+                res = eng.scan(data)
+                if res.n_matches != want_lines:
+                    errs.append((want_lines, res.n_matches))
+                    return
+                # per-thread stats: this thread must see ITS scan's numbers
+                if int(eng.stats.get("end_offsets", -1)) < 0:
+                    errs.append(("stats", dict(eng.stats)))
+                    return
+        except Exception as e:  # a crash is as much a failure as a miscount
+            errs.append(("raised", repr(e)))
+
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # shake the interleaving
+    try:
+        ts = [threading.Thread(target=scan_loop, args=(a, 2 * N)),
+              threading.Thread(target=scan_loop, args=(b, N))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert not errs, errs
