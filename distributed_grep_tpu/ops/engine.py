@@ -123,6 +123,11 @@ class GrepEngine:
         segment_bytes: int = 64 * 1024 * 1024,
         max_states: int = 4096,
         max_states_per_bank: int = 1 << 16,
+        device_min_bytes: int | None = None,  # inputs smaller than this
+        # scan on host even on a device engine: a device round-trip is
+        # latency-bound (~ms on PCIe, ~100 ms through a tunnel) while the
+        # exact host scanners do sub-MB inputs in <= low ms — the grep -r
+        # many-small-files regime.  None = DGREP_DEVICE_MIN_BYTES or 1 MB.
     ):
         if (pattern is None) == (patterns is None):
             raise ValueError("exactly one of pattern / patterns is required")
@@ -157,6 +162,10 @@ class GrepEngine:
                 )
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
+        self.device_min_bytes = (
+            device_min_bytes if device_min_bytes is not None
+            else int(_os.environ.get("DGREP_DEVICE_MIN_BYTES", 1 << 20))
+        )
         self.ignore_case = ignore_case
 
         self.shift_and: ShiftAndModel | None = None
@@ -191,6 +200,7 @@ class GrepEngine:
         # same compile each declare their own grace.
         self._compiled_keys: set = set()
         self._model_gen = 0  # bumped when a retune swaps kernel constants
+        self._accel_cached: bool | None = None  # see _accel_backend
         # THREAD-LOCAL: one engine is scanned concurrently by worker slots
         # sharing the app module (grep_tpu), and a shared stash would let
         # thread A consume thread B's newline index whenever their splits
@@ -775,7 +785,43 @@ class GrepEngine:
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._host_scan(self._scan_re, data, progress)
+        if (
+            len(data) < self.device_min_bytes
+            and not self._interpret  # CI interpret engines exist to
+            # exercise the kernels — never reroute them
+            and self.mesh is None  # a mesh engine EXISTS to run the
+            # sharded path (and dryrun_multichip asserts its psum
+            # telemetry on tiny shapes — driver contract)
+            and self.mode != "approx"  # the host approx oracle is a ~MB/s
+            # Python recurrence; the device wins at any size
+            and self._accel_backend()
+        ):
+            # Sub-threshold inputs are round-trip-latency-bound on a real
+            # accelerator (~ms over PCIe, ~100 ms through a tunnel) while
+            # the EXACT host engines — native memmem / AC-DFA banks, or the
+            # re loop for the DFA-less NFA rescue — finish in <= low ms:
+            # the grep -r many-small-files regime.  XLA-on-CPU "devices"
+            # are not gated (dispatch is ~µs there, and the CI suite's
+            # device-path coverage runs on them).
+            scanner = self._scan_native if self.tables else self._scan_re
+            return self._host_scan(scanner, data, progress)
         return self._scan_device(data, progress=progress)
+
+    def _accel_backend(self) -> bool:
+        """True when jax's default backend is a real accelerator (tpu /
+        tunneled tpu / gpu) — the regime where per-scan dispatch latency,
+        not throughput, prices small inputs.  Cached: the answer cannot
+        change within a process."""
+        cached = self._accel_cached
+        if cached is None:
+            try:
+                import jax
+
+                cached = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — no jax: nothing to gate
+                cached = False
+            self._accel_cached = cached
+        return cached
 
     # A host-routed scan of a large in-memory split proceeds in
     # newline-aligned pieces with a progress stamp between pieces — the

@@ -767,3 +767,55 @@ def test_concurrent_scans_nullable_eol_thread_safe():
     finally:
         sys.setswitchinterval(old)
     assert not errs, errs
+
+
+def test_small_input_routes_host_on_accelerator():
+    """On a real accelerator backend, a sub-threshold input scans on the
+    EXACT host engines instead of paying a per-scan device round trip
+    (~ms on PCIe, ~100 ms through a tunnel) — the grep -r many-small-files
+    regime.  Simulated here by forcing the cached accelerator probe."""
+    data = make_text(400, inject=[(7, b"xx volcano yy"), (300, b"volcano")])
+    want = sorted(oracle_lines("volcano", data))
+
+    eng = GrepEngine("volcano", backend="device")
+    eng._accel_cached = True  # pretend jax.default_backend() is a TPU
+    res = eng.scan(data)
+    assert res.matched_lines.tolist() == want
+    # host-native route: no device telemetry was populated
+    assert "scan_wall_seconds" not in eng.stats
+    assert eng.stats.get("end_offsets", 0) >= len(want)
+
+    # the DFA-less NFA rescue has no tables: the re loop is the host route
+    eng2 = GrepEngine("a[^q]{2,700}z", backend="device")
+    assert eng2.mode == "nfa" and not eng2.tables
+    eng2._accel_cached = True
+    res2 = eng2.scan(b"a!!z ok\nnope\nabcz\n" * 50)
+    assert res2.n_matches == 100
+    assert "scan_wall_seconds" not in eng2.stats
+
+    # device_min_bytes=0 disables the gate: the device path runs (XLA on
+    # the CPU "device" here) and populates its telemetry
+    eng3 = GrepEngine("volcano", backend="device", device_min_bytes=0)
+    eng3._accel_cached = True
+    res3 = eng3.scan(data)
+    assert res3.matched_lines.tolist() == want
+    assert "scan_wall_seconds" in eng3.stats
+
+    # interpret-mode engines (CI kernel coverage) are never rerouted
+    eng4 = GrepEngine("volcano", backend="device", interpret=True)
+    eng4._accel_cached = True
+    res4 = eng4.scan(data)
+    assert res4.matched_lines.tolist() == want
+    assert "scan_wall_seconds" in eng4.stats
+
+    # mesh engines are never rerouted either: the sharded path IS their
+    # purpose, and dryrun_multichip asserts its psum telemetry on tiny
+    # shapes (driver contract)
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    eng5 = GrepEngine("volcano", backend="device",
+                      mesh=make_mesh((8,), ("data",)))
+    eng5._accel_cached = True
+    res5 = eng5.scan(data)
+    assert res5.matched_lines.tolist() == want
+    assert "scan_wall_seconds" in eng5.stats
