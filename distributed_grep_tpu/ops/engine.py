@@ -432,17 +432,9 @@ class GrepEngine:
                 # scanner).  Route to the native scanner loudly; keep the
                 # device path only when the native lib is unavailable.
                 if self.mode == "dfa":
-                    from distributed_grep_tpu.utils.native import (
-                        native_available,
+                    self._route_native(
+                        "pattern set ineligible for the FDR device filter"
                     )
-
-                    if native_available():
-                        log.warning(
-                            "pattern set ineligible for the FDR device "
-                            "filter -> native MT host scanner (the XLA "
-                            "DFA-bank device path would run ~100x slower)"
-                        )
-                        self.mode = "native"
         else:
             self.pattern = pattern
             try:
@@ -515,8 +507,42 @@ class GrepEngine:
                         # re-checked per line
                         self._nfa_filter = True
                         self.mode = "nfa"
+        if (
+            self.mode == "dfa" and backend == "device" and self.tables
+            # mesh/interpret engines exist to run the device path (CI
+            # kernel coverage; the sharded step) — never demote them,
+            # mirroring the small-input gate in _scan_impl
+            and self.mesh is None and not self._interpret
+        ):
+            # Single patterns the bit-parallel kernels can't host ('$'
+            # accepts, > 128 Glushkov positions — e.g. a 200-char literal)
+            # would otherwise run the per-byte XLA DFA device path at
+            # ~0.1 GB/s.  The native host scanner (memmem for long
+            # literals, the MT DFA walk otherwise) is ~3-25x faster on any
+            # real host — same loud routing as FDR-ineligible sets above.
+            self._route_native(
+                f"pattern {self.pattern!r} outside the device kernel subset"
+            )
         if backend == "cpu" and self.mode != "re":
             self.mode = "native"  # host C scanner, same tables
+
+    def _route_native(self, why: str) -> bool:
+        """Loud device->host demotion (one policy, three callers: the
+        FDR-ineligible set branch, the single-pattern device-subset
+        branch, the FDR retune rejection): the native scanners give the
+        exact same answers off the AC/DFA tables at ~3-100x the XLA DFA
+        device path's ~0.1 GB/s.  No-op when the native lib is missing —
+        the device path, slow as it is, beats a Python table walk."""
+        from distributed_grep_tpu.utils.native import native_available
+
+        if not native_available():
+            return False
+        log.warning(
+            "%s -> native host scanner (the XLA DFA device path would "
+            "run ~100x slower)", why,
+        )
+        self.mode = "native"
+        return True
 
     # ------------------------------------------------- FDR self-calibration
     def _active_chip_count(self) -> int:
@@ -599,14 +625,10 @@ class GrepEngine:
         except FdrError as e:
             # real pricing says the set is not worth filtering at all:
             # same routing as the compile-time rejection
-            from distributed_grep_tpu.utils.native import native_available
-
-            if native_available():
-                log.warning(
-                    "FDR retune (%s): set not filterable under measured "
-                    "pricing (%s) -> native MT host scanner", reason, e,
-                )
-                self.mode = "native"
+            self._route_native(
+                f"FDR retune ({reason}): set not filterable under "
+                f"measured pricing ({e})"
+            )
             self._fdr_pricing = pricing
             return
         old = [(b.m, b.checks) for b in self.fdr.banks]

@@ -400,7 +400,13 @@ def test_engine_multi_device_segments():
     assert rm.n_matches == rs.n_matches
 
 
-def test_engine_multi_device_dfa_banks():
+def test_engine_multi_device_dfa_banks(monkeypatch):
+    # '$' accepts route to the native host scanner when the lib exists;
+    # disable it here so the XLA DFA-bank device path keeps multi-device
+    # round-robin coverage.
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.native_available", lambda: False
+    )
     data = make_text(400, inject=[(5, b"needle here or neet")])
     kw = dict(segment_bytes=4096, target_lanes=16)
     multi = GrepEngine("nee(dle|t)$", devices="all", **kw)
@@ -409,6 +415,42 @@ def test_engine_multi_device_dfa_banks():
     np.testing.assert_array_equal(
         multi.scan(data).matched_lines, single.scan(data).matched_lines
     )
+
+
+def test_anchored_eol_device_path_boundaries(monkeypatch):
+    """The XLA DFA device path ('$' accepts) stays pinned for stripe and
+    segment boundary behavior even though native routing normally takes
+    these patterns (review follow-up: the anchored-pattern tests above
+    now exercise the native route on hosts with the lib)."""
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.native_available", lambda: False
+    )
+    data = make_text(
+        300,
+        inject=[(0, b"ends with world"), (150, b"world"), (299, b"world")],
+    )
+    for pattern in ["world$", r"\w+$"]:
+        eng = GrepEngine(pattern, target_lanes=16, segment_bytes=4096)
+        assert eng.mode == "dfa", pattern
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == oracle_lines(pattern, data), pattern
+
+
+def test_engine_dfa_only_pattern_routes_native():
+    """Single patterns outside the device kernel subset ('$' accepts,
+    > 128 Glushkov positions, e.g. a 200-char literal) route loudly to
+    the native host scanner instead of the ~0.1 GB/s XLA DFA device path
+    — the same policy as FDR-ineligible sets."""
+    from distributed_grep_tpu.utils.native import native_available
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    data = make_text(300, inject=[(5, b"ends with world"), (200, b"world")])
+    for pattern in ["world$", "x" * 200]:
+        eng = GrepEngine(pattern, backend="device")
+        assert eng.mode == "native", pattern
+        assert set(eng.scan(data).matched_lines.tolist()) == \
+            oracle_lines(pattern, data), pattern
 
 
 def test_grep_tpu_app_devices_all():
