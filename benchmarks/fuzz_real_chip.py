@@ -51,19 +51,24 @@ def make_corpus(rng, injections: list[bytes], n_lines=30000) -> bytes:
     return b"\n".join(lines) + b"\n"
 
 
-def oracle(pattern: bytes, data: bytes, flags=0) -> list[int]:
-    pat = re.compile(pattern, flags)
-    return [i for i, ln in enumerate(data.split(b"\n")[:-1], 1) if pat.search(ln)]
+def re_oracle(pattern: bytes, flags=0):
+    """Family oracle: matched 1-based line numbers per host `re`."""
+    def want(data: bytes) -> list[int]:
+        pat = re.compile(pattern, flags)
+        return [i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+                if pat.search(ln)]
+
+    return want
 
 
 def rand_word(rng, lo=3, hi=9) -> str:
     return "".join(ALPHA[i] for i in rng.integers(0, 26, int(rng.integers(lo, hi))))
 
 
-# Each family: seed -> (engine_kwargs, oracle_regex_bytes, flags, injection list)
+# Each family: seed -> (engine_kwargs, want_fn(data)->line list, injections)
 def fam_literal(rng):
     w = rand_word(rng)
-    return dict(pattern=w), re.escape(w).encode(), 0, [w.encode()]
+    return dict(pattern=w), re_oracle(re.escape(w).encode()), [w.encode()]
 
 
 def fam_class_seq(rng):
@@ -78,20 +83,20 @@ def fam_class_seq(rng):
             parts.append(c)
             inj.append(c)
     pat = "".join(parts)
-    return dict(pattern=pat), pat.encode(), 0, ["".join(inj).encode()]
+    return dict(pattern=pat), re_oracle(pat.encode()), ["".join(inj).encode()]
 
 
 def fam_alternation(rng):
     ws = [rand_word(rng) for _ in range(int(rng.integers(2, 6)))]
     pat = "(" + "|".join(ws) + ")"
-    return dict(pattern=pat), pat.encode(), 0, [w.encode() for w in ws[:2]]
+    return dict(pattern=pat), re_oracle(pat.encode()), [w.encode() for w in ws[:2]]
 
 
 def fam_ignore_case(rng):
     w = rand_word(rng)
     mixed = "".join(c.upper() if rng.random() < 0.5 else c for c in w)
-    return (dict(pattern=w, ignore_case=True), re.escape(w).encode(),
-            re.IGNORECASE, [mixed.encode()])
+    return (dict(pattern=w, ignore_case=True),
+            re_oracle(re.escape(w).encode(), re.IGNORECASE), [mixed.encode()])
 
 
 def fam_bounded_repeat(rng):
@@ -100,14 +105,13 @@ def fam_bounded_repeat(rng):
     n = m + int(rng.integers(1, 30))
     pat = f"{a}[a-z ]{{{m},{n}}}{b}"
     inj = (a + "x" * m + b).encode()
-    return dict(pattern=pat), pat.encode(), 0, [inj]
+    return dict(pattern=pat), re_oracle(pat.encode()), [inj]
 
 
 def fam_literal_set(rng):
     ws = sorted({rand_word(rng) for _ in range(int(rng.integers(20, 120)))})
     pat = b"|".join(re.escape(w).encode() for w in ws)
-    return (dict(patterns=list(ws)), pat, 0,
-            [w.encode() for w in ws[:3]])
+    return dict(patterns=list(ws)), re_oracle(pat), [w.encode() for w in ws[:3]]
 
 
 def fam_pairset(rng):
@@ -116,7 +120,28 @@ def fam_pairset(rng):
     # (1-char members route native by density — separately covered)
     ws = sorted({rand_word(rng, 2, 3) for _ in range(int(rng.integers(3, 10)))})
     pat = b"|".join(re.escape(w).encode() for w in ws)
-    return dict(patterns=list(ws)), pat, 0, []
+    return dict(patterns=list(ws)), re_oracle(pat), []
+
+
+def fam_approx(rng):
+    # agrep k=1: oracle is the host recurrence (models/approx
+    # line_matches — CI pins IT against an independent edit-distance DP),
+    # so this checks device kernel == host model on the real chip
+    w = rand_word(rng, 6, 11)
+    mutated = list(w)
+    mutated[int(rng.integers(0, len(w)))] = ALPHA[int(rng.integers(0, 26))]
+
+    def want(data: bytes) -> list[int]:
+        from distributed_grep_tpu.models.approx import (
+            line_matches,
+            try_compile_approx,
+        )
+
+        model = try_compile_approx(w, 1)
+        return [i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+                if line_matches(model, ln)]
+
+    return dict(pattern=w, max_errors=1), want, [w.encode(), "".join(mutated).encode()]
 
 
 FAMILIES = {
@@ -127,6 +152,7 @@ FAMILIES = {
     "bounded_repeat": fam_bounded_repeat,
     "literal_set": fam_literal_set,
     "pairset": fam_pairset,
+    "approx": fam_approx,
 }
 
 
@@ -146,11 +172,11 @@ def main() -> int:
         modes: Counter = Counter()
         for seed in range(args.start, args.start + args.seeds):
             rng = np.random.default_rng(900_000 + seed)
-            kw, opat, flags, inj = gen(rng)
+            kw, want_fn, inj = gen(rng)
             data = make_corpus(rng, inj)
             eng = GrepEngine(backend="device", device_min_bytes=0, **kw)
             got = eng.scan(data).matched_lines.tolist()
-            want = oracle(opat, data, flags)
+            want = want_fn(data)
             if got != want:
                 print(f"FAIL {name} seed={seed} kw={kw} mode={eng.mode} "
                       f"got {len(got)} want {len(want)} "

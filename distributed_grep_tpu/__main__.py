@@ -631,6 +631,32 @@ def cmd_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_status(args: argparse.Namespace) -> int:
+    """Operator surface for a running coordinator: pretty-print its
+    GET /status JSON (task states per phase + metrics counters)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{args.addr}/status"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            body = r.read()
+        status = json.loads(body)
+    except urllib.error.HTTPError as e:  # reached, but not a coordinator
+        print(f"error: {url} answered {e.code} {e.reason}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot reach coordinator at {args.addr}: {e}",
+              file=sys.stderr)
+        return 2
+    except ValueError:  # 200 with a non-JSON body (proxy page, wrong port)
+        print(f"error: {url} did not return JSON — not a coordinator?",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(status, indent=2, sort_keys=True))
+    return 0
+
+
 class _GlobFilterAction(argparse.Action):
     """--include/--exclude share one ORDERED filter list (GNU grep decides
     by the last matching glob, so relative option order is semantic)."""
@@ -729,6 +755,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--config", required=True)
     p.add_argument("--resume", action="store_true")
     p.set_defaults(fn=cmd_coordinator)
+
+    p = sub.add_parser("status", help="query a running coordinator's task/metric state")
+    p.add_argument("--addr", required=True, help="coordinator http address host:port")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("worker", help="connect to a coordinator and process tasks")
     p.add_argument("--addr", required=True, help="coordinator http address host:port")

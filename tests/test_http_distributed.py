@@ -490,3 +490,31 @@ def test_multiprocess_device_backend_mesh_job(tmp_path, corpus):
         for p in [coord, worker]:
             if p is not None and p.poll() is None:
                 p.kill()
+
+
+def test_status_cli_verb(tmp_path, corpus):
+    """`status --addr` pretty-prints a running coordinator's /status JSON
+    (operator surface); unreachable coordinators exit 2 with a clean
+    message, like the other CLI error paths."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    server = make_server(tmp_path, corpus)
+    try:
+        out = subprocess.run(
+            [_sys.executable, "-m", "distributed_grep_tpu", "status",
+             "--addr", f"127.0.0.1:{server.port}"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stderr
+        st = _json.loads(out.stdout)
+        assert {"map", "reduce", "done", "metrics"} <= set(st)
+    finally:
+        server.shutdown(linger_s=0.1)
+    bad = subprocess.run(
+        [_sys.executable, "-m", "distributed_grep_tpu", "status",
+         "--addr", "127.0.0.1:1", "--timeout", "1"],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert bad.returncode == 2 and "cannot reach" in bad.stderr
