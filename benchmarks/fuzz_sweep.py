@@ -71,7 +71,7 @@ def main() -> int:
         if params != ["seed"]:
             print(f"{name}: skipped (needs fixtures: {params})")
             continue
-        ok = 0
+        ok = skipped = 0
         for seed in range(args.start, args.start + args.count):
             try:
                 fn(seed)
@@ -82,13 +82,15 @@ def main() -> int:
                 traceback.print_exc(limit=3)
             except BaseException as e:  # pytest.Skipped is a BaseException
                 if "skip" in type(e).__name__.lower():
+                    skipped += 1  # ineligible draw (e.g. no Pallas mode)
                     continue
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
                 failures += 1
                 print(f"ERROR {name} seed={seed}: {e!r}")
                 traceback.print_exc(limit=3)
-        print(f"{name}: {ok}/{args.count} ok")
+        note = f" ({skipped} ineligible-draw skips)" if skipped else ""
+        print(f"{name}: {ok}/{args.count} ok{note}")
     return 1 if failures else 0
 
 
