@@ -201,6 +201,7 @@ class GrepEngine:
         self._compiled_keys: set = set()
         self._model_gen = 0  # bumped when a retune swaps kernel constants
         self._accel_cached: bool | None = None  # see _accel_backend
+        self._device_broken = False  # every device route failed: host-only
         # THREAD-LOCAL: one engine is scanned concurrently by worker slots
         # sharing the app module (grep_tpu), and a shared stash would let
         # thread A consume thread B's newline index whenever their splits
@@ -807,6 +808,14 @@ class GrepEngine:
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._host_scan(self._scan_re, data, progress)
+        if self._device_broken:
+            # a prior scan exhausted every device route (dead link,
+            # repeated kernel failure): stay on the exact host engines
+            res = self._host_scan(self._host_scanner(), data, progress)
+            self.stats["device_fallback"] = True  # telemetry marker, like
+            # the FDR path's fdr_fallback: degraded-mode scans must be
+            # distinguishable from healthy ones without grepping logs
+            return res
         if (
             len(data) < self.device_min_bytes
             and not self._interpret  # CI interpret engines exist to
@@ -816,6 +825,7 @@ class GrepEngine:
             # telemetry on tiny shapes — driver contract)
             and self.mode != "approx"  # the host approx oracle is a ~MB/s
             # Python recurrence; the device wins at any size
+            and self._host_scanner() is not None
             and self._accel_backend()
         ):
             # Sub-threshold inputs are round-trip-latency-bound on a real
@@ -825,9 +835,23 @@ class GrepEngine:
             # the grep -r many-small-files regime.  XLA-on-CPU "devices"
             # are not gated (dispatch is ~µs there, and the CI suite's
             # device-path coverage runs on them).
-            scanner = self._scan_native if self.tables else self._scan_re
-            return self._host_scan(scanner, data, progress)
+            return self._host_scan(self._host_scanner(), data, progress)
         return self._scan_device(data, progress=progress)
+
+    def _host_scanner(self):
+        """The exact host engine for this pattern, or None if no host
+        route exists (today every engine that reaches _scan_device has
+        one — approx mode sets self.approx, sets compile AC banks,
+        single patterns set tables or _re_fallback — but callers guard
+        on this return rather than re-encoding that knowledge): the
+        native scanners when tables exist (AC/DFA banks, memmem for
+        literals) or the approx host recurrence; the re loop for the
+        DFA-less NFA rescue."""
+        if self.tables or self.approx is not None:
+            return self._scan_native
+        if self._re_fallback is not None:
+            return self._scan_re
+        return None
 
     def _accel_backend(self) -> bool:
         """True when jax's default backend is a real accelerator (tpu /
@@ -1761,6 +1785,21 @@ class GrepEngine:
                     )
                     self._pallas_broken = True
                     return self.scan(data, progress=progress)
+                host_scanner = self._host_scanner()
+                if host_scanner is not None:
+                    # Every DEVICE route is exhausted (e.g. the device link
+                    # died mid-job — observed live when the tunneled chip's
+                    # transport dropped): an exact host engine exists, so
+                    # degrade to it for the rest of this engine's life
+                    # instead of crashing the map task.
+                    log.warning(
+                        "device scan failed with no device fallback left "
+                        "(%s) -> exact host engines for this engine", e,
+                    )
+                    self._device_broken = True
+                    result = self._host_scan(host_scanner, data, progress)
+                    self.stats["device_fallback"] = True
+                    return result
                 raise
             log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
             self._fdr_broken = True

@@ -861,3 +861,28 @@ def test_small_input_routes_host_on_accelerator():
     res5 = eng5.scan(data)
     assert res5.matched_lines.tolist() == want
     assert "scan_wall_seconds" in eng5.stats
+
+
+def test_total_device_failure_degrades_to_host(monkeypatch):
+    """When EVERY device route fails (dead device link mid-job — observed
+    live when the tunneled chip's transport dropped), the engine degrades
+    to the exact host scanners for the rest of its life instead of
+    crashing the map task; later scans skip the device entirely."""
+    data = make_text(300, inject=[(5, b"xx volcano yy"), (99, b"volcano")])
+    want = sorted(oracle_lines("volcano", data))
+    eng = GrepEngine("volcano", backend="device", interpret=True)
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("device link down")
+
+    monkeypatch.setattr(pallas_scan, "shift_and_scan_words", boom)
+    monkeypatch.setattr(scan_jnp, "shift_and_scan", boom)
+    res = eng.scan(data)
+    assert res.matched_lines.tolist() == want
+    assert eng._device_broken and calls["n"] == 2  # pallas, then XLA
+    res2 = eng.scan(data)
+    assert res2.matched_lines.tolist() == want
+    assert calls["n"] == 2  # second scan never touched the device
