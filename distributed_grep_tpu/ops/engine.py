@@ -76,6 +76,42 @@ from distributed_grep_tpu.utils.logging import get_logger
 # failure-detector window tolerates compiles without being blind to hangs.
 COMPILE_GRACE_S = float(_os.environ.get("DGREP_COMPILE_GRACE_S", "90"))
 
+# First-touch device responsiveness wall (engine._device_responsive): a
+# wedged device transport hangs jax's backend init in C with no exception
+# to catch, so the first jax touch is time-boxed on a side thread.  Cold
+# init through a healthy tunnel is ~1-2 s; 30 s is comfortably above any
+# legitimate init and far below a hung map task's cost.  The verdict is
+# PROCESS-wide (one backend per process; responsiveness cannot change
+# within it, like _accel_backend's cache) and lock-serialized so
+# concurrent first scans wait for one probe instead of hanging past it.
+DEVICE_PROBE_S = float(_os.environ.get("DGREP_DEVICE_PROBE_S", "30"))
+import threading as _threading_mod
+
+_device_probe_lock = _threading_mod.Lock()
+_device_probe_verdict: bool | None = None
+
+
+def _probe_device_blocking() -> bool:
+    """Time-boxed `jax.devices()` on an abandoned daemon thread."""
+    import queue as _queue
+
+    out: _queue.Queue = _queue.Queue()
+
+    def probe() -> None:
+        try:
+            import jax
+
+            jax.devices()
+            out.put(True)
+        except Exception:  # noqa: BLE001 — broken backend = not responsive
+            out.put(False)
+
+    _threading_mod.Thread(target=probe, daemon=True, name="dev-probe").start()
+    try:
+        return out.get(timeout=DEVICE_PROBE_S)
+    except _queue.Empty:
+        return False
+
 log = get_logger("engine")
 
 # Coarse span path: above this many candidate lines per segment, per-line
@@ -202,6 +238,7 @@ class GrepEngine:
         self._model_gen = 0  # bumped when a retune swaps kernel constants
         self._accel_cached: bool | None = None  # see _accel_backend
         self._device_broken = False  # every device route failed: host-only
+        self._device_probed = False  # first-touch responsiveness wall done
         # THREAD-LOCAL: one engine is scanned concurrently by worker slots
         # sharing the app module (grep_tpu), and a shared stash would let
         # thread A consume thread B's newline index whenever their splits
@@ -791,6 +828,29 @@ class GrepEngine:
             return ScanResult(np.arange(1, n_lines + 1, dtype=np.int64), n_lines, len(data))
         if self.mode == "native":
             return self._host_scan(self._scan_native, data, progress)
+        # The first-touch responsiveness wall runs BEFORE any branch that
+        # touches jax (_kernel_backend_ok/_accel_backend included): a
+        # wedged transport hangs the first jax call in C with no
+        # exception, wherever it happens (round-4 review finding).
+        if (
+            not self._device_probed
+            and not self._device_broken
+            and self._host_scanner() is not None
+        ):
+            if not self._device_responsive():
+                log.warning(
+                    "device backend unresponsive after %.0fs -> exact "
+                    "host engines for this engine", DEVICE_PROBE_S,
+                )
+                self._device_broken = True
+            # AFTER the verdict: a concurrent scan that reads this flag
+            # early just re-enters _device_responsive and waits on the
+            # probe lock for the shared verdict
+            self._device_probed = True
+        if self._device_broken:
+            res = self._host_scan(self._host_scanner(), data, progress)
+            self.stats["device_fallback"] = True  # degraded-mode marker
+            return res
         if self.mode == "pairset" and not self._kernel_backend_ok():
             # no kernel backend: the exact AC banks are the same
             # answer on host (native MT scanner when available)
@@ -808,14 +868,6 @@ class GrepEngine:
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._host_scan(self._scan_re, data, progress)
-        if self._device_broken:
-            # a prior scan exhausted every device route (dead link,
-            # repeated kernel failure): stay on the exact host engines
-            res = self._host_scan(self._host_scanner(), data, progress)
-            self.stats["device_fallback"] = True  # telemetry marker, like
-            # the FDR path's fdr_fallback: degraded-mode scans must be
-            # distinguishable from healthy ones without grepping logs
-            return res
         if (
             len(data) < self.device_min_bytes
             and not self._interpret  # CI interpret engines exist to
@@ -837,6 +889,21 @@ class GrepEngine:
             # device-path coverage runs on them).
             return self._host_scan(self._host_scanner(), data, progress)
         return self._scan_device(data, progress=progress)
+
+    def _device_responsive(self) -> bool:
+        """Process-cached first-touch device probe: True when
+        `jax.devices()` answers within DEVICE_PROBE_S (probed once per
+        process; later engines and concurrent scans reuse the verdict —
+        the lock makes racers WAIT on the in-flight probe rather than
+        falling through to a hanging device call).  Interpret engines
+        skip the wall: their CPU backend cannot wedge."""
+        global _device_probe_verdict
+        if self._interpret:
+            return True
+        with _device_probe_lock:
+            if _device_probe_verdict is None:
+                _device_probe_verdict = _probe_device_blocking()
+            return _device_probe_verdict
 
     def _host_scanner(self):
         """The exact host engine for this pattern, or None if no host

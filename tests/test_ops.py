@@ -886,3 +886,22 @@ def test_total_device_failure_degrades_to_host(monkeypatch):
     res2 = eng.scan(data)
     assert res2.matched_lines.tolist() == want
     assert calls["n"] == 2  # second scan never touched the device
+
+
+def test_unresponsive_device_routes_host(monkeypatch):
+    """A wedged device transport hangs jax's first touch in C (no
+    exception); the time-boxed first-touch probe detects it and routes
+    the engine to the exact host scanners (live-verified against a
+    dropped tunnel: the job completed exactly in probe-wall time
+    instead of hanging forever)."""
+    data = make_text(300, inject=[(5, b"xx volcano yy"), (80, b"volcano")])
+    want = sorted(oracle_lines("volcano", data))
+    eng = GrepEngine("volcano", backend="device")
+    monkeypatch.setattr(eng, "_device_responsive", lambda: False)
+    res = eng.scan(data)
+    assert res.matched_lines.tolist() == want
+    assert eng._device_broken
+    assert eng.stats.get("device_fallback") is True
+    # interpret engines never pay the probe (their CPU backend can't wedge)
+    eng2 = GrepEngine("volcano", backend="device", interpret=True)
+    assert eng2._device_responsive() is True
