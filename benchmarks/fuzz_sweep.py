@@ -19,16 +19,24 @@ import sys
 import traceback
 from pathlib import Path
 
-# CPU-pinned like tests/conftest.py: the fuzz families run interpret-mode
-# kernels on the 8-virtual-device CPU mesh; without this, importing the
-# engine initializes the default backend (the axon TPU tunnel here, which
-# can block indefinitely when wedged).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CPU-pinned like tests/conftest.py — FORCED, not setdefault: the
+# deployment environment ships JAX_PLATFORMS=axon globally, which a
+# setdefault silently honors (observed: this script then runs every seed
+# through the device tunnel until the tunnel drops mid-campaign).  The
+# axon plugin factory is also deregistered: even under jax_platforms=cpu,
+# backend discovery calls every registered factory, and a black-holed
+# tunnel blocks that call indefinitely.
+os.environ["JAX_PLATFORMS"] = "cpu"
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
 
 _root = Path(__file__).resolve().parent
 if not (_root / "distributed_grep_tpu").is_dir():
