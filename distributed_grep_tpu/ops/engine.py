@@ -85,10 +85,99 @@ COMPILE_GRACE_S = float(_os.environ.get("DGREP_COMPILE_GRACE_S", "90"))
 # within it, like _accel_backend's cache) and lock-serialized so
 # concurrent first scans wait for one probe instead of hanging past it.
 DEVICE_PROBE_S = float(_os.environ.get("DGREP_DEVICE_PROBE_S", "30"))
+
+# Mid-scan stall wall: the per-segment collect/feed waits are time-boxed
+# so a device that black-holes AFTER a healthy first touch (observed: the
+# tunnel degraded from fast connection-errors to indefinite hangs within
+# the same outage) degrades the scan to the exact host engines instead of
+# hanging the dispatch thread forever.  Generous: a legitimate segment
+# collect through the slow tunnel (upload + execute + confirm) is tens of
+# seconds at worst.
+DEVICE_STALL_S = float(_os.environ.get("DGREP_DEVICE_STALL_S", "300"))
 import threading as _threading_mod
 
 _device_probe_lock = _threading_mod.Lock()
 _device_probe_verdict: bool | None = None
+
+
+class _DeviceStall(TimeoutError):
+    """Raised when a collect/feed wait exceeds DEVICE_STALL_S — a DISTINCT
+    type so the recovery handler cannot confuse the wall with a transient
+    transport timeout surfacing from inside a device call (socket.timeout
+    is an alias of builtin TimeoutError since 3.10; those must keep the
+    ordinary kernel-retry chain, not a permanent device demotion)."""
+
+
+def _await_wall(fut):
+    """fut.result() bounded by the stall wall; converts the futures
+    timeout (its own type on 3.10, the builtin alias on 3.11+) into
+    _DeviceStall so the except net can identify the wall precisely."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    try:
+        return fut.result(timeout=DEVICE_STALL_S)
+    except (_FutTimeout, TimeoutError) as e:
+        raise _DeviceStall(
+            f"no collect/feed progress within {DEVICE_STALL_S:.0f}s"
+        ) from e
+
+
+class _DaemonPool:
+    """Minimal executor whose workers are DAEMON threads.
+
+    The stdlib ThreadPoolExecutor's workers are non-daemon (Py>=3.9) and
+    joined by threading._shutdown at interpreter exit, so ONE worker
+    blocked forever inside a dead device transport would hang process
+    shutdown — verified empirically; no registry surgery avoids that
+    join.  Daemon workers simply die with the process.  API subset used
+    by _scan_device: submit() -> concurrent.futures.Future, and
+    shutdown(wait=, cancel_futures=)."""
+
+    def __init__(self, max_workers: int, thread_name_prefix: str):
+        import queue as _q
+
+        self._q: _q.SimpleQueue = _q.SimpleQueue()
+        self._futs: list = []  # for cancel_futures
+        self._threads = [
+            _threading_mod.Thread(
+                target=self._worker, daemon=True,
+                name=f"{thread_name_prefix}-{i}",
+            )
+            for i in range(max_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+    def submit(self, fn, *args):
+        from concurrent.futures import Future
+
+        fut = Future()
+        self._futs.append(fut)
+        self._q.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
+        if cancel_futures:
+            for f in self._futs:
+                f.cancel()
+        for _ in self._threads:
+            self._q.put(None)
+        if wait:
+            for t in self._threads:
+                t.join()
 
 
 def _probe_device_blocking() -> bool:
@@ -848,7 +937,13 @@ class GrepEngine:
             # probe lock for the shared verdict
             self._device_probed = True
         if self._device_broken:
-            res = self._host_scan(self._host_scanner(), data, progress)
+            scanner = self._host_scanner()
+            if scanner is None:  # device dead AND no host route: fail fast
+                raise RuntimeError(
+                    "device backend is broken and no exact host engine "
+                    "exists for this pattern"
+                )
+            res = self._host_scan(scanner, data, progress)
             self.stats["device_fallback"] = True  # degraded-mode marker
             return res
         if self.mode == "pairset" and not self._kernel_backend_ok():
@@ -1608,7 +1703,10 @@ class GrepEngine:
                     arr = jnp.asarray(arr)
             return seg_bytes, lay, arr, dev
 
-        pool = ThreadPoolExecutor(1) if len(seg_starts) > 1 else None
+        pool = (
+            _DaemonPool(1, thread_name_prefix="dgrep-feed")
+            if len(seg_starts) > 1 else None
+        )
         # Collect pool (VERDICT r3 item 1): sparse decode + host confirm of
         # finished segments runs here, so confirms from different devices'
         # segments overlap each other and the dispatch loop instead of
@@ -1620,7 +1718,8 @@ class GrepEngine:
 
         n_collect = 2 if use_mesh else min(4, max(1, len(devs)))
         collect_pool = (
-            ThreadPoolExecutor(n_collect) if len(seg_starts) > 1 else None
+            _DaemonPool(n_collect, thread_name_prefix="dgrep-collect")
+            if len(seg_starts) > 1 else None
         )
         collect_futs: _deque = _deque()
         st["feed_wait_seconds"] = 0.0
@@ -1809,18 +1908,20 @@ class GrepEngine:
                     collect_futs.append(collect_pool.submit(collect, job))
                     if len(collect_futs) >= max_inflight:
                         # bound resident result planes, like the old pending
-                        # list: wait out the oldest in-flight collect
-                        collect_futs.popleft().result()
+                        # list: wait out the oldest in-flight collect.
+                        # Time-boxed (DEVICE_STALL_S): a device that
+                        # black-holes mid-scan must degrade, not hang.
+                        _await_wall(collect_futs.popleft())
                 else:
                     collect(job)
                 if progress is not None:
                     progress()  # one milestone per dispatched segment
                 if nxt_future is not None:
                     t0 = _time.perf_counter()
-                    nxt = nxt_future.result()
+                    nxt = _await_wall(nxt_future)
                     st["feed_wait_seconds"] += _time.perf_counter() - t0
             while collect_futs:
-                collect_futs.popleft().result()
+                _await_wall(collect_futs.popleft())
                 if progress is not None:
                     progress()
         except Exception as e:
@@ -1835,11 +1936,39 @@ class GrepEngine:
             # occur inside jax on version skew, so they stay in the net.
             if isinstance(e, (MemoryError, UnicodeError)):
                 raise
+            stalled = isinstance(e, _DeviceStall)  # the DEVICE_STALL_S wall
+            # (a transient socket.timeout from INSIDE a device call is a
+            # plain TimeoutError and keeps the ordinary retry chain)
             if collect_pool is not None:
                 # running collects mutate st/device_lines — let them
                 # drain before any fallback rescan resets those under them
-                # (their un-awaited exceptions, if any, mirror this one)
-                collect_pool.shutdown(wait=True, cancel_futures=True)
+                # (their un-awaited exceptions, if any, mirror this one).
+                # EXCEPT when the device stalled: the hung collect never
+                # returns, so waiting on it would hang this recovery too.
+                collect_pool.shutdown(wait=not stalled, cancel_futures=True)
+            if stalled:
+                host_scanner = self._host_scanner()
+                if host_scanner is not None:
+                    # Black-holed mid-scan (a healthy first touch, then the
+                    # transport died hanging instead of erroring): skip the
+                    # kernel-retry chain — the device is gone, not the
+                    # kernel — and degrade straight to the exact host
+                    # engines.  The hung pool threads are abandoned;
+                    # scrubbing them from the futures exit-join registry
+                    # keeps process shutdown from blocking on them.
+                    log.warning(
+                        "device execution stalled > %.0fs mid-scan (%s) -> "
+                        "exact host engines for this engine",
+                        DEVICE_STALL_S, e,
+                    )
+                    self._device_broken = True
+                    result = self._host_scan(host_scanner, data, progress)
+                    self.stats["device_fallback"] = True
+                    return result
+                # no host route: still mark the device dead so the next
+                # scan fails fast instead of re-paying the full wall
+                self._device_broken = True
+                raise
             if not use_fdr:
                 if use_pallas and not self._pallas_broken:
                     # same policy as the FDR net: a Mosaic/runtime kernel

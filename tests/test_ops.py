@@ -905,3 +905,87 @@ def test_unresponsive_device_routes_host(monkeypatch):
     # interpret engines never pay the probe (their CPU backend can't wedge)
     eng2 = GrepEngine("volcano", backend="device", interpret=True)
     assert eng2._device_responsive() is True
+
+
+def test_mid_scan_device_stall_degrades_to_host(monkeypatch):
+    """A device that black-holes MID-scan (healthy first touch, then the
+    transport hangs instead of erroring — the tunnel outage's second
+    phase) trips the DEVICE_STALL_S wall on the collect wait and degrades
+    to the exact host engines; the hung collect worker is a DAEMON
+    thread (engine._DaemonPool), so it cannot block process exit —
+    pinned separately by test_stalled_collect_does_not_block_exit."""
+    import time as _t
+
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    data = make_text(2000, inject=[(5, b"xx volcano yy"), (1500, b"volcano")])
+    want = sorted(oracle_lines("volcano", data))
+    # several segments so the collect pool exists and the bounded wait runs
+    eng = GrepEngine("volcano", backend="device", interpret=True,
+                     segment_bytes=1 << 14, target_lanes=8)
+    monkeypatch.setattr(engine_mod, "DEVICE_STALL_S", 0.3)
+
+    real = scan_jnp.sparse_nonzero
+
+    def hang(payload):
+        _t.sleep(60.0)  # "indefinite": only the stall wall can save us
+        return real(payload)
+
+    monkeypatch.setattr(scan_jnp, "sparse_nonzero", hang)
+    t0 = _t.monotonic()
+    res = eng.scan(data)
+    wall = _t.monotonic() - t0
+    assert res.matched_lines.tolist() == want
+    assert eng._device_broken
+    assert eng.stats.get("device_fallback") is True
+    # interpret-mode dispatch dominates the wall; the proof is that we
+    # did NOT sit out the 60 s hang (nor the shutdown join on it)
+    assert wall < 30
+    monkeypatch.setattr(scan_jnp, "sparse_nonzero", real)
+    res2 = eng.scan(data)  # stays on host, no device wait at all
+    assert res2.matched_lines.tolist() == want
+
+
+def test_stalled_collect_does_not_block_exit():
+    """Interpreter exit must not join a collect worker blocked in a dead
+    device transport: stdlib executor workers are non-daemon and joined
+    by threading._shutdown at exit (verified: registry surgery does NOT
+    avoid that join), so the engine uses daemon workers.  A subprocess
+    triggers the stall degrade with a worker still sleeping 120 s and
+    must exit promptly with the exact result."""
+    import subprocess
+    import sys as _sys
+    import time as _t
+
+    code = r"""
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax, jax._src.xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+from distributed_grep_tpu.ops import engine as engine_mod, scan_jnp
+from distributed_grep_tpu.ops.engine import GrepEngine
+engine_mod.DEVICE_STALL_S = 0.3
+real = scan_jnp.sparse_nonzero
+def hang(payload):
+    time.sleep(120.0)
+    return real(payload)
+scan_jnp.sparse_nonzero = hang
+data = (b"filler line\n" * 50 + b"xx volcano yy\n") * 40
+eng = GrepEngine("volcano", backend="device", interpret=True,
+                 segment_bytes=1 << 13, target_lanes=8)
+res = eng.scan(data)
+assert eng._device_broken
+print("N", res.n_matches, flush=True)
+"""
+    t0 = _t.monotonic()
+    out = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=90, env={**os.environ, "PYTHONPATH": ""},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    wall = _t.monotonic() - t0
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "N 40" in out.stdout
+    assert wall < 60  # exited without joining the 120 s-sleeping worker
