@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os as _os
 import re as _re
+import time as _time_mod
 from dataclasses import dataclass
 
 import numpy as np
@@ -94,10 +95,33 @@ DEVICE_PROBE_S = float(_os.environ.get("DGREP_DEVICE_PROBE_S", "30"))
 # collect through the slow tunnel (upload + execute + confirm) is tens of
 # seconds at worst.
 DEVICE_STALL_S = float(_os.environ.get("DGREP_DEVICE_STALL_S", "300"))
+
+# A degraded engine re-probes the device this often (0 disables): device
+# outages are usually transient (the observed tunnel drop recovered in
+# past sessions), and a long-lived worker should win the device back
+# instead of staying on host scanners forever.  A failed retry costs one
+# bounded probe (DEVICE_PROBE_S) per window.
+DEVICE_RETRY_S = float(_os.environ.get("DGREP_DEVICE_RETRY_S", "600"))
 import threading as _threading_mod
 
 _device_probe_lock = _threading_mod.Lock()
-_device_probe_verdict: bool | None = None
+# Process-global probe state {verdict, at}: one backend per process, so
+# one verdict serves every engine; a False verdict re-probes at most once
+# per DEVICE_RETRY_S window PROCESS-WIDE (N degraded engines share the
+# single probe instead of each paying their own).  Guarded by the lock —
+# racers wait on an in-flight probe rather than falling through to a
+# hanging device call.
+_device_probe_state: dict = {"verdict": None, "at": 0.0}
+
+
+def _report_device_sick() -> None:
+    """A demotion (stall wall, exhausted routes, failed first touch) is
+    process-wide evidence: jax answers `jax.devices()` from its client
+    cache after a successful init, so only REAL device work can observe a
+    mid-session black-hole — record the sickness so every engine's next
+    probe is the deep retry, not the stale cached True."""
+    with _device_probe_lock:
+        _device_probe_state.update(verdict=False, at=_time_mod.monotonic())
 
 
 class _DeviceStall(TimeoutError):
@@ -181,7 +205,12 @@ class _DaemonPool:
 
 
 def _probe_device_blocking() -> bool:
-    """Time-boxed `jax.devices()` on an abandoned daemon thread."""
+    """Time-boxed DEEP device probe on an abandoned daemon thread: backend
+    init (`jax.devices()` — the call that hangs on a cold wedge) plus one
+    tiny round trip (`device_put` + block_until_ready — the only way to
+    observe a transport that black-holed AFTER a healthy init, since
+    devices() is answered from jax's cache from then on).  ~ms when
+    healthy."""
     import queue as _queue
 
     out: _queue.Queue = _queue.Queue()
@@ -191,6 +220,9 @@ def _probe_device_blocking() -> bool:
             import jax
 
             jax.devices()
+            jax.block_until_ready(
+                jax.device_put(np.zeros(8, np.uint8))
+            )
             out.put(True)
         except Exception:  # noqa: BLE001 — broken backend = not responsive
             out.put(False)
@@ -922,6 +954,24 @@ class GrepEngine:
         # wedged transport hangs the first jax call in C with no
         # exception, wherever it happens (round-4 review finding).
         if (
+            self._device_broken
+            and DEVICE_RETRY_S > 0
+            and not self._interpret
+            and self._device_responsive()  # shared verdict: deep-probes a
+            # False verdict at most once per window PROCESS-wide, else
+            # answers from the cache instantly
+        ):
+            # The device came back: un-demote.  The kernel-level flags
+            # reset too — their failures were co-temporal with the
+            # outage; a genuinely broken kernel re-flags within one scan.
+            log.warning(
+                "device backend responsive again -> leaving host-degraded "
+                "mode (retry window %.0fs)", DEVICE_RETRY_S,
+            )
+            self._device_broken = False
+            self._pallas_broken = False
+            self._fdr_broken = False
+        if (
             not self._device_probed
             and not self._device_broken
             and self._host_scanner() is not None
@@ -931,7 +981,7 @@ class GrepEngine:
                     "device backend unresponsive after %.0fs -> exact "
                     "host engines for this engine", DEVICE_PROBE_S,
                 )
-                self._device_broken = True
+                self._mark_device_broken()
             # AFTER the verdict: a concurrent scan that reads this flag
             # early just re-enters _device_responsive and waits on the
             # probe lock for the shared verdict
@@ -986,19 +1036,32 @@ class GrepEngine:
         return self._scan_device(data, progress=progress)
 
     def _device_responsive(self) -> bool:
-        """Process-cached first-touch device probe: True when
-        `jax.devices()` answers within DEVICE_PROBE_S (probed once per
-        process; later engines and concurrent scans reuse the verdict —
-        the lock makes racers WAIT on the in-flight probe rather than
-        falling through to a hanging device call).  Interpret engines
-        skip the wall: their CPU backend cannot wedge."""
-        global _device_probe_verdict
+        """Shared device verdict (see _device_probe_state): probes on
+        first use, and re-probes a False verdict at most once per
+        DEVICE_RETRY_S window — outages are usually transient, and the
+        deep probe is what can actually observe both the wedge and the
+        recovery.  Interpret engines skip the wall: their CPU backend
+        cannot wedge."""
         if self._interpret:
             return True
         with _device_probe_lock:
-            if _device_probe_verdict is None:
-                _device_probe_verdict = _probe_device_blocking()
-            return _device_probe_verdict
+            v = _device_probe_state["verdict"]
+            stale = (
+                v is False
+                and DEVICE_RETRY_S > 0
+                and _time_mod.monotonic() - _device_probe_state["at"]
+                >= DEVICE_RETRY_S
+            )
+            if v is None or stale:
+                v = _probe_device_blocking()
+                _device_probe_state.update(
+                    verdict=v, at=_time_mod.monotonic()
+                )
+            return v
+
+    def _mark_device_broken(self) -> None:
+        self._device_broken = True
+        _report_device_sick()  # process-wide: starts the shared retry window
 
     def _host_scanner(self):
         """The exact host engine for this pattern, or None if no host
@@ -1961,13 +2024,13 @@ class GrepEngine:
                         "exact host engines for this engine",
                         DEVICE_STALL_S, e,
                     )
-                    self._device_broken = True
+                    self._mark_device_broken()
                     result = self._host_scan(host_scanner, data, progress)
                     self.stats["device_fallback"] = True
                     return result
                 # no host route: still mark the device dead so the next
                 # scan fails fast instead of re-paying the full wall
-                self._device_broken = True
+                self._mark_device_broken()
                 raise
             if not use_fdr:
                 if use_pallas and not self._pallas_broken:
@@ -1992,7 +2055,7 @@ class GrepEngine:
                         "device scan failed with no device fallback left "
                         "(%s) -> exact host engines for this engine", e,
                     )
-                    self._device_broken = True
+                    self._mark_device_broken()
                     result = self._host_scan(host_scanner, data, progress)
                     self.stats["device_fallback"] = True
                     return result

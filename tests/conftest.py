@@ -84,3 +84,16 @@ def corpus(tmp_path):
         p.write_text(text)
         files[name] = p
     return files
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_probe_state():
+    """The engine's device-probe verdict is process-global (one backend
+    per process in production); tests that exercise demotion would poison
+    it for every later test, silently rerouting device-path coverage to
+    host — reset per test."""
+    from distributed_grep_tpu.ops import engine as _eng
+
+    with _eng._device_probe_lock:
+        _eng._device_probe_state.update(verdict=None, at=0.0)
+    yield

@@ -1011,3 +1011,54 @@ def test_redos_pattern_immune():
         res = eng.scan(data)
         assert _t.monotonic() - t0 < 20  # linear, not exponential
         assert res.matched_lines.tolist() == [401], backend
+
+
+def test_degraded_engine_retries_device_after_window(monkeypatch):
+    """A host-degraded engine wins the device back once the shared probe
+    verdict turns True again (deep re-probe at most once per
+    DEVICE_RETRY_S window, process-wide) — kernel-level flags reset too,
+    since their failures were co-temporal with the outage."""
+    import time as _t
+
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    data = make_text(300, inject=[(5, b"xx volcano yy")])
+    want = sorted(oracle_lines("volcano", data))
+    probes = {"n": 0}
+
+    def dead_probe():
+        probes["n"] += 1
+        return False
+
+    monkeypatch.setattr(engine_mod, "_probe_device_blocking", dead_probe)
+    eng = GrepEngine("volcano", backend="device")
+    res = eng.scan(data)
+    assert eng._device_broken and res.matched_lines.tolist() == want
+    assert probes["n"] == 1
+
+    # inside the window: the cached False answers instantly, no re-probe
+    res2 = eng.scan(data)
+    assert eng._device_broken and "device_fallback" in eng.stats
+    assert probes["n"] == 1
+
+    # window elapsed, still dead: exactly ONE shared re-probe fires
+    with engine_mod._device_probe_lock:
+        engine_mod._device_probe_state["at"] = (
+            _t.monotonic() - engine_mod.DEVICE_RETRY_S - 1
+        )
+    res3 = eng.scan(data)
+    assert eng._device_broken and res3.matched_lines.tolist() == want
+    assert probes["n"] == 2
+
+    # window elapsed and the device recovered: back on the device path
+    monkeypatch.setattr(
+        engine_mod, "_probe_device_blocking", lambda: True
+    )
+    with engine_mod._device_probe_lock:
+        engine_mod._device_probe_state["at"] = (
+            _t.monotonic() - engine_mod.DEVICE_RETRY_S - 1
+        )
+    res4 = eng.scan(data)
+    assert not eng._device_broken
+    assert res4.matched_lines.tolist() == want
+    assert "scan_wall_seconds" in eng.stats  # the device path ran
