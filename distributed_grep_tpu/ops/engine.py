@@ -359,6 +359,8 @@ class GrepEngine:
         self._model_gen = 0  # bumped when a retune swaps kernel constants
         self._accel_cached: bool | None = None  # see _accel_backend
         self._device_broken = False  # every device route failed: host-only
+        self._device_demotion_permanent = False  # deterministic (non-
+        # transport) failure: exempt from the DEVICE_RETRY_S un-demote
         self._device_probed = False  # first-touch responsiveness wall done
         # THREAD-LOCAL: one engine is scanned concurrently by worker slots
         # sharing the app module (grep_tpu), and a shared stash would let
@@ -955,6 +957,8 @@ class GrepEngine:
         # exception, wherever it happens (round-4 review finding).
         if (
             self._device_broken
+            and not self._device_demotion_permanent  # deterministic per-
+            # pattern failures don't heal with the transport
             and DEVICE_RETRY_S > 0
             and not self._interpret
             and self._device_responsive()  # shared verdict: deep-probes a
@@ -1059,9 +1063,25 @@ class GrepEngine:
                 )
             return v
 
-    def _mark_device_broken(self) -> None:
+    def _mark_device_broken(self, transport_evidence: bool = True) -> None:
+        """Demote this engine to its exact host scanners.
+
+        ``transport_evidence=True`` (stall wall, failed first-touch probe)
+        additionally reports process-wide sickness — those failures can
+        only come from the device transport, so every engine's next probe
+        should be the deep retry — and leaves the demotion eligible for
+        the DEVICE_RETRY_S un-demote when the transport heals.  A generic
+        exhausted-routes failure (``False``) may be a deterministic
+        per-pattern defect on a HEALTHY device: it keeps the old permanent
+        per-engine demotion and must not poison the shared verdict (a
+        poisoned verdict would demote unrelated engines, then flip-flop
+        every retry window: deep probe succeeds, this engine un-demotes,
+        fails deterministically again, re-poisons — round-4 review)."""
         self._device_broken = True
-        _report_device_sick()  # process-wide: starts the shared retry window
+        if transport_evidence:
+            _report_device_sick()  # process-wide: starts the shared retry window
+        else:
+            self._device_demotion_permanent = True
 
     def _host_scanner(self):
         """The exact host engine for this pattern, or None if no host
@@ -2055,7 +2075,10 @@ class GrepEngine:
                         "device scan failed with no device fallback left "
                         "(%s) -> exact host engines for this engine", e,
                     )
-                    self._mark_device_broken()
+                    # a generic exception here may be a per-pattern defect
+                    # on a healthy device — demote this engine permanently,
+                    # but do NOT poison the process-wide probe verdict
+                    self._mark_device_broken(transport_evidence=False)
                     result = self._host_scan(host_scanner, data, progress)
                     self.stats["device_fallback"] = True
                     return result

@@ -1013,6 +1013,57 @@ def test_redos_pattern_immune():
         assert res.matched_lines.tolist() == [401], backend
 
 
+def test_deterministic_device_failure_is_permanent_and_local(monkeypatch):
+    """A generic exhausted-routes failure may be a per-pattern defect on a
+    HEALTHY device: it must demote only its own engine — permanently —
+    without poisoning the process-global probe verdict.  Otherwise one bad
+    pattern demotes every new engine in the process, then flip-flops each
+    retry window (deep probe succeeds, the engine un-demotes, fails
+    deterministically again, re-poisons — round-4 review finding)."""
+    import time as _t
+
+    from distributed_grep_tpu.ops import engine as engine_mod
+
+    data = make_text(300, inject=[(5, b"xx volcano yy"), (99, b"volcano")])
+    want = sorted(oracle_lines("volcano", data))
+    monkeypatch.setattr(engine_mod, "_probe_device_blocking", lambda: True)
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("per-pattern defect")
+
+    monkeypatch.setattr(pallas_scan, "shift_and_scan_words", boom)
+    monkeypatch.setattr(scan_jnp, "shift_and_scan", boom)
+    eng = GrepEngine("volcano", backend="device")
+    res = eng.scan(data)
+    assert res.matched_lines.tolist() == want
+    assert eng._device_broken and eng._device_demotion_permanent
+    with engine_mod._device_probe_lock:
+        # the shared verdict was NOT poisoned by the generic failure
+        assert engine_mod._device_probe_state["verdict"] is not False
+
+    # an unrelated engine in the same process keeps its device path
+    # (NFA mode — the booms above patch only the shift-and kernels)
+    eng2 = GrepEngine("volc+ano", backend="device", interpret=True)
+    assert eng2.mode == "nfa", eng2.mode
+    res2 = eng2.scan(data)
+    assert res2.matched_lines.tolist() == want
+    assert not eng2._device_broken
+
+    # elapsed retry window + responsive device: the deterministic demotion
+    # does NOT un-demote (no flip-flop), and never touches the device again
+    with engine_mod._device_probe_lock:
+        engine_mod._device_probe_state.update(
+            verdict=False, at=_t.monotonic() - engine_mod.DEVICE_RETRY_S - 1
+        )
+    n = calls["n"]
+    res3 = eng.scan(data)
+    assert res3.matched_lines.tolist() == want
+    assert eng._device_broken and calls["n"] == n
+
+
 def test_degraded_engine_retries_device_after_window(monkeypatch):
     """A host-degraded engine wins the device back once the shared probe
     verdict turns True again (deep re-probe at most once per
