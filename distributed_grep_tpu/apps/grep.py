@@ -31,6 +31,7 @@ _ac_tables: list | None = None  # Aho-Corasick banks when configured with a set
 _ac_confirm: re.Pattern[bytes] | None = None  # -w/-x confirm for set mode
 _invert: bool = False  # grep -v
 _line_mode: str = "search"  # "search" | "word" (-w) | "line" (-x)
+_count_only: bool = False  # emit one per-file count record, not per-line
 _configured_with: tuple | None = None
 
 # GNU grep word constituents in the C locale: [A-Za-z0-9_]
@@ -54,6 +55,7 @@ def configure(
     invert: bool = False,
     word_regexp: bool = False,
     line_regexp: bool = False,
+    count_only: bool = False,
     **_: object,
 ) -> None:
     """``pattern`` is a regex; ``patterns`` is a literal set (grep -F -f).
@@ -63,11 +65,15 @@ def configure(
     ``invert`` = grep -v: emit the lines that do NOT match.  ``word_regexp``
     / ``line_regexp`` = grep -w / -x: the scan stays on the raw pattern
     (set mode: candidates from the AC banks) and each candidate line is
-    confirmed against the boundary-wrapped regex."""
-    global _pattern, _ac_tables, _ac_confirm, _invert, _line_mode, _configured_with
+    confirmed against the boundary-wrapped regex.  ``count_only`` = count
+    queries (grep -c/-l/-L/-q): one record per file, key = filename, value
+    = selected line count — same contract as apps/grep_tpu.py."""
+    global _pattern, _ac_tables, _ac_confirm, _invert, _line_mode, \
+        _count_only, _configured_with
     if isinstance(pattern, str):
         pattern = pattern.encode("utf-8", "surrogateescape")
     _invert = bool(invert)
+    _count_only = bool(count_only)
     _line_mode = "line" if line_regexp else ("word" if word_regexp else "search")
     key = (pattern, ignore_case, tuple(patterns) if patterns else None, _invert,
            _line_mode)
@@ -103,6 +109,7 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if lines and lines[-1] == b"":
         lines.pop()  # trailing '\n' does not open a phantom empty line (grep -n)
     out: list[KeyValue] = []
+    n_selected = 0
     for lineno, line in enumerate(lines, start=1):
         if matched is not None:
             hit = lineno in matched and (
@@ -111,12 +118,17 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         else:
             hit = _pattern.search(line)
         if bool(hit) != _invert:
+            if _count_only:
+                n_selected += 1
+                continue
             out.append(
                 KeyValue(
                     key=f"{filename} (line number #{lineno})",
                     value=line.decode("utf-8", errors="replace"),
                 )
             )
+    if _count_only:
+        return [KeyValue(key=filename, value=str(n_selected))]
     return out
 
 

@@ -23,6 +23,7 @@ from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
 _engine: GrepEngine | None = None
 _invert: bool = False  # grep -v
 _confirm = None  # -w/-x: boundary-wrapped host regex over candidate lines
+_count_only: bool = False  # emit one per-file count record, not per-line
 _configured_with: tuple | None = None
 
 # Progress reporting (runtime liveness, VERDICT r3 item 3): the worker
@@ -66,12 +67,18 @@ def configure(
     # mirror of JobConfig.mesh_shape — the long-context configuration)
     mesh_axes: object = ("data",),
     pattern_axis: object = None,  # with a 2D mesh: EP-shard FDR banks
+    count_only: bool = False,  # count queries (grep -c/-l/-L/-q): emit ONE
+    # record per file — "<filename>" -> str(selected line count) — instead
+    # of one per matched line.  A match-dense count job otherwise pays the
+    # full per-line record pipeline for output it immediately collapses
+    # (measured: 549k-match 64 MB `-c` fell 17.5 s -> ~1.5 s)
     **engine_opts: object,
 ) -> None:
-    global _engine, _invert, _confirm, _configured_with
+    global _engine, _invert, _confirm, _count_only, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
     _invert = bool(invert)
+    _count_only = bool(count_only)
     mode = "line" if line_regexp else ("word" if word_regexp else "search")
     if backend == "device" and mesh_shape:
         from distributed_grep_tpu.parallel.mesh import make_mesh
@@ -141,6 +148,8 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         ]
     if _invert:
         emit = sorted(set(range(1, count_lines(contents) + 1)) - set(emit))
+    if _count_only:
+        return [KeyValue(key=filename, value=str(len(emit)))]
     if not emit:
         return []
     if nl is None:
@@ -173,6 +182,18 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
     if _invert:
         with open(path, "rb") as f:
             return map_fn(filename, f.read())
+    if _count_only:
+        # count queries keep O(1) state even on match-dense streams
+        n = 0
+
+        def emit_count(line_no: int, line: bytes) -> None:
+            nonlocal n
+            if _confirm is not None and not _confirm.search(line):
+                return
+            n += 1
+
+        _engine.scan_file(path, emit=emit_count, progress=_progress_fn())
+        return [KeyValue(key=filename, value=str(n))]
     out: list[KeyValue] = []
 
     def emit(line_no: int, line: bytes) -> None:
