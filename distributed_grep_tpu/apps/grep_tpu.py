@@ -134,6 +134,16 @@ def configure(
     _configured_with = key
 
 
+def _stamp_every(progress, i: int, stride: int = 16384) -> None:
+    """Throttled liveness stamp inside match-dense per-line loops: the
+    engine's scan stamps stop once the scan returns, and building or
+    confirming ~500k records can outlast the failure-detector window by
+    itself.  The callback self-throttles; the stride just bounds call
+    overhead."""
+    if progress is not None and i % stride == 0:
+        progress()
+
+
 def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
@@ -142,10 +152,13 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     nl = None
     if _confirm is not None and emit:
         nl = newline_index(contents)
-        emit = [
-            ln for ln in emit
-            if _confirm.search(contents[slice(*line_span(nl, ln, len(contents)))])
-        ]
+        progress = _progress_fn()
+        kept = []
+        for i, ln in enumerate(emit):
+            if _confirm.search(contents[slice(*line_span(nl, ln, len(contents)))]):
+                kept.append(ln)
+            _stamp_every(progress, i)  # -w/-x over dense candidates
+        emit = kept
     if _invert:
         emit = sorted(set(range(1, count_lines(contents) + 1)) - set(emit))
     if _count_only:
@@ -155,7 +168,8 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if nl is None:
         nl = newline_index(contents)
     out: list[KeyValue] = []
-    for line_no in emit:
+    progress = _progress_fn()
+    for i, line_no in enumerate(emit):
         start, end = line_span(nl, line_no, len(contents))
         out.append(
             KeyValue(
@@ -163,6 +177,7 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
                 value=contents[start:end].decode("utf-8", errors="replace"),
             )
         )
+        _stamp_every(progress, i)  # match-dense record building
     return out
 
 

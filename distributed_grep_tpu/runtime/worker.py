@@ -216,13 +216,23 @@ class WorkerLoop:
         finally:
             if has_progress:
                 self.app.set_progress(None)
-        buckets = shuffle.bucketize(records, a.n_reduce)
-        self._fault("before_map_commit")
-        produced: list[int] = []
-        for r, kvs in sorted(buckets.items()):
-            # Atomic write == the temp-file + rename commit (worker.go:103).
-            self.transport.write_intermediate(f"mr-{a.task_id}-{r}", shuffle.encode_records(kvs))
-            produced.append(r)
+        # The shuffle leg (bucketize + intermediate writes) is worker-side
+        # code with no app involvement, and on a match-dense map it can
+        # run past the sweep window by itself (549k records measured ~8 s
+        # on this host — observed swept mid-shuffle and re-executed).  The
+        # coarse pump is the right liveness here, same tradeoff as the
+        # download legs: a hang in OUR shuffle is a worker bug, not an
+        # app hang the detector needs to catch.
+        with self._pumping("map", a.task_id, pump_s):
+            buckets = shuffle.bucketize(records, a.n_reduce)
+            self._fault("before_map_commit")
+            produced: list[int] = []
+            for r, kvs in sorted(buckets.items()):
+                # Atomic write == the temp-file + rename commit (worker.go:103).
+                self.transport.write_intermediate(
+                    f"mr-{a.task_id}-{r}", shuffle.encode_records(kvs)
+                )
+                produced.append(r)
         self._fault("before_map_finished")
         self.transport.map_finished(
             rpc.TaskFinishedArgs(

@@ -362,3 +362,52 @@ def test_progressless_app_survives_via_compute_pump(tmp_path):
     assert counters.get("map_retries", 0) == 0
     assert counters.get("heartbeats", 0) >= 1
     assert counters["map_completed"] == 1
+
+
+def test_slow_shuffle_leg_survives_tight_timeout(tmp_path, monkeypatch):
+    """The map SHUFFLE leg (bucketize + intermediate writes) runs after the
+    app's last progress stamp, and on match-dense output it can outlast
+    the detector window by itself (observed live: a 549k-record map was
+    swept mid-shuffle and re-executed).  The worker pumps coarse liveness
+    over it — a slow shuffle must complete in ONE attempt even for
+    progress-capable apps (whose compute pump is a nullcontext)."""
+    from distributed_grep_tpu.runtime import shuffle as shuffle_mod
+
+    app_py = tmp_path / "emit_app.py"
+    app_py.write_text(  # progress-capable, and emits a record so the
+        # shuffle leg actually encodes something
+        "import time\n"
+        "from distributed_grep_tpu.apps.base import KeyValue\n"
+        "_p = None\n"
+        "def set_progress(fn):\n"
+        "    global _p; _p = fn\n"
+        "def configure(**kw): pass\n"
+        "def map_fn(filename, contents):\n"
+        "    if _p: _p()\n"
+        "    return [KeyValue(key='k', value='v')]\n"
+        "def reduce_fn(key, values):\n"
+        "    return values[0]\n"
+    )
+    f = tmp_path / "in.txt"
+    f.write_text("x\n")
+
+    real_encode = shuffle_mod.encode_records
+    encoded = []
+
+    def slow_encode(kvs):
+        encoded.append(len(kvs))
+        time.sleep(1.0)  # slower than the 0.4 s window, like dense output
+        return real_encode(kvs)
+
+    monkeypatch.setattr(shuffle_mod, "encode_records", slow_encode)
+    cfg = JobConfig(
+        input_files=[str(f)], application=str(app_py),
+        app_options={}, n_reduce=1,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=0.4, sweep_interval_s=0.05,
+    )
+    res = run_job(cfg, n_workers=1)
+    counters = res.metrics["counters"]
+    assert encoded, "the slow shuffle leg never ran — vacuous test"
+    assert counters.get("map_retries", 0) == 0
+    assert counters["map_completed"] == 1
