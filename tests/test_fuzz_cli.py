@@ -131,6 +131,17 @@ def test_fuzz_cli_count_and_list(seed, tmp_path, capsys):
         grc, gout = _run_gnu([flag, pattern, *paths])
         assert out == gout, f"seed={seed} {flag}: {out} vs {gout}"
         assert rc == grc, f"seed={seed} {flag}: rc {rc} vs {grc}"
+    # count_only modifier combos (-v/-i/-m/-w reshape the selected-line
+    # set BEFORE the per-file count record is emitted; a 120-seed sweep
+    # of these ran clean 2026-07-31).  All combos every seed — a drawn
+    # subset under FIXED seeds would deterministically never run some
+    # (round-4 review finding), and each run is milliseconds
+    for flags in (["-c", "-v"], ["-c", "-i"], ["-c", "-m", "2"],
+                  ["-c", "-w"], ["-l", "-v"], ["-q"], ["-q", "-v"]):
+        rc, out = _run_ours(["grep", pattern, *paths, *flags], capsys)
+        grc, gout = _run_gnu([*flags, pattern, *paths])
+        assert out == gout, f"seed={seed} {flags}: {out} vs {gout}"
+        assert rc == grc, f"seed={seed} {flags}: rc {rc} vs {grc}"
 
 
 @pytest.mark.parametrize("seed", range(4))
