@@ -198,14 +198,19 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
         with open(path, "rb") as f:
             return map_fn(filename, f.read())
     if _count_only:
-        # count queries keep O(1) state even on match-dense streams
+        if _confirm is None:
+            # no -w/-x: the ScanResult's matched-line list IS the answer —
+            # skip the per-line emit machinery entirely (549k line_span +
+            # callback invocations measured ~1.3 s of a 1.6 s dense map)
+            res = _engine.scan_file(path, progress=_progress_fn())
+            return [KeyValue(key=filename, value=str(len(res.matched_lines)))]
+        # -w/-x confirm needs the line bytes; count with O(1) state
         n = 0
 
         def emit_count(line_no: int, line: bytes) -> None:
             nonlocal n
-            if _confirm is not None and not _confirm.search(line):
-                return
-            n += 1
+            if _confirm.search(line):
+                n += 1
 
         _engine.scan_file(path, emit=emit_count, progress=_progress_fn())
         return [KeyValue(key=filename, value=str(n))]
