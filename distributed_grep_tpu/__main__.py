@@ -294,6 +294,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
             **({"line_regexp": True} if args.line_regexp else {}),
             **({"max_errors": args.max_errors} if args.max_errors else {}),
             **({"count_only": True} if count_only else {}),
+            # -q/-l/-L consume only per-file truthiness: the scan may stop
+            # at the first match (GNU grep does); -c needs the full count
+            **({"presence_only": True}
+               if count_only and not args.count else {}),
             # Backend resolution: no flag defaults to the cpu engine path
             # (native scanners, no jax import) EXCEPT for --max-errors,
             # whose fast core is the XLA approx kernel (on the CPU jax

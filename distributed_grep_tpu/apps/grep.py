@@ -32,6 +32,7 @@ _ac_confirm: re.Pattern[bytes] | None = None  # -w/-x confirm for set mode
 _invert: bool = False  # grep -v
 _line_mode: str = "search"  # "search" | "word" (-w) | "line" (-x)
 _count_only: bool = False  # emit one per-file count record, not per-line
+_presence: bool = False  # -q/-l/-L: truthiness only; may stop at first hit
 _configured_with: tuple | None = None
 
 # GNU grep word constituents in the C locale: [A-Za-z0-9_]
@@ -56,6 +57,7 @@ def configure(
     word_regexp: bool = False,
     line_regexp: bool = False,
     count_only: bool = False,
+    presence_only: bool = False,
     **_: object,
 ) -> None:
     """``pattern`` is a regex; ``patterns`` is a literal set (grep -F -f).
@@ -69,11 +71,12 @@ def configure(
     queries (grep -c/-l/-L/-q): one record per file, key = filename, value
     = selected line count — same contract as apps/grep_tpu.py."""
     global _pattern, _ac_tables, _ac_confirm, _invert, _line_mode, \
-        _count_only, _configured_with
+        _count_only, _presence, _configured_with
     if isinstance(pattern, str):
         pattern = pattern.encode("utf-8", "surrogateescape")
     _invert = bool(invert)
     _count_only = bool(count_only)
+    _presence = bool(presence_only)
     _line_mode = "line" if line_regexp else ("word" if word_regexp else "search")
     key = (pattern, ignore_case, tuple(patterns) if patterns else None, _invert,
            _line_mode)
@@ -120,6 +123,8 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         if bool(hit) != _invert:
             if _count_only:
                 n_selected += 1
+                if _presence:
+                    break  # grep -q/-l: first selected line settles it
                 continue
             out.append(
                 KeyValue(

@@ -24,6 +24,7 @@ _engine: GrepEngine | None = None
 _invert: bool = False  # grep -v
 _confirm = None  # -w/-x: boundary-wrapped host regex over candidate lines
 _count_only: bool = False  # emit one per-file count record, not per-line
+_presence: bool = False  # -q/-l/-L: truthiness only; streaming may stop early
 _configured_with: tuple | None = None
 
 # Progress reporting (runtime liveness, VERDICT r3 item 3): the worker
@@ -72,13 +73,18 @@ def configure(
     # of one per matched line.  A match-dense count job otherwise pays the
     # full per-line record pipeline for output it immediately collapses
     # (measured: 549k-match 64 MB `-c` fell 17.5 s -> ~1.5 s)
+    presence_only: bool = False,  # refinement of count_only for -q/-l/-L:
+    # only per-file TRUTHINESS is consumed, so the streaming scan may stop
+    # at the first chunk containing a match (GNU grep -q/-l stop at the
+    # first match); the emitted count may then be partial
     **engine_opts: object,
 ) -> None:
-    global _engine, _invert, _confirm, _count_only, _configured_with
+    global _engine, _invert, _confirm, _count_only, _presence, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
     _invert = bool(invert)
     _count_only = bool(count_only)
+    _presence = bool(presence_only)
     mode = "line" if line_regexp else ("word" if word_regexp else "search")
     if backend == "device" and mesh_shape:
         from distributed_grep_tpu.parallel.mesh import make_mesh
@@ -202,9 +208,14 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
             # no -w/-x: the ScanResult's matched-line list IS the answer —
             # skip the per-line emit machinery entirely (549k line_span +
             # callback invocations measured ~1.3 s of a 1.6 s dense map)
-            res = _engine.scan_file(path, progress=_progress_fn())
+            res = _engine.scan_file(
+                path, progress=_progress_fn(), stop_after_match=_presence
+            )
             return [KeyValue(key=filename, value=str(len(res.matched_lines)))]
-        # -w/-x confirm needs the line bytes; count with O(1) state
+        # -w/-x confirm needs the line bytes; count with O(1) state.
+        # Presence mode stops the stream once one line CONFIRMS (the
+        # engine's own match bit is pre-confirm, so stop_after_match
+        # would false-positive here — the stop predicate decides).
         n = 0
 
         def emit_count(line_no: int, line: bytes) -> None:
@@ -212,7 +223,10 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
             if _confirm.search(line):
                 n += 1
 
-        _engine.scan_file(path, emit=emit_count, progress=_progress_fn())
+        _engine.scan_file(
+            path, emit=emit_count, progress=_progress_fn(),
+            stop=(lambda: n > 0) if _presence else None,
+        )
         return [KeyValue(key=filename, value=str(n))]
     out: list[KeyValue] = []
 

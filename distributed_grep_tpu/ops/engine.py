@@ -1160,7 +1160,8 @@ class GrepEngine:
         return ScanResult(ml, n_matches, len(data))
 
     def scan_file(self, path, chunk_bytes: int | None = None, emit=None,
-                  progress=None) -> ScanResult:
+                  progress=None, stop_after_match: bool = False,
+                  stop=None) -> ScanResult:
         """Stream a file of any size through the scanner: chunks are cut at
         newline boundaries (partial tail lines carry into the next chunk),
         so no line — and hence no grep match — ever spans a chunk, and host
@@ -1181,6 +1182,15 @@ class GrepEngine:
         corpus pays max(read, scan) per chunk instead of their sum.
         Residual stall is recorded in stats["read_wait_seconds"] (~0 when
         the scan hides the read); host memory stays bounded by TWO chunks.
+
+        ``stop_after_match=True`` stops reading after the first chunk that
+        contains any matched line (GNU grep -q/-l stop at the first match;
+        chunk granularity keeps the exactness machinery untouched).  The
+        result then reports only the lines seen so far — presence, not a
+        total count.  ``stop`` generalizes it: a zero-arg callable checked
+        after each chunk's emits — return True to end the stream (callers
+        whose emit applies a further filter, e.g. the -w/-x confirm,
+        decide presence themselves).
         """
         import time as _time
         from concurrent.futures import ThreadPoolExecutor
@@ -1234,6 +1244,10 @@ class GrepEngine:
                         lines_before += lines_mod.count_lines(buf)
                     if progress is not None:
                         progress()  # one work milestone per streamed chunk
+                    if (stop_after_match and n_matches) or (
+                        stop is not None and stop()
+                    ):
+                        break  # presence settled: skip the rest of the file
                 if final:
                     break
         finally:

@@ -1113,3 +1113,68 @@ def test_degraded_engine_retries_device_after_window(monkeypatch):
     assert not eng._device_broken
     assert res4.matched_lines.tolist() == want
     assert "scan_wall_seconds" in eng.stats  # the device path ran
+
+
+def test_scan_file_stop_after_match(tmp_path):
+    """GNU grep -q/-l stop reading at the first match; the streaming scan
+    honors that at chunk granularity (presence_only app contract): a
+    match in the first chunk must end the read there, and the default
+    full scan must be unaffected."""
+    p = tmp_path / "big.txt"
+    with open(p, "wb") as f:
+        f.write(b"hit early\n")
+        for _ in range(200):
+            f.write(b"filler line of no consequence\n" * 100)
+    size = p.stat().st_size
+    eng = GrepEngine("early", backend="cpu")
+    res = eng.scan_file(str(p), chunk_bytes=1 << 16, stop_after_match=True)
+    assert res.n_matches == 1 and res.matched_lines.tolist() == [1]
+    assert res.bytes_scanned < size // 4  # stopped after the first chunk
+    full = eng.scan_file(str(p), chunk_bytes=1 << 16)
+    assert full.bytes_scanned == size and full.n_matches == 1
+
+
+def test_scan_file_stop_predicate_confirmed_presence(tmp_path):
+    """-w/-x presence: the engine's own match bit is pre-confirm, so the
+    caller's stop predicate — not stop_after_match — decides when
+    truthiness is settled.  A chunk full of UNconfirmed candidates must
+    not end the stream; the first confirmed line must."""
+    p = tmp_path / "big.txt"
+    with open(p, "wb") as f:
+        # the pattern only ever appears INSIDE a longer word -> every
+        # engine candidate fails the -w confirm
+        f.write(b"xxwordxmatchyy unconfirmed\n" * 50)
+        f.write(b"a xwordxmatch9 b\n")
+        f.write(b"filler\n" * 5000)
+        f.write(b"tail candidate xxwordxmatch0\n")
+    import re as _re
+
+    from distributed_grep_tpu.apps.grep import wrap_mode
+    confirm = _re.compile(wrap_mode(rb"wordxmatch", "word"))
+    eng = GrepEngine("wordxmatch", backend="cpu")
+    hits = []
+
+    def emit(ln, line):
+        if confirm.search(line):
+            hits.append(ln)
+
+    res = eng.scan_file(str(p), chunk_bytes=1 << 10, emit=emit,
+                        stop=lambda: len(hits) > 0)
+    # candidates existed from chunk 1, but nothing ever confirms -> the
+    # stream must have run to the LAST candidate without stopping early
+    assert hits == [] and res.bytes_scanned == p.stat().st_size
+
+    p2 = tmp_path / "big2.txt"
+    with open(p2, "wb") as f:
+        f.write(b"the wordxmatch stands alone here\n")  # space-bounded:
+        f.write(b"filler\n" * 5000)                     # confirms under -w
+    hits2 = []
+
+    def emit2(ln, line):
+        if confirm.search(line):
+            hits2.append(ln)
+
+    res2 = eng.scan_file(str(p2), chunk_bytes=1 << 10, emit=emit2,
+                         stop=lambda: len(hits2) > 0)
+    assert hits2 == [1]
+    assert res2.bytes_scanned < p2.stat().st_size // 4  # stopped early
