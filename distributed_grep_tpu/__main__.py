@@ -171,10 +171,47 @@ def cmd_grep(args: argparse.Namespace) -> int:
         except re.error as e:
             print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
             return 2
+    import os as _os
+
+    stdin_label: str | None = None  # resolved spool path shown as GNU's label
+    stdin_spool: str | None = None  # raw spool path as placed in args.files
+    if (not args.files and not args.recursive) or "-" in args.files:
+        # GNU grep: no FILE, or the FILE "-", means standard input.  The
+        # runtime schedules map tasks over real files, so stdin is spooled
+        # once to a temp file, searched like any split, and displayed as
+        # "(standard input)".  Repeated "-" collapses to the one spool
+        # (GNU's second read of stdin sees EOF anyway).  Batch semantics,
+        # deliberately: the WHOLE stream is spooled before the scan, so an
+        # unbounded pipe (`tail -f | ... grep -q`) does not terminate at
+        # the first match the way GNU's streaming read does — this is a
+        # job scheduler; stdin is treated as one finite input split.
+        import atexit
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        fd, _spool = _tempfile.mkstemp(prefix="dgrep-stdin-")
+        with _os.fdopen(fd, "wb") as _out:
+            _shutil.copyfileobj(sys.stdin.buffer, _out, 1 << 20)
+        atexit.register(lambda p=_spool: _os.path.exists(p) and _os.unlink(p))
+        stdin_spool = _spool
+        stdin_label = str(Path(_spool).resolve())
+        if args.files:
+            repl, seen = [], False
+            for f in args.files:
+                if f == "-":
+                    if not seen:
+                        repl.append(_spool)
+                    seen = True
+                else:
+                    repl.append(f)
+            args.files = repl
+        else:
+            args.files = [_spool]
+    if args.recursive and not args.files:
+        args.files = ["."]  # GNU grep -r with no FILE searches the cwd
     if not args.files:
         print("error: no input files", file=sys.stderr)
         return 2
-    import os as _os
 
     def _readable(f: str) -> bool:
         p = Path(f)
@@ -225,7 +262,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
                         walk_bad.append(sp)
                         continue
                     expanded.append(sp)
-            elif _included(pf.name):
+            elif f == stdin_spool or _included(pf.name):
+                # the spool's temp basename must not be glob-filtered:
+                # stdin is not a file name (GNU applies no filters to it)
                 expanded.append(f)
         if walk_bad:
             had_file_errors = True
@@ -244,7 +283,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 print(f"error: {', '.join(dirs)}: is a directory (use -r)",
                       file=sys.stderr)
             return 2
-        args.files = [f for f in args.files if _included(Path(f).name)]
+        args.files = [f for f in args.files
+                      if f == stdin_spool or _included(Path(f).name)]
+        # stdin is not a file name: --include/--exclude never apply (GNU)
         if not args.files:
             return 2 if had_file_errors else 1  # everything --include-filtered
 
@@ -374,6 +415,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
                     break  # -q: one selected line settles the answer
         if args.max_count is not None:
             counts = {f: min(c, args.max_count) for f, c in counts.items()}
+    def disp(path: str) -> str:
+        # GNU grep shows stdin under this label wherever a name prints
+        return "(standard input)" if path == stdin_label else path
+
     any_selected = any(counts[f] for f in cfg.input_files)
     # grep exit conventions: -q reports selection (0) even after file
     # errors; otherwise an error forces 2
@@ -390,7 +435,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # against GNU grep 3.8 (tests/test_fuzz_cli.py)
         listed = [f for f in cfg.input_files if not counts[f]]
         for f in listed:
-            print(f)
+            print(disp(f))
         exit_early = 2 if had_file_errors else (0 if any_selected else 1)
         if args.metrics:
             print(json.dumps(res.metrics, indent=2, sort_keys=True),
@@ -400,11 +445,11 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # grep -l: names only, argv order, each file once
         for f in cfg.input_files:
             if counts[f]:
-                print(f)
+                print(disp(f))
     elif args.count:
         # grep -c: one "<file>:<count>" line per input, in argv order
         for f in cfg.input_files:
-            prefix = (f"{f}:" if len(cfg.input_files) > 1
+            prefix = (f"{disp(f)}:" if len(cfg.input_files) > 1
                       and not args.no_filename else "")
             print(f"{prefix}{counts[f]}")
     elif args.only_matching:
@@ -412,7 +457,8 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # matched substrings (grep prints nothing for -v -o).
         if not args.invert:
             offsets = _line_offsets(matched) if args.byte_offset else None
-            _print_only_matching(res, args, patterns, matched, offsets)
+            _print_only_matching(res, args, patterns, matched, offsets,
+                                 disp=disp)
     elif ctx_before or ctx_after:
         # the '--' group separator is global across input files, like grep
         printed_any = False
@@ -421,6 +467,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 f, matched[f], ctx_before, ctx_after, printed_any,
                 no_filename=args.no_filename,
                 byte_offset=args.byte_offset,
+                display=disp(f),
             )
     else:
         # default print: stream in (file, line) order with bounded memory
@@ -434,9 +481,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 if emitted[m.group(1)] >= args.max_count:
                     continue  # dropped by the -m cap
                 emitted[m.group(1)] += 1
-            if m and (args.no_filename or offsets is not None):
+            if m and (args.no_filename or offsets is not None
+                      or stdin_label is not None):
                 path, ln = m.group(1), int(m.group(2))
-                head = "" if args.no_filename else f"{path} "
+                head = "" if args.no_filename else f"{disp(path)} "
                 boff = (f"(byte #{offsets[path].get(ln, '?')}) "
                         if offsets is not None else "")
                 print(f"{head}(line number #{ln}) {boff}{value}")
@@ -507,7 +555,8 @@ def _read_line_bytes(f, offset: int) -> bytes:
     return b"".join(chunks)
 
 
-def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
+def _print_only_matching(res, args, patterns, matched, offsets=None,
+                         disp=lambda p: p) -> None:
     import re
 
     from distributed_grep_tpu.runtime.job import GREP_KEY_RE
@@ -543,7 +592,7 @@ def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
             line_off = None
             if m:
                 if not args.no_filename:
-                    prefix = f"{m.group(1)} "
+                    prefix = f"{disp(m.group(1))} "
                 prefix += f"(line number #{m.group(2)}) "
                 if offsets is not None:
                     line_off = offsets.get(m.group(1), {}).get(int(m.group(2)))
@@ -571,7 +620,8 @@ def _print_only_matching(res, args, patterns, matched, offsets=None) -> None:
 def _print_with_context(path: str, lines_set: set[int], before: int,
                         after: int, printed_any: bool,
                         no_filename: bool = False,
-                        byte_offset: bool = False) -> bool:
+                        byte_offset: bool = False,
+                        display: str | None = None) -> bool:
     """grep -A/-B/-C over one file, streaming (memory bounded by the
     context width).  Matched lines print in the usual key format; context
     lines use ')-' instead of ') ' and non-contiguous groups are separated
@@ -585,7 +635,7 @@ def _print_with_context(path: str, lines_set: set[int], before: int,
     prevq: collections.deque = collections.deque(maxlen=max(before, 0))
     pending_after = 0
     last_printed = 0
-    head = "" if no_filename else f"{path} "
+    head = "" if no_filename else f"{display if display is not None else path} "
 
     def fmt(n: int, off: int, ctx: bool) -> str:
         sep = "-" if ctx else ""

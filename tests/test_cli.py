@@ -518,3 +518,44 @@ def test_count_only_app_contract(tmp_path):
         ))
         recs = app.map_fn("f.txt", data)
         assert [(r.key, r.value) for r in recs] == [("f.txt", "2")], app.__name__
+
+
+def test_stdin_input(tmp_path, corpus, capsys, monkeypatch):
+    """GNU grep reads standard input when no FILE is given, or for the
+    FILE "-"; output shows the "(standard input)" label.  The runtime
+    schedules real files, so stdin spools to a temp file under the hood."""
+    import io
+    import types
+
+    def feed(data: bytes):
+        monkeypatch.setattr(
+            sys, "stdin", types.SimpleNamespace(buffer=io.BytesIO(data))
+        )
+
+    # bare stdin, default print: label + line numbers, grep exit code
+    feed(b"one hello\ntwo\nthree hello\n")
+    code, out, _ = run_cli(
+        ["grep", "hello", "--work-dir", str(tmp_path / "w1")], capsys)
+    assert code == 0
+    assert out.splitlines() == [
+        "(standard input) (line number #1) one hello",
+        "(standard input) (line number #3) three hello",
+    ]
+    # "-" mixed with a real file; -l lists the label
+    a = str(corpus["a.txt"])
+    feed(b"piped hello\n")
+    code, out, _ = run_cli(
+        ["grep", "-l", "hello", "-", a, "--work-dir", str(tmp_path / "w2")],
+        capsys)
+    assert code == 0
+    assert out.splitlines() == ["(standard input)", a]
+    # -c from bare stdin: bare count, no prefix
+    feed(b"x hello\ny\nz hello\n")
+    code, out, _ = run_cli(
+        ["grep", "-c", "hello", "--work-dir", str(tmp_path / "w3")], capsys)
+    assert (code, out.strip()) == (0, "2")
+    # no match from stdin: exit 1
+    feed(b"nothing\n")
+    code, out, _ = run_cli(
+        ["grep", "-q", "hello", "--work-dir", str(tmp_path / "w4")], capsys)
+    assert (code, out) == (1, "")
