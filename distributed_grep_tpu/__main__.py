@@ -173,6 +173,28 @@ def cmd_grep(args: argparse.Namespace) -> int:
             return 2
     import os as _os
 
+    if args.max_errors:
+        # validated BEFORE any stdin spooling: a guaranteed exit-2
+        # invocation must not first drain (and write to disk) the pipe
+        if patterns:
+            print("error: --max-errors applies to a single pattern, not -f",
+                  file=sys.stderr)
+            return 2
+        from distributed_grep_tpu.models.approx import MAX_ERRORS
+        from distributed_grep_tpu.models.shift_and import try_compile_shift_and
+
+        if not 1 <= args.max_errors <= MAX_ERRORS:
+            print(f"error: --max-errors must be 1..{MAX_ERRORS}", file=sys.stderr)
+            return 2
+        if try_compile_shift_and(args.pattern, ignore_case=args.ignore_case) is None:
+            print("error: --max-errors needs a literal/class-sequence pattern "
+                  "of <= 32 symbols", file=sys.stderr)
+            return 2
+        if args.only_matching:
+            print("error: -o is not supported with --max-errors (approximate "
+                  "matches have no unique matched substring)", file=sys.stderr)
+            return 2
+
     stdin_label: str | None = None  # resolved spool path shown as GNU's label
     stdin_spool: str | None = None  # raw spool path as placed in args.files
     if (not args.files and not args.recursive) or "-" in args.files:
@@ -209,9 +231,8 @@ def cmd_grep(args: argparse.Namespace) -> int:
             args.files = [_spool]
     if args.recursive and not args.files:
         args.files = ["."]  # GNU grep -r with no FILE searches the cwd
-    if not args.files:
-        print("error: no input files", file=sys.stderr)
-        return 2
+    # args.files can no longer be empty here: a no-FILE invocation either
+    # spooled stdin (non-recursive) or defaulted to the cwd (-r)
 
     def _readable(f: str) -> bool:
         p = Path(f)
@@ -289,25 +310,6 @@ def cmd_grep(args: argparse.Namespace) -> int:
         if not args.files:
             return 2 if had_file_errors else 1  # everything --include-filtered
 
-    if args.max_errors:
-        if patterns:
-            print("error: --max-errors applies to a single pattern, not -f",
-                  file=sys.stderr)
-            return 2
-        from distributed_grep_tpu.models.approx import MAX_ERRORS
-        from distributed_grep_tpu.models.shift_and import try_compile_shift_and
-
-        if not 1 <= args.max_errors <= MAX_ERRORS:
-            print(f"error: --max-errors must be 1..{MAX_ERRORS}", file=sys.stderr)
-            return 2
-        if try_compile_shift_and(args.pattern, ignore_case=args.ignore_case) is None:
-            print("error: --max-errors needs a literal/class-sequence pattern "
-                  "of <= 32 symbols", file=sys.stderr)
-            return 2
-        if args.only_matching:
-            print("error: -o is not supported with --max-errors (approximate "
-                  "matches have no unique matched substring)", file=sys.stderr)
-            return 2
     # Count queries (-c/-l/-L/-q) with no mode that needs per-line output
     # downstream: the app emits ONE count record per file instead of one
     # record per matched line, so a match-dense count job skips the whole
@@ -449,7 +451,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
     elif args.count:
         # grep -c: one "<file>:<count>" line per input, in argv order
         for f in cfg.input_files:
-            prefix = (f"{disp(f)}:" if len(cfg.input_files) > 1
+            # -H forces the prefix even for a single input (GNU)
+            prefix = (f"{disp(f)}:"
+                      if (len(cfg.input_files) > 1 or args.with_filename)
                       and not args.no_filename else "")
             print(f"{prefix}{counts[f]}")
     elif args.only_matching:
@@ -806,6 +810,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-s", "--no-messages", action="store_true",
                    help="suppress messages about missing/unreadable files "
                         "(grep -s)")
+    # GNU-compatibility no-ops: each names behavior that is already this
+    # CLI's default, so scripts written against GNU grep keep working.
+    # -n: line numbers always print (the output format embeds them, the
+    # reference app's key shape); -H: file names always print unless -h;
+    # -a: input is always processed as binary-safe text (lines split on
+    # \n only, output lossily decoded — there is no "binary file" mode).
+    p.add_argument("-n", "--line-number", action="store_true",
+                   help="accepted for GNU compatibility (line numbers "
+                        "always print here)")
+    p.add_argument("-H", "--with-filename", action="store_true",
+                   help="accepted for GNU compatibility (file names "
+                        "always print here unless -h)")
+    p.add_argument("-a", "--text", action="store_true",
+                   help="accepted for GNU compatibility (input is always "
+                        "treated as binary-safe text here)")
     p.add_argument("--include", action=_GlobFilterAction, dest="glob_filters",
                    default=None, metavar="GLOB",
                    help="search only files whose basename matches GLOB "
