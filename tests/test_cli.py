@@ -559,3 +559,30 @@ def test_stdin_input(tmp_path, corpus, capsys, monkeypatch):
     code, out, _ = run_cli(
         ["grep", "-q", "hello", "--work-dir", str(tmp_path / "w4")], capsys)
     assert (code, out) == (1, "")
+
+
+def test_exclude_dir_recursive(tmp_path, capsys):
+    """grep -r --exclude-dir: directories whose basename matches any glob
+    are pruned — descended ones AND explicitly named command-line ones
+    (probed against grep 3.8, which skips both)."""
+    (tmp_path / "keep").mkdir()
+    (tmp_path / ".git").mkdir()
+    (tmp_path / "skipme" / "nested").mkdir(parents=True)
+    for p in ("keep/k.txt", ".git/g.txt", "skipme/nested/n.txt", "top.txt"):
+        (tmp_path / p).write_text("needle\n")
+    code, out, _ = run_cli(
+        ["grep", "-r", "--exclude-dir=.git", "--exclude-dir", "skip*",
+         "-l", "needle", str(tmp_path / "keep"), str(tmp_path),
+         "--work-dir", str(tmp_path / "w")],
+        capsys,
+    )
+    assert code == 0
+    names = sorted(l.rsplit("/", 1)[-1] for l in out.splitlines())
+    assert names == ["k.txt", "k.txt", "top.txt"]
+    # a command-line directory matching the glob is itself skipped (GNU)
+    code, out, _ = run_cli(
+        ["grep", "-r", "--exclude-dir", "skip*", "-l", "needle",
+         str(tmp_path / "skipme"), "--work-dir", str(tmp_path / "w2")],
+        capsys,
+    )
+    assert (code, out) == (1, "")
