@@ -272,7 +272,12 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # GNU --exclude-dir matches directory BASENAMES — both descended
         # directories and explicitly named command-line ones (probed
         # against grep 3.8: `grep -r --exclude-dir=build pat build/`
-        # searches nothing and exits 1)
+        # searches nothing and exits 1).  Globs containing '/' therefore
+        # never match (a basename has no '/') — probed against grep 3.8
+        # too: `--exclude-dir=build/sub`, `./build`, and `*/sub` all
+        # exclude nothing there as well, so basename-only IS the
+        # GNU-compatible behavior (round-4 ADVICE follow-up, pinned by
+        # test_fuzz_cli.py::test_exclude_dir_slash_glob_matches_gnu).
         return any(fnmatch.fnmatch(name, g) for g in excl_dirs)
 
     if args.recursive:
@@ -851,7 +856,9 @@ def main(argv: list[str] | None = None) -> int:
                         "treated as binary-safe text here)")
     p.add_argument("--exclude-dir", action="append", metavar="GLOB",
                    help="with -r: skip descended directories whose basename "
-                        "matches GLOB (repeatable, grep --exclude-dir)")
+                        "matches GLOB (repeatable, grep --exclude-dir; like "
+                        "GNU grep, a GLOB containing '/' never matches a "
+                        "basename)")
     p.add_argument("--include", action=_GlobFilterAction, dest="glob_filters",
                    default=None, metavar="GLOB",
                    help="search only files whose basename matches GLOB "

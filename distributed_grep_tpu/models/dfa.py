@@ -657,7 +657,9 @@ def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
 
     Uses the native C scanner (utils/native.py) for the plain accept set and
     handles the '$' accept set (accept_eol: match iff next byte is '\\n' or
-    end-of-input) in numpy on top of the same state sequence.
+    end-of-input) in numpy on top of the same state sequence.  Always
+    returns int64 — multi-table callers concatenate results, and a mixed
+    uint64/int64 concat would silently promote to float64.
     """
     from distributed_grep_tpu.utils import native
 
@@ -671,19 +673,30 @@ def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
         offsets, _ = native.dfa_scan(
             data, full, table.accept.astype(np.uint8), table.start
         )
+    offsets = offsets.astype(np.int64)
     if not table.accept_eol.any():
         return offsets
-    # Recompute the state sequence to evaluate accept_eol positions.
-    s = table.start
-    eol_hits = []
+    # '$' accepts: rescan with accept_eol as the accept set (same native
+    # scanner — the state sequence is identical), then keep only offsets
+    # whose NEXT byte is '\n' (or end-of-input).  Replaces the round-1
+    # per-byte Python walk (~5 MB/s — it made every native-mode '$' scan
+    # host-bound) with a second native pass + one vectorized compare.
     n = len(data)
-    for i, b in enumerate(data):
-        s = int(full[s, b])
-        if table.accept_eol[s] and (i + 1 == n or data[i + 1] == NL):
-            eol_hits.append(i + 1)
-    if not eol_hits:
+    eol_accept = table.accept_eol.astype(np.uint8)
+    if n >= native.MT_THRESHOLD_BYTES:
+        eol_offs = native.dfa_scan_mt(data, full, eol_accept, table.start)
+    else:
+        eol_offs, _ = native.dfa_scan(data, full, eol_accept, table.start)
+    if eol_offs.size:
+        e = eol_offs.astype(np.int64)
+        arr = np.frombuffer(data, dtype=np.uint8)
+        keep = (e == n) | (arr[np.minimum(e, n - 1)] == NL)
+        eol_offs = e[keep]
+    if not eol_offs.size:
         return offsets
-    return np.unique(np.concatenate([offsets, np.asarray(eol_hits, dtype=np.uint64)]))
+    return np.unique(
+        np.concatenate([offsets, eol_offs.astype(np.int64)])
+    )
 
 
 def matched_lines(table: DfaTable, data: bytes) -> set[int]:

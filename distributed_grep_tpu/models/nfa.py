@@ -224,13 +224,130 @@ def compile_scan_model(
     return filt, True
 
 
+def _count_positions(node) -> int:
+    """Char positions the Glushkov/Thompson construction will spend on
+    `node` (char edges, counting repeat expansion the way _Nfa._build_repeat
+    does: min copies plus one loop copy for unbounded, max copies bounded)."""
+    if isinstance(node, _dfa.Char):
+        return 1
+    if isinstance(node, _dfa.Concat):
+        return sum(_count_positions(p) for p in node.parts)
+    if isinstance(node, _dfa.Alt):
+        return sum(_count_positions(o) for o in node.options)
+    if isinstance(node, _dfa.Repeat):
+        inner = _count_positions(node.node)
+        copies = node.min + (1 if node.max is None else node.max - node.min)
+        return inner * max(copies, 1)
+    return 0  # Anchor: no char positions
+
+
+def _truncate_prefix(node, budget: int):
+    """Longest REQUIRED prefix of `node` fitting `budget` positions, or
+    None if no usable prefix exists.  Only prefixes every match must
+    contain are kept — optional parts (min-0 repeats) and alternations
+    never get partially included — so any string matching `node` has a
+    substring matching the truncation: a candidate FILTER at line
+    granularity (see compile_device_filter)."""
+    if _count_positions(node) <= budget:
+        return node
+    if isinstance(node, _dfa.Concat):
+        kept, used = [], 0
+        for part in node.parts:
+            c = _count_positions(part)
+            if used + c <= budget:
+                kept.append(part)
+                used += c
+                continue
+            t = _truncate_prefix(part, budget - used)
+            if t is not None:
+                kept.append(t)
+            break  # everything after the cut is dropped
+        if not kept:
+            return None
+        return kept[0] if len(kept) == 1 else _dfa.Concat(kept)
+    if isinstance(node, _dfa.Repeat) and node.min >= 1:
+        # the first min copies are required: keep k <= min whole copies
+        inner = _count_positions(node.node)
+        k = min(budget // inner, node.min) if inner else 0
+        if k < 1:
+            return None
+        return _dfa.Repeat(node.node, k, k)
+    return None  # Alt / optional repeat / single big leaf: no required prefix
+
+
+def compile_device_filter(
+    pattern: str, ignore_case: bool = False, max_positions: int = MAX_POSITIONS
+) -> GlushkovModel | None:
+    """A Glushkov FILTER for single patterns outside the exact device
+    kernel subset: '$' end-anchors dropped, bounded repeats relaxed, and
+    over-cap bodies truncated to a required prefix.
+
+    Every transform yields a language superset at LINE granularity — a
+    line containing an exact match always contains a filter match ('$'
+    removal keeps the same end offsets; prefix truncation keeps a
+    required substring) — so the engine's existing cand_words host-confirm
+    contract (ops/engine.py, per-line DFA re-check) restores exactness,
+    the same architecture as the relaxed-repeat filter above.  This is
+    what puts everyday patterns like ``error$`` and >MAX_POSITIONS
+    literals on the Pallas path instead of the host scanner (reference
+    analogue: application/grep.go:21 — regexp.Match handles '$' on the
+    worker; the TPU path must too).
+
+    Returns None when no non-nullable filter compiles (caller keeps the
+    host route)."""
+    try:
+        ast = _dfa._Parser(pattern, ignore_case).parse()
+    except RegexError:
+        return None
+    relaxed, _ = _relax_bounded(ast)
+    branches = [
+        (a_start, body) for a_start, body, _ in _dfa._split_anchors(relaxed)
+    ]
+    total = sum(_count_positions(b) for _, b in branches)
+    # Fits untruncated: keep the whole body (max selectivity — the filter
+    # then differs from the pattern only by the dropped '$').  Over cap:
+    # prefer a 32-position truncation (1 state word — the fastest kernel
+    # shape; a 32-symbol required prefix is already astronomically
+    # selective) and widen to the full cap only if 32 yields no usable
+    # prefix (e.g. leading optional parts making short prefixes nullable).
+    if total <= max_positions:
+        whole = [(a_start, body, False) for a_start, body in branches]
+        try:
+            return _compile_from_branches(whole, pattern, max_positions)
+        except RegexError:
+            return None
+    for budget in (32, max_positions):
+        per = max(1, budget // max(len(branches), 1))
+        trunc = []
+        for a_start, body in branches:
+            t = _truncate_prefix(body, per)
+            if t is None:
+                trunc = None
+                break
+            trunc.append((a_start, t, False))
+        if trunc is None:
+            continue
+        try:
+            m = _compile_from_branches(trunc, pattern, max_positions)
+        except RegexError:
+            return None
+        if m is not None:
+            return m
+    return None
+
+
 def _compile_from_ast(
     ast, pattern: str, max_positions: int
 ) -> GlushkovModel | None:
     branches = _dfa._split_anchors(ast)
     if any(a_end for _, _, a_end in branches):
         return None  # '$' needs next-byte lookahead — DFA path handles it
+    return _compile_from_branches(branches, pattern, max_positions)
 
+
+def _compile_from_branches(
+    branches, pattern: str, max_positions: int
+) -> GlushkovModel | None:
     nfa = _dfa._Nfa()
     root = nfa.new_state()  # line-start entry
     floating = nfa.new_state()  # unanchored restart entry (no self-loop edge:

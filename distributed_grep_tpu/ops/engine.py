@@ -114,6 +114,49 @@ _device_probe_lock = _threading_mod.Lock()
 _device_probe_state: dict = {"verdict": None, "at": 0.0}
 
 
+# Substrings that mark a device-scan exception as TRANSPORT evidence (the
+# tunnel/link, not a deterministic kernel or pattern defect).  Drawn from
+# the observed outage phases: grpc-style status names, socket-level errno
+# text, and the tunnel's own "Connection Failed" wording.
+_TRANSPORT_ERR_MARKERS = (
+    "connection", "unavailable", "deadline", "timed out", "timeout",
+    "socket", "transport", "tunnel", "broken pipe", "reset by peer",
+    "unreachable",
+)
+
+
+def _accepts_grace_kwarg(progress) -> bool:
+    """Whether a progress callback can take ``grace_s=`` — decided from
+    its SIGNATURE (once per scan), not by catching TypeError around the
+    live call, which cannot distinguish 'callback lacks the kwarg' from a
+    TypeError raised inside the callback body (round-4 ADVICE).  C
+    callables without an introspectable signature are assumed modern: a
+    TypeError from them is then a real bug and propagates."""
+    import inspect
+
+    try:
+        inspect.signature(progress).bind(grace_s=COMPILE_GRACE_S)
+        return True
+    except TypeError:
+        return False
+    except ValueError:  # no introspectable signature (C callable)
+        return True
+
+
+def _is_transport_error(e: BaseException) -> bool:
+    """True when a device-scan failure looks like the transport died
+    (jaxlib RuntimeError/XlaRuntimeError carrying connection wording)
+    rather than a deterministic per-pattern failure.  Transport-evidence
+    demotions stay eligible for the DEVICE_RETRY_S un-demote; anything
+    unrecognized keeps the conservative permanent per-engine demotion
+    (a wrong True here costs one bounded probe per retry window; a wrong
+    False costs the device until process restart — round-4 ADVICE)."""
+    if not isinstance(e, RuntimeError):
+        return False
+    msg = f"{type(e).__name__}: {e}".lower()
+    return any(m in msg for m in _TRANSPORT_ERR_MARKERS)
+
+
 def _report_device_sick() -> None:
     """A demotion (stall wall, exhausted routes, failed first touch) is
     process-wide evidence: jax answers `jax.devices()` from its client
@@ -668,22 +711,41 @@ class GrepEngine:
                         # re-checked per line
                         self._nfa_filter = True
                         self.mode = "nfa"
-        if (
-            self.mode == "dfa" and backend == "device" and self.tables
-            # mesh/interpret engines exist to run the device path (CI
-            # kernel coverage; the sharded step) — never demote them,
-            # mirroring the small-input gate in _scan_impl
-            and self.mesh is None and not self._interpret
-        ):
-            # Single patterns the bit-parallel kernels can't host ('$'
-            # accepts, > 128 Glushkov positions — e.g. a 200-char literal)
-            # would otherwise run the per-byte XLA DFA device path at
-            # ~0.1 GB/s.  The native host scanner (memmem for long
-            # literals, the MT DFA walk otherwise) is ~3-25x faster on any
-            # real host — same loud routing as FDR-ineligible sets above.
-            self._route_native(
-                f"pattern {self.pattern!r} outside the device kernel subset"
-            )
+        if self.mode == "dfa" and backend == "device" and self.tables:
+            # Single patterns the bit-parallel kernels can't host exactly
+            # ('$' accepts, > 128 Glushkov positions — e.g. a 200-char
+            # literal) would otherwise run the per-byte XLA DFA device
+            # path at ~0.1 GB/s.  First choice (round-5): a Glushkov
+            # FILTER with the '$' dropped / body prefix-truncated
+            # (models/nfa.compile_device_filter) — a candidate superset at
+            # line granularity riding the same Pallas NFA kernel +
+            # cand_words host-confirm contract as the relaxed-repeat
+            # filters, which keeps everyday patterns like 'error$' on the
+            # TPU.  Applies to mesh/interpret engines too (it IS the
+            # device path — CI kernel coverage and the sharded step both
+            # exercise it).  Only when no filter compiles: the native host
+            # scanner (~3-25x the XLA DFA path) — same loud routing as
+            # FDR-ineligible sets above, still excluding mesh/interpret
+            # engines, which exist to run the device path.
+            from distributed_grep_tpu.models.nfa import compile_device_filter
+
+            filt = compile_device_filter(self.pattern, ignore_case=ignore_case)
+            if filt is not None:
+                log.info(
+                    "pattern %r outside the exact device kernel subset -> "
+                    "device NFA filter (%d positions; '$' dropped / prefix-"
+                    "truncated), host-confirmed lines",
+                    self.pattern, filt.n_pos,
+                )
+                self.glushkov = filt
+                self.glushkov_exact = None  # no exact automaton exists here
+                self._nfa_filter = True  # every candidate line is confirmed
+                self.mode = "nfa"
+            elif self.mesh is None and not self._interpret:
+                self._route_native(
+                    f"pattern {self.pattern!r} outside the device kernel "
+                    f"subset"
+                )
         if backend == "cpu" and self.mode != "re":
             self.mode = "native"  # host C scanner, same tables
 
@@ -1414,6 +1476,12 @@ class GrepEngine:
         # fallback RESCAN, which replaces the thread's dict and makes this
         # capture stale)
         st = self.stats
+        # Grace capability probed ONCE from the callback's signature: a
+        # live `except TypeError` around progress(grace_s=...) would also
+        # swallow a TypeError raised INSIDE the callback body, silently
+        # converting an internal callback bug into a plain stamp and
+        # losing the compile-grace declaration (round-4 ADVICE).
+        supports_grace = progress is not None and _accepts_grace_kwarg(progress)
         nl = lines_mod.newline_index(data)
         self._nl_local.stash = (len(data), nl)  # reused by scan()'s EOL leg
         device_lines: set[int] = set()
@@ -1570,14 +1638,30 @@ class GrepEngine:
             from distributed_grep_tpu.utils.native import dfa_scan_mt
 
             t = self.table
+            seg_bytes_ = data[seg_start : seg_start + seg_len]
             offs = dfa_scan_mt(
-                data[seg_start : seg_start + seg_len],
-                t.full_table(), t.accept, t.start,
-            )
+                seg_bytes_, t.full_table(), t.accept, t.start,
+            ).astype(np.int64)
+            if t.accept_eol.any():
+                # '$' accepts (the round-5 device-filter patterns): second
+                # pass with accept_eol as the accept set, kept only where
+                # the next byte IN THE FULL DOCUMENT is '\n' or EOF (a
+                # segment-final offset is not EOL unless it ends the data).
+                eol = dfa_scan_mt(
+                    seg_bytes_, t.full_table(),
+                    t.accept_eol.astype(np.uint8), t.start,
+                ).astype(np.int64)
+                if eol.size:
+                    g = eol + seg_start
+                    arr = np.frombuffer(data, dtype=np.uint8)
+                    keep = (g == len(data)) | (
+                        arr[np.minimum(g, len(data) - 1)] == 10
+                    )
+                    offs = np.concatenate([offs, eol[keep]])
             if not offs.size:
                 return 0
             uniq = np.unique(
-                lines_mod.line_of_offsets(offs.astype(np.int64) + seg_start, nl)
+                lines_mod.line_of_offsets(offs + seg_start, nl)
             )
             with state_lock:
                 device_lines.update(uniq.tolist())
@@ -1844,9 +1928,9 @@ class GrepEngine:
                     getattr(arr, "shape", None),
                 )
                 if progress is not None and compile_key not in self._compiled_keys:
-                    try:
+                    if supports_grace:
                         progress(grace_s=COMPILE_GRACE_S)
-                    except TypeError:  # callbacks without the grace kwarg
+                    else:  # legacy callbacks without the grace kwarg
                         progress()
                 ctx = jax.default_device(dev) if dev is not None else nullcontext()
                 # Dispatch the device scan; the sparse fetch (a 4-byte count
@@ -2089,10 +2173,18 @@ class GrepEngine:
                         "device scan failed with no device fallback left "
                         "(%s) -> exact host engines for this engine", e,
                     )
-                    # a generic exception here may be a per-pattern defect
-                    # on a healthy device — demote this engine permanently,
-                    # but do NOT poison the process-wide probe verdict
-                    self._mark_device_broken(transport_evidence=False)
+                    # Recognizable transport failures (the fast
+                    # `Connection Failed` phase of a tunnel outage surfaces
+                    # here as XlaRuntimeError, not via the stall wall) keep
+                    # the demotion eligible for the DEVICE_RETRY_S
+                    # un-demote — a long-lived worker reclaims the device
+                    # when the tunnel heals (round-4 ADVICE).  A generic
+                    # exception may be a per-pattern defect on a healthy
+                    # device: permanent demotion, and do NOT poison the
+                    # process-wide probe verdict.
+                    self._mark_device_broken(
+                        transport_evidence=_is_transport_error(e)
+                    )
                     result = self._host_scan(host_scanner, data, progress)
                     self.stats["device_fallback"] = True
                     return result

@@ -298,6 +298,35 @@ def test_exclude_glob_matches_gnu(tmp_path, capsys):
     assert rc == grc == 1
 
 
+def test_exclude_dir_slash_glob_matches_gnu(tmp_path, capsys):
+    """--exclude-dir globs containing '/' never match (GNU compares
+    directory BASENAMES, which contain no '/'): probed grep 3.8 excludes
+    nothing for 'build/sub', './build' and '*/sub' alike.  Pinned
+    differentially so a GNU behavior change would surface here
+    (round-4 ADVICE follow-up)."""
+    (tmp_path / "build" / "sub").mkdir(parents=True)
+    (tmp_path / "other" / "build").mkdir(parents=True)
+    (tmp_path / "build" / "sub" / "f.txt").write_text("foo\n")
+    (tmp_path / "other" / "build" / "g.txt").write_text("foo\n")
+    (tmp_path / "top.txt").write_text("foo\n")
+    for glob in ("build/sub", "./build", "*/sub"):
+        rc, out = _run_ours(
+            ["grep", "-r", "--exclude-dir", glob, "-l", "foo",
+             str(tmp_path)], capsys)
+        grc, gout = _run_gnu(
+            ["-r", "--exclude-dir", glob, "-l", "foo", str(tmp_path)])
+        assert rc == grc == 0, glob
+        assert sorted(out) == sorted(gout), glob
+    # control: the plain basename glob DOES prune both build dirs
+    rc, out = _run_ours(
+        ["grep", "-r", "--exclude-dir", "build", "-l", "foo",
+         str(tmp_path)], capsys)
+    grc, gout = _run_gnu(
+        ["-r", "--exclude-dir", "build", "-l", "foo", str(tmp_path)])
+    assert rc == grc == 0
+    assert sorted(out) == sorted(gout) == [str(tmp_path / "top.txt")]
+
+
 def test_include_exclude_order_semantics(tmp_path, capsys):
     """GNU treats --include/--exclude as one ordered list: the LAST
     matching glob decides, and unmatched files default to included iff the

@@ -351,3 +351,172 @@ def test_expansion_cap_repeat_rescued_to_device_filter():
     # no Pallas -> per-line re loop, still exact
     eng2 = GrepEngine(pat)
     assert set(eng2.scan(data).matched_lines.tolist()) == want
+
+
+# ------------------------------------------- round-5: '$' / over-cap filters
+
+def test_compile_device_filter_drops_end_anchor():
+    """'$' accepts have no exact Glushkov form; the device filter drops
+    the anchor (language superset at the same end offsets) so everyday
+    patterns like 'error$' reach the Pallas path."""
+    for pat in ("error$", "abc$|def$", "^end$", "a*b$"):
+        assert nfa_mod.try_compile_glushkov(pat) is None, pat
+        m = nfa_mod.compile_device_filter(pat)
+        assert m is not None, pat
+    # no usable filter: nullable bodies (engine short-circuits these)
+    for pat in ("x*$", "^$", "(ab)*$"):
+        assert nfa_mod.compile_device_filter(pat) is None, pat
+
+
+def test_compile_device_filter_prefix_truncates_over_cap():
+    """>MAX_POSITIONS bodies truncate to a <=32-position required prefix
+    (1 state word — the fastest kernel shape)."""
+    for pat in ("A" * 200, "x{200}", "[0-9]{150}"):
+        assert nfa_mod.try_compile_glushkov(pat) is None, pat
+        m = nfa_mod.compile_device_filter(pat)
+        assert m is not None and m.n_pos <= 32 and m.n_words == 1, pat
+    # optional parts are never partially included: x*y{200} must keep a
+    # REQUIRED prefix (y's), not the optional x-run
+    m = nfa_mod.compile_device_filter("x*y{200}")
+    assert m is not None
+    data = b"yyy " + b"y" * 220 + b"\n" + b"x" * 40 + b"\n"
+    offs = nfa_mod.scan_reference(m, data)
+    nl = data.index(b"\n")
+    assert offs.size and offs.max() <= nl + 1  # no hits on the x-only line
+
+
+def test_device_filter_is_line_superset_of_dfa_oracle():
+    """Candidate lines from the filter must cover every exact match line
+    (the cand_words confirm contract)."""
+    cases = [
+        ("error$", [(3, b"an error"), (9, b"error in middle"), (20, b"error")]),
+        ("A" * 60, [(5, b"A" * 70), (12, b"A" * 30)]),
+        ("[ab]{4,200}c$", [(7, b"abab" * 30 + b"c"), (15, b"ababc x")]),
+    ]
+    for pat, inject in cases:
+        table = dfa_mod.compile_dfa(pat)
+        m = nfa_mod.compile_device_filter(pat)
+        assert m is not None, pat
+        data = make_text(60, inject=inject)
+        nl = np.flatnonzero(np.frombuffer(data, np.uint8) == 10)
+
+        def lines_of(offs):
+            o = np.asarray(offs, np.int64)
+            return set((np.searchsorted(nl, o - 1, side="left") + 1).tolist())
+
+        exact = lines_of(dfa_mod.reference_scan(table, data))
+        cand = lines_of(nfa_mod.scan_reference(m, data))
+        assert exact <= cand, pat
+
+
+def test_engine_dollar_anchor_device_path_exact():
+    """'error$'-class patterns ride the device NFA filter (round-5: they
+    used to route to the native host scanner even on backend=device) and
+    the host confirm restores exact '$' semantics."""
+    import re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    pat = "error$"
+    data = make_text(
+        500,
+        inject=[
+            (2, b"an error"),
+            (40, b"error in middle not end"),
+            (41, b"error"),
+            (499, b"tail error"),  # '$' at EOF (no trailing newline context)
+        ],
+    )
+    want = {
+        i for i, l in enumerate(data.split(b"\n")[:-1], 1)
+        if re.search(rb"error$", l)
+    }
+    eng = GrepEngine(pat, backend="device", interpret=True)
+    assert eng.mode == "nfa" and eng._nfa_filter
+    assert eng.glushkov is not None and eng.glushkov_exact is None
+    assert set(eng.scan(data).matched_lines.tolist()) == want
+    assert eng.stats.get("candidates", 0) >= len(want)
+
+
+def test_engine_dollar_anchor_dense_confirm_eol():
+    """Candidate-dense '$' corpus takes dense_native_confirm, whose
+    accept_eol leg (round-5) must not under-report: every line ending in
+    the pattern matches, mid-line occurrences do not."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    lines = []
+    for i in range(9000):
+        if i % 3 == 0:
+            lines.append(b"x" * (i % 7) + b" error")
+        elif i % 3 == 1:
+            lines.append(b"error not at end")
+        else:
+            lines.append(b"clean")
+    data = b"\n".join(lines) + b"\n"
+    want = {i + 1 for i in range(9000) if i % 3 == 0}
+    eng = GrepEngine("error$", backend="device", interpret=True)
+    eng._accel_cached = True
+    res = eng.scan(data)
+    assert set(res.matched_lines.tolist()) == want
+    assert eng.stats.get("candidates", 0) > 4096  # dense path exercised
+
+
+def test_engine_over_cap_literal_device_path_exact():
+    """A >128-char literal (no exact kernel form) scans via the truncated
+    prefix filter; confirm rejects lines holding only the prefix."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    lit = bytes(range(65, 91)) * 6  # 156-byte literal A..Z repeated
+    pat = lit.decode()
+    data = make_text(
+        300,
+        inject=[(10, lit + b" full hit"), (100, lit[:40] + b" prefix only")],
+    )
+    eng = GrepEngine(pat, backend="device", interpret=True)
+    assert eng.mode == "nfa" and eng._nfa_filter
+    assert set(eng.scan(data).matched_lines.tolist()) == {11}
+
+
+def test_engine_dollar_anchor_mesh_path_exact():
+    """The sharded NFA kernel hosts the '$' filter too (mesh engines used
+    to stay on the XLA DFA path for these patterns)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    data = make_text(400, inject=[(5, b"an error"), (9, b"error mid line")])
+    import re
+
+    want = {
+        i for i, l in enumerate(data.split(b"\n")[:-1], 1)
+        if re.search(rb"error$", l)
+    }
+    eng = GrepEngine("error$", backend="device", interpret=True, mesh=mesh)
+    assert eng.mode == "nfa" and eng._nfa_filter
+    assert set(eng.scan(data).matched_lines.tolist()) == want
+
+
+def test_reference_scan_eol_vectorized_matches_oracle():
+    """reference_scan's '$' leg (round-5: second native pass + next-byte
+    mask, replacing the per-byte Python walk) vs a re-derived oracle."""
+    import re
+
+    for pat, rx in [("error$", rb"error$"), ("[ab]+c$", rb"[ab]+c$")]:
+        table = dfa_mod.compile_dfa(pat)
+        data = make_text(
+            200,
+            inject=[
+                (0, b"error"),
+                (50, b"abc"),
+                (51, b"error trailing"),
+                (199, b"aac"),
+            ],
+        )
+        got = dfa_mod.reference_scan(table, data)
+        want = sorted(
+            m.end() for m in re.finditer(rx, data, re.M)
+        )
+        assert sorted(int(o) for o in got) == want, pat

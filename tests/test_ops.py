@@ -401,11 +401,15 @@ def test_engine_multi_device_segments():
 
 
 def test_engine_multi_device_dfa_banks(monkeypatch):
-    # '$' accepts route to the native host scanner when the lib exists;
-    # disable it here so the XLA DFA-bank device path keeps multi-device
-    # round-robin coverage.
+    # '$' accepts now ride the device NFA filter (round 5) and otherwise
+    # route native; pin BOTH rescues off so the XLA DFA-bank device path
+    # keeps multi-device round-robin coverage.
     monkeypatch.setattr(
         "distributed_grep_tpu.utils.native.native_available", lambda: False
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.models.nfa.compile_device_filter",
+        lambda *a, **k: None,
     )
     data = make_text(400, inject=[(5, b"needle here or neet")])
     kw = dict(segment_bytes=4096, target_lanes=16)
@@ -425,6 +429,10 @@ def test_anchored_eol_device_path_boundaries(monkeypatch):
     monkeypatch.setattr(
         "distributed_grep_tpu.utils.native.native_available", lambda: False
     )
+    monkeypatch.setattr(  # round 5: '$' normally rides the NFA filter now
+        "distributed_grep_tpu.models.nfa.compile_device_filter",
+        lambda *a, **k: None,
+    )
     data = make_text(
         300,
         inject=[(0, b"ends with world"), (150, b"world"), (299, b"world")],
@@ -436,15 +444,20 @@ def test_anchored_eol_device_path_boundaries(monkeypatch):
         assert got == oracle_lines(pattern, data), pattern
 
 
-def test_engine_dfa_only_pattern_routes_native():
-    """Single patterns outside the device kernel subset ('$' accepts,
-    > 128 Glushkov positions, e.g. a 200-char literal) route loudly to
-    the native host scanner instead of the ~0.1 GB/s XLA DFA device path
-    — the same policy as FDR-ineligible sets."""
+def test_engine_dfa_only_pattern_routes_native(monkeypatch):
+    """Single patterns outside the device kernel subset with NO usable
+    device filter route loudly to the native host scanner instead of the
+    ~0.1 GB/s XLA DFA device path — the same policy as FDR-ineligible
+    sets.  (Round 5: '$' accepts and long literals normally ride the NFA
+    filter first; the native route is the no-filter fallback.)"""
     from distributed_grep_tpu.utils.native import native_available
 
     if not native_available():
         pytest.skip("native lib unavailable")
+    monkeypatch.setattr(
+        "distributed_grep_tpu.models.nfa.compile_device_filter",
+        lambda *a, **k: None,
+    )
     data = make_text(300, inject=[(5, b"ends with world"), (200, b"world")])
     for pattern in ["world$", "x" * 200]:
         eng = GrepEngine(pattern, backend="device")

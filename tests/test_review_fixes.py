@@ -95,3 +95,83 @@ def test_concurrent_jobs_different_patterns(tmp_path, corpus):
     quick_lines = "\n".join(results["quick"].sorted_lines())
     assert "fox" in fox_lines and "quick" not in fox_lines.replace("quick brown", "")
     assert all("quick" in l for l in results["quick"].sorted_lines())
+
+
+# ----------------------------------------------- round-5 ADVICE regressions
+
+def test_transport_error_classification():
+    """Fast `Connection Failed`-phase exceptions from the device transport
+    must be classified as transport evidence (retry-window-eligible
+    demotion), while generic runtime failures stay per-pattern permanent
+    (round-4 ADVICE: a worker degraded during the fast-error phase never
+    reclaimed the device after the tunnel healed)."""
+    from distributed_grep_tpu.ops.engine import _is_transport_error
+
+    transport = [
+        RuntimeError("Connection Failed: tunnel endpoint went away"),
+        RuntimeError("UNAVAILABLE: socket closed"),
+        RuntimeError("Deadline Exceeded while dispatching"),
+        RuntimeError("read: connection reset by peer"),
+    ]
+    for e in transport:
+        assert _is_transport_error(e), e
+    non_transport = [
+        RuntimeError("Mosaic lowering failed: unsupported op"),
+        RuntimeError("INVALID_ARGUMENT: bad dimension"),
+        ValueError("connection"),  # not a RuntimeError: not device-layer
+    ]
+    for e in non_transport:
+        assert not _is_transport_error(e), e
+
+
+def test_transport_demotion_stays_retry_eligible():
+    """_mark_device_broken(transport_evidence=True) must NOT set the
+    permanent flag (the DEVICE_RETRY_S un-demote path stays open)."""
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    eng = GrepEngine("needle", interpret=True)
+    eng._mark_device_broken(transport_evidence=True)
+    assert eng._device_broken and not eng._device_demotion_permanent
+    eng2 = GrepEngine("needle", interpret=True)
+    eng2._mark_device_broken(transport_evidence=False)
+    assert eng2._device_broken and eng2._device_demotion_permanent
+
+
+def test_progress_grace_capability_probed_from_signature():
+    """The compile-grace declaration must be capability-probed from the
+    callback signature, not by catching TypeError around the live call —
+    a TypeError raised INSIDE a grace-capable callback is a real bug and
+    must propagate, not silently degrade to a plain stamp (round-4
+    ADVICE)."""
+    import pytest
+
+    from distributed_grep_tpu.ops.engine import _accepts_grace_kwarg
+
+    def modern(grace_s=None):
+        pass
+
+    def legacy():
+        pass
+
+    def kwargs_only(**kw):
+        pass
+
+    assert _accepts_grace_kwarg(modern)
+    assert not _accepts_grace_kwarg(legacy)
+    assert _accepts_grace_kwarg(kwargs_only)
+
+    # integration: a buggy grace-capable callback surfaces its TypeError
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    calls = {"n": 0}
+
+    def buggy(grace_s=None):
+        calls["n"] += 1
+        raise TypeError("bug inside callback body")
+
+    eng = GrepEngine("needle", interpret=True)
+    eng._accel_cached = True
+    data = b"a needle here\nnothing\n" * 50
+    with pytest.raises(TypeError, match="bug inside callback body"):
+        eng.scan(data, progress=buggy)
+    assert calls["n"] >= 1
