@@ -53,6 +53,150 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metrics", action="store_true", help="print job metrics to stderr")
 
 
+def _grep_stdin_stream(args: argparse.Namespace, patterns) -> int:
+    """GNU-streaming stdin grep (round 5): one in-process split fed from
+    incremental pipe reads through the same engine the job path uses.
+
+    Chunks cut at newline boundaries keep every engine mode exact (the
+    scan_file contract, ops/engine.py); -w/-x candidates confirm against
+    the boundary-wrapped regex per line like the apps do.  Presence
+    queries return at the first selected line — the pipe is NOT drained
+    (GNU semantics the round-4 spool could not give); -m stops reading at
+    the cap like GNU.  Reference: worker.go:72-76 reads whole files; GNU
+    grep streams — this path sides with GNU.
+    """
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
+
+    label = "(standard input)"
+    backend = (
+        "cpu"
+        if args.backend == "cpu"
+        or (args.backend is None and not args.max_errors)
+        else "device"
+    )
+    try:
+        eng = GrepEngine(
+            args.pattern if patterns is None else None,
+            patterns=patterns,
+            ignore_case=args.ignore_case,
+            backend=backend,
+            max_errors=args.max_errors or 0,
+            **({"devices": "all"} if backend == "device" else {}),
+        )
+    except Exception as e:  # noqa: BLE001 — mirrors the job path's exit 2
+        print(f"error: invalid pattern: {e}", file=sys.stderr)
+        return 2
+    from distributed_grep_tpu.apps.grep import build_confirm
+
+    confirm = build_confirm(
+        pattern=args.pattern, patterns=patterns,
+        ignore_case=args.ignore_case,
+        mode=(
+            "line" if args.line_regexp
+            else "word" if args.word_regexp else "search"
+        ),
+    )
+
+    presence = args.quiet or args.files_with_matches or args.files_without_match
+    f = sys.stdin.buffer
+    # read1 (not read): a pipe must hand over whatever is AVAILABLE, not
+    # block until a full chunk accumulates — which is also why this loop
+    # cannot reuse GrepEngine.scan_file (its pipelined reader issues
+    # full-chunk read() calls, correct for files, a stall on live pipes);
+    # the newline-carry logic mirrors scan_file's chunk contract.
+    read1 = getattr(f, "read1", None) or f.read
+    carry = b""
+    lines_before = 0
+    n_selected = 0
+    cap = args.max_count
+    stdout = sys.stdout
+    # GNU -m 0 selects nothing, prints nothing, exits 1 — and reads
+    # nothing (probed: `printf 'a\n' | grep -m 0 a -` returns at once)
+    done = cap == 0
+    while not done:
+        block = read1(1 << 20)
+        final = not block
+        buf = carry + block
+        if not final:
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                carry = buf  # no complete line yet: keep reading
+                continue
+            carry, buf = buf[cut + 1 :], buf[: cut + 1]
+        else:
+            carry = b""
+        if buf:
+            sel = eng.scan(buf).matched_lines.tolist()
+            nl = None
+            if confirm is not None and sel:
+                nl = newline_index(buf)
+                sel = [
+                    ln for ln in sel
+                    if confirm.search(buf[slice(*line_span(nl, ln, len(buf)))])
+                ]
+            if args.invert:
+                sel = sorted(set(range(1, count_lines(buf) + 1)) - set(sel))
+            for ln in sel:
+                n_selected += 1
+                if presence:
+                    done = True
+                    break  # first selected line settles -q/-l/-L
+                if not args.count:
+                    if nl is None:
+                        nl = newline_index(buf)
+                    s, e = line_span(nl, ln, len(buf))
+                    head = "" if args.no_filename else f"{label} "
+                    print(
+                        f"{head}(line number #{lines_before + ln}) "
+                        f"{buf[s:e].decode('utf-8', errors='replace')}",
+                        file=stdout,
+                    )
+                if cap is not None and n_selected >= cap:
+                    done = True  # GNU -m: stop READING at the cap
+                    break
+            if sel and not presence and not args.count:
+                stdout.flush()  # matches appear as the pipe produces them
+            lines_before += count_lines(buf)
+        if final:
+            break
+    rc = 0 if n_selected else 1
+
+    def finish(code: int) -> int:
+        if args.metrics:
+            # the streaming path has no job/scheduler, so the metrics are
+            # the stream's own counters (same stderr-JSON contract as the
+            # job path's res.metrics)
+            print(json.dumps({
+                "counters": {
+                    "stdin_lines": lines_before,
+                    "selected_lines": n_selected,
+                },
+                "streaming_stdin": True,
+            }, indent=2, sort_keys=True), file=sys.stderr)
+        return code
+
+    if args.quiet:
+        return finish(rc)
+    if args.files_with_matches:
+        if n_selected:
+            print(label)
+        return finish(rc)
+    if args.files_without_match:
+        if not n_selected:
+            print(label)
+        return finish(rc)
+    if args.count:
+        shown = n_selected if cap is None else min(n_selected, cap)
+        prefix = (
+            f"{label}:"
+            if args.with_filename and not args.no_filename else ""
+        )
+        print(f"{prefix}{shown}")
+        return finish(rc)
+    return finish(rc)
+
+
 def cmd_grep(args: argparse.Namespace) -> int:
     import re
     from pathlib import Path
@@ -197,16 +341,30 @@ def cmd_grep(args: argparse.Namespace) -> int:
 
     stdin_label: str | None = None  # resolved spool path shown as GNU's label
     stdin_spool: str | None = None  # raw spool path as placed in args.files
+    stdin_only = (
+        (not args.files and not args.recursive) or args.files == ["-"]
+    )
+    if stdin_only and not (
+        args.only_matching or args.byte_offset or args.context is not None
+        or args.before_context or args.after_context
+    ):
+        # Round 5: stdin as the ONLY input streams through the engine
+        # in-process with GNU semantics — presence queries (-q/-l/-L)
+        # stop at the first settled match WITHOUT draining the pipe
+        # (`tail -f log | dgrep -q pat -` terminates like GNU), counts
+        # and default print run chunk-by-chunk to EOF with bounded
+        # memory and matches print as they arrive.  Modes that re-read
+        # the input (-o, context, -b) keep the spool below.
+        return _grep_stdin_stream(args, patterns)
     if (not args.files and not args.recursive) or "-" in args.files:
-        # GNU grep: no FILE, or the FILE "-", means standard input.  The
-        # runtime schedules map tasks over real files, so stdin is spooled
-        # once to a temp file, searched like any split, and displayed as
-        # "(standard input)".  Repeated "-" collapses to the one spool
-        # (GNU's second read of stdin sees EOF anyway).  Batch semantics,
-        # deliberately: the WHOLE stream is spooled before the scan, so an
-        # unbounded pipe (`tail -f | ... grep -q`) does not terminate at
-        # the first match the way GNU's streaming read does — this is a
-        # job scheduler; stdin is treated as one finite input split.
+        # GNU grep: the FILE "-" mixed with real files means standard
+        # input.  The runtime schedules map tasks over real files, so
+        # stdin is spooled once to a temp file, searched like any split,
+        # and displayed as "(standard input)".  Repeated "-" collapses to
+        # the one spool (GNU's second read of stdin sees EOF anyway).
+        # Batch semantics here, deliberately: mixed-input jobs go through
+        # the scheduler, which needs finite splits ((-o/-b/context
+        # stdin-only jobs spool too — they re-read their input).
         import atexit
         import shutil as _shutil
         import tempfile as _tempfile
@@ -419,6 +577,15 @@ def cmd_grep(args: argparse.Namespace) -> int:
     )
     matched: dict[str, set[int]] | None = None
     counts: dict[str, int] = {f: 0 for f in cfg.input_files}
+    # Default print mode needs no pre-count pass: selection counts only
+    # decide the exit code there, and the print loop observes every
+    # record anyway — a match-dense job should not pay a full extra
+    # iter_results + key-parse pass (round-5 columnar work).
+    default_print = not (
+        args.quiet or args.files_without_match or args.files_with_matches
+        or args.count or args.only_matching or ctx_before or ctx_after
+    )
+    stream_counts = default_print and not need_sets and not count_only
     if need_sets:
         matched = {f: set() for f in cfg.input_files}
         for key, _v in res.iter_results():
@@ -430,7 +597,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
             matched = {f: set(sorted(ln)[: args.max_count])
                        for f, ln in matched.items()}
         counts = {f: len(matched[f]) for f in cfg.input_files}
-    else:
+    elif not stream_counts:
         for key, v in res.iter_results():
             if count_only:
                 # count records: key = filename, value = selected count
@@ -504,16 +671,45 @@ def cmd_grep(args: argparse.Namespace) -> int:
             )
     else:
         # default print: stream in (file, line) order with bounded memory
-        # (external re-sort — runtime/job.iter_results_sorted); -m caps
+        # (identity-reduce jobs arrive pre-sorted and merge; others
+        # external re-sort — runtime/job.iter_results_sorted); -m caps
         # per file as lines stream past
         offsets = _line_offsets(matched) if args.byte_offset else None
         emitted: dict[str, int] = {f: 0 for f in cfg.input_files}
+        # per-record key parsing only when some option consumes the parts
+        # (match-dense default output otherwise prints the record as-is)
+        needs_parse = (
+            args.max_count is not None or args.no_filename
+            or offsets is not None or stdin_label is not None
+        )
+        saw_any = False
+        if not needs_parse and res.fileline_sorted:
+            # match-dense fast path: display lines stream as BYTES from
+            # the pre-sorted output files (no per-record str round trip)
+            sys.stdout.flush()
+            out_buf = sys.stdout.buffer
+            for line in res.iter_display_bytes_sorted():
+                out_buf.write(line)
+                saw_any = True
+            out_buf.flush()
+            if stream_counts:
+                rc_final = 2 if had_file_errors else (0 if saw_any else 1)
+            if args.metrics:
+                print(json.dumps(res.metrics, indent=2, sort_keys=True),
+                      file=sys.stderr)
+            return rc_final
         for key, value in res.iter_results_sorted():
+            if not needs_parse:
+                saw_any = True
+                print(f"{key} {value}")
+                continue
             m = GREP_KEY_RE.match(key)
             if args.max_count is not None and m and m.group(1) in emitted:
                 if emitted[m.group(1)] >= args.max_count:
-                    continue  # dropped by the -m cap
+                    continue  # dropped by the -m cap — and not counted
+                    # toward the exit code (GNU -m 0 exits 1)
                 emitted[m.group(1)] += 1
+            saw_any = True
             if m and (args.no_filename or offsets is not None
                       or stdin_label is not None):
                 path, ln = m.group(1), int(m.group(2))
@@ -523,6 +719,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 print(f"{head}(line number #{ln}) {boff}{value}")
             else:
                 print(f"{key} {value}")
+        if stream_counts:
+            # the pre-count pass was skipped: the streamed records decide
+            # the exit code (selection presence), file errors still win
+            rc_final = 2 if had_file_errors else (0 if saw_any else 1)
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
     return rc_final

@@ -23,6 +23,12 @@ import re
 
 from distributed_grep_tpu.apps.base import KeyValue
 
+# Reduce is values[0] and keys are unique per (file, line): the runtime's
+# identity-reduce collator keeps map output columnar and writes
+# (file, line)-ordered output (runtime/columnar.py) — interchangeable
+# with apps/grep_tpu.py, including the shuffle fast path.
+reduce_is_identity = True
+
 # Job-configured state (set via configure(); the reference's missing plumbing).
 # The loader gives every job its own module instance, so this is per-job, not
 # per-process, state.
@@ -47,6 +53,34 @@ def wrap_mode(pattern: bytes, mode: str) -> bytes:
     if mode == "line":
         return rb"\A(?:" + pattern + rb")\Z"
     return pattern
+
+
+def build_confirm(
+    pattern: str | bytes | None = None,
+    patterns: list | None = None,
+    ignore_case: bool = False,
+    mode: str = "search",
+) -> "re.Pattern[bytes] | None":
+    """The -w/-x per-line confirm regex — ONE definition for every
+    consumer (this app, apps/grep_tpu.configure, the CLI's streaming
+    stdin path): a literal set escapes and alternates, a single pattern
+    wraps as-is; mode 'search' needs no confirm (None)."""
+    if mode == "search":
+        return None
+    if patterns is not None:
+        norm = [
+            p.encode("utf-8", "surrogateescape") if isinstance(p, str)
+            else bytes(p) for p in patterns
+        ]
+        base = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
+    else:
+        base = (
+            pattern.encode("utf-8", "surrogateescape")
+            if isinstance(pattern, str) else bytes(pattern)
+        )
+    return re.compile(
+        wrap_mode(base, mode), re.IGNORECASE if ignore_case else 0
+    )
 
 
 def configure(
@@ -92,10 +126,9 @@ def configure(
         ]
         _ac_tables = compile_aho_corasick_banks(norm, ignore_case=ignore_case)
         _pattern = None
-        _ac_confirm = None
-        if _line_mode != "search":
-            alt = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
-            _ac_confirm = re.compile(wrap_mode(alt, _line_mode), flags)
+        _ac_confirm = build_confirm(
+            patterns=norm, ignore_case=ignore_case, mode=_line_mode
+        )
     else:
         _ac_tables = None
         _ac_confirm = None
@@ -111,7 +144,8 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     lines = contents.split(b"\n")
     if lines and lines[-1] == b"":
         lines.pop()  # trailing '\n' does not open a phantom empty line (grep -n)
-    out: list[KeyValue] = []
+    sel_nos: list[int] = []
+    sel_lines: list[bytes] = []
     n_selected = 0
     for lineno, line in enumerate(lines, start=1):
         if matched is not None:
@@ -126,15 +160,31 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
                 if _presence:
                     break  # grep -q/-l: first selected line settles it
                 continue
-            out.append(
-                KeyValue(
-                    key=f"{filename} (line number #{lineno})",
-                    value=line.decode("utf-8", errors="replace"),
-                )
-            )
+            sel_nos.append(lineno)
+            sel_lines.append(line)
     if _count_only:
         return [KeyValue(key=filename, value=str(n_selected))]
-    return out
+    if not sel_nos:
+        return []
+    # Columnar emit (round 5): one LineBatch for the split — a join + a
+    # cumsum instead of a KeyValue + f-string + utf-8 decode per matched
+    # line (runtime/columnar.py; same record semantics, same shuffle
+    # partitioning).
+    import numpy as np
+
+    from distributed_grep_tpu.runtime.columnar import LineBatch
+
+    lens = np.fromiter(
+        (len(l) for l in sel_lines), dtype=np.int64, count=len(sel_lines)
+    )
+    offsets = np.zeros(lens.size + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return [LineBatch(
+        filename=filename,
+        linenos=np.asarray(sel_nos, dtype=np.int64),
+        offsets=offsets,
+        slab=b"".join(sel_lines),
+    )]
 
 
 def _ac_matched_lines(contents: bytes) -> set[int]:

@@ -16,9 +16,17 @@ CPU app would accept.
 
 from __future__ import annotations
 
+import numpy as _np
+
 from distributed_grep_tpu.apps.base import KeyValue
 from distributed_grep_tpu.ops.engine import GrepEngine
 from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
+from distributed_grep_tpu.runtime.columnar import make_batch_from_lines
+
+# Reduce is values[0] and keys are unique per (file, line): the runtime's
+# identity-reduce collator may keep map output COLUMNAR end to end and
+# write (file, line)-ordered output (runtime/columnar.IdentityCollator).
+reduce_is_identity = True
 
 _engine: GrepEngine | None = None
 _invert: bool = False  # grep -v
@@ -116,27 +124,17 @@ def configure(
         backend=backend,
         **engine_opts,  # type: ignore[arg-type]
     )
-    _confirm = None
-    if mode != "search":
-        # grep -w / -x: the device scan stays on the raw pattern (its
-        # matched lines are a SUPERSET of word/line matches — a word/line
-        # match is in particular a substring match), and each candidate
-        # line is confirmed against the boundary-wrapped regex host-side.
-        import re
+    # grep -w / -x: the device scan stays on the raw pattern (its matched
+    # lines are a SUPERSET of word/line matches — a word/line match is in
+    # particular a substring match), and each candidate line is confirmed
+    # against the boundary-wrapped regex host-side (ONE shared builder:
+    # apps/grep.build_confirm).
+    from distributed_grep_tpu.apps.grep import build_confirm
 
-        from distributed_grep_tpu.apps.grep import wrap_mode
-
-        if patterns is not None:
-            norm = [
-                p.encode("utf-8", "surrogateescape") if isinstance(p, str)
-                else bytes(p) for p in patterns
-            ]
-            base = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
-        else:
-            base = pattern.encode("utf-8", "surrogateescape")
-        _confirm = re.compile(
-            wrap_mode(base, mode), re.IGNORECASE if ignore_case else 0
-        )
+    _confirm = build_confirm(
+        pattern=pattern, patterns=patterns, ignore_case=ignore_case,
+        mode=mode,
+    )
     _configured_with = key
 
 
@@ -173,18 +171,15 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
         return []
     if nl is None:
         nl = newline_index(contents)
-    out: list[KeyValue] = []
-    progress = _progress_fn()
-    for i, line_no in enumerate(emit):
-        start, end = line_span(nl, line_no, len(contents))
-        out.append(
-            KeyValue(
-                key=f"{filename} (line number #{line_no})",
-                value=contents[start:end].decode("utf-8", errors="replace"),
-            )
-        )
-        _stamp_every(progress, i)  # match-dense record building
-    return out
+    # Columnar emit (round 5): ONE LineBatch for the whole split — line
+    # spans and the output slab are built with vectorized gathers instead
+    # of a KeyValue + f-string + utf-8 decode per matched line (the
+    # ~28 us/record pipeline BASELINE.md profiled; runtime/columnar.py).
+    batch = make_batch_from_lines(
+        filename, _np.asarray(emit, dtype=_np.int64),
+        _np.frombuffer(contents, dtype=_np.uint8), nl, len(contents),
+    )
+    return [batch]
 
 
 def map_path_fn(filename: str, path: str) -> list[KeyValue]:
@@ -228,20 +223,34 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
             stop=(lambda: n > 0) if _presence else None,
         )
         return [KeyValue(key=filename, value=str(n))]
-    out: list[KeyValue] = []
+    # Columnar emit (round 5): one LineBatch per streamed chunk, built
+    # with vectorized span gathers (runtime/columnar.py) — the -w/-x
+    # confirm still runs per candidate line (it is a host regex), but the
+    # surviving lines batch the same way.
+    batches: list = []
+    progress = _progress_fn()
 
-    def emit(line_no: int, line: bytes) -> None:
-        if _confirm is not None and not _confirm.search(line):
-            return  # -w/-x: candidate line fails the boundary confirm
-        out.append(
-            KeyValue(
-                key=f"{filename} (line number #{line_no})",
-                value=line.decode("utf-8", errors="replace"),
-            )
+    def emit_chunk(lines_before: int, buf: bytes, mlines, nl_idx) -> None:
+        arr = _np.frombuffer(buf, dtype=_np.uint8)
+        batch = make_batch_from_lines(
+            filename, mlines, arr, nl_idx, len(buf),
+            lineno_base=lines_before,
         )
+        if _confirm is not None:
 
-    _engine.scan_file(path, emit=emit, progress=_progress_fn())
-    return out
+            def confirmed():
+                for i in range(len(batch)):
+                    _stamp_every(progress, i)  # -w/-x over dense candidates
+                    yield bool(_confirm.search(batch.line_bytes(i)))
+
+            keep = _np.fromiter(confirmed(), dtype=bool, count=len(batch))
+            if not keep.all():
+                batch = batch.select(keep)
+        if len(batch):
+            batches.append(batch)
+
+    _engine.scan_file(path, emit_chunk=emit_chunk, progress=progress)
+    return batches
 
 
 def reduce_fn(key: str, values: list[str]) -> str:

@@ -1223,7 +1223,7 @@ class GrepEngine:
 
     def scan_file(self, path, chunk_bytes: int | None = None, emit=None,
                   progress=None, stop_after_match: bool = False,
-                  stop=None) -> ScanResult:
+                  stop=None, emit_chunk=None) -> ScanResult:
         """Stream a file of any size through the scanner: chunks are cut at
         newline boundaries (partial tail lines carry into the next chunk),
         so no line — and hence no grep match — ever spans a chunk, and host
@@ -1237,6 +1237,13 @@ class GrepEngine:
         a second pass.  Line numbers in the result are file-global.  A
         single line longer than chunk_bytes is accumulated whole (a line
         must fit in memory; grep semantics need the full line anyway).
+
+        ``emit_chunk(lines_before, buf, matched_lines, nl_index)`` is the
+        columnar alternative (round 5): called once per chunk that has
+        matches, with the chunk-LOCAL 1-based matched line numbers and
+        the chunk's newline index — the grep apps build one LineBatch per
+        chunk from it (runtime/columnar.py) instead of paying a Python
+        callback per matched line.
 
         Disk reads are pipelined (VERDICT r3 item 4): a one-slot reader
         thread fetches chunk i+1 while chunk i scans — the same shape as
@@ -1297,6 +1304,11 @@ class GrepEngine:
                             for ln in res.matched_lines.tolist():
                                 s, e = lines_mod.line_span(nl_idx, ln, len(buf))
                                 emit(lines_before + ln, buf[s:e])
+                        elif emit_chunk is not None:
+                            nl_idx = lines_mod.newline_index(buf)
+                            emit_chunk(
+                                lines_before, buf, res.matched_lines, nl_idx
+                            )
                         matched.extend((res.matched_lines + lines_before).tolist())
                     if nl_idx is not None:
                         # chunks are newline-terminated except possibly the

@@ -1,0 +1,424 @@
+"""Columnar record batches for the built-in grep apps' match-dense path.
+
+The per-record pipeline (one KeyValue per matched line through emit ->
+bucketize -> JSONL encode -> decode -> external sort -> collation resort)
+measured ~28 us/record — a 549k-match 64 MB dense print job spent 17 s in
+Python object churn around a 0.3 s scan (BASELINE.md round-4 profile), the
+one workload where plain grep still beat the framework >10x end to end.
+
+A ``LineBatch`` carries a whole chunk's matched lines as three arrays —
+line numbers, a byte slab, and slab offsets — and flows through the same
+pipeline stages with vectorized equivalents:
+
+* partitioning: FNV-32a of each record's key, computed vectorized (the key
+  ``"<file> (line number #N)"`` shares a per-batch prefix whose hash is
+  folded once; only the line-number digits fold per record, grouped by
+  digit count) — bit-identical to ``utils.native.partition`` per key, so
+  the record->partition mapping is EXACTLY the per-record path's
+  (reference ihash, map_reduce/worker.go:13-17);
+* shuffle wire format: one header line + three binary sections per batch
+  (runtime/shuffle.py embeds the blocks between ordinary JSONL records —
+  old files decode unchanged);
+* reduce: identity-reduce apps (the grep apps — reduce is ``values[0]``
+  and keys are unique by construction) collate batches in (file, line)
+  order via ``IdentityCollator`` instead of re-sorting records through
+  the generic external sorter.  Output files come out ALREADY in the
+  CLI's display order, so collation downstream is a streamed k-way merge
+  instead of a second full external sort (round-4 VERDICT item 7: the
+  reference sorts once, worker.go:161-169 — ours must not sort twice).
+
+Custom applications never see any of this: map outputs containing only
+KeyValue records take the per-record path everywhere (VERDICT item 3).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.apps.base import KeyValue
+
+# Batch block marker inside intermediate files.  JSONL records always start
+# with '[' (json.dumps of a [key, value] list), so a line starting with '#'
+# is unambiguous.
+MARKER = b"#!dgrep-colv1 "
+
+_FNV_OFFSET = np.uint64(2166136261)
+_FNV_PRIME = np.uint64(16777619)
+_U32 = np.uint64(0xFFFFFFFF)
+
+
+@dataclass
+class LineBatch:
+    """One file-chunk's matched lines, columnar.
+
+    Logically equivalent to ``[KeyValue(f"{filename} (line number #{n})",
+    text_n) for n in linenos]`` where ``text_n`` is the line's raw bytes
+    (decoded utf-8/replace only at output time — the per-record path
+    decodes at emit time; both produce identical output bytes).
+
+    linenos   int64[n]    1-based line numbers, strictly increasing
+    offsets   int64[n+1]  slab offsets; line i = slab[offsets[i]:offsets[i+1]]
+    slab      bytes       concatenated line bytes (no separators)
+    """
+
+    filename: str
+    linenos: np.ndarray
+    offsets: np.ndarray
+    slab: bytes
+
+    def __len__(self) -> int:
+        return int(self.linenos.size)
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.slab) + self.linenos.nbytes + self.offsets.nbytes
+
+    def line_bytes(self, i: int) -> bytes:
+        return self.slab[self.offsets[i] : self.offsets[i + 1]]
+
+    def to_keyvalues(self) -> list[KeyValue]:
+        """Per-record escape hatch (tests, generic consumers)."""
+        return [
+            KeyValue(
+                key=f"{self.filename} (line number #{int(n)})",
+                value=self.line_bytes(i).decode("utf-8", errors="replace"),
+            )
+            for i, n in enumerate(self.linenos)
+        ]
+
+    # ------------------------------------------------------------ partition
+    def partitions(self, n_reduce: int) -> np.ndarray:
+        """FNV-32a(key) % n_reduce per record, vectorized — bit-identical
+        to utils.native.partition on the formatted key string."""
+        prefix = (self.filename + " (line number #").encode(
+            "utf-8", "surrogateescape"
+        )
+        h0 = _FNV_OFFSET
+        for b in prefix:
+            h0 = ((h0 ^ np.uint64(b)) * _FNV_PRIME) & _U32
+        n = len(self)
+        h = np.full(n, h0, dtype=np.uint64)
+        v = self.linenos.astype(np.uint64)
+        ndig = np.ones(n, dtype=np.int64)
+        t = v // 10
+        while np.any(t > 0):
+            ndig += (t > 0).astype(np.int64)
+            t //= 10
+        for d in np.unique(ndig):
+            sel = ndig == d
+            vv = v[sel]
+            hh = h[sel]
+            for k in range(int(d)):
+                digit = (vv // np.uint64(10 ** (int(d) - 1 - k))) % np.uint64(10)
+                hh = ((hh ^ (digit + np.uint64(48))) * _FNV_PRIME) & _U32
+            hh = ((hh ^ np.uint64(41)) * _FNV_PRIME) & _U32  # ')'
+            h[sel] = hh
+        return ((h & np.uint64(0x7FFFFFFF)) % np.uint64(n_reduce)).astype(
+            np.int64
+        )
+
+    def select(self, mask: np.ndarray) -> "LineBatch":
+        """Sub-batch of the records where ``mask`` is True (slab rebuilt
+        via one vectorized gather)."""
+        idx = np.flatnonzero(mask)
+        starts = self.offsets[idx]
+        ends = self.offsets[idx + 1]
+        slab, offsets = gather_ranges(
+            np.frombuffer(self.slab, dtype=np.uint8), starts, ends
+        )
+        return LineBatch(
+            filename=self.filename,
+            linenos=self.linenos[idx],
+            offsets=offsets,
+            slab=slab,
+        )
+
+    def split_by_partition(self, n_reduce: int) -> dict[int, "LineBatch"]:
+        parts = self.partitions(n_reduce)
+        return {
+            int(r): self.select(parts == r) for r in np.unique(parts)
+        }
+
+    # -------------------------------------------------------------- output
+    def texts(self) -> list[str]:
+        """Per-line decoded text (utf-8/replace), batched: ASCII slabs
+        (the overwhelmingly common case) slice the one decoded string by
+        the same offsets; anything else decodes per line."""
+        if self.slab.isascii():
+            s = self.slab.decode("ascii")
+            off = self.offsets
+            return [s[off[i] : off[i + 1]] for i in range(len(self))]
+        return [
+            self.line_bytes(i).decode("utf-8", errors="replace")
+            for i in range(len(self))
+        ]
+
+    def format_lines(self, sep: str = "\t") -> str:
+        """The mr-out text form — ``"<file> (line number #N)<sep><text>\\n"``
+        per record, one joined string (the reduce-side writer)."""
+        head = f"{self.filename} (line number #"
+        return "".join(
+            f"{head}{n}){sep}{t}\n"
+            for n, t in zip(self.linenos.tolist(), self.texts())
+        )
+
+
+def gather_ranges(
+    arr: np.ndarray, starts: np.ndarray, ends: np.ndarray
+) -> tuple[bytes, np.ndarray]:
+    """Concatenate arr[starts[i]:ends[i]] for all i — vectorized (one
+    cumsum-built index gather, no per-range Python slicing).  Returns
+    (slab bytes, int64 offsets[n+1])."""
+    starts = np.asarray(starts, dtype=np.int64)
+    ends = np.asarray(ends, dtype=np.int64)
+    lens = ends - starts
+    offsets = np.zeros(starts.size + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    if total == 0:
+        return b"", offsets
+    # idx[j] = delta of the source index at output byte j: +1 within a
+    # range, and at each range head a jump from the previous range's last
+    # byte to this range's start.  Empty ranges contribute no output
+    # bytes, so they are dropped before the head positions are computed
+    # (their heads would collide with the next range's).
+    ne = np.flatnonzero(lens > 0)
+    s, l = starts[ne], lens[ne]
+    idx = np.ones(total, dtype=np.int64)
+    idx[0] = s[0]
+    if ne.size > 1:
+        heads = offsets[ne[1:]]  # output position where each range begins
+        idx[heads] = s[1:] - (s[:-1] + l[:-1] - 1)
+    src = np.cumsum(idx)
+    return arr[src].tobytes(), offsets
+
+
+def make_batch_from_lines(
+    filename: str,
+    linenos: np.ndarray,
+    data: np.ndarray,
+    nl_index: np.ndarray,
+    n_bytes: int,
+    lineno_base: int = 0,
+) -> LineBatch:
+    """Build a LineBatch for 1-based ``linenos`` of ``data`` (uint8 view)
+    using its newline index — the vectorized form of ops/lines.line_span
+    per line (end excludes the '\\n').  ``lineno_base`` shifts the STORED
+    line numbers (file-global numbering for a chunk of a streamed file);
+    spans are computed from the local numbers."""
+    ln = np.asarray(linenos, dtype=np.int64)
+    if ln.size == 0:
+        return LineBatch(
+            filename=filename, linenos=ln,
+            offsets=np.zeros(1, dtype=np.int64), slab=b"",
+        )
+    nl = nl_index.astype(np.int64)
+    if nl.size == 0:  # chunk with no newline: only line 1 exists
+        starts = np.zeros(ln.size, dtype=np.int64)
+        ends = np.full(ln.size, n_bytes, dtype=np.int64)
+    else:
+        # np.where evaluates both branches: clip the fancy indexes so the
+        # out-of-range side (line 1 / last line) reads a harmless slot
+        starts = np.where(
+            ln == 1, 0, nl[np.clip(ln - 2, 0, nl.size - 1)] + 1
+        )
+        ends = np.where(
+            ln - 1 < nl.size, nl[np.clip(ln - 1, 0, nl.size - 1)], n_bytes
+        )
+    slab, offsets = gather_ranges(data, starts, ends)
+    return LineBatch(
+        filename=filename, linenos=ln + lineno_base, offsets=offsets,
+        slab=slab,
+    )
+
+
+# ------------------------------------------------------------- wire format
+
+def encode_batch(b: LineBatch) -> bytes:
+    header = MARKER + json.dumps(
+        {"file": b.filename, "n": len(b), "slab": len(b.slab)},
+        ensure_ascii=False,
+    ).encode("utf-8", "surrogateescape") + b"\n"
+    return b"".join([
+        header,
+        np.ascontiguousarray(b.linenos, dtype="<i8").tobytes(),
+        np.ascontiguousarray(b.offsets, dtype="<i8").tobytes(),
+        b.slab,
+        b"\n",
+    ])
+
+
+def iter_blocks(path):
+    """Stream records from a spill-run file (the shuffle wire format):
+    KeyValue per JSONL line, LineBatch per block — without reading the
+    whole file (the merge phase holds one block per run, not one run)."""
+    with open(path, "rb") as f:
+        while True:
+            line = f.readline()
+            if not line:
+                return
+            if line.startswith(MARKER):
+                meta = json.loads(
+                    line[len(MARKER) :].decode("utf-8", "surrogateescape")
+                )
+                n, slab_len = int(meta["n"]), int(meta["slab"])
+                body = f.read(n * 8 + (n + 1) * 8 + slab_len + 1)
+                linenos = np.frombuffer(body, dtype="<i8", count=n).astype(
+                    np.int64
+                )
+                offsets = np.frombuffer(
+                    body, dtype="<i8", count=n + 1, offset=n * 8
+                ).astype(np.int64)
+                slab = body[(2 * n + 1) * 8 : (2 * n + 1) * 8 + slab_len]
+                yield LineBatch(
+                    filename=meta["file"], linenos=linenos,
+                    offsets=offsets, slab=slab,
+                )
+            elif line.strip():
+                k, v = json.loads(
+                    line.decode("utf-8", "surrogateescape")
+                )
+                yield KeyValue(k, v)
+
+
+class IdentityCollator:
+    """Reduce-side collation for identity-reduce applications (the grep
+    apps: ``reduce_fn = values[0]`` and keys are unique by construction,
+    one per (file, line) — declared via the module attribute
+    ``reduce_is_identity``).
+
+    Orders everything by (file, line number) — the CLI's display order —
+    so the job's mr-out files need NO downstream re-sort: collation
+    becomes a streamed k-way merge (runtime/job.iter_results_sorted),
+    closing the round-4 'collation resort' finding (the reference sorts
+    once, worker.go:161-169).
+
+    Batches stay columnar end to end; memory is bounded by spilling
+    sorted runs in the shuffle wire format.  Contract: batches of one
+    file arrive with internally ascending, pairwise disjoint line-number
+    ranges (true for the grep apps — one map task per file, one batch per
+    chunk), so batch-granularity merge keys of (file, first line) give a
+    globally record-sorted stream."""
+
+    def __init__(self, memory_limit_bytes: int = 128 << 20,
+                 spill_dir: str | None = None):
+        self.memory_limit = memory_limit_bytes
+        self._spill_parent = spill_dir
+        self._tmp: str | None = None
+        self._mem: list = []
+        self._mem_bytes = 0
+        self._runs: list = []
+        # the shared grep-key shape (runtime/job.GREP_KEY_RE duplicated
+        # here only in spirit — imported lazily to keep this module a leaf)
+        from distributed_grep_tpu.runtime.job import GREP_KEY_RE
+
+        self._key_re = GREP_KEY_RE
+
+    @property
+    def spill_count(self) -> int:
+        return len(self._runs)
+
+    def _sort_key(self, item) -> tuple[str, int, int]:
+        if isinstance(item, LineBatch):
+            return (item.filename, int(item.linenos[0]) if len(item) else 0, 0)
+        m = self._key_re.match(item.key)
+        if m:
+            return (m.group(1), int(m.group(2)), 1)
+        return (item.key, 0, 1)
+
+    def add_many(self, records) -> None:
+        for rec in records:
+            self._mem.append(rec)
+            self._mem_bytes += (
+                rec.nbytes + 256 if isinstance(rec, LineBatch)
+                else len(rec.key) + len(rec.value) + 120
+            )
+            if self._mem_bytes >= self.memory_limit:
+                self._spill()
+
+    def _spill(self) -> None:
+        import tempfile
+        from pathlib import Path
+
+        from distributed_grep_tpu.runtime import shuffle
+
+        if not self._mem:
+            return
+        if self._tmp is None:
+            self._tmp = tempfile.mkdtemp(
+                prefix="dgrep-collate-", dir=self._spill_parent
+            )
+        run = Path(self._tmp) / f"run-{len(self._runs)}"
+        self._mem.sort(key=self._sort_key)
+        with open(run, "wb") as f:
+            for i in range(0, len(self._mem), 1024):
+                f.write(shuffle.encode_records(self._mem[i : i + 1024]))
+        self._runs.append(run)
+        self._mem = []
+        self._mem_bytes = 0
+
+    def merged(self):
+        """All items (LineBatch | KeyValue) in (file, line) order."""
+        import heapq
+
+        self._mem.sort(key=self._sort_key)
+        streams = [iter_blocks(run) for run in self._runs]
+        streams.append(iter(self._mem))
+        return heapq.merge(*streams, key=self._sort_key)
+
+    def iter_output_chunks(self):
+        """The mr-out text, streamed in display order: one string per
+        batch (batched formatting) or per loose KeyValue."""
+        for item in self.merged():
+            if isinstance(item, LineBatch):
+                if len(item):
+                    yield item.format_lines()
+            else:
+                yield f"{item.key}\t{item.value}\n"
+
+    def close(self) -> None:
+        import shutil
+
+        if self._tmp is not None:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
+        self._mem = []
+        self._runs = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def decode_batch_at(data: bytes, pos: int) -> tuple[LineBatch, int]:
+    """Decode one batch block starting at ``pos`` (which must point at
+    MARKER); returns (batch, next position)."""
+    eol = data.index(b"\n", pos)
+    meta = json.loads(
+        data[pos + len(MARKER) : eol].decode("utf-8", "surrogateescape")
+    )
+    n, slab_len = int(meta["n"]), int(meta["slab"])
+    p = eol + 1
+    linenos = np.frombuffer(data, dtype="<i8", count=n, offset=p).astype(
+        np.int64
+    )
+    p += n * 8
+    offsets = np.frombuffer(data, dtype="<i8", count=n + 1, offset=p).astype(
+        np.int64
+    )
+    p += (n + 1) * 8
+    slab = data[p : p + slab_len]
+    p += slab_len
+    if p < len(data) and data[p : p + 1] == b"\n":
+        p += 1
+    return (
+        LineBatch(
+            filename=meta["file"], linenos=linenos, offsets=offsets, slab=slab
+        ),
+        p,
+    )

@@ -19,25 +19,84 @@ from distributed_grep_tpu.apps.base import KeyValue
 from distributed_grep_tpu.utils.native import partition
 
 
-def bucketize(records: list[KeyValue], n_reduce: int) -> dict[int, list[KeyValue]]:
-    """Single-pass partition of map output into reduce buckets."""
-    buckets: dict[int, list[KeyValue]] = {}
-    for kv in records:
-        r = partition(kv.key, n_reduce)
-        buckets.setdefault(r, []).append(kv)
+def bucketize(records: list, n_reduce: int) -> dict[int, list]:
+    """Single-pass partition of map output into reduce buckets.
+
+    Records are KeyValue (per-record FNV of the key) or columnar
+    LineBatch (runtime/columnar.py — the match-dense fast path; its
+    vectorized per-record FNV gives the EXACT same record->partition
+    mapping, so per-record and columnar maps shuffle identically)."""
+    from distributed_grep_tpu.runtime.columnar import LineBatch
+
+    buckets: dict[int, list] = {}
+    for rec in records:
+        if isinstance(rec, LineBatch):
+            for r, sub in rec.split_by_partition(n_reduce).items():
+                buckets.setdefault(r, []).append(sub)
+        else:
+            r = partition(rec.key, n_reduce)
+            buckets.setdefault(r, []).append(rec)
     return buckets
 
 
-def encode_records(records: list[KeyValue]) -> bytes:
+def encode_records(records: list) -> bytes:
     # surrogateescape: keys embed filenames, which on POSIX may contain
     # non-UTF8 bytes that argv/os decoding maps to lone surrogates — they
     # must round-trip the wire format (CLAUDE.md invariant), not crash it.
-    return "".join(
-        json.dumps([kv.key, kv.value], ensure_ascii=False) + "\n" for kv in records
-    ).encode("utf-8", "surrogateescape")
+    # LineBatch records interleave as binary blocks (runtime/columnar.py);
+    # a batch-free record list encodes byte-identically to round 4.
+    from distributed_grep_tpu.runtime import columnar
+
+    parts: list[bytes] = []
+    jsonl: list[str] = []
+
+    def flush_jsonl() -> None:
+        if jsonl:
+            parts.append("".join(jsonl).encode("utf-8", "surrogateescape"))
+            jsonl.clear()
+
+    for rec in records:
+        if isinstance(rec, columnar.LineBatch):
+            flush_jsonl()
+            parts.append(columnar.encode_batch(rec))
+        else:
+            jsonl.append(
+                json.dumps([rec.key, rec.value], ensure_ascii=False) + "\n"
+            )
+    flush_jsonl()
+    return b"".join(parts)
 
 
-def decode_records(data: bytes) -> list[KeyValue]:
+def decode_records(data: bytes) -> list:
+    """Inverse of encode_records: KeyValue per JSONL line, LineBatch per
+    columnar block (kept columnar — expanding 500k records to Python
+    objects is the cost this format exists to avoid).  JSONL lines always
+    start with '[' and batch blocks with '#', so the two cannot be
+    confused; batch-free data decodes exactly as before."""
+    from distributed_grep_tpu.runtime import columnar
+
+    if columnar.MARKER not in data:
+        return _decode_jsonl(data)
+    out: list = []
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if data.startswith(columnar.MARKER, pos):
+            batch, pos = columnar.decode_batch_at(data, pos)
+            out.append(batch)
+            continue
+        # A marker is a block boundary only at a LINE START — a grep'd
+        # line may itself contain the marker text, which JSON embeds
+        # literally (but raw newlines are always escaped, so '\n'+MARKER
+        # cannot occur inside a record).
+        nxt = data.find(b"\n" + columnar.MARKER, pos)
+        chunk = data[pos:] if nxt < 0 else data[pos : nxt + 1]
+        out.extend(_decode_jsonl(chunk))
+        pos = n if nxt < 0 else nxt + 1
+    return out
+
+
+def _decode_jsonl(data: bytes) -> list[KeyValue]:
     out: list[KeyValue] = []
     # Split on \n only: JSON escapes \r and \n inside strings but leaves
     #  /  literal with ensure_ascii=False, and splitlines() would

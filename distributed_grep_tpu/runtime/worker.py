@@ -252,8 +252,15 @@ class WorkerLoop:
         # Bounded-memory grouping: records spill to sorted on-disk runs past
         # the cap and group-reduce as a streaming merge (runtime/extsort.py).
         # The reference materializes the whole partition (worker.go:161-162).
+        # Identity-reduce apps (the grep apps — ``reduce_is_identity`` on
+        # the module) instead collate columnar batches in (file, line)
+        # order (runtime/columnar.IdentityCollator): records never expand
+        # to per-line Python objects, and the output files come out in the
+        # CLI's display order so collation downstream is a plain merge.
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
+        if getattr(self.app.module, "reduce_is_identity", False):
+            return self._run_reduce_identity(a, t0)
         reducer = ExternalReducer(
             memory_limit_bytes=self.reduce_memory_bytes, spill_dir=self.spill_dir
         )
@@ -310,6 +317,73 @@ class WorkerLoop:
             if reducer.spill_count:
                 self.metrics.inc("reduce_spills", reducer.spill_count)
             reducer.close()
+        self.transport.reduce_finished(
+            rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
+        )
+        self.metrics.inc("reduce_tasks")
+        self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
+
+    def _run_reduce_identity(self, a: rpc.AssignTaskReply, t0: float) -> None:
+        """Columnar reduce for identity-reduce apps: same RPC/commit shape
+        as _run_reduce, but records collate batch-wise in (file, line)
+        order instead of re-sorting through the generic external sorter
+        (the reference sorts once, worker.go:161-169 — so do we)."""
+        import os
+        import tempfile
+
+        from distributed_grep_tpu.runtime.columnar import IdentityCollator
+
+        collator = IdentityCollator(
+            memory_limit_bytes=self.reduce_memory_bytes,
+            spill_dir=self.spill_dir,
+        )
+        try:
+            files_processed = 0
+            while True:
+                r = self.transport.reduce_next_file(
+                    rpc.ReduceNextFileArgs(
+                        task_id=a.task_id, files_processed=files_processed
+                    )
+                )
+                if r.done:
+                    break
+                if not r.next_file:
+                    continue  # long-poll window expired; re-poll
+                data = self.transport.read_intermediate(r.next_file)
+                collator.add_many(shuffle.decode_records(data))
+                files_processed += 1
+                self._fault("after_reduce_file")
+            fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
+                                         dir=self.spill_dir or None)
+            try:
+                progress = self._progress_fn(
+                    "reduce", a.task_id, a.task_timeout_s
+                )
+                with self.metrics.timer("reduce_compute"), \
+                        trace.annotate(f"reduce_compute:{a.task_id}"), \
+                        os.fdopen(fd, "w", encoding="utf-8",
+                                  errors="surrogateescape", newline="") as out:
+                    for n_chunks, chunk in enumerate(
+                        collator.iter_output_chunks()
+                    ):
+                        out.write(chunk)
+                        if n_chunks % 64 == 0:
+                            progress()  # chunks are whole batches: coarse
+                self._fault("before_reduce_commit")
+                wof = getattr(self.transport, "write_output_from_file", None)
+                if wof is not None:
+                    wof(f"mr-out-{a.task_id}", spool)
+                else:
+                    with open(spool, "rb") as f:
+                        self.transport.write_output(
+                            f"mr-out-{a.task_id}", f.read()
+                        )
+            finally:
+                os.unlink(spool)
+        finally:
+            if collator.spill_count:
+                self.metrics.inc("reduce_spills", collator.spill_count)
+            collator.close()
         self.transport.reduce_finished(
             rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
         )

@@ -97,3 +97,19 @@ def _fresh_device_probe_state():
     with _eng._device_probe_lock:
         _eng._device_probe_state.update(verdict=None, at=0.0)
     yield
+
+
+def expand_records(records):
+    """Flatten map output to per-record KeyValues: the built-in grep apps
+    emit columnar LineBatch objects (round 5, runtime/columnar.py); tests
+    asserting record shapes expand them through the semantic equivalence
+    (LineBatch.to_keyvalues)."""
+    from distributed_grep_tpu.runtime.columnar import LineBatch
+
+    out = []
+    for r in records:
+        if isinstance(r, LineBatch):
+            out.extend(r.to_keyvalues())
+        else:
+            out.append(r)
+    return out

@@ -4,6 +4,7 @@ import pytest
 
 from distributed_grep_tpu.apps import KeyValue, load_application
 from distributed_grep_tpu.apps.base import group_reduce
+from tests.conftest import expand_records
 
 
 def test_group_reduce_sort_merge_semantics():
@@ -23,7 +24,7 @@ def test_group_reduce_sort_merge_semantics():
 
 def test_load_application_by_module_name():
     app = load_application("distributed_grep_tpu.apps.grep", pattern="fox")
-    kvs = app.map_fn("f.txt", b"a fox\nno match\nfoxfox")
+    kvs = expand_records(app.map_fn("f.txt", b"a fox\nno match\nfoxfox"))
     assert [kv.key for kv in kvs] == ["f.txt (line number #1)", "f.txt (line number #3)"]
     assert app.reduce_fn("k", ["v1", "v2"]) == "v1"
 
@@ -52,16 +53,16 @@ def test_load_application_rejects_incomplete_module(tmp_path):
 
 def test_grep_app_pattern_plumbing_and_regex():
     app = load_application("distributed_grep_tpu.apps.grep", pattern=r"h[ae]llo")
-    kvs = app.map_fn("t", b"hallo\nhello\nhullo\n")
+    kvs = expand_records(app.map_fn("t", b"hallo\nhello\nhullo\n"))
     assert len(kvs) == 2
     # Reconfigure (new job, new pattern) — state must not leak.
     app.configure(pattern="hullo")
-    assert len(app.map_fn("t", b"hallo\nhello\nhullo\n")) == 1
+    assert len(expand_records(app.map_fn("t", b"hallo\nhello\nhullo\n"))) == 1
 
 
 def test_grep_app_case_insensitive_and_binary_safe():
     app = load_application("distributed_grep_tpu.apps.grep", pattern="hello", ignore_case=True)
-    kvs = app.map_fn("t", b"HELLO\nx\xff\xfehello\xff\n")
+    kvs = expand_records(app.map_fn("t", b"HELLO\nx\xff\xfehello\xff\n"))
     assert len(kvs) == 2
     assert kvs[1].key == "t (line number #2)"
 
@@ -79,7 +80,7 @@ def test_grep_cpu_no_phantom_trailing_line():
     from distributed_grep_tpu.apps import grep as grep_app
 
     grep_app.configure(pattern="")
-    out = grep_app.map_fn("f", b"one\ntwo\n")
+    out = expand_records(grep_app.map_fn("f", b"one\ntwo\n"))
     assert [kv.key for kv in out] == [
         "f (line number #1)", "f (line number #2)"
     ]
@@ -89,7 +90,7 @@ def test_grep_cpu_pattern_set_uses_ac():
     from distributed_grep_tpu.apps import grep as grep_app
 
     grep_app.configure(patterns=["needle", "vol.cano"])  # literals, not regex
-    out = grep_app.map_fn("f", b"a needle\nvolXcano\nvol.cano literal\nnone\n")
+    out = expand_records(grep_app.map_fn("f", b"a needle\nvolXcano\nvol.cano literal\nnone\n"))
     assert [kv.key for kv in out] == [
         "f (line number #1)", "f (line number #3)"
     ]
@@ -103,8 +104,8 @@ def test_grep_invert_both_apps():
     cpu_app.configure(pattern="hello", invert=True)
     tpu_app.configure(pattern="hello", invert=True, backend="cpu")
     want = ["f (line number #2)", "f (line number #4)"]
-    assert [kv.key for kv in cpu_app.map_fn("f", data)] == want
-    assert [kv.key for kv in tpu_app.map_fn("f", data)] == want
+    assert [kv.key for kv in expand_records(cpu_app.map_fn("f", data))] == want
+    assert [kv.key for kv in expand_records(tpu_app.map_fn("f", data))] == want
 
 
 def test_inverted_index_app():

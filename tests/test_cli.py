@@ -586,3 +586,135 @@ def test_exclude_dir_recursive(tmp_path, capsys):
         capsys,
     )
     assert (code, out) == (1, "")
+
+
+def test_stdin_streaming_early_exit(tmp_path, capsys, monkeypatch):
+    """Round 5: stdin as the only input STREAMS — presence queries stop
+    at the first settled match without draining the pipe (GNU semantics;
+    the round-4 spool read to EOF first).  An endless stream object
+    stands in for an unbounded pipe: reading past the match would hang
+    or exhaust it."""
+    import itertools
+    import types
+
+    class EndlessPipe:
+        """Yields one matching chunk, then infinite filler chunks; fails
+        the test if read more than `limit` times (a drain would)."""
+
+        def __init__(self, first: bytes, limit: int = 5):
+            self.chunks = itertools.chain(
+                [first], itertools.repeat(b"filler line\n" * 10)
+            )
+            self.reads = 0
+            self.limit = limit
+
+        def read1(self, n: int = -1) -> bytes:
+            self.reads += 1
+            assert self.reads <= self.limit, "presence query drained the pipe"
+            return next(self.chunks)
+
+    pipe = EndlessPipe(b"no\nhas needle here\nmore\n")
+    monkeypatch.setattr(sys, "stdin", types.SimpleNamespace(buffer=pipe))
+    code = main(["grep", "-q", "needle"])
+    assert code == 0 and pipe.reads == 1
+
+    pipe = EndlessPipe(b"x needle\n")
+    monkeypatch.setattr(sys, "stdin", types.SimpleNamespace(buffer=pipe))
+    code = main(["grep", "-l", "needle", "-"])
+    out = capsys.readouterr().out
+    assert code == 0 and out.splitlines() == ["(standard input)"]
+    assert pipe.reads == 1
+
+    # -L: a match settles the (empty) answer early too
+    pipe = EndlessPipe(b"x needle\n")
+    monkeypatch.setattr(sys, "stdin", types.SimpleNamespace(buffer=pipe))
+    code = main(["grep", "-L", "needle"])
+    assert code == 0 and capsys.readouterr().out == ""
+    assert pipe.reads == 1
+
+    # -m stops READING at the cap (GNU) — chunk granularity
+    pipe = EndlessPipe(b"a needle\nb needle\nc needle\n", limit=2)
+    monkeypatch.setattr(sys, "stdin", types.SimpleNamespace(buffer=pipe))
+    code = main(["grep", "-m", "2", "needle"])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert out.splitlines() == [
+        "(standard input) (line number #1) a needle",
+        "(standard input) (line number #2) b needle",
+    ]
+
+
+def test_stdin_streaming_matches_gnu_modes(tmp_path, capsys, monkeypatch):
+    """Streamed stdin agrees with GNU for -c/-w/-x/-v/-i and partial
+    trailing lines; line numbers accumulate across chunked reads."""
+    import io
+    import shutil
+    import subprocess
+    import types
+
+    gnu = shutil.which("grep")
+
+    class TrickleBytesIO(io.BytesIO):
+        """Returns at most 7 bytes per read1 — forces carry/chunk logic."""
+
+        def read1(self, n: int = -1) -> bytes:
+            return super().read(7)
+
+    data = (
+        b"The needle one\nno match\nNEEDLE up\nneedles plural\n"
+        b"needle\nlast without newline needle"
+    )
+
+    cases = [
+        (["-c", "needle"], ["-c", "needle"]),
+        (["-w", "needle"], ["-nw", "needle"]),
+        (["-x", "needle"], ["-nx", "needle"]),
+        (["-v", "-c", "needle"], ["-cv", "needle"]),
+        (["-i", "-c", "needle"], ["-ci", "needle"]),
+    ]
+    for ours_args, gnu_args in cases:
+        monkeypatch.setattr(
+            sys, "stdin", types.SimpleNamespace(buffer=TrickleBytesIO(data))
+        )
+        code = main(["grep", *ours_args])
+        out = capsys.readouterr().out
+        p = subprocess.run([gnu, *gnu_args], input=data,
+                           capture_output=True, env={"LC_ALL": "C"})
+        assert code == p.returncode, (ours_args, out, p.stdout)
+        if "-c" in ours_args or "-cv" in gnu_args[0]:
+            assert out.strip() == p.stdout.decode().strip(), ours_args
+        else:
+            ours_lines = {
+                int(l.split("#")[1].split(")")[0])
+                for l in out.splitlines()
+            }
+            gnu_lines = {
+                int(l.split(":")[0]) for l in p.stdout.decode().splitlines()
+            }
+            assert ours_lines == gnu_lines, ours_args
+
+
+def test_max_count_zero_selects_nothing(tmp_path, corpus, capsys, monkeypatch):
+    """GNU -m 0: prints nothing, exits 1 — on files AND streamed stdin
+    (probed grep 3.8; round-5 review finding: both paths printed/exited
+    wrong when the cap was zero)."""
+    import io
+    import types
+
+    a = str(corpus["a.txt"])
+    code, out, _ = run_cli(
+        ["grep", "-m", "0", "hello", a, "--work-dir", str(tmp_path / "w")],
+        capsys)
+    assert (code, out) == (1, "")
+    monkeypatch.setattr(
+        sys, "stdin",
+        types.SimpleNamespace(buffer=io.BytesIO(b"a hello\n")),
+    )
+    code, out, _ = run_cli(["grep", "-m", "0", "hello"], capsys)
+    assert (code, out) == (1, "")
+    monkeypatch.setattr(
+        sys, "stdin",
+        types.SimpleNamespace(buffer=io.BytesIO(b"a hello\n")),
+    )
+    code, out, _ = run_cli(["grep", "-c", "-m", "0", "hello"], capsys)
+    assert (code, out.strip()) == (1, "0")

@@ -1,0 +1,290 @@
+"""Columnar match-dense pipeline (runtime/columnar.py, round 5).
+
+The LineBatch path must be SEMANTICALLY INVISIBLE: identical records,
+identical shuffle partitioning (bit-identical FNV per key), identical
+mr-out text, identical CLI output — just without a Python object per
+matched line.  Oracles: the per-record implementations they replace.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.apps.base import KeyValue
+from distributed_grep_tpu.runtime import shuffle
+from distributed_grep_tpu.runtime.columnar import (
+    MARKER,
+    IdentityCollator,
+    LineBatch,
+    decode_batch_at,
+    encode_batch,
+    gather_ranges,
+    make_batch_from_lines,
+)
+from distributed_grep_tpu.utils.native import partition
+
+
+def _random_batch(rng: random.Random, fname: str, n: int) -> LineBatch:
+    linenos = np.array(
+        sorted(rng.sample(range(1, max(2, n * 17)), n)), dtype=np.int64
+    )
+    texts = [
+        bytes(rng.randrange(32, 127) for _ in range(rng.randrange(0, 40)))
+        for _ in range(n)
+    ]
+    lens = np.fromiter((len(t) for t in texts), dtype=np.int64, count=n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return LineBatch(fname, linenos, offsets, b"".join(texts))
+
+
+def test_gather_ranges_fuzz_vs_naive():
+    rng = random.Random(11)
+    for _ in range(200):
+        n = rng.randrange(0, 1500)
+        raw = bytes(rng.randrange(256) for _ in range(n))
+        arr = np.frombuffer(raw, np.uint8)
+        m = rng.randrange(0, 40)
+        starts = np.array([rng.randrange(0, n + 1) for _ in range(m)],
+                          np.int64)
+        ends = np.minimum(
+            n, starts + np.array([rng.randrange(0, 25) for _ in range(m)])
+        )
+        slab, off = gather_ranges(arr, starts, ends)
+        want = b"".join(raw[a:b] for a, b in zip(starts, ends))
+        assert slab == want
+        assert off[-1] == len(want)
+
+
+def test_vectorized_fnv_bit_identical_to_partition():
+    """The shuffle contract: batch partitioning must reproduce the
+    per-record FNV-32a routing exactly (reference ihash semantics)."""
+    rng = random.Random(5)
+    for fname in ["/data/split-03.txt", "weird \udcff\udc80 name", "", "a b"]:
+        linenos = np.array(
+            sorted(rng.sample(range(1, 10**8), 300)), np.int64
+        )
+        b = LineBatch(fname, linenos, np.arange(301, dtype=np.int64),
+                      b"y" * 300)
+        for n_reduce in (1, 3, 8, 97):
+            got = b.partitions(n_reduce).tolist()
+            want = [
+                partition(f"{fname} (line number #{int(n)})", n_reduce)
+                for n in linenos
+            ]
+            assert got == want, (fname, n_reduce)
+
+
+def test_split_by_partition_matches_per_record_bucketize():
+    rng = random.Random(7)
+    batch = _random_batch(rng, "/f.txt", 400)
+    per_record = shuffle.bucketize(batch.to_keyvalues(), 5)
+    columnar = shuffle.bucketize([batch], 5)
+    assert set(per_record) == set(columnar)
+    for r in per_record:
+        expanded = []
+        for item in columnar[r]:
+            expanded.extend(item.to_keyvalues())
+        assert expanded == per_record[r], r
+
+
+def test_wire_roundtrip_mixed_records():
+    rng = random.Random(3)
+    b1 = _random_batch(rng, "/a", 50)
+    b2 = _random_batch(rng, "/b \udcfe", 1)
+    records = [
+        KeyValue("k1", "v1"),
+        b1,
+        KeyValue("k2", "line with \t tab and \\n"),
+        b2,
+        KeyValue("k3", ""),
+    ]
+    data = shuffle.encode_records(records)
+    back = shuffle.decode_records(data)
+    assert [type(r).__name__ for r in back] == [
+        "KeyValue", "LineBatch", "KeyValue", "LineBatch", "KeyValue"
+    ]
+    assert back[0] == records[0] and back[2] == records[2]
+    assert back[1].to_keyvalues() == b1.to_keyvalues()
+    assert back[3].to_keyvalues() == b2.to_keyvalues()
+
+
+def test_wire_marker_in_value_and_slab_is_not_a_boundary():
+    """Adversarial: a grep'd line may CONTAIN the block marker text — in
+    a JSONL value and inside a batch slab.  Neither may be parsed as a
+    block boundary."""
+    evil = MARKER.decode() + '{"file": "x", "n": 9, "slab": 9}'
+    kv = KeyValue("k", evil)
+    slab_line = (MARKER + b' {"n": 1}').decode()
+    texts = [slab_line.encode(), b"plain"]
+    lens = np.array([len(t) for t in texts], np.int64)
+    off = np.zeros(3, np.int64)
+    np.cumsum(lens, out=off[1:])
+    batch = LineBatch("/f", np.array([4, 9], np.int64), off, b"".join(texts))
+    data = shuffle.encode_records([kv, batch, kv])
+    back = shuffle.decode_records(data)
+    assert [type(r).__name__ for r in back] == [
+        "KeyValue", "LineBatch", "KeyValue"
+    ]
+    assert back[0].value == evil and back[2].value == evil
+    assert back[1].line_bytes(0).decode() == slab_line
+
+
+def test_batch_free_encoding_unchanged():
+    """A record list with no batches must encode byte-identically to the
+    round-4 JSONL wire (resume/journal compatibility)."""
+    import json
+
+    records = [KeyValue("a", "1"), KeyValue("b \udcff", "x\ty")]
+    want = "".join(
+        json.dumps([kv.key, kv.value], ensure_ascii=False) + "\n"
+        for kv in records
+    ).encode("utf-8", "surrogateescape")
+    assert shuffle.encode_records(records) == want
+
+
+def test_make_batch_from_lines_matches_line_span():
+    from distributed_grep_tpu.ops.lines import line_span, newline_index
+
+    cases = [
+        b"one\ntwo\nthree\n",
+        b"no trailing newline",
+        b"\n\nempty heads\n\n",
+        b"single\n",
+    ]
+    for data in cases:
+        nl = newline_index(data)
+        n_lines = data.count(b"\n") + (
+            0 if not data or data.endswith(b"\n") else 1
+        )
+        lns = np.arange(1, n_lines + 1, dtype=np.int64)
+        b = make_batch_from_lines(
+            "/f", lns, np.frombuffer(data, np.uint8), nl, len(data)
+        )
+        for i, ln in enumerate(lns.tolist()):
+            s, e = line_span(nl, ln, len(data))
+            assert b.line_bytes(i) == data[s:e], (data, ln)
+
+
+def test_make_batch_lineno_base_shifts_only_stored_numbers():
+    data = b"aa\nbb\ncc\n"
+    from distributed_grep_tpu.ops.lines import newline_index
+
+    b = make_batch_from_lines(
+        "/f", np.array([2], np.int64), np.frombuffer(data, np.uint8),
+        newline_index(data), len(data), lineno_base=100,
+    )
+    assert b.linenos.tolist() == [102]
+    assert b.line_bytes(0) == b"bb"
+
+
+def test_identity_collator_orders_and_spills(tmp_path):
+    """Batches + loose KeyValues from many 'map tasks' come out in
+    (file, line) order with bounded memory (forced spills)."""
+    rng = random.Random(13)
+    items = []
+    want = []
+    for fi in range(3):
+        fname = f"/data/split-{fi}"
+        all_lines = sorted(rng.sample(range(1, 5000), 600))
+        for c in range(0, 600, 150):  # 4 chunk batches per file
+            chunk = np.array(all_lines[c : c + 150], np.int64)
+            texts = [f"t{fi}-{int(n)}".encode() for n in chunk]
+            lens = np.array([len(t) for t in texts], np.int64)
+            off = np.zeros(lens.size + 1, np.int64)
+            np.cumsum(lens, out=off[1:])
+            items.append(LineBatch(fname, chunk, off, b"".join(texts)))
+        want.extend(
+            (fname, int(n), f"t{fi}-{int(n)}") for n in all_lines
+        )
+    rng.shuffle(items)
+    coll = IdentityCollator(memory_limit_bytes=8 << 10,
+                           spill_dir=str(tmp_path))
+    with coll:
+        coll.add_many(items)
+        coll.add_many([KeyValue("/data/split-1 (line number #0)", "kv")])
+        assert coll.spill_count > 0  # the cap actually forced spills
+        out = "".join(coll.iter_output_chunks())
+    lines = out.splitlines()
+    got = []
+    for line in lines:
+        k, _, v = line.partition("\t")
+        f, _, rest = k.partition(" (line number #")
+        got.append((f, int(rest[:-1]), v))
+    want_all = sorted(want + [("/data/split-1", 0, "kv")])
+    assert got == sorted(got) == want_all
+
+
+def test_full_job_columnar_output_matches_per_record_oracle(tmp_path):
+    """End to end: a grep job through the columnar pipeline produces the
+    same results dict as expanding map output per record, and the mr-out
+    files are already in display order (fileline_sorted merge)."""
+    from distributed_grep_tpu.runtime.job import grep_key_sort, run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    rng = random.Random(21)
+    files = []
+    for fi in range(3):
+        p = tmp_path / f"in-{fi}.txt"
+        lines = []
+        for i in range(400):
+            lines.append(
+                "needle %d-%d" % (fi, i) if rng.random() < 0.5
+                else "nothing %d" % i
+            )
+        p.write_text("\n".join(lines) + "\n")
+        files.append(str(p))
+    cfg = JobConfig(
+        input_files=files,
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": "needle"},
+        n_reduce=4,
+        work_dir=str(tmp_path / "job"),
+    )
+    res = run_job(cfg, n_workers=2)
+    assert res.fileline_sorted
+    # every output file individually in (file, line) order
+    for path in res.output_files:
+        keys = [grep_key_sort((k, v)) for k, v in res._iter_file(path)]
+        assert keys == sorted(keys), path
+    # global sorted stream == sorted(all records)
+    merged = list(res.iter_results_sorted())
+    assert merged == sorted(merged, key=grep_key_sort)
+    # records match a direct per-record oracle
+    import re
+
+    want = {}
+    for f in files:
+        data = open(f, "rb").read()
+        for i, line in enumerate(data.split(b"\n")[:-1], 1):
+            if re.search(b"needle", line):
+                want[f"{f} (line number #{i})"] = line.decode()
+    assert dict(merged) == want
+    # display-bytes stream agrees with the (key, value) stream
+    display = list(res.iter_display_bytes_sorted())
+    assert display == [
+        f"{k} {v}\n".encode("utf-8", "surrogateescape") for k, v in merged
+    ]
+
+
+def test_collator_used_only_for_identity_apps(tmp_path):
+    """wordcount (real reduce) must keep the generic external-sort path —
+    its records aggregate per key, which the identity collator does not."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    p = tmp_path / "in.txt"
+    p.write_text("the cat and the hat and the bat\n")
+    cfg = JobConfig(
+        input_files=[str(p)],
+        application="distributed_grep_tpu.apps.wordcount",
+        app_options={},
+        n_reduce=2,
+        work_dir=str(tmp_path / "job"),
+    )
+    res = run_job(cfg, n_workers=1)
+    assert not res.fileline_sorted
+    assert res.results["the"] == "3" and res.results["and"] == "2"

@@ -7,6 +7,7 @@ jobs (BASELINE.json north_star; SURVEY.md §1 plugin boundary).
 import pytest
 
 from distributed_grep_tpu.apps.loader import load_application
+from tests.conftest import expand_records
 from distributed_grep_tpu.runtime.job import run_job
 from distributed_grep_tpu.utils.config import JobConfig
 
@@ -19,14 +20,16 @@ def test_cpu_and_tpu_apps_emit_identical_records(pattern):
         b"hello world\nthe quick brown fox\nhallo again\nHELLO up\n"
         b"the end\nno match here\nfox hello the"
     )
-    assert cpu.map_fn("f.txt", data) == tpu.map_fn("f.txt", data)
+    assert expand_records(cpu.map_fn("f.txt", data)) == \
+        expand_records(tpu.map_fn("f.txt", data))
 
 
 def test_tpu_app_case_insensitive():
     cpu = load_application("distributed_grep_tpu.apps.grep", pattern="hello", ignore_case=True)
     tpu = load_application("distributed_grep_tpu.apps.grep_tpu", pattern="hello", ignore_case=True)
     data = b"HELLO\nx\nHeLLo there\n"
-    assert cpu.map_fn("f", data) == tpu.map_fn("f", data)
+    assert expand_records(cpu.map_fn("f", data)) == \
+        expand_records(tpu.map_fn("f", data))
 
 
 def test_tpu_app_multi_pattern_set():
@@ -34,7 +37,7 @@ def test_tpu_app_multi_pattern_set():
         "distributed_grep_tpu.apps.grep_tpu", patterns=["fox", "hello"]
     )
     data = b"a fox\nnothing\nhello\n"
-    keys = [kv.key for kv in tpu.map_fn("f", data)]
+    keys = [kv.key for kv in expand_records(tpu.map_fn("f", data))]
     assert keys == ["f (line number #1)", "f (line number #3)"]
 
 

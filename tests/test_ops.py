@@ -469,8 +469,10 @@ def test_engine_dfa_only_pattern_routes_native(monkeypatch):
 def test_grep_tpu_app_devices_all():
     from distributed_grep_tpu.apps import grep_tpu
 
+    from tests.conftest import expand_records
+
     grep_tpu.configure(pattern="needle", devices="all")
-    out = grep_tpu.map_fn("f", b"a needle\nnothing\n")
+    out = expand_records(grep_tpu.map_fn("f", b"a needle\nnothing\n"))
     assert [kv.key for kv in out] == ["f (line number #1)"]
 
 
@@ -512,13 +514,16 @@ def test_grep_tpu_map_path_fn_matches_map_fn(tmp_path):
     p = tmp_path / "doc.txt"
     p.write_bytes(data)
     grep_tpu.configure(pattern="needle", segment_bytes=4096, target_lanes=16)
-    want = grep_tpu.map_fn(str(p), data)
-    got = grep_tpu.map_path_fn(str(p), str(p))
+    from tests.conftest import expand_records
+
+    want = expand_records(grep_tpu.map_fn(str(p), data))
+    got = expand_records(grep_tpu.map_path_fn(str(p), str(p)))
     assert got == want
     # invert falls back to whole-bytes and still agrees
     grep_tpu.configure(pattern="needle", invert=True, segment_bytes=4096,
                        target_lanes=16)
-    assert grep_tpu.map_path_fn(str(p), str(p)) == grep_tpu.map_fn(str(p), data)
+    assert expand_records(grep_tpu.map_path_fn(str(p), str(p))) == \
+        expand_records(grep_tpu.map_fn(str(p), data))
 
 
 def test_scan_re_no_phantom_trailing_line():

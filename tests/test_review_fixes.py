@@ -69,11 +69,13 @@ def test_read_chunks_no_carry_only_tail(tmp_path):
 
 def test_app_instances_are_isolated():
     """Two loads of the same app module must not share pattern state."""
+    from tests.conftest import expand_records
+
     a = load_application("distributed_grep_tpu.apps.grep", pattern="aaa")
     b = load_application("distributed_grep_tpu.apps.grep", pattern="bbb")
-    assert len(a.map_fn("f", b"aaa\nbbb\n")) == 1
-    assert a.map_fn("f", b"aaa\nbbb\n")[0].key.endswith("#1)")
-    assert b.map_fn("f", b"aaa\nbbb\n")[0].key.endswith("#2)")
+    assert len(expand_records(a.map_fn("f", b"aaa\nbbb\n"))) == 1
+    assert expand_records(a.map_fn("f", b"aaa\nbbb\n"))[0].key.endswith("#1)")
+    assert expand_records(b.map_fn("f", b"aaa\nbbb\n"))[0].key.endswith("#2)")
 
 
 def test_concurrent_jobs_different_patterns(tmp_path, corpus):
