@@ -167,86 +167,6 @@ def _report_device_sick() -> None:
         _device_probe_state.update(verdict=False, at=_time_mod.monotonic())
 
 
-class _DeviceStall(TimeoutError):
-    """Raised when a collect/feed wait exceeds DEVICE_STALL_S — a DISTINCT
-    type so the recovery handler cannot confuse the wall with a transient
-    transport timeout surfacing from inside a device call (socket.timeout
-    is an alias of builtin TimeoutError since 3.10; those must keep the
-    ordinary kernel-retry chain, not a permanent device demotion)."""
-
-
-def _await_wall(fut):
-    """fut.result() bounded by the stall wall; converts the futures
-    timeout (its own type on 3.10, the builtin alias on 3.11+) into
-    _DeviceStall so the except net can identify the wall precisely."""
-    from concurrent.futures import TimeoutError as _FutTimeout
-
-    try:
-        return fut.result(timeout=DEVICE_STALL_S)
-    except (_FutTimeout, TimeoutError) as e:
-        raise _DeviceStall(
-            f"no collect/feed progress within {DEVICE_STALL_S:.0f}s"
-        ) from e
-
-
-class _DaemonPool:
-    """Minimal executor whose workers are DAEMON threads.
-
-    The stdlib ThreadPoolExecutor's workers are non-daemon (Py>=3.9) and
-    joined by threading._shutdown at interpreter exit, so ONE worker
-    blocked forever inside a dead device transport would hang process
-    shutdown — verified empirically; no registry surgery avoids that
-    join.  Daemon workers simply die with the process.  API subset used
-    by _scan_device: submit() -> concurrent.futures.Future, and
-    shutdown(wait=, cancel_futures=)."""
-
-    def __init__(self, max_workers: int, thread_name_prefix: str):
-        import queue as _q
-
-        self._q: _q.SimpleQueue = _q.SimpleQueue()
-        self._futs: list = []  # for cancel_futures
-        self._threads = [
-            _threading_mod.Thread(
-                target=self._worker, daemon=True,
-                name=f"{thread_name_prefix}-{i}",
-            )
-            for i in range(max_workers)
-        ]
-        for t in self._threads:
-            t.start()
-
-    def _worker(self) -> None:
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            fut, fn, args = item
-            if not fut.set_running_or_notify_cancel():
-                continue
-            try:
-                fut.set_result(fn(*args))
-            except BaseException as e:  # noqa: BLE001 — future carries it
-                fut.set_exception(e)
-
-    def submit(self, fn, *args):
-        from concurrent.futures import Future
-
-        fut = Future()
-        self._futs.append(fut)
-        self._q.put((fut, fn, args))
-        return fut
-
-    def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
-        if cancel_futures:
-            for f in self._futs:
-                f.cancel()
-        for _ in self._threads:
-            self._q.put(None)
-        if wait:
-            for t in self._threads:
-                t.join()
-
-
 def _probe_device_blocking() -> bool:
     """Time-boxed DEEP device probe on an abandoned daemon thread: backend
     init (`jax.devices()` — the call that hangs on a cold wedge) plus one
@@ -811,133 +731,23 @@ class GrepEngine:
             pricing = _replace(pricing, n_chips=n_chips)
         return pricing
 
+    # ---------------------------------------------- FDR self-calibration
+    # (implementation in ops/device_scan.py — split out round 5; thin
+    # delegates keep the engine surface and test hooks unchanged)
     def _calibrate_fdr_confirm(self) -> None:
-        """Init-time probe: measure this host's single-thread ConfirmSet
-        cost on synthetic candidates; if it is >4x off the priced constant
-        (either way), recompile the filter plan under measured pricing.
-        Random-offset probes under-read the FDR-candidate bias ~2x, hence
-        the wide gate — the post-scan retune handles fine constants."""
-        from dataclasses import replace as _replace
+        from distributed_grep_tpu.ops.device_scan import calibrate_fdr_confirm
 
-        from distributed_grep_tpu.models.fdr import probe_confirm_ps
-
-        self._fdr_pricing = self._fdr_base_pricing()
-        self._fdr_retuned = False
-        if _os.environ.get("DGREP_NO_CALIBRATE"):
-            return
-        measured = probe_confirm_ps(self._fdr_confirm)
-        self.calibration = {"confirm_probe_ps": measured}
-        ratio = measured / self._fdr_pricing.confirm_ps_per_candidate
-        if 0.25 <= ratio <= 4.0:
-            return
-        pricing = _replace(
-            self._fdr_pricing, confirm_ps_per_candidate=measured
-        )
-        self._swap_fdr_plan(pricing, reason=(
-            f"confirm probe {measured:.0f} ps/candidate vs priced "
-            f"{self._fdr_pricing.confirm_ps_per_candidate:.0f}"
-        ))
+        calibrate_fdr_confirm(self)
 
     def _swap_fdr_plan(self, pricing, reason: str) -> None:
-        """Recompile the FDR model under `pricing`; adopt it if the check
-        plan actually changed (device tables re-upload lazily)."""
-        try:
-            model = compile_fdr(
-                self._fdr_pats, ignore_case=self.ignore_case, pricing=pricing
-            )
-        except FdrError as e:
-            # real pricing says the set is not worth filtering at all:
-            # same routing as the compile-time rejection
-            self._route_native(
-                f"FDR retune ({reason}): set not filterable under "
-                f"measured pricing ({e})"
-            )
-            self._fdr_pricing = pricing
-            return
-        old = [(b.m, b.checks) for b in self.fdr.banks]
-        new = [(b.m, b.checks) for b in model.banks]
-        if old != new:
-            log.info(
-                "FDR plan retuned (%s): %s gathers -> %s",
-                reason,
-                sum(b.total_gathers for b in self.fdr.banks),
-                sum(b.total_gathers for b in model.banks),
-            )
-            self.fdr = model
-            self._fdr_dev_tables = None
-            self._fdr_ep_dev_tables = None
-            self._model_gen += 1  # new plan = new kernel compile: re-grace
-        self._fdr_pricing = pricing
+        from distributed_grep_tpu.ops.device_scan import swap_fdr_plan
+
+        swap_fdr_plan(self, pricing, reason)
 
     def _maybe_retune_fdr(self, n_bytes: int) -> None:
-        """Self-calibration stage 2: after a scan with enough evidence,
-        replace the assumed fp bias and confirm cost with the MEASURED
-        values from engine.stats (real candidates, real confirm wall) and
-        retune the plan if the constants were >2.5x off.  Runs at most once
-        per engine; the measured constants subsume OVERLAP_RESIDUE's role
-        for plan choice (both legs are observed, not modeled)."""
-        from dataclasses import replace as _replace
+        from distributed_grep_tpu.ops.device_scan import maybe_retune_fdr
 
-        if (
-            self.mode != "fdr"
-            or self._fdr_retuned
-            or _os.environ.get("DGREP_NO_CALIBRATE")
-            # mixed sets OR the pairset kernel's EXACT 1-byte matches into
-            # the candidate words, so stats["candidates"] no longer
-            # measures the FDR filter's false-positive rate — a frequent
-            # short member would read as a massively blown bias and swap
-            # in a garbage plan.  The init probe and chip-aware pricing
-            # still calibrate these engines; only the stats-based stage-2
-            # retune is disabled.
-            or self._fdr_pairset is not None
-        ):
-            return
-        cands = self.stats.get("candidates", 0)
-        conf_s = self.stats.get("confirm_seconds", 0.0)
-        if cands < 10_000 or n_bytes < (1 << 23) or conf_s <= 0.0:
-            return  # not enough evidence for stable constants
-        self._fdr_retuned = True
-        measured_bias = (cands / n_bytes) / max(self.fdr.fp_per_byte, 1e-12)
-        # confirm_seconds is wall through the ACTUAL thread fan of this
-        # host (min(8, cpu)); convert to the single-thread constant, keep
-        # pricing against the DECLARED deployment thread count.  The
-        # memory-bound confirm scales sublinearly with threads, so ideal
-        # x actual_threads would overestimate the single-thread cost and
-        # bias the retune toward extra device gathers — measure the real
-        # speedup with a second ConfirmSet probe at the actual fan and use
-        # probe_1t/probe_Nt (== measured speedup <= N) as the factor.
-        actual_threads = min(8, _os.cpu_count() or 1)
-        speedup = float(actual_threads)
-        probe_1t = getattr(self, "calibration", {}).get("confirm_probe_ps")
-        if actual_threads > 1 and probe_1t and self._fdr_confirm is not None:
-            from distributed_grep_tpu.models.fdr import probe_confirm_ps
-
-            probe_nt = probe_confirm_ps(
-                self._fdr_confirm, n_threads=actual_threads
-            )
-            if probe_nt > 0:
-                speedup = min(speedup, max(1.0, probe_1t / probe_nt))
-        measured_ps = conf_s / cands * 1e12 * speedup
-        pr = self._fdr_pricing
-        bias_off = measured_bias / pr.fp_bias
-        ps_off = measured_ps / pr.confirm_ps_per_candidate
-        self.calibration = {
-            **getattr(self, "calibration", {}),
-            "measured_fp_bias": measured_bias,
-            "measured_confirm_ps": measured_ps,
-        }
-        if 0.4 <= bias_off <= 2.5 and 0.4 <= ps_off <= 2.5:
-            return  # priced within tolerance: keep the plan
-        pricing = _replace(
-            pr,
-            fp_bias=max(measured_bias, 0.5),
-            confirm_ps_per_candidate=measured_ps,
-        )
-        self._swap_fdr_plan(pricing, reason=(
-            f"measured bias {measured_bias:.2f} (priced {pr.fp_bias:.2f}), "
-            f"confirm {measured_ps:.0f} ps (priced "
-            f"{pr.confirm_ps_per_candidate:.0f})"
-        ))
+        maybe_retune_fdr(self, n_bytes)
 
     # ------------------------------------------------------------------ scan
     @property
@@ -1478,765 +1288,11 @@ class GrepEngine:
 
     # --------------------------------------------------------- device engine
     def _scan_device(self, data: bytes, progress=None) -> ScanResult:
-        import time as _time
+        """Per-segment device dispatch (ops/device_scan.py — split out
+        round 5; the orchestration is the engine's, moved)."""
+        from distributed_grep_tpu.ops.device_scan import scan_device
 
-        t_wall0 = _time.perf_counter()
-        self.stats = {"candidates": 0, "confirm_seconds": 0.0, "end_offsets": 0}
-        # the ONE dict for this scan: collect()/prepare() run in pool
-        # threads, where `self.stats` would resolve to the POOL thread's
-        # slot — references below go through this capture (except after a
-        # fallback RESCAN, which replaces the thread's dict and makes this
-        # capture stale)
-        st = self.stats
-        # Grace capability probed ONCE from the callback's signature: a
-        # live `except TypeError` around progress(grace_s=...) would also
-        # swallow a TypeError raised INSIDE the callback body, silently
-        # converting an internal callback bug into a plain stamp and
-        # losing the compile-grace declaration (round-4 ADVICE).
-        supports_grace = progress is not None and _accepts_grace_kwarg(progress)
-        nl = lines_mod.newline_index(data)
-        self._nl_local.stash = (len(data), nl)  # reused by scan()'s EOL leg
-        device_lines: set[int] = set()
-        boundaries: list[int] = []
-        seg = self.segment_bytes
-        # jax-importing modules stay out of the cpu/native path: a plain
-        # `--backend cpu` grep never pays the ~0.8 s jax import
-        from distributed_grep_tpu.ops import layout as layout_mod
-        from distributed_grep_tpu.ops import scan_jnp
-        from distributed_grep_tpu.ops import sparse as sparse_mod
-        from distributed_grep_tpu.ops import (
-            pallas_approx,
-            pallas_fdr,
-            pallas_nfa,
-            pallas_scan,
-        )
-
-        # `_interpret` forces the Pallas kernels through interpret mode so
-        # the CI mesh (8 virtual CPU devices) exercises the production
-        # kernel path — the same gates a real TPU run takes.  The flag is
-        # passed to every kernel call below (None = wrapper auto-detect).
-        pallas_ok = self._kernel_backend_ok()
-        interp_flag = True if self._interpret else None
-        use_pallas_sa = (
-            self.mode == "shift_and"
-            and pallas_ok
-            and pallas_scan.eligible(self.shift_and)
-        )
-        # NFA mode without a real TPU (or over budget) falls back to the XLA
-        # DFA path — same tables, interpreter-free.
-        use_pallas_nfa = (
-            self.mode == "nfa"
-            and pallas_ok
-            and pallas_nfa.eligible(self.glushkov)
-        )
-        # FDR filter path: candidates on device, exact per-offset confirm on
-        # host (ConfirmSet probe inside collect, overlapped with the next
-        # segment's device scan); without a TPU (or after a kernel failure)
-        # the same engine falls back to the exact DFA banks below.
-        use_fdr = (
-            self.mode == "fdr" and not self._fdr_broken and pallas_ok
-        )
-        use_pallas_approx = (
-            self.mode == "approx"
-            and pallas_ok
-            and pallas_approx.eligible(self.approx)
-        )
-        # Exact short-set pair kernel: match words straight off the device
-        # (kind "words", no confirm) — scan() already routed to the native
-        # host path when no kernel backend exists.
-        use_pairset = self.mode == "pairset" and pallas_ok
-        if use_pairset or self._fdr_pairset is not None:
-            from distributed_grep_tpu.ops import pallas_pairset
-        use_pallas = (
-            use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
-            or use_pairset
-        )
-        # Scan-local rare-class filter state: the dense-candidate guard in
-        # collect() drops it for the REST OF THIS SCAN only (a dense corpus
-        # says nothing about the next file this engine greps).
-        sa_filtered = self._sa_filtered
-
-        # Segments round-robin across local chips (the worker drives every
-        # chip on its host, SURVEY.md §7 step 5).  Dispatch is async — the
-        # dense result plane stays on its device and the O(matches) sparse
-        # fetch happens in a second phase, so device i+1 scans while device
-        # i's results drain; MAX_INFLIGHT bounds resident result planes.
-        import jax
-        from contextlib import nullcontext
-
-        if self.devices == "all":
-            try:
-                devs: list = list(jax.local_devices())
-            except Exception:  # noqa: BLE001 — no backend: default placement
-                devs = [None]
-        elif self.devices:
-            devs = list(self.devices)  # type: ignore[arg-type]
-        else:
-            devs = [None]
-        max_inflight = 2 * len(devs)
-
-        # Mesh mode: each segment's lanes shard over the mesh and the SAME
-        # Pallas kernels run per device under shard_map (the multi-chip
-        # fast path — parallel/sharded_kernels).  The psum'd candidate
-        # count is kept per segment as the collective cross-check.
-        use_mesh = self.mesh is not None and (
-            use_pallas_sa or use_pallas_nfa or use_fdr or use_pallas_approx
-            or use_pairset
-        )
-        if self.mesh is not None and not use_mesh:
-            log.warning(
-                "mesh requested but mode %r has no sharded kernel "
-                "(pallas_ok=%s) — scanning on the default device",
-                self.mode, pallas_ok,
-            )
-        if use_mesh:
-            from distributed_grep_tpu.parallel import sharded_kernels as shk
-
-            mesh_mult = shk.mesh_lane_multiple(self.mesh, self.mesh_axis)
-            psum_totals: list = []
-        ep_axis = self.pattern_axis
-        if use_mesh and use_fdr and ep_axis is not None:
-            from distributed_grep_tpu.ops import pallas_fdr as _pfdr
-
-            if len({(b.m, _pfdr.kernel_plan(b)) for b in self.fdr.banks}) != 1:
-                log.info(
-                    "mixed-plan FDR banks: pattern-parallel sharding "
-                    "unavailable — lanes shard over the full mesh instead"
-                )
-                ep_axis = None
-
-        # Scan-local NFA model state: the defeat guard below may swap the
-        # relaxed filter for the exact automaton mid-scan (this scan only).
-        nfa_model = self.glushkov
-        nfa_is_filter = self._nfa_filter
-
-        # Collects run on a small pool so confirms from different devices'
-        # segments overlap each other AND the dispatch loop (VERDICT r3
-        # item 1: with devices="all" the scan leg scales xN chips while a
-        # dispatch-thread confirm stream doesn't).  Shared state below
-        # (device_lines, stats, the mid-scan defeat guards) mutates under
-        # one lock; the heavy legs — ConfirmSet probes, per-line matchers,
-        # the native dense rescan — run outside it.
-        import threading
-
-        state_lock = threading.Lock()
-        confirm_active = [0]  # live confirm legs; peak recorded in stats
-
-        def _confirm_enter() -> None:
-            with state_lock:
-                confirm_active[0] += 1
-                if confirm_active[0] > st.get("confirm_concurrency_peak", 0):
-                    st["confirm_concurrency_peak"] = confirm_active[0]
-
-        def _confirm_exit() -> None:
-            with state_lock:
-                confirm_active[0] -= 1
-
-        def confirm_lines(cand) -> None:
-            """Per-line host confirm for a sparse candidate-line set (the
-            shared tail of the span/cand filter paths)."""
-            good = []
-            for ln in cand:
-                start, end = lines_mod.line_span(nl, ln, len(data))
-                if self._host_line_matcher(data[start:end]):
-                    good.append(ln)
-            with state_lock:
-                device_lines.update(good)
-
-        def dense_native_confirm(seg_start: int, seg_len: int) -> int:
-            """Candidate-dense segment: one native DFA pass (C, ~GB/s)
-            resolves every line vectorized instead of per-line Python
-            confirm.  Returns the number of true matched lines found."""
-            from distributed_grep_tpu.utils.native import dfa_scan_mt
-
-            t = self.table
-            seg_bytes_ = data[seg_start : seg_start + seg_len]
-            offs = dfa_scan_mt(
-                seg_bytes_, t.full_table(), t.accept, t.start,
-            ).astype(np.int64)
-            if t.accept_eol.any():
-                # '$' accepts (the round-5 device-filter patterns): second
-                # pass with accept_eol as the accept set, kept only where
-                # the next byte IN THE FULL DOCUMENT is '\n' or EOF (a
-                # segment-final offset is not EOL unless it ends the data).
-                eol = dfa_scan_mt(
-                    seg_bytes_, t.full_table(),
-                    t.accept_eol.astype(np.uint8), t.start,
-                ).astype(np.int64)
-                if eol.size:
-                    g = eol + seg_start
-                    arr = np.frombuffer(data, dtype=np.uint8)
-                    keep = (g == len(data)) | (
-                        arr[np.minimum(g, len(data) - 1)] == 10
-                    )
-                    offs = np.concatenate([offs, eol[keep]])
-            if not offs.size:
-                return 0
-            uniq = np.unique(
-                lines_mod.line_of_offsets(offs + seg_start, nl)
-            )
-            with state_lock:
-                device_lines.update(uniq.tolist())
-            return int(uniq.size)
-
-        def collect(job) -> None:
-            with trace_mod.annotate(f"collect:{job[0]}@{job[3]}"):
-                return _collect(job)
-
-        def _collect(job) -> None:
-            sparse_kind, payload, lay, seg_start, seg_len, dev = job
-            # Fetch under the job's device context so the decode runs where
-            # the plane lives instead of copying it to the default device.
-            ctx = jax.default_device(dev) if dev is not None else nullcontext()
-            with ctx:
-                if sparse_kind == "span_words":
-                    # Coarse shift-and: nonzero words name 32-byte spans
-                    # that contain >= 1 candidate match end (exact at span
-                    # granularity for the full model; a superset when the
-                    # rare-class filter ran).  Map spans to their
-                    # overlapping lines, confirm each line once on host —
-                    # overlapped with the next segment's device scan.
-                    idx, _ = scan_jnp.sparse_nonzero(payload)
-                    starts = sparse_mod.span_starts_from_sparse_words(idx, lay)
-                    if starts.size:
-                        g0 = starts + seg_start  # global span starts
-                        g1 = np.minimum(g0 + 32, len(data))
-                        l0 = lines_mod.line_of_offsets(g0 + 1, nl)
-                        l1 = lines_mod.line_of_offsets(g1, nl)
-                        cand = set()
-                        for a, b in zip(l0.tolist(), l1.tolist()):
-                            cand.update(range(a, b + 1))
-                        with state_lock:
-                            cand -= device_lines  # already confirmed earlier
-                            st["candidates"] += len(cand)
-                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT:
-                            _confirm_enter()
-                            try:
-                                true_lines = dense_native_confirm(seg_start, seg_len)
-                            finally:
-                                _confirm_exit()
-                            nonlocal sa_filtered
-                            if sa_filtered is not None and true_lines * 4 < len(cand):
-                                # mostly-false candidates: the corpus defeats
-                                # the filter's byte prior — remaining segments
-                                # of THIS scan run the full compare set.  (A
-                                # dense corpus of TRUE matches keeps the
-                                # filter: the DFA fallback was inevitable
-                                # either way.)
-                                log.info(
-                                    "rare-class filter mostly false on this "
-                                    "corpus (%d candidate lines, %d true) -> "
-                                    "full model for this scan",
-                                    len(cand), true_lines,
-                                )
-                                with state_lock:
-                                    sa_filtered = None
-                        else:
-                            _confirm_enter()
-                            try:
-                                confirm_lines(cand)
-                            finally:
-                                _confirm_exit()
-                    return
-                if sparse_kind == "cand_words":
-                    # NFA filter path (models/nfa.compile_scan_model): the
-                    # device offsets are a candidate SUPERSET (bounded
-                    # repeats relaxed to save state words); confirm each
-                    # candidate line on host — overlapped with the next
-                    # segment's device scan.
-                    idx, vals = scan_jnp.sparse_nonzero(payload)
-                    offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-                    with state_lock:
-                        st["candidates"] += int(offsets.size)
-                    if offsets.size:
-                        t0 = _time.perf_counter()
-                        glines = lines_mod.line_of_offsets(offsets + seg_start, nl)
-                        cand = set(np.unique(glines).tolist())
-                        with state_lock:
-                            cand -= device_lines
-                        if len(cand) > SPAN_CONFIRM_LINE_LIMIT and \
-                                self.table is not None:
-                            _confirm_enter()
-                            try:
-                                true_lines = dense_native_confirm(seg_start, seg_len)
-                            finally:
-                                _confirm_exit()
-                            nonlocal nfa_model, nfa_is_filter
-                            if (
-                                nfa_is_filter
-                                and true_lines * 4 < len(cand)
-                                and self.glushkov_exact is not None
-                                and pallas_nfa.eligible(self.glushkov_exact)
-                            ):
-                                # mostly-false candidates: this corpus defeats
-                                # the relaxed filter — remaining segments of
-                                # THIS scan run the exact automaton.  (With
-                                # no eligible exact model, filter + native
-                                # rescan stays the best device plan: the XLA
-                                # DFA fallback is ~10x slower than even a
-                                # full native rescan per segment.)
-                                log.info(
-                                    "relaxed NFA filter mostly false on this "
-                                    "corpus (%d candidate lines, %d true) -> "
-                                    "exact automaton for this scan",
-                                    len(cand), true_lines,
-                                )
-                                with state_lock:
-                                    nfa_model = self.glushkov_exact
-                                    nfa_is_filter = False
-                                    st["nfa_filter_defeated"] = True
-                        else:
-                            _confirm_enter()
-                            try:
-                                confirm_lines(cand)
-                            finally:
-                                _confirm_exit()
-                        with state_lock:
-                            st["confirm_seconds"] += _time.perf_counter() - t0
-                    return
-                if sparse_kind == "words":
-                    idx, vals = scan_jnp.sparse_nonzero(payload)
-                    offsets = sparse_mod.offsets_from_sparse_words(idx, vals, lay)
-                    if use_fdr:
-                        # Exact per-candidate confirm (suffix probe + memcmp)
-                        # against the WHOLE document, so a window reaching
-                        # back across the segment start still confirms; runs
-                        # here so it overlaps the next segment's device scan.
-                        t0 = _time.perf_counter()
-                        _confirm_enter()
-                        try:
-                            keep = self._fdr_confirm.confirm(
-                                data, offsets + seg_start
-                            )
-                        finally:
-                            _confirm_exit()
-                        with state_lock:
-                            st["confirm_seconds"] += (
-                                _time.perf_counter() - t0
-                            )
-                            st["candidates"] += int(offsets.size)
-                        offsets = offsets[keep]
-                elif sparse_kind == "lane_bytes":
-                    idx, vals = scan_jnp.sparse_nonzero(payload)
-                    offsets = sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
-                else:  # "bank_list": one packed plane per DFA bank
-                    per_bank = []
-                    for packed in payload:
-                        idx, vals = scan_jnp.sparse_nonzero(packed)
-                        per_bank.append(
-                            sparse_mod.offsets_from_sparse_lane_bytes(idx, vals, lay)
-                        )
-                    offsets = np.unique(np.concatenate(per_bank)) if per_bank else \
-                        np.zeros(0, dtype=np.int64)
-            with state_lock:
-                st["end_offsets"] += int(offsets.size)
-            if offsets.size:
-                # transient slice: jobs hold (start, len), not segment copies
-                seg_view = data[seg_start : seg_start + seg_len]
-                seg_nl = lines_mod.newline_index(seg_view)
-                seg_lines = np.unique(lines_mod.line_of_offsets(offsets, seg_nl))
-                base = int(np.searchsorted(nl, seg_start))  # lines before segment
-                with state_lock:
-                    device_lines.update((seg_lines + base).tolist())
-
-        # Double-buffered device feed (VERDICT r2 item 4): a one-slot
-        # prepare thread builds segment i+1's stripe layout (host pad +
-        # transpose copy) and enqueues its device upload while segment i's
-        # kernels dispatch and its results confirm — the upload rides the
-        # async transfer engine instead of serializing the dispatch loop.
-        # stats["feed_wait_seconds"] is the residual stall: ~0 when compute
-        # hides the feed, ~upload time when the scan is feed-bound.
-        from concurrent.futures import ThreadPoolExecutor
-
-        seg_starts = list(range(0, max(len(data), 1), seg))
-
-        from distributed_grep_tpu.utils import trace as trace_mod
-
-        def prepare(i: int, seg_start: int):
-            # feed leg: visible as its own row in the profiler timeline so
-            # the upload/compute overlap is inspectable (DGREP_TRACE_DIR)
-            with trace_mod.annotate(f"feed:seg{i}"):
-                return _prepare(i, seg_start)
-
-        def _prepare(i: int, seg_start: int):
-            seg_bytes = data[seg_start : seg_start + seg]
-            if use_pallas:
-                lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
-                lay = layout_mod.choose_layout(
-                    len(seg_bytes),
-                    target_lanes=max(self.target_lanes, lane_mult),
-                    min_chunk=512,
-                    lane_multiple=lane_mult,
-                    chunk_multiple=512,
-                    quantize_chunk=True,  # bound jit compiles over
-                    # arbitrarily-sized tails (full segments are unchanged)
-                )
-            else:
-                lay = layout_mod.choose_layout(
-                    len(seg_bytes), target_lanes=self.target_lanes,
-                    quantize_chunk=True,
-                )
-            arr = layout_mod.to_device_array(seg_bytes, lay)
-            dev = devs[i % len(devs)]
-            if use_mesh:
-                # the tile reshape/copy and the NamedSharding device_put
-                # need no kernel state — running them HERE (prepare thread)
-                # is what makes the double-buffer real in mesh mode: the
-                # sharded upload of segment i+1 rides the transfer engine
-                # while segment i's shard_map dispatch runs (round-3 advisor
-                # finding: doing this inside the dispatch loop kept the mesh
-                # path feed-serialized and under-reported feed_wait_seconds)
-                arr = shk.prepare_tiles(arr, self.mesh, self.mesh_axis)
-            else:
-                # enqueue the host->device copy now (async on real backends)
-                pctx = jax.default_device(dev) if dev is not None else nullcontext()
-                with pctx:
-                    import jax.numpy as jnp
-
-                    arr = jnp.asarray(arr)
-            return seg_bytes, lay, arr, dev
-
-        pool = (
-            _DaemonPool(1, thread_name_prefix="dgrep-feed")
-            if len(seg_starts) > 1 else None
-        )
-        # Collect pool (VERDICT r3 item 1): sparse decode + host confirm of
-        # finished segments runs here, so confirms from different devices'
-        # segments overlap each other and the dispatch loop instead of
-        # serializing on it.  Mesh mode has one sharded stream — two workers
-        # cover decode/confirm pipelining; round-robin mode sizes to the
-        # device fan.  Single-segment scans collect inline (nothing to
-        # overlap).
-        from collections import deque as _deque
-
-        n_collect = 2 if use_mesh else min(4, max(1, len(devs)))
-        collect_pool = (
-            _DaemonPool(n_collect, thread_name_prefix="dgrep-collect")
-            if len(seg_starts) > 1 else None
-        )
-        collect_futs: _deque = _deque()
-        st["feed_wait_seconds"] = 0.0
-        nxt = prepare(0, seg_starts[0]) if seg_starts else None
-        try:
-            for i, seg_start in enumerate(seg_starts):
-                seg_bytes, lay, arr, dev = nxt
-                nxt_future = (
-                    pool.submit(prepare, i + 1, seg_starts[i + 1])
-                    if i + 1 < len(seg_starts) else None
-                )
-                if seg_start > 0:
-                    boundaries.append(seg_start)
-                # Every kernel below jit-specializes on the padded layout
-                # shape (+ the plan constants, _model_gen): a key this
-                # engine has not completed a dispatch for may block on a
-                # fresh ~20-40 s compile with no observable progress, so
-                # declare a grace window first.  Marked done only AFTER the
-                # kernel call returns — a concurrent scan blocked on the
-                # same compile still declares its own grace.  (The mid-scan
-                # defeat guards swap models without bumping _model_gen;
-                # their rare recompile risks one spurious sweep, accepted.)
-                compile_key = (
-                    self.mode, use_mesh, self._model_gen,
-                    getattr(arr, "shape", None),
-                )
-                if progress is not None and compile_key not in self._compiled_keys:
-                    if supports_grace:
-                        progress(grace_s=COMPILE_GRACE_S)
-                    else:  # legacy callbacks without the grace kwarg
-                        progress()
-                ctx = jax.default_device(dev) if dev is not None else nullcontext()
-                # Dispatch the device scan; the sparse fetch (a 4-byte count
-                # round-trip plus O(matches) coordinates — never the dense
-                # packed plane) happens in collect().
-                with ctx:
-                    if use_fdr:
-                        if use_mesh and ep_axis is not None:
-                            # EP: same-plan banks shard their tables over
-                            # pattern_axis, lanes over mesh_axis
-                            words, pt = shk.sharded_fdr_pattern_step(
-                                arr, self.fdr, self.mesh,
-                                data_axis=self.mesh_axis,
-                                pattern_axis=ep_axis,
-                                interpret=interp_flag,
-                                fold_case=self.ignore_case,
-                                tabs_dev=self._fdr_ep_tables(ep_axis),
-                            )
-                            psum_totals.append(pt)
-                        elif use_mesh:
-                            words, pt = shk.sharded_fdr_words(
-                                arr, self.fdr, self.mesh, self.mesh_axis,
-                                interpret=interp_flag,
-                                dev_tables=self._fdr_device_tables(None),
-                                fold_case=self.ignore_case,
-                            )
-                            psum_totals.append(pt)
-                        else:
-                            words = None
-                            for bank, dev_tab in zip(
-                                self.fdr.banks, self._fdr_device_tables(dev)
-                            ):
-                                # A-Z folds on device (pallas_fdr fold_case)
-                                # instead of a host .lower() pass per segment
-                                w = pallas_fdr.fdr_scan_words(
-                                    arr, bank, dev_tables=dev_tab,
-                                    interpret=interp_flag,
-                                    fold_case=self.ignore_case,
-                                )
-                                words = w if words is None else words | w
-                        if self._fdr_pairset is not None:
-                            # a mixed set's 1-byte members: exact pairset
-                            # kernel on device, OR'd into the candidate
-                            # words (the ConfirmSet includes the short
-                            # members, so the union confirms exactly) —
-                            # replaces a ~0.2 s/segment host AC scan that
-                            # used to serialize this dispatch loop
-                            if use_mesh:
-                                pw, ppt = shk.sharded_pairset_words(
-                                    arr, self._fdr_pairset, self.mesh,
-                                    self.mesh_axis, interpret=interp_flag,
-                                    dev_tables=self._pairset_device_tables(None),
-                                )
-                                words = words | pw
-                                psum_totals.append(ppt)
-                            else:
-                                words = words | pallas_pairset.pairset_scan_words(
-                                    arr, self._fdr_pairset,
-                                    dev_tables=self._pairset_device_tables(dev),
-                                    interpret=interp_flag,
-                                )
-                        job = ("words", words, lay, seg_start, len(seg_bytes),
-                               dev)
-                    elif use_pallas:
-                        if use_pallas_sa:
-                            # coarse packing: a nonzero word = "a match ends
-                            # in this 32-byte span" (~2x kernel throughput);
-                            # the span's lines are confirmed in collect()
-                            if use_mesh:
-                                words, pt = shk.sharded_shift_and_words(
-                                    arr, sa_filtered or self.shift_and,
-                                    self.mesh, self.mesh_axis,
-                                    coarse=True, interpret=interp_flag,
-                                )
-                                psum_totals.append(pt)
-                            else:
-                                words = pallas_scan.shift_and_scan_words(
-                                    arr, sa_filtered or self.shift_and,
-                                    coarse=True, interpret=interp_flag,
-                                )
-                            kind = "span_words"
-                        elif use_pallas_approx:
-                            if use_mesh:
-                                words, pt = shk.sharded_approx_words(
-                                    arr, self.approx, self.mesh,
-                                    self.mesh_axis, interpret=interp_flag,
-                                )
-                                psum_totals.append(pt)
-                            else:
-                                words = pallas_approx.approx_scan_words(
-                                    arr, self.approx, interpret=interp_flag
-                                )
-                            kind = "words"
-                        elif use_pairset:
-                            if use_mesh:
-                                words, pt = shk.sharded_pairset_words(
-                                    arr, self.pairset, self.mesh,
-                                    self.mesh_axis, interpret=interp_flag,
-                                    dev_tables=self._pairset_device_tables(None),
-                                )
-                                psum_totals.append(pt)
-                            else:
-                                words = pallas_pairset.pairset_scan_words(
-                                    arr, self.pairset,
-                                    dev_tables=self._pairset_device_tables(dev),
-                                    interpret=interp_flag,
-                                )
-                            kind = "words"
-                        else:
-                            # snapshot model+kind together: the defeat guard
-                            # swaps them from a collect-pool thread, and a
-                            # torn read (filter model + kind "words") would
-                            # skip the confirm pass filter planes require
-                            with state_lock:
-                                nfa_now, nfa_filter_now = nfa_model, nfa_is_filter
-                            if use_mesh:
-                                words, pt = shk.sharded_nfa_words(
-                                    arr, nfa_now, self.mesh,
-                                    self.mesh_axis, interpret=interp_flag,
-                                )
-                                psum_totals.append(pt)
-                            else:
-                                words = pallas_nfa.nfa_scan_words(
-                                    arr, nfa_now, interpret=interp_flag
-                                )
-                            kind = "cand_words" if nfa_filter_now else "words"
-                        job = (kind, words, lay, seg_start, len(seg_bytes), dev)
-                    elif self.mode == "shift_and":
-                        packed = scan_jnp.shift_and_scan(arr, self.shift_and)
-                        job = ("lane_bytes", packed, lay, seg_start, len(seg_bytes),
-                               dev)
-                    elif self.mode == "approx":
-                        packed = scan_jnp.approx_scan(arr, self.approx)
-                        job = ("lane_bytes", packed, lay, seg_start, len(seg_bytes),
-                               dev)
-                    else:
-                        # One device pass per automaton bank; bytes AND bank
-                        # tables are uploaded once (tables are cached on the
-                        # engine — a near-full bank's table is ~67 MB,
-                        # re-uploading it per segment would swamp the link
-                        # the sparse fetch protects).
-                        import jax.numpy as jnp
-
-                        arr_dev = jnp.asarray(arr)
-                        planes = []
-                        for kind, bank in self._device_tables(dev):
-                            if kind == "stride":
-                                planes.append(scan_jnp._dfa_stride_core(arr_dev, *bank))
-                            else:
-                                planes.append(scan_jnp._dfa_scan_core(arr_dev, *bank))
-                        job = ("bank_list", planes, lay, seg_start, len(seg_bytes),
-                               dev)
-                self._compiled_keys.add(compile_key)
-                boundaries.extend((seg_start + lay.stripe_starts()).tolist())
-                if collect_pool is not None:
-                    collect_futs.append(collect_pool.submit(collect, job))
-                    if len(collect_futs) >= max_inflight:
-                        # bound resident result planes, like the old pending
-                        # list: wait out the oldest in-flight collect.
-                        # Time-boxed (DEVICE_STALL_S): a device that
-                        # black-holes mid-scan must degrade, not hang.
-                        _await_wall(collect_futs.popleft())
-                else:
-                    collect(job)
-                if progress is not None:
-                    progress()  # one milestone per dispatched segment
-                if nxt_future is not None:
-                    t0 = _time.perf_counter()
-                    nxt = _await_wall(nxt_future)
-                    st["feed_wait_seconds"] += _time.perf_counter() - t0
-            while collect_futs:
-                _await_wall(collect_futs.popleft())
-                if progress is not None:
-                    progress()
-        except Exception as e:
-            # Dispatch is async: a kernel can fail at execution time (first
-            # consumed in collect) as well as at compile time.  Mosaic
-            # limits are empirical — on an FDR device failure, flip to the
-            # exact DFA banks and rescan; everything else propagates.
-            # Host-side failures that cannot come from the Pallas/Mosaic
-            # layer must not be misattributed to it (and silently retried
-            # on the slower DFA path).  Only types jax internals never
-            # surface kernel failures as: AttributeError/KeyError/etc. DO
-            # occur inside jax on version skew, so they stay in the net.
-            if isinstance(e, (MemoryError, UnicodeError)):
-                raise
-            stalled = isinstance(e, _DeviceStall)  # the DEVICE_STALL_S wall
-            # (a transient socket.timeout from INSIDE a device call is a
-            # plain TimeoutError and keeps the ordinary retry chain)
-            if collect_pool is not None:
-                # running collects mutate st/device_lines — let them
-                # drain before any fallback rescan resets those under them
-                # (their un-awaited exceptions, if any, mirror this one).
-                # EXCEPT when the device stalled: the hung collect never
-                # returns, so waiting on it would hang this recovery too.
-                collect_pool.shutdown(wait=not stalled, cancel_futures=True)
-            if stalled:
-                host_scanner = self._host_scanner()
-                if host_scanner is not None:
-                    # Black-holed mid-scan (a healthy first touch, then the
-                    # transport died hanging instead of erroring): skip the
-                    # kernel-retry chain — the device is gone, not the
-                    # kernel — and degrade straight to the exact host
-                    # engines.  The hung pool threads are abandoned;
-                    # scrubbing them from the futures exit-join registry
-                    # keeps process shutdown from blocking on them.
-                    log.warning(
-                        "device execution stalled > %.0fs mid-scan (%s) -> "
-                        "exact host engines for this engine",
-                        DEVICE_STALL_S, e,
-                    )
-                    self._mark_device_broken()
-                    result = self._host_scan(host_scanner, data, progress)
-                    self.stats["device_fallback"] = True
-                    return result
-                # no host route: still mark the device dead so the next
-                # scan fails fast instead of re-paying the full wall
-                self._mark_device_broken()
-                raise
-            if not use_fdr:
-                if use_pallas and not self._pallas_broken:
-                    # same policy as the FDR net: a Mosaic/runtime kernel
-                    # failure flips this engine to its non-Pallas engine
-                    # (XLA scan / DFA banks / re) and rescans — exactness
-                    # is preserved, speed degrades loudly.
-                    log.warning(
-                        "pallas %s kernel failed (%s) -> non-Pallas fallback",
-                        self.mode, e,
-                    )
-                    self._pallas_broken = True
-                    return self.scan(data, progress=progress)
-                host_scanner = self._host_scanner()
-                if host_scanner is not None:
-                    # Every DEVICE route is exhausted (e.g. the device link
-                    # died mid-job — observed live when the tunneled chip's
-                    # transport dropped): an exact host engine exists, so
-                    # degrade to it for the rest of this engine's life
-                    # instead of crashing the map task.
-                    log.warning(
-                        "device scan failed with no device fallback left "
-                        "(%s) -> exact host engines for this engine", e,
-                    )
-                    # Recognizable transport failures (the fast
-                    # `Connection Failed` phase of a tunnel outage surfaces
-                    # here as XlaRuntimeError, not via the stall wall) keep
-                    # the demotion eligible for the DEVICE_RETRY_S
-                    # un-demote — a long-lived worker reclaims the device
-                    # when the tunnel heals (round-4 ADVICE).  A generic
-                    # exception may be a per-pattern defect on a healthy
-                    # device: permanent demotion, and do NOT poison the
-                    # process-wide probe verdict.
-                    self._mark_device_broken(
-                        transport_evidence=_is_transport_error(e)
-                    )
-                    result = self._host_scan(host_scanner, data, progress)
-                    self.stats["device_fallback"] = True
-                    return result
-                raise
-            log.warning("pallas FDR kernel failed (%s) -> DFA banks", e)
-            self._fdr_broken = True
-            from distributed_grep_tpu.utils.native import native_available
-
-            if native_available():
-                # same policy as the compile-time FDR rejection: the native
-                # MT scanner beats the XLA DFA-bank device path ~100x
-                self.mode = "native"
-                result = self._scan_native(data)
-            else:
-                result = self._scan_device(data, progress=progress)
-            # rescan stats only — the rescan REPLACED this thread's stats
-            # dict, so write through the property (scanning thread), not
-            # the pre-fallback `st` capture (now orphaned)
-            self.stats["fdr_fallback"] = True
-            return result
-        finally:
-            if pool is not None:
-                pool.shutdown(wait=False, cancel_futures=True)
-            if collect_pool is not None:
-                collect_pool.shutdown(wait=False, cancel_futures=True)
-
-        # FDR candidates were already confirmed offset-exactly in collect();
-        # boundary lines (stripe/segment heads, where the filter's all-ones
-        # seed under-reports) are restored by the stitching pass below.
-        stitched = lines_mod.stitch_lines(
-            device_lines, data, nl, boundaries, self._host_line_matcher
-        )
-        if use_mesh and psum_totals:
-            # ICI-collective candidate tally across all segments — the
-            # cross-check dryrun_multichip asserts against the host count.
-            st["psum_candidates"] = sum(int(t) for t in psum_totals)
-        st["scan_wall_seconds"] = _time.perf_counter() - t_wall0
-        self._maybe_retune_fdr(len(data))
-        lines_arr = np.asarray(sorted(stitched), dtype=np.int64)
-        return ScanResult(lines_arr, int(lines_arr.size), len(data))
+        return scan_device(self, data, progress=progress)
 
 def make_engine(
     pattern: str | None = None, patterns: list[str] | None = None, **kw

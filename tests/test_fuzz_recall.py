@@ -499,3 +499,58 @@ def test_fuzz_filter_superset_invariant(seed):
     ex = set(nfa_mod.scan_reference(exact, data).tolist())
     fi = set(nfa_mod.scan_reference(model, data).tolist())
     assert ex <= fi, f"seed={seed} pattern={pattern!r} missing {sorted(ex - fi)[:5]}"
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_fuzz_dollar_anchor_device_filter(seed):
+    """Round-5 family: '$'-anchored and over-cap patterns ride the device
+    NFA filter (compile_device_filter) with host-confirmed lines — fuzzed
+    vs the re oracle on both backends, with needles injected at line ENDS
+    (the position '$' actually tests) and as mid-line decoys."""
+    rng = np.random.default_rng(9000 + seed)
+    variant = seed % 5
+    if variant == 3:  # over-cap literal (prefix-truncated filter)
+        pattern = _gen_literal(rng, int(rng.integers(130, 240)))
+    elif variant == 4:  # over-cap literal + '$'
+        pattern = _gen_literal(rng, int(rng.integers(130, 200))) + "$"
+    else:
+        base = _gen_pattern(rng).rstrip("$").lstrip("^")
+        if not base:
+            base = _gen_literal(rng, 3)
+        pattern = {
+            0: lambda: f"(?:{base})$",
+            1: lambda: f"^(?:{base})$",
+            2: lambda: f"(?:{base})$|{_gen_literal(rng, 2)}",
+        }[variant]()
+    try:
+        rx = re.compile(pattern.encode("utf-8", "surrogateescape"))
+    except re.error:
+        pytest.skip("generator drew an invalid wrapper combination")
+    try:
+        # the anchor-stripped sampling pattern may be syntactically
+        # mangled (e.g. '\$' losing its '$') — sample opportunistically
+        needle = _sample_match(rng, pattern.replace("$", "").replace("^", "")
+                               if variant < 3 else pattern.rstrip("$"))
+    except re.error:
+        needle = None
+    data = _gen_corpus(rng, "words" if seed % 2 else "binary", 48 << 10, [])
+    if needle:
+        nd = needle.replace(b"\n", b"x")
+        # end-of-line injections (true '$' hits) + mid-line decoys
+        lines = data.split(b"\n")
+        for _ in range(4):
+            i = int(rng.integers(0, len(lines)))
+            lines[i] = lines[i] + nd
+        for _ in range(4):
+            i = int(rng.integers(0, len(lines)))
+            lines[i] = nd + b" trailing decoy"
+        data = b"\n".join(lines)
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == want, (
+            f"seed={seed} variant={variant} backend={backend} "
+            f"mode={eng.mode} pattern={pattern!r}: "
+            f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+        )

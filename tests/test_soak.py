@@ -150,7 +150,9 @@ def test_soak_rolling_window(tmp_path):
 
     split_bytes = 500 * 1000 * 1000
     n_splits = max(4, (ROLL_GB * 1_000_000_000) // split_bytes)
-    window = 16  # <= 8 GB of splits resident
+    # <= 8 GB of splits resident at full scale; small smoke runs shrink
+    # the window so the generator gate and the reaper actually engage
+    window = min(16, max(2, n_splits // 2))
     rng = np.random.default_rng(7)
 
     # One 500 MB random template; each split = copy + fresh needle patch
@@ -214,7 +216,9 @@ def test_soak_rolling_window(tmp_path):
                 state["stop"] = True
                 cv.notify_all()
 
-    journal_path = tmp_path / "job" / "journal.jsonl"
+    from distributed_grep_tpu.utils.io import WorkDir
+
+    journal_path = WorkDir(str(tmp_path / "job")).journal_path()
 
     def reap() -> None:
         """Delete splits whose map completion the journal has committed."""
