@@ -136,6 +136,54 @@ def configure(
     _configured_with = key
 
 
+_WORD_BYTES = None  # lazy [256] bool lookup: GNU word constituents
+
+
+def literal_mode_lines(
+    contents: bytes, lit: bytes, mode: str, nl=None
+):
+    """1-based line numbers ``grep -w`` / ``-x`` selects for a LITERAL
+    pattern — the vectorized replacement for the per-candidate-line regex
+    confirm (measured ~8 us/line over 663k dense candidates): one native
+    occurrence scan plus boundary-byte masks.  Semantically identical to
+    ``wrap_mode``'s lookarounds (which are differentially pinned against
+    GNU grep 3.8): -w keeps occurrences whose previous AND next bytes are
+    non-word (line/buffer edges count as non-word); -x keeps occurrences
+    spanning exactly line start to line end."""
+    import numpy as np
+
+    from distributed_grep_tpu.ops.lines import line_of_offsets, newline_index
+    from distributed_grep_tpu.utils.native import literal_scan
+
+    global _WORD_BYTES
+    if _WORD_BYTES is None:
+        t = np.zeros(256, dtype=bool)
+        # GNU word constituents in the C locale (_W): 0-9 A-Z a-z _
+        for lo, hi in ((48, 57), (65, 90), (97, 122)):
+            t[lo : hi + 1] = True
+        t[95] = True  # '_'
+        _WORD_BYTES = t
+    ends = literal_scan(contents, lit).astype(np.int64)
+    empty = np.zeros(0, dtype=np.int64)
+    if not ends.size:
+        return empty
+    n = len(contents)
+    arr = np.frombuffer(contents, dtype=np.uint8)
+    starts = ends - len(lit)
+    prev = np.where(starts > 0, arr[np.maximum(starts - 1, 0)], 0x0A)
+    nxt = np.where(ends < n, arr[np.minimum(ends, n - 1)], 0x0A)
+    if mode == "word":
+        ok = ~_WORD_BYTES[prev] & ~_WORD_BYTES[nxt]
+    else:  # "line": the occurrence IS the whole line
+        ok = (prev == 0x0A) & (nxt == 0x0A)
+    ends = ends[ok]
+    if not ends.size:
+        return empty
+    if nl is None:
+        nl = newline_index(contents)
+    return np.unique(line_of_offsets(ends, nl))
+
+
 def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _ac_tables is not None:
         matched = _ac_matched_lines(contents)

@@ -139,3 +139,58 @@ def test_inverted_index_through_runtime(tmp_path):
     res = run_job(cfg, n_workers=2)
     assert res.results["beta"] == f"2 {f1},{f2}"
     assert res.results["alpha"] == f"1 {f1}"
+
+
+def test_literal_mode_lines_matches_wrapped_regex():
+    """The vectorized -w/-x literal confirm (round 5) vs the wrap_mode
+    regex oracle, over boundary-adversarial corpora: BOF/EOF occurrences,
+    line-edge occurrences, overlapping occurrences, '_' constituents."""
+    import re
+
+    import numpy as np
+
+    from distributed_grep_tpu.apps.grep import literal_mode_lines, wrap_mode
+
+    cases = [
+        (b"the", b"the\nthe end\nxthe\nthe_y\na the b\n_the\nthe"),
+        (b"aa", b"aaa\naa\nb aa c\naaaa\n"),  # overlapping occurrences
+        (b"a-b", b"a-b\nxa-b\na-b y\nza-bw\n"),  # non-word pattern edges
+        (b"x", b"x"),  # single byte, no trailing newline
+        (b"t t", b"t t\na t t b\nt tt\n"),  # literal containing a space
+    ]
+    for lit, data in cases:
+        for mode in ("word", "line"):
+            rx = re.compile(wrap_mode(re.escape(lit), mode))
+            lines = data.split(b"\n")
+            if lines and lines[-1] == b"":
+                lines.pop()
+            want = sorted(
+                i for i, ln in enumerate(lines, 1) if rx.search(ln)
+            )
+            got = literal_mode_lines(data, lit, mode).tolist()
+            assert got == want, (lit, mode, got, want)
+
+
+def test_grep_tpu_literal_word_fast_path_engages():
+    """A case-sensitive single literal with -w must take the vectorized
+    confirm (and agree with the regex path's records exactly)."""
+    from distributed_grep_tpu.apps import grep_tpu
+    from tests.conftest import expand_records
+
+    data = b"the\nother\n a the b\nthe_x\nthe end\n"
+    grep_tpu.configure(pattern="the", word_regexp=True, backend="cpu")
+    from distributed_grep_tpu.utils.native import native_available
+
+    if native_available():
+        assert grep_tpu._confirm_lit == b"the"
+    fast = expand_records(grep_tpu.map_fn("f", data))
+    # force the regex path and compare; reset the configure memo so the
+    # override cannot leak into later tests via the key == memo early-out
+    grep_tpu._confirm_lit = None
+    grep_tpu._configured_with = None
+    slow = expand_records(grep_tpu.map_fn("f", data))
+    assert [(kv.key, kv.value) for kv in fast] == \
+        [(kv.key, kv.value) for kv in slow]
+    assert [kv.key for kv in fast] == [
+        "f (line number #1)", "f (line number #3)", "f (line number #5)"
+    ]
