@@ -588,10 +588,12 @@ def cmd_grep(args: argparse.Namespace) -> int:
     stream_counts = default_print and not need_sets and not count_only
     if need_sets:
         matched = {f: set() for f in cfg.input_files}
-        for key, _v in res.iter_results():
-            m = GREP_KEY_RE.match(key)
-            if m and m.group(1) in matched:
-                matched[m.group(1)].add(int(m.group(2)))
+        # bytes-parsed pre-pass (round 5): no regex / value decode per
+        # record — the -o/-b/context set building over match-dense output
+        for path, ln in res.iter_grep_keys():
+            s = matched.get(path)
+            if s is not None:
+                s.add(ln)
         if args.max_count is not None:
             # grep -m: keep only the first NUM selected lines per file
             matched = {f: set(sorted(ln)[: args.max_count])
@@ -875,35 +877,39 @@ def _print_with_context(path: str, lines_set: set[int], before: int,
         b = f" (byte #{off}){sep}" if byte_offset else ""
         return f"{head}(line number #{n}){sep}{b} "
 
+    # errors="replace" matches the default output mode exactly: map
+    # values are replace-decoded at emit time (apps/grep.py), so the
+    # same matched line must print identically under -C.  (Lone
+    # surrogates would also crash a strict-encoding stdout.)  Decode
+    # LAZILY — only lines actually printed pay it (round 5: the loop
+    # used to decode every line of the file).
+    def dec(raw: bytes) -> str:
+        return raw.rstrip(b"\n").decode("utf-8", "replace")
+
     pos = 0
     with open(path, "rb") as f:
         for n, raw in enumerate(f, 1):
             off = pos
             pos += len(raw)
-            # errors="replace" matches the default output mode exactly: map
-            # values are replace-decoded at emit time (apps/grep.py), so the
-            # same matched line must print identically under -C.  (Lone
-            # surrogates would also crash a strict-encoding stdout.)
-            line = raw.rstrip(b"\n").decode("utf-8", "replace")
             if n in lines_set:
                 if printed_any and (
                     last_printed == 0 or n - last_printed > len(prevq) + 1
                 ):
                     print("--")
-                for qn, qoff, qline in prevq:
+                for qn, qoff, qraw in prevq:
                     if qn > last_printed:
-                        print(f"{fmt(qn, qoff, ctx=True)}{qline}")
+                        print(f"{fmt(qn, qoff, ctx=True)}{dec(qraw)}")
                 prevq.clear()
-                print(f"{fmt(n, off, ctx=False)}{line}")
+                print(f"{fmt(n, off, ctx=False)}{dec(raw)}")
                 printed_any = True
                 last_printed = n
                 pending_after = after
             elif pending_after > 0:
-                print(f"{fmt(n, off, ctx=True)}{line}")
+                print(f"{fmt(n, off, ctx=True)}{dec(raw)}")
                 last_printed = n
                 pending_after -= 1
             elif before:
-                prevq.append((n, off, line))
+                prevq.append((n, off, raw))
     return printed_any
 
 

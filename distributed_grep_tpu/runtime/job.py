@@ -40,6 +40,23 @@ def grep_key_sort(item: tuple[str, str]):
     return (m.group(1), int(m.group(2))) if m else (item[0], 0)
 
 
+_GREP_KEY_MARKER = b" (line number #"
+
+
+def parse_grep_key_bytes(key: bytes) -> tuple[bytes, int] | None:
+    """(path_bytes, lineno) for a grep-shaped key, or None — the
+    byte-level twin of GREP_KEY_RE with EXACTLY its accept semantics
+    (isdigit: no sign/underscore/whitespace forms int() would take).
+    One definition shared by every bytes-mode output pass."""
+    i = key.rfind(_GREP_KEY_MARKER)
+    if i < 0 or not key.endswith(b")"):
+        return None
+    digits = key[len(_GREP_KEY_MARKER) + i : -1]
+    if not digits.isdigit():
+        return None
+    return key[:i], int(digits)
+
+
 @dataclass
 class JobResult:
     """Job outputs.  Results are backed by the workdir's mr-out-* files
@@ -150,6 +167,30 @@ class JobResult:
                 k, v = _json.loads(payload)
                 yield k, v
 
+    def iter_grep_keys(self):
+        """(path, lineno) per grep-shaped record, allocation-light: bytes
+        parse (no regex, no value decode) with the path string cached
+        across consecutive records of the same file — the -o/-b/context
+        modes' set-building pre-pass over match-dense output."""
+        last_raw: bytes | None = None
+        last_path: str | None = None
+        for out in self.output_files:
+            with open(out, "rb") as f:
+                for raw in f:
+                    line = raw.rstrip(b"\n")
+                    if not line:
+                        continue
+                    tab = line.find(b"\t")
+                    key = line[:tab] if tab >= 0 else line
+                    parsed = parse_grep_key_bytes(key)
+                    if parsed is None:
+                        continue  # not a grep-shaped key
+                    pb, ln = parsed
+                    if pb != last_raw:
+                        last_raw = pb
+                        last_path = pb.decode("utf-8", "surrogateescape")
+                    yield last_path, ln
+
     def iter_display_bytes_sorted(self):
         """Final display lines (``b"<key> <value>\\n"``) in (file, line)
         order — the match-dense CLI print path: bytes in, bytes out, one
@@ -164,8 +205,6 @@ class JobResult:
             raise RuntimeError(
                 "iter_display_bytes_sorted needs fileline_sorted outputs"
             )
-        marker = b" (line number #"
-
         def keyed(path):
             with open(path, "rb") as f:
                 for raw in f:
@@ -174,14 +213,8 @@ class JobResult:
                         continue
                     tab = line.find(b"\t")
                     key = line[:tab] if tab >= 0 else line
-                    i = key.rfind(marker)
-                    if i >= 0 and key.endswith(b")"):
-                        try:
-                            yield (key[:i], int(key[i + 15 : -1])), line
-                            continue
-                        except ValueError:
-                            pass
-                    yield (key, 0), line
+                    parsed = parse_grep_key_bytes(key)
+                    yield parsed if parsed is not None else (key, 0), line
 
         for _, line in heapq.merge(*(keyed(p) for p in self.output_files)):
             yield line.replace(b"\t", b" ", 1) + b"\n"
