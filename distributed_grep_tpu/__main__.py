@@ -811,10 +811,33 @@ def _print_only_matching(res, args, patterns, matched, offsets=None,
     # lines are selected — wrap before finditer.  With -b (offsets) the
     # match runs over the RAW LINE BYTES (exact offsets on any encoding);
     # otherwise over the display string.
+    # ONE matcher for every -o path: the BYTES regex (GNU's C-locale
+    # byte-wise semantics, incl. ASCII-only -i folding — the str-typed
+    # fallback previously Unicode-folded, so `-o -i` could select
+    # different substrings than `-o -i -m N` — round-5 review).
     wrapped = wrap_mode(base.encode("utf-8", "surrogateescape"), mode)
-    if offsets is not None:
-        rx_b = re.compile(wrapped, flags)
-    rx = re.compile(wrapped.decode("utf-8", "surrogateescape"), flags)
+    rx_b = re.compile(wrapped, flags)
+
+    if offsets is None and matched is None and res.fileline_sorted:
+        # plain -o over a grep-shaped job (round 5): merge the pre-sorted
+        # outputs as BYTES and finditer the raw line bytes — no str round
+        # trip per record
+        last_p: str | None = None
+        prefix_path = ""
+        for (p, ln), value_b in res.iter_grep_records_bytes():
+            if ln:
+                if p != last_p:
+                    last_p = p
+                    prefix_path = (
+                        "" if args.no_filename else f"{disp(p)} "
+                    )
+                prefix = f"{prefix_path}(line number #{ln}) "
+            else:
+                prefix = ""  # non-grep-shaped key: match the value alone
+            for hit in rx_b.finditer(value_b):
+                if hit.group(0):
+                    print(f"{prefix}{hit.group(0).decode('utf-8', 'replace')}")
+        return
 
     handles: dict[str, object] = {}  # -b: one open handle per path
     try:
@@ -844,9 +867,12 @@ def _print_only_matching(res, args, patterns, matched, offsets=None,
                         print(f"{prefix}(byte #{line_off + hit.start()}) "
                               f"{hit.group(0).decode('utf-8', 'replace')}")
                 continue
-            for hit in rx.finditer(value):
+            for hit in rx_b.finditer(
+                value.encode("utf-8", "surrogateescape")
+            ):
                 if hit.group(0):
-                    print(f"{prefix}{hit.group(0)}")
+                    print(f"{prefix}"
+                          f"{hit.group(0).decode('utf-8', 'replace')}")
     finally:
         for f in handles.values():
             f.close()

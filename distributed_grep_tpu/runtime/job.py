@@ -191,21 +191,26 @@ class JobResult:
                         last_path = pb.decode("utf-8", "surrogateescape")
                     yield last_path, ln
 
-    def iter_display_bytes_sorted(self):
-        """Final display lines (``b"<key> <value>\\n"``) in (file, line)
-        order — the match-dense CLI print path: bytes in, bytes out, one
-        allocation-light parse per record for the merge key (no regex, no
-        str decode/encode round trip — non-UTF8 filename bytes pass
-        through verbatim, like GNU grep's output).  Requires
-        ``fileline_sorted`` (the per-file streams must already be in
-        display order for the k-way merge to be exact)."""
+    def _iter_records_bytes_sorted(self):
+        """((path_str, lineno), line_bytes, tab_index) in display order —
+        the ONE bytes-mode record merge every fast output path builds on.
+        The merge key uses the DECODED path (cached across consecutive
+        records of a file, so the decode runs per file change, not per
+        record): the per-file streams were sorted by the collator under
+        grep_key_sort's STR ordering, and a bytes-keyed merge would
+        silently misorder exotic filenames where surrogateescape
+        codepoint order diverges from UTF-8 byte order (round-5 review).
+        Requires ``fileline_sorted``."""
         import heapq
 
         if not self.fileline_sorted:
             raise RuntimeError(
-                "iter_display_bytes_sorted needs fileline_sorted outputs"
+                "bytes-mode record streams need fileline_sorted outputs"
             )
+
         def keyed(path):
+            last_pb = None
+            last_p = None
             with open(path, "rb") as f:
                 for raw in f:
                     line = raw.rstrip(b"\n")
@@ -214,9 +219,34 @@ class JobResult:
                     tab = line.find(b"\t")
                     key = line[:tab] if tab >= 0 else line
                     parsed = parse_grep_key_bytes(key)
-                    yield parsed if parsed is not None else (key, 0), line
+                    if parsed is None:
+                        k = (key.decode("utf-8", "surrogateescape"), 0)
+                    else:
+                        pb, ln = parsed
+                        if pb != last_pb:
+                            last_pb = pb
+                            last_p = pb.decode("utf-8", "surrogateescape")
+                        k = (last_p, ln)
+                    yield k, line, tab
 
-        for _, line in heapq.merge(*(keyed(p) for p in self.output_files)):
+        return heapq.merge(
+            *(keyed(p) for p in self.output_files), key=lambda t: t[0]
+        )
+
+    def iter_grep_records_bytes(self):
+        """((path_str, lineno) — lineno 0 for non-grep-shaped keys —,
+        value_bytes) in display order: the -o mode's bytes stream (the
+        match regex then runs over the raw line bytes, GNU's C-locale
+        semantics for -i)."""
+        for k, line, tab in self._iter_records_bytes_sorted():
+            yield k, (line[tab + 1 :] if tab >= 0 else b"")
+
+    def iter_display_bytes_sorted(self):
+        """Final display lines (``b"<key> <value>\\n"``) in (file, line)
+        order — the match-dense CLI print path: bytes in, bytes out
+        (non-UTF8 filename bytes pass through verbatim, like GNU grep's
+        output)."""
+        for _k, line, _tab in self._iter_records_bytes_sorted():
             yield line.replace(b"\t", b" ", 1) + b"\n"
 
     def sorted_lines(self) -> list[str]:

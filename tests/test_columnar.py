@@ -288,3 +288,25 @@ def test_collator_used_only_for_identity_apps(tmp_path):
     res = run_job(cfg, n_workers=1)
     assert not res.fileline_sorted
     assert res.results["the"] == "3" and res.results["and"] == "2"
+
+
+def test_parse_grep_key_bytes_parity_with_regex():
+    """The bytes-mode key parser must accept EXACTLY what GREP_KEY_RE
+    accepts (round-5 review: int() alone would take '+5' / '1_0')."""
+    from distributed_grep_tpu.runtime.job import (
+        GREP_KEY_RE,
+        parse_grep_key_bytes,
+    )
+
+    cases = [
+        "f (line number #5)", "s (line number #+5)",
+        "u (line number #1_0)", "x (line number # 5)",
+        "y (line number #)", "no marker",
+        "a (line number #3) (line number #7)",
+        "t (line number #5) extra", "weird) (line number #9)",
+        " (line number #1)", "p (line number #007)",
+    ]
+    for k in cases:
+        m = GREP_KEY_RE.match(k)
+        want = (m.group(1).encode(), int(m.group(2))) if m else None
+        assert parse_grep_key_bytes(k.encode()) == want, k
