@@ -167,9 +167,9 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
     result = _engine.scan(contents, progress=_progress_fn())
-    emit = result.matched_lines.tolist()
+    emit = result.matched_lines  # int64 ndarray, stays vectorized throughout
     nl = None
-    if _confirm is not None and emit:
+    if _confirm is not None and emit.size:
         nl = newline_index(contents)
         if _confirm_lit is not None:
             # literal -w/-x: vectorized boundary confirm — the selected
@@ -180,24 +180,24 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
             sel = literal_mode_lines(
                 contents, _confirm_lit, _confirm_mode, nl
             )
-            emit = _np.intersect1d(
-                _np.asarray(emit, dtype=_np.int64), sel
-            ).tolist()
+            emit = _np.intersect1d(emit, sel)
         else:
             progress = _progress_fn()
             kept = []
-            for i, ln in enumerate(emit):
+            for i, ln in enumerate(emit.tolist()):
                 if _confirm.search(
                     contents[slice(*line_span(nl, ln, len(contents)))]
                 ):
                     kept.append(ln)
                 _stamp_every(progress, i)  # -w/-x over dense candidates
-            emit = kept
+            emit = _np.asarray(kept, dtype=_np.int64)
     if _invert:
-        emit = sorted(set(range(1, count_lines(contents) + 1)) - set(emit))
+        emit = _np.setdiff1d(
+            _np.arange(1, count_lines(contents) + 1, dtype=_np.int64), emit
+        )
     if _count_only:
-        return [KeyValue(key=filename, value=str(len(emit)))]
-    if not emit:
+        return [KeyValue(key=filename, value=str(int(emit.size)))]
+    if not emit.size:
         return []
     if nl is None:
         nl = newline_index(contents)
@@ -206,8 +206,8 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     # of a KeyValue + f-string + utf-8 decode per matched line (the
     # ~28 us/record pipeline BASELINE.md profiled; runtime/columnar.py).
     batch = make_batch_from_lines(
-        filename, _np.asarray(emit, dtype=_np.int64),
-        _np.frombuffer(contents, dtype=_np.uint8), nl, len(contents),
+        filename, emit, _np.frombuffer(contents, dtype=_np.uint8), nl,
+        len(contents),
     )
     return [batch]
 

@@ -251,6 +251,32 @@ def encode_batch(b: LineBatch) -> bytes:
     ])
 
 
+def _batch_from_body(meta: dict, body, offset: int = 0) -> LineBatch:
+    """Decode one block's binary body (linenos + offsets + slab) from
+    ``body`` starting at ``offset`` — the ONE place that knows the
+    section layout.  ``body`` may be the whole enclosing buffer (the
+    in-buffer decoder passes the intermediate file's bytes + offset, so
+    no extra copy of the block is made) or an exact body slice (the
+    streaming decoder)."""
+    n, slab_len = int(meta["n"]), int(meta["slab"])
+    linenos = np.frombuffer(body, dtype="<i8", count=n, offset=offset).astype(
+        np.int64
+    )
+    offsets = np.frombuffer(
+        body, dtype="<i8", count=n + 1, offset=offset + n * 8
+    ).astype(np.int64)
+    slab_at = offset + (2 * n + 1) * 8
+    slab = bytes(body[slab_at : slab_at + slab_len])
+    return LineBatch(
+        filename=meta["file"], linenos=linenos, offsets=offsets, slab=slab
+    )
+
+
+def _block_body_len(meta: dict) -> int:
+    n = int(meta["n"])
+    return n * 8 + (n + 1) * 8 + int(meta["slab"])
+
+
 def iter_blocks(path):
     """Stream records from a spill-run file (the shuffle wire format):
     KeyValue per JSONL line, LineBatch per block — without reading the
@@ -264,19 +290,8 @@ def iter_blocks(path):
                 meta = json.loads(
                     line[len(MARKER) :].decode("utf-8", "surrogateescape")
                 )
-                n, slab_len = int(meta["n"]), int(meta["slab"])
-                body = f.read(n * 8 + (n + 1) * 8 + slab_len + 1)
-                linenos = np.frombuffer(body, dtype="<i8", count=n).astype(
-                    np.int64
-                )
-                offsets = np.frombuffer(
-                    body, dtype="<i8", count=n + 1, offset=n * 8
-                ).astype(np.int64)
-                slab = body[(2 * n + 1) * 8 : (2 * n + 1) * 8 + slab_len]
-                yield LineBatch(
-                    filename=meta["file"], linenos=linenos,
-                    offsets=offsets, slab=slab,
-                )
+                body = f.read(_block_body_len(meta) + 1)  # + trailing '\n'
+                yield _batch_from_body(meta, body)
             elif line.strip():
                 k, v = json.loads(
                     line.decode("utf-8", "surrogateescape")
@@ -402,23 +417,9 @@ def decode_batch_at(data: bytes, pos: int) -> tuple[LineBatch, int]:
     meta = json.loads(
         data[pos + len(MARKER) : eol].decode("utf-8", "surrogateescape")
     )
-    n, slab_len = int(meta["n"]), int(meta["slab"])
     p = eol + 1
-    linenos = np.frombuffer(data, dtype="<i8", count=n, offset=p).astype(
-        np.int64
-    )
-    p += n * 8
-    offsets = np.frombuffer(data, dtype="<i8", count=n + 1, offset=p).astype(
-        np.int64
-    )
-    p += (n + 1) * 8
-    slab = data[p : p + slab_len]
-    p += slab_len
+    batch = _batch_from_body(meta, data, offset=p)  # no body copy
+    p += _block_body_len(meta)
     if p < len(data) and data[p : p + 1] == b"\n":
         p += 1
-    return (
-        LineBatch(
-            filename=meta["file"], linenos=linenos, offsets=offsets, slab=slab
-        ),
-        p,
-    )
+    return batch, p

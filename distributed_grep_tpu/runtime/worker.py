@@ -245,98 +245,49 @@ class WorkerLoop:
     # ---------------------------------------------------------------- reduce
     def _run_reduce(self, a: rpc.AssignTaskReply) -> None:
         import os
-        import tempfile
 
         t0 = time.perf_counter()
         self.app.configure(**a.app_options)
-        # Bounded-memory grouping: records spill to sorted on-disk runs past
-        # the cap and group-reduce as a streaming merge (runtime/extsort.py).
-        # The reference materializes the whole partition (worker.go:161-162).
-        # Identity-reduce apps (the grep apps — ``reduce_is_identity`` on
-        # the module) instead collate columnar batches in (file, line)
-        # order (runtime/columnar.IdentityCollator): records never expand
-        # to per-line Python objects, and the output files come out in the
-        # CLI's display order so collation downstream is a plain merge.
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
+        # Two record sinks behind one loop:
+        # * generic apps — bounded-memory sort-merge grouping: records
+        #   spill to sorted on-disk runs past the cap and group-reduce as
+        #   a streaming merge (runtime/extsort.py; the reference
+        #   materializes the whole partition, worker.go:161-162).
+        #   Associative apps expose reduce_stream_fn to keep hot keys
+        #   O(1) too.  Output: one "key<TAB>value\n" line per key (the
+        #   reference writes "key value", worker.go:111-124, but grep
+        #   keys contain spaces — a tab keeps the k/v split unambiguous),
+        #   keys in sorted order for determinism.
+        # * identity-reduce apps (the grep apps — ``reduce_is_identity``
+        #   on the module) — columnar batches collate in (file, line)
+        #   order (runtime/columnar.IdentityCollator): records never
+        #   expand to per-line Python objects, output files come out in
+        #   the CLI's display order, and collation downstream is a plain
+        #   merge (the reference sorts once, worker.go:161-169 — so do
+        #   we).
         if getattr(self.app.module, "reduce_is_identity", False):
-            return self._run_reduce_identity(a, t0)
-        reducer = ExternalReducer(
-            memory_limit_bytes=self.reduce_memory_bytes, spill_dir=self.spill_dir
-        )
-        # Associative apps expose reduce_stream_fn to keep hot keys O(1) too.
-        stream_fn = getattr(self.app, "reduce_stream_fn", None)
-        try:
-            files_processed = 0
-            while True:
-                r = self.transport.reduce_next_file(
-                    rpc.ReduceNextFileArgs(task_id=a.task_id, files_processed=files_processed)
-                )
-                if r.done:
-                    break
-                if not r.next_file:
-                    continue  # long-poll window expired; re-poll (worker.go:153-160)
-                data = self.transport.read_intermediate(r.next_file)
-                reducer.add_many(shuffle.decode_records(data))
-                files_processed += 1
-                self._fault("after_reduce_file")
-            # One "key<TAB>value\n" line per key (the reference writes
-            # "key value", worker.go:111-124, but grep keys contain spaces —
-            # a tab keeps the k/v split unambiguous).  The merge streams keys
-            # in sorted order (determinism) straight to a local spool file,
-            # so output size never bounds on worker memory either.
-            fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
-                                         dir=self.spill_dir or None)
-            try:
-                progress = self._progress_fn(
-                    "reduce", a.task_id, a.task_timeout_s
-                )
-                with self.metrics.timer("reduce_compute"), \
-                        trace.annotate(f"reduce_compute:{a.task_id}"), \
-                        os.fdopen(fd, "w", encoding="utf-8",
-                                  errors="surrogateescape", newline="") as out:
-                    for n_keys, (k, v) in enumerate(
-                        reducer.reduce(self.app.reduce_fn, stream_fn)
-                    ):
-                        out.write(f"{k}\t{v}\n")
-                        if n_keys % 4096 == 0:
-                            # the merge of a big spilled partition can run
-                            # past the sweep window with no RPC activity;
-                            # a throttled stamp keeps it alive
-                            progress()
-                self._fault("before_reduce_commit")
-                wof = getattr(self.transport, "write_output_from_file", None)
-                if wof is not None:
-                    wof(f"mr-out-{a.task_id}", spool)
-                else:  # custom transports without the streaming commit
-                    with open(spool, "rb") as f:
-                        self.transport.write_output(f"mr-out-{a.task_id}", f.read())
-            finally:
-                os.unlink(spool)
-        finally:
-            if reducer.spill_count:
-                self.metrics.inc("reduce_spills", reducer.spill_count)
-            reducer.close()
-        self.transport.reduce_finished(
-            rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
-        )
-        self.metrics.inc("reduce_tasks")
-        self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
+            from distributed_grep_tpu.runtime.columnar import IdentityCollator
 
-    def _run_reduce_identity(self, a: rpc.AssignTaskReply, t0: float) -> None:
-        """Columnar reduce for identity-reduce apps: same RPC/commit shape
-        as _run_reduce, but records collate batch-wise in (file, line)
-        order instead of re-sorting through the generic external sorter
-        (the reference sorts once, worker.go:161-169 — so do we)."""
-        import os
-        import tempfile
+            sink = IdentityCollator(
+                memory_limit_bytes=self.reduce_memory_bytes,
+                spill_dir=self.spill_dir,
+            )
+            chunks = sink.iter_output_chunks
+            progress_stride = 64  # chunks are whole batches: coarse
+        else:
+            sink = ExternalReducer(
+                memory_limit_bytes=self.reduce_memory_bytes,
+                spill_dir=self.spill_dir,
+            )
+            stream_fn = getattr(self.app, "reduce_stream_fn", None)
 
-        from distributed_grep_tpu.runtime.columnar import IdentityCollator
+            def chunks():
+                for k, v in sink.reduce(self.app.reduce_fn, stream_fn):
+                    yield f"{k}\t{v}\n"
 
-        collator = IdentityCollator(
-            memory_limit_bytes=self.reduce_memory_bytes,
-            spill_dir=self.spill_dir,
-        )
+            progress_stride = 4096
         try:
             files_processed = 0
             while True:
@@ -348,44 +299,49 @@ class WorkerLoop:
                 if r.done:
                     break
                 if not r.next_file:
-                    continue  # long-poll window expired; re-poll
+                    continue  # long-poll window expired; re-poll (worker.go:153-160)
                 data = self.transport.read_intermediate(r.next_file)
-                collator.add_many(shuffle.decode_records(data))
+                sink.add_many(shuffle.decode_records(data))
                 files_processed += 1
                 self._fault("after_reduce_file")
-            fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
-                                         dir=self.spill_dir or None)
-            try:
-                progress = self._progress_fn(
-                    "reduce", a.task_id, a.task_timeout_s
-                )
-                with self.metrics.timer("reduce_compute"), \
-                        trace.annotate(f"reduce_compute:{a.task_id}"), \
-                        os.fdopen(fd, "w", encoding="utf-8",
-                                  errors="surrogateescape", newline="") as out:
-                    for n_chunks, chunk in enumerate(
-                        collator.iter_output_chunks()
-                    ):
-                        out.write(chunk)
-                        if n_chunks % 64 == 0:
-                            progress()  # chunks are whole batches: coarse
-                self._fault("before_reduce_commit")
-                wof = getattr(self.transport, "write_output_from_file", None)
-                if wof is not None:
-                    wof(f"mr-out-{a.task_id}", spool)
-                else:
-                    with open(spool, "rb") as f:
-                        self.transport.write_output(
-                            f"mr-out-{a.task_id}", f.read()
-                        )
-            finally:
-                os.unlink(spool)
+            self._write_reduce_output(a, chunks(), progress_stride)
         finally:
-            if collator.spill_count:
-                self.metrics.inc("reduce_spills", collator.spill_count)
-            collator.close()
+            if sink.spill_count:
+                self.metrics.inc("reduce_spills", sink.spill_count)
+            sink.close()
         self.transport.reduce_finished(
             rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
         )
         self.metrics.inc("reduce_tasks")
         self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
+
+    def _write_reduce_output(self, a: rpc.AssignTaskReply, chunks,
+                             progress_stride: int) -> None:
+        """Spool the output chunks locally, then commit atomically (the
+        temp-file + rename commit, worker.go:103) — output size never
+        bounds on worker memory.  Throttled progress stamps keep a long
+        merge alive past the sweep window (it has no RPC activity)."""
+        import os
+        import tempfile
+
+        fd, spool = tempfile.mkstemp(prefix="dgrep-redout-",
+                                     dir=self.spill_dir or None)
+        try:
+            progress = self._progress_fn("reduce", a.task_id, a.task_timeout_s)
+            with self.metrics.timer("reduce_compute"), \
+                    trace.annotate(f"reduce_compute:{a.task_id}"), \
+                    os.fdopen(fd, "w", encoding="utf-8",
+                              errors="surrogateescape", newline="") as out:
+                for i, chunk in enumerate(chunks):
+                    out.write(chunk)
+                    if i % progress_stride == 0:
+                        progress()
+            self._fault("before_reduce_commit")
+            wof = getattr(self.transport, "write_output_from_file", None)
+            if wof is not None:
+                wof(f"mr-out-{a.task_id}", spool)
+            else:  # custom transports without the streaming commit
+                with open(spool, "rb") as f:
+                    self.transport.write_output(f"mr-out-{a.task_id}", f.read())
+        finally:
+            os.unlink(spool)
