@@ -310,3 +310,41 @@ def test_parse_grep_key_bytes_parity_with_regex():
         m = GREP_KEY_RE.match(k)
         want = (m.group(1).encode(), int(m.group(2))) if m else None
         assert parse_grep_key_bytes(k.encode()) == want, k
+
+
+def test_e2e_spilling_collator_output_identical(tmp_path):
+    """A grep job forced into heavy IdentityCollator spilling must write
+    byte-identical mr-out content to the no-spill run (e2e guard on the
+    spill wire + merge; pinned at 64 MB scale in BASELINE.md)."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    rng = random.Random(31)
+    p = tmp_path / "in.txt"
+    with open(p, "w") as f:
+        for i in range(20000):
+            f.write(
+                ("needle %d x\n" % i) if rng.random() < 0.6
+                else ("nothing %d\n" % i)
+            )
+
+    def job(tag, mem):
+        cfg = JobConfig(
+            input_files=[str(p)],
+            application="distributed_grep_tpu.apps.grep",
+            app_options={"pattern": "needle"},
+            n_reduce=4,
+            work_dir=str(tmp_path / f"job-{tag}"),
+            reduce_memory_bytes=mem,
+        )
+        res = run_job(cfg, n_workers=2)
+        spills = res.metrics["counters"].get("reduce_spills", 0)
+        out = b"".join(
+            open(q, "rb").read() for q in sorted(res.output_files)
+        )
+        return spills, out
+
+    s_big, out_big = job("big", 128 << 20)
+    s_tiny, out_tiny = job("tiny", 64 << 10)  # 64 KB cap: heavy spilling
+    assert s_big == 0 and s_tiny > 0, (s_big, s_tiny)
+    assert out_big == out_tiny
