@@ -690,9 +690,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
             # the pre-sorted output files (no per-record str round trip)
             sys.stdout.flush()
             out_buf = sys.stdout.buffer
-            for line in res.iter_display_bytes_sorted():
-                out_buf.write(line)
-                saw_any = True
+            for block in res.display_blocks_sorted():
+                if block:
+                    out_buf.write(block)
+                    saw_any = True
             out_buf.flush()
             if stream_counts:
                 rc_final = 2 if had_file_errors else (0 if saw_any else 1)

@@ -249,6 +249,116 @@ class JobResult:
         for _k, line, _tab in self._iter_records_bytes_sorted():
             yield line.replace(b"\t", b" ", 1) + b"\n"
 
+    # Output totals up to this size may take the vectorized whole-buffer
+    # display merge.  Peak transient memory is a small MULTIPLE of the
+    # output (the joined buffer, the per-line prefix/digit windows, the
+    # int64 gather index at 8 bytes/output byte, and the final slab —
+    # intermediates are freed as the pass proceeds), so the cap is set
+    # well below RESULTS_MATERIALIZE_LIMIT; larger jobs keep the
+    # O(1)-memory record merge.
+    DISPLAY_VECTOR_CAP = 128 << 20
+
+    def display_blocks_sorted(self):
+        """Display output as bytes BLOCKS in (file, line) order — same
+        bytes as iter_display_bytes_sorted joined, bigger pieces.
+
+        Fast path (round 5): when every record names the SAME file (the
+        single-input grep job — the common CLI shape and the dense
+        receipt) and total output fits DISPLAY_VECTOR_CAP, the merge is
+        one vectorized pass: line numbers parse as (n, 10) digit-window
+        arithmetic, ordering is one argsort, and the output slab is one
+        gather — no per-record Python at all.  Everything else streams
+        through the record merge unchanged."""
+        total = sum(p.stat().st_size for p in self.output_files)
+        if 0 < total <= self.DISPLAY_VECTOR_CAP:
+            block = self._single_path_display_block()
+            if block is not None:
+                yield block
+                return
+        yield from self.iter_display_bytes_sorted()
+
+    def _single_path_display_block(self):
+        """The vectorized single-file display merge, or None when the
+        output is not single-path grep-shaped (caller falls back)."""
+        import numpy as np
+
+        from distributed_grep_tpu.ops.lines import newline_index
+        from distributed_grep_tpu.runtime.columnar import gather_ranges
+
+        parts = [p.read_bytes() for p in self.output_files]
+        # EVERY file must be newline-terminated, or concatenation would
+        # fuse a record across the file boundary into one silently
+        # corrupt line (round-5 review) — the reduce writer always
+        # terminates lines, so a violation means foreign output: fall
+        # back to the per-file record merge.
+        if any(part and not part.endswith(b"\n") for part in parts):
+            return None
+        buf = b"".join(parts)
+        del parts
+        if not buf:
+            return None
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        nl = newline_index(buf).astype(np.int64)
+        if nl.size == 0:
+            return None
+        starts = np.concatenate(([0], nl[:-1] + 1)).astype(np.int64)
+        ends = nl  # exclusive of '\n'
+        keep = ends > starts  # drop empty lines
+        starts, ends = starts[keep], ends[keep]
+        if not starts.size:
+            return None
+        # the common prefix "path (line number #" from the first record
+        first = buf[int(starts[0]) : int(ends[0])]
+        tab = first.find(b"\t")
+        parsed = parse_grep_key_bytes(first[:tab] if tab >= 0 else first)
+        if parsed is None:
+            return None
+        prefix = parsed[0] + _GREP_KEY_MARKER
+        plen = len(prefix)
+        if np.any(ends - starts < plen + 2):
+            return None  # some line cannot even hold prefix + digit + ')'
+        # every line must carry the SAME prefix (single-input job)
+        win = arr[starts[:, None] + np.arange(plen)]
+        prefix_ok = (win == np.frombuffer(prefix, np.uint8)).all()
+        del win
+        if not prefix_ok:
+            return None
+        # parse line numbers: up to 19 digit bytes after the prefix
+        MAXD = 19
+        dwin = arr[
+            np.minimum(starts[:, None] + plen + np.arange(MAXD), arr.size - 1)
+        ]
+        isdig = (dwin >= 48) & (dwin <= 57)
+        # digits run from column 0; first non-digit column per row
+        ndig = np.where(
+            isdig.all(axis=1), MAXD, np.argmin(isdig, axis=1)
+        ).astype(np.int64)
+        if np.any(ndig == 0) or np.any(ndig >= MAXD):
+            return None
+        # the byte after the digits must be ')' then '\t'
+        after = starts + plen + ndig
+        if not (
+            (arr[np.minimum(after, arr.size - 1)] == 0x29).all()
+            and (arr[np.minimum(after + 1, arr.size - 1)] == 0x09).all()
+        ):
+            return None
+        linenos = np.zeros(starts.size, dtype=np.int64)
+        for k in range(int(ndig.max())):
+            active = ndig > k
+            linenos[active] = (
+                linenos[active] * 10 + (dwin[active, k].astype(np.int64) - 48)
+            )
+        del dwin, isdig
+        order = np.argsort(linenos, kind="stable")
+        slab, offsets = gather_ranges(
+            arr, starts[order], ends[order] + 1  # include the '\n'
+        )
+        out = np.frombuffer(slab, dtype=np.uint8).copy()
+        # the one '\t' per line sits right after "...#<digits>)"
+        tab_pos = offsets[:-1] + plen + ndig[order] + 1
+        out[tab_pos] = 0x20
+        return out.tobytes()
+
     def sorted_lines(self) -> list[str]:
         """Output lines sorted naturally: grep-style keys sort by (file, line
         number); anything else sorts lexicographically."""

@@ -348,3 +348,58 @@ def test_e2e_spilling_collator_output_identical(tmp_path):
     s_tiny, out_tiny = job("tiny", 64 << 10)  # 64 KB cap: heavy spilling
     assert s_big == 0 and s_tiny > 0, (s_big, s_tiny)
     assert out_big == out_tiny
+
+
+def test_display_blocks_sorted_vector_path_matches_generator(tmp_path):
+    """The vectorized single-path display merge must produce byte-exact
+    generator output — including a path that CONTAINS the key marker,
+    values holding tabs/marker text, and >9-digit-free mixed widths —
+    and must FALL BACK (not corrupt) for multi-file jobs."""
+    from distributed_grep_tpu.runtime.job import JobResult, run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    rng = random.Random(17)
+    evil_name = "in (line number #7) weird.txt"
+    p = tmp_path / evil_name
+    lines = []
+    for i in range(3000):
+        if rng.random() < 0.5:
+            lines.append("needle\tvalue with (line number #5) text %d" % i)
+        else:
+            lines.append("nothing %d" % i)
+    p.write_text("\n".join(lines) + "\n")
+    cfg = JobConfig(
+        input_files=[str(p)],
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": "needle"},
+        n_reduce=5,
+        work_dir=str(tmp_path / "job"),
+    )
+    res = run_job(cfg, n_workers=2)
+    want = b"".join(res.iter_display_bytes_sorted())
+    got = b"".join(res.display_blocks_sorted())
+    assert got == want
+    blocks = list(res.display_blocks_sorted())
+    assert len(blocks) == 1, "single-path job should take the vector path"
+
+    # multi-file job: paths differ -> prefix check fails -> generator path
+    q = tmp_path / "other.txt"
+    q.write_text("a needle\n")
+    cfg2 = JobConfig(
+        input_files=[str(p), str(q)],
+        application="distributed_grep_tpu.apps.grep",
+        app_options={"pattern": "needle"},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job2"),
+    )
+    res2 = run_job(cfg2, n_workers=2)
+    assert b"".join(res2.display_blocks_sorted()) == \
+        b"".join(res2.iter_display_bytes_sorted())
+
+    # over-cap totals keep the streaming path (no materialization)
+    old_cap = JobResult.DISPLAY_VECTOR_CAP
+    try:
+        JobResult.DISPLAY_VECTOR_CAP = 1  # force fallback
+        assert b"".join(res.display_blocks_sorted()) == want
+    finally:
+        JobResult.DISPLAY_VECTOR_CAP = old_cap
