@@ -558,6 +558,11 @@ def cmd_grep(args: argparse.Namespace) -> int:
         import tempfile
 
         cfg.work_dir = tempfile.mkdtemp(prefix="dgrep-")
+        # Ephemeral workdir: nobody can resume a randomly-named temp dir,
+        # so the per-task fsync'd journal is pure overhead here (a
+        # 2,000-file grep -r paid 2,000 fsyncs for nothing — round 5).
+        # --work-dir jobs keep the journal: their path is re-addressable.
+        cfg.journal = False
     ctx_before = args.context if args.context is not None else args.before_context
     ctx_after = args.context if args.context is not None else args.after_context
 

@@ -1072,7 +1072,6 @@ class GrepEngine:
         decide presence themselves).
         """
         import time as _time
-        from concurrent.futures import ThreadPoolExecutor
 
         chunk_target = chunk_bytes or max(self.segment_bytes, 1 << 26)
         matched: list[int] = []
@@ -1082,17 +1081,50 @@ class GrepEngine:
         read_wait = 0.0
         lines_before = 0
         carry = b""
-        rpool = ThreadPoolExecutor(1)  # all reads run here, in file order
+
+        class _Ready:
+            """Future-like wrapper for data already in hand (the first,
+            synchronous read, and the EOF sentinel)."""
+
+            def __init__(self, v: bytes):
+                self._v = v
+
+            def result(self) -> bytes:
+                return self._v
+
+        # The one-slot reader thread exists to overlap disk with scan —
+        # pointless (and measurably expensive: one thread spawn per file
+        # in a 2,000-file grep -r) for files that fit in a single chunk.
+        # BufferedReader.read(n) returns short only at EOF, so a full
+        # block is the one case where more data may follow: the pool is
+        # created lazily at the first full block.
+        rpool = None
+
+        def submit_read():
+            nonlocal rpool
+            if rpool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                rpool = ThreadPoolExecutor(1)  # all reads, in file order
+            return rpool.submit(f.read, chunk_target)
+
         try:
             f = open(path, "rb")
-            nxt = rpool.submit(f.read, chunk_target)
+            t0 = _time.perf_counter()
+            nxt = _Ready(f.read(chunk_target))
+            read_wait += _time.perf_counter() - t0  # the synchronous first
+            # read is genuine stall: keep stats[read_wait_seconds] honest
             while True:
                 t0 = _time.perf_counter()
                 block = nxt.result()
                 read_wait += _time.perf_counter() - t0
                 if block:
-                    # enqueue the NEXT read now; it overlaps this chunk's scan
-                    nxt = rpool.submit(f.read, chunk_target)
+                    # enqueue the NEXT read now; it overlaps this chunk's
+                    # scan (short block = EOF: no read, no thread)
+                    nxt = (
+                        submit_read() if len(block) == chunk_target
+                        else _Ready(b"")
+                    )
                     buf = carry + block
                     cut = buf.rfind(b"\n")
                     if cut < 0:
@@ -1136,7 +1168,8 @@ class GrepEngine:
                     break
         finally:
             # the in-flight read must not outlive the file handle
-            rpool.shutdown(wait=True, cancel_futures=True)
+            if rpool is not None:
+                rpool.shutdown(wait=True, cancel_futures=True)
             try:
                 f.close()
             except NameError:

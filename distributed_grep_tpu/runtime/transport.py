@@ -46,6 +46,10 @@ class Transport(Protocol):
 class LocalTransport:
     """Direct scheduler calls + shared-filesystem data plane."""
 
+    # data-plane ops resolve in microseconds: the worker skips its
+    # download-leg liveness pump for this transport (worker.py)
+    is_local = True
+
     def __init__(self, scheduler: Scheduler, workdir: WorkDir, rpc_timeout_s: float = 30.0):
         self.scheduler = scheduler
         self.workdir = workdir

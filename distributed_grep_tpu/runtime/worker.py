@@ -109,9 +109,11 @@ class WorkerLoop:
     def _pumping(self, task_type: str, task_id: int, interval_s: float = 2.0):
         """Context manager: stamp heartbeats from a side thread while the
         body runs — coarse process-alive liveness.  Two call sites:
-        transport downloads (always — the data plane has its own 15 s
-        liveness budget, http_transport.py, so no app hang can hide
-        there), and the map COMPUTE leg of apps without set_progress
+        transport downloads (on non-local transports — the HTTP data
+        plane has its own 15 s liveness budget, http_transport.py, so no
+        app hang can hide there; the local transport resolves in
+        microseconds and skips the pump, round 5), and the map COMPUTE
+        leg of apps without set_progress
         support (there it genuinely cannot distinguish a slow map from a
         hung one — the accepted tradeoff, documented at the call site,
         because the alternative is spuriously re-executing every map
@@ -185,11 +187,20 @@ class WorkerLoop:
                 return contextlib.nullcontext()
             return self._pumping("map", a.task_id, pump_s)
 
+        # Download-leg pumping only matters when the data plane can
+        # actually take a while (HTTP pull): a local-filesystem transport
+        # resolves the path in microseconds, and a pump thread per map
+        # task is measurable overhead on a 2,000-file grep -r (round 5).
+        def download_guard():
+            if getattr(self.transport, "is_local", False):
+                return contextlib.nullcontext()
+            return self._pumping("map", a.task_id, pump_s)
+
         try:
             if use_path:
                 import os
 
-                with self._pumping("map", a.task_id, pump_s), \
+                with download_guard(), \
                         trace.annotate(f"map_read:{a.task_id}"):
                     path, is_temp = self.transport.read_input_path(a.filename)
                 try:
@@ -204,7 +215,7 @@ class WorkerLoop:
                         os.unlink(path)
                 self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
             else:
-                with self._pumping("map", a.task_id, pump_s), \
+                with download_guard(), \
                         trace.annotate(f"map_read:{a.task_id}"):
                     contents = self.transport.read_input(a.filename)
                 self._fault("after_map_read")
@@ -222,8 +233,25 @@ class WorkerLoop:
         # on this host — observed swept mid-shuffle and re-executed).  The
         # coarse pump is the right liveness here, same tradeoff as the
         # download legs: a hang in OUR shuffle is a worker bug, not an
-        # app hang the detector needs to catch.
-        with self._pumping("map", a.task_id, pump_s):
+        # app hang the detector needs to catch.  Small outputs skip the
+        # pump: their shuffle leg is sub-millisecond ON THE LOCAL
+        # TRANSPORT (bucketize scales with records; a remote transport's
+        # intermediate PUSH can stall on the network at any size, so it
+        # always keeps the pump), nowhere near any sweep window — and a
+        # thread per map task costs real time on many-small-file jobs
+        # (round 5).
+        def shuffle_guard():
+            if getattr(self.transport, "is_local", False):
+                from distributed_grep_tpu.runtime.columnar import LineBatch
+
+                n_records = sum(
+                    len(r) if isinstance(r, LineBatch) else 1 for r in records
+                )
+                if n_records < 50_000:
+                    return contextlib.nullcontext()
+            return self._pumping("map", a.task_id, pump_s)
+
+        with shuffle_guard():
             buckets = shuffle.bucketize(records, a.n_reduce)
             self._fault("before_map_commit")
             produced: list[int] = []

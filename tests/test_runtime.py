@@ -400,6 +400,13 @@ def test_slow_shuffle_leg_survives_tight_timeout(tmp_path, monkeypatch):
         return real_encode(kvs)
 
     monkeypatch.setattr(shuffle_mod, "encode_records", slow_encode)
+    # Round 5: small maps on the LOCAL transport skip the shuffle pump
+    # (their leg is sub-ms); this test pins the pump itself, so present
+    # as a remote-style transport where a slow leg is realistic at any
+    # record count (a network push can stall regardless of size).
+    from distributed_grep_tpu.runtime.transport import LocalTransport
+
+    monkeypatch.setattr(LocalTransport, "is_local", False)
     cfg = JobConfig(
         input_files=[str(f)], application=str(app_py),
         app_options={}, n_reduce=1,
