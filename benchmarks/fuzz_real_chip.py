@@ -144,6 +144,30 @@ def fam_approx(rng):
     return dict(pattern=w, max_errors=1), want, [w.encode(), "".join(mutated).encode()]
 
 
+def fam_dollar_anchor(rng):
+    # round-5 device filter: '$'-anchored single pattern rides the NFA
+    # kernel with the '$' dropped (models/nfa.compile_device_filter) and
+    # every candidate line host-confirmed.  Injections plant both true
+    # matches (word at line end) and near-misses (word mid-line) so the
+    # confirm pass has false positives to reject on every draw.
+    w = rand_word(rng, 4, 9)
+    pat = w + "$"
+    return (dict(pattern=pat),
+            re_oracle(re.escape(w).encode() + b"$"),
+            [w.encode(), w.encode() + b"qq"])
+
+
+def fam_overcap_literal(rng):
+    # round-5 device filter: a literal past the 128-Glushkov-position
+    # kernel cap runs prefix-truncated on the device; host confirm
+    # restores exactness.  Near-miss = shared long prefix, different
+    # tail — the device filter flags it, the confirm must drop it.
+    n = int(rng.integers(130, 200))
+    w = "".join(ALPHA[i] for i in rng.integers(0, 26, n))
+    near = (w[:-4] + rand_word(rng, 4, 5)).encode()
+    return dict(pattern=w), re_oracle(re.escape(w).encode()), [w.encode(), near]
+
+
 FAMILIES = {
     "literal": fam_literal,
     "class_seq": fam_class_seq,
@@ -153,6 +177,8 @@ FAMILIES = {
     "literal_set": fam_literal_set,
     "pairset": fam_pairset,
     "approx": fam_approx,
+    "dollar_anchor": fam_dollar_anchor,
+    "overcap_literal": fam_overcap_literal,
 }
 
 

@@ -1,0 +1,165 @@
+"""Probe the Mosaic lane-gather compile ceiling past MAX_GATHERS=40.
+
+CLAUDE.md hardware facts: 40 gathers/byte compile and run at unroll 4
+and 8 (round-4 probe); 48 was unprobed and gated off.  The FDR kernel
+(ops/pallas_fdr._kernel) is plan-generic — a check's domain is just its
+subtable count — so this probe hand-builds synthetic m=6 banks whose
+checks sum to 44/48/56/64 gathers (fillers at D=1024, i.e. 8 subtables,
+beyond the production DOMAINS=(128,256,512)), compiles them for real,
+verifies candidates bit-exact against models/fdr.reference_candidates,
+and slope-times throughput.
+
+    PYTHONPATH=/root/repo:/root/.axon_site \
+        python benchmarks/probe_gather_ceiling.py [--targets 44,48,56,64]
+
+If 48+ compiles and runs exactly at both production unrolls, MAX_GATHERS
+can be raised (models/fdr.py) and D=1024 considered for the tuner's
+domain menu for sets dense enough that halving per-check fp is worth 2x
+gather cost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+sys.path.insert(0, str(_root))
+
+from distributed_grep_tpu.models.fdr import FdrBank, reference_candidates  # noqa: E402
+
+M = 6
+
+
+def synth_bank(rng: np.random.Generator, total_gathers: int) -> FdrBank:
+    """m=6 bank: a D=128 check (slot 5, fam 0) + fillers from
+    D in {1024, 512, 256, 128} chosen to hit the target gather count
+    exactly.  Tables are uniform random (bit density 0.5): with 12
+    checks the per-byte candidate rate is ~32 * 0.5^12 ~ 8e-3 — a real
+    nonzero stream for the bit-exact compare, not all-zeros (which would
+    let an under-reporting kernel pass) and not every-byte."""
+    slots = [(k, 0) for k in range(M - 2, -1, -1)] + [
+        (k, 1) for k in range(M - 1, -1, -1)
+    ]
+    checks = [(M - 1, 0, 128)]
+    need = total_gathers - 1
+    for slot, fam in slots:
+        if need <= 0:
+            break
+        d = 1024 if need >= 8 else 128 * need
+        checks.append((slot, fam, d))
+        need -= d // 128
+    if need:
+        raise ValueError(f"cannot reach {total_gathers} gathers with m={M}")
+
+    tables = tuple(
+        rng.integers(0, 2 ** 32, size=d, dtype=np.uint32) for _, _, d in checks
+    )
+    return FdrBank(m=M, checks=tuple(checks), tables=tables,
+                   patterns=[b"<synthetic>"], fp_per_byte=0.0)
+
+
+def check_exact(bank: FdrBank, unroll: int) -> tuple[bool, float, str]:
+    """Compile + run a small real-Mosaic scan; compare every lane stripe
+    against the NumPy reference.  Returns (ok, compile_seconds, note)."""
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import layout as layout_mod
+    from distributed_grep_tpu.ops import pallas_scan
+    from distributed_grep_tpu.ops.pallas_fdr import (
+        _fdr_pallas,
+        bank_device_tables,
+        kernel_plan,
+    )
+    from distributed_grep_tpu.ops.pallas_scan import _unpack_words_to_lane_bits
+
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=2 * 1024 * 1024, dtype=np.uint8).tobytes()
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=4096, min_chunk=512,
+        lane_multiple=pallas_scan.LANES_PER_BLOCK, chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    tiles = pallas_scan.as_tiles(arr, lay.lanes // pallas_scan.LANES_PER_BLOCK)
+    tabs = jnp.asarray(bank_device_tables(bank))
+    t0 = time.time()
+    try:
+        words = _fdr_pallas(
+            tiles, tabs, m=bank.m, plan=kernel_plan(bank), chunk=lay.chunk,
+            lane_blocks=lay.lanes // pallas_scan.LANES_PER_BLOCK,
+            interpret=False, unroll=unroll,
+        ).block_until_ready()
+    except Exception as e:
+        return False, time.time() - t0, "FAIL: " + str(e).replace("\n", " ")[:200]
+    dt = time.time() - t0
+    got = _unpack_words_to_lane_bits(np.asarray(words), lay.chunk, lay.lanes)
+    arr_np = np.asarray(arr)
+    want = np.zeros((lay.chunk, lay.lanes), dtype=bool)
+    for lane in range(lay.lanes):
+        ends = reference_candidates(bank, bytes(arr_np[:, lane]))
+        want[(ends - 1).astype(np.int64), lane] = True
+    ok = np.array_equal(got, np.packbits(want, axis=1, bitorder="little"))
+    return ok, dt, "exact" if ok else "MISMATCH"
+
+
+def slope_gbps(bank: FdrBank, unroll: int, mb: int) -> float:
+    from distributed_grep_tpu.ops.pallas_fdr import (
+        _fdr_pallas,
+        bank_device_tables,
+        kernel_plan,
+    )
+    from distributed_grep_tpu.utils.slope import _pallas_device_setup, slope_per_pass
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=mb * 1024 * 1024, dtype=np.uint8).tobytes()
+    dev, lay, lane_blocks, pad_rows = _pallas_device_setup(data, 8192)
+    tabs = jnp.asarray(bank_device_tables(bank))
+    plan = kernel_plan(bank)
+
+    def scan(win):
+        return _fdr_pallas(
+            win, tabs, m=bank.m, plan=plan, chunk=lay.chunk,
+            lane_blocks=lane_blocks, interpret=False, unroll=unroll,
+        )
+
+    sec, _count = slope_per_pass(dev, lay.chunk, pad_rows, scan)
+    return lay.chunk * lay.lanes / sec / 1e9
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--targets", default="44,48,56,64")
+    ap.add_argument("--unrolls", default="4,8")
+    ap.add_argument("--mb", type=int, default=32, help="corpus MiB for timing")
+    args = ap.parse_args()
+
+    import jax
+
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    rng = np.random.default_rng(4242)
+    failures = 0
+    for target in [int(t) for t in args.targets.split(",")]:
+        bank = synth_bank(rng, target)
+        assert bank.total_gathers == target, bank.total_gathers
+        for unroll in [int(u) for u in args.unrolls.split(",")]:
+            ok, dt, note = check_exact(bank, unroll)
+            if not ok:
+                failures += 1
+                print(f"gathers={target} unroll={unroll}: {note} "
+                      f"({dt:.1f}s)", flush=True)
+                continue
+            gbps = slope_gbps(bank, unroll, args.mb)
+            print(f"gathers={target} unroll={unroll}: compile {dt:.1f}s, "
+                  f"{note}, {gbps:.2f} GB/s", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,7 @@ import numpy as np
 NL = 0x0A
 N_BUCKETS = 32
 MAX_DEPTHS = 6  # pipeline slots; window = depths + 1 <= 7 bytes
-DOMAINS = (128, 256, 512)  # kernel gathers per check = D / 128
+DOMAINS = (128, 256, 512, 1024)  # kernel gathers per check = D / 128
 CLUSTER_DOMAIN = 128  # the clustered check's domain: Σ-density 1 at 1 gather
 # Two independent pair hash families; ANDing lookups of both families at
 # one slot squares that slot's density (d -> d0*d1), which beats adding
@@ -117,11 +117,23 @@ CONFIRM_THREADS = _confirm_threads()
 # confirm with this bias; the analytic value still ranks plans.
 EMPIRICAL_FP_BIAS = 2.5
 OVERLAP_RESIDUE = 0.2  # fraction of the smaller leg that fails to overlap
-# Kernel compile ceiling: lane-gathers per byte step.  Probed on v5e at
-# both production unroll factors (4 and 8): a 40-gather kernel compiles
-# and runs; the old 24-gather ceiling was an unroll-32 artifact
-# (ops/pallas_fdr.py notes).
-MAX_GATHERS = 40
+# Kernel compile ceiling: lane-gathers per byte step.  Round-5 probe
+# (benchmarks/probe_gather_ceiling.py, v5e 2026-08-01): 44/48/56/64-gather
+# m=6 plans (fillers at D=1024) ALL compile and run bit-exact vs the
+# NumPy reference at both production unrolls, with throughput tracking
+# the ~4.7 ps/gather model (64 gathers -> 3.3-3.7 GB/s) — the old
+# 40-gather cap (itself replacing an unroll-32-artifact 24) was
+# conservative, not a hardware wall.  64 is the new probed bound.
+MAX_GATHERS = 64
+# The native MT host scanner is the engine's routing alternative for
+# FDR-rejected sets: ~0.33 GB/s/core measured on this VM's AC/DFA table
+# walk (BASELINE.md "native MT host scanner" row), scaling ~linearly
+# with the confirm-thread fan.  With MAX_GATHERS=64 the plan menu now
+# admits filters big enough to price BELOW that host fan, so
+# eligibility must gate on scan cost too, not just candidate rate —
+# a filter that scans slower than the host's exact scanner is not
+# worth the device no matter how clean its candidate stream is.
+NATIVE_SCAN_GBPS_PER_THREAD = 0.33
 
 
 @dataclass(frozen=True)
@@ -524,6 +536,22 @@ def compile_fdr(
                              max_banks, pricing)
         )
     banks = min(candidates, key=group_cost)
+    from distributed_grep_tpu.utils.native import native_available
+
+    scan_ps = sum(b.scan_cost_ps() for b in banks)
+    device_gbps = pricing.n_chips * 1000.0 / scan_ps if scan_ps else float("inf")
+    native_gbps = NATIVE_SCAN_GBPS_PER_THREAD * pricing.confirm_threads
+    # Only cede to the host when the host scanner actually exists: on a
+    # native-less install the engine's FdrError fallback is the ~0.1 GB/s
+    # XLA DFA-bank path (_route_native no-ops there), and even a
+    # 100-gather filter beats that by ~20x.
+    if device_gbps < native_gbps and native_available():
+        raise FdrError(
+            f"cheapest filter plan scans at {device_gbps:.1f} GB/s "
+            f"({sum(b.total_gathers for b in banks)} gathers x "
+            f"{pricing.n_chips} chip(s)) — below the ~{native_gbps:.1f} GB/s "
+            f"native host fan; the exact host scanner wins this set"
+        )
     model = FdrModel(banks=banks, ignore_case=ignore_case, n_patterns=len(norm))
     # gate on the EXPECTED REAL rate (analytic x measured bias), like the
     # cost model — an analytic-only gate would admit sets whose true

@@ -369,9 +369,17 @@ class GrepEngine:
                     # ceiling): a set that falls back to the XLA DFA-bank
                     # device path would be far slower than the Glushkov
                     # NFA this regex otherwise compiles to.  The probe
-                    # model is kept — the set branch reuses it.
+                    # model is kept — the set branch reuses it.  Probe
+                    # under the engine's chip-aware pricing: both the
+                    # plan choice AND the round-5 native-crossover floor
+                    # depend on n_chips, so a default-pricing probe
+                    # would veto multi-chip-viable sets (and its model
+                    # would need recompiling anyway).
                     try:
-                        routed_fdr = compile_fdr(lits, ignore_case=ignore_case)
+                        routed_fdr = compile_fdr(
+                            lits, ignore_case=ignore_case,
+                            pricing=self._fdr_base_pricing(),
+                        )
                     except FdrError:
                         route = False
                 if route:
@@ -473,9 +481,8 @@ class GrepEngine:
                         # Chip-aware pricing (VERDICT r3 item 1): the host
                         # confirm threads are shared across every chip this
                         # engine drives, so the tuner prices the confirm leg
-                        # at the per-chip share from the start.  The routed
-                        # decomposition probe compiled at n_chips=1; recompile
-                        # it only when the chip count actually changes plans.
+                        # at the per-chip share from the start (the routed
+                        # decomposition probe above used the same pricing).
                         if short_pats:
                             # A dense 1-byte member ("e", " ") defeats the
                             # filter architecture outright: the pairset
@@ -499,8 +506,8 @@ class GrepEngine:
                                     f"candidate ceiling"
                                 )
                         base_pricing = self._fdr_base_pricing()
-                        if routed_fdr is not None and base_pricing.n_chips > 1:
-                            routed_fdr = None
+                        # routed_fdr was probed under the same base
+                        # pricing (chip count included) — reuse it as-is
                         self.fdr = routed_fdr or compile_fdr(
                             long_pats, ignore_case=ignore_case,
                             pricing=base_pricing,
@@ -709,6 +716,16 @@ class GrepEngine:
                 n *= int(self.mesh.shape[a])
             return n
         if self.devices == "all":
+            # jax.local_devices() initializes the backend on first touch
+            # and hangs in C (no exception) on a black-holed transport;
+            # this path runs at CONSTRUCTION time (chip-aware FDR
+            # pricing), so gate it behind the shared time-boxed deep
+            # probe instead of calling it bare — after a healthy probe
+            # local_devices() answers from jax's client cache.  On a
+            # dead transport price at 1 chip; the scan-time wall and
+            # retry-window un-demote own the rest of the story.
+            if not self._device_responsive():
+                return 1
             try:
                 import jax
 

@@ -66,8 +66,9 @@ def unroll_for(plan) -> int:
     gather plans) run ~10% faster at unroll=4 — register pressure — while
     small-gather kernels (the 1k-set 5-gather plan: 42 vs 35 GB/s) want
     unroll=8 to amortize the per-iteration pipeline carries.  The
-    MAX_GATHERS=40 compile ceiling was re-probed at BOTH unroll factors
-    (a 12-check 40-gather m=6 plan compiles and runs at unroll 4 and 8)."""
+    compile ceiling was re-probed at BOTH unroll factors each round:
+    round 4 cleared 40 gathers; round 5 cleared 44/48/56/64 (fillers at
+    D=1024, benchmarks/probe_gather_ceiling.py) — MAX_GATHERS=64 now."""
     return 4 if sum(ns for _, _, ns in plan) >= 12 else 8
 
 

@@ -177,3 +177,36 @@ def test_progress_grace_capability_probed_from_signature():
     with pytest.raises(TypeError, match="bug inside callback body"):
         eng.scan(data, progress=buggy)
     assert calls["n"] >= 1
+
+
+def test_chip_count_gated_behind_device_verdict(monkeypatch):
+    """devices="all" chip counting runs at CONSTRUCTION time (chip-aware
+    FDR pricing probes the decomposition under it), and a bare
+    jax.local_devices() there hangs in C on a black-holed transport —
+    it must consult the shared time-boxed verdict first and price at 1
+    chip on a dead device (round-5 review)."""
+    from distributed_grep_tpu.ops import engine as engine_mod
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    eng = GrepEngine("needle", interpret=True)
+    eng.devices = "all"
+    eng.mesh = None
+    # interpret engines skip the wall by design (CPU backend can't
+    # wedge) — force the non-interpret path to exercise the gate
+    eng._interpret = False
+
+    monkeypatch.setattr(engine_mod.GrepEngine, "_device_responsive",
+                        lambda self: False)
+
+    def boom():
+        raise AssertionError("jax touched while device verdict is False")
+
+    import jax
+
+    monkeypatch.setattr(jax, "local_devices", boom)
+    assert eng._active_chip_count() == 1
+
+    monkeypatch.setattr(engine_mod.GrepEngine, "_device_responsive",
+                        lambda self: True)
+    monkeypatch.setattr(jax, "local_devices", lambda: [object()] * 4)
+    assert eng._active_chip_count() == 4
