@@ -40,14 +40,22 @@ WORDS = (
 ALPHA = "abcdefghijklmnopqrstuvwxyz"
 
 
-def make_corpus(rng, injections: list[bytes], n_lines=30000) -> bytes:
+def make_corpus(rng, injections: list, n_lines=30000) -> bytes:
+    """Injections land at line ENDS by default; a ("start", payload)
+    tuple plants the payload at the line START instead (the position a
+    '^' branch actually gates — round-5 mid_anchor family)."""
     lines = [
         " ".join(WORDS[i] for i in rng.integers(0, len(WORDS), int(rng.integers(3, 12)))).encode()
         for _ in range(n_lines)
     ]
     for inj in injections:
+        at_start = isinstance(inj, tuple)
+        payload = inj[1] if at_start else inj
         for pos in rng.integers(0, n_lines, 20):
-            lines[int(pos)] = lines[int(pos)] + b" " + inj
+            if at_start:
+                lines[int(pos)] = payload + b" " + lines[int(pos)]
+            else:
+                lines[int(pos)] = lines[int(pos)] + b" " + payload
     return b"\n".join(lines) + b"\n"
 
 
@@ -168,6 +176,27 @@ def fam_overcap_literal(rng):
     return dict(pattern=w), re_oracle(re.escape(w).encode()), [w.encode(), near]
 
 
+def fam_mid_anchor(rng):
+    # round-5: mid-pattern anchors ('(^a|b)c') are in the subset compiler
+    # (models/dfa ls_eps/eol_eps); on the device they ride the
+    # anchor-stripped NFA filter with host confirm.  Inject line-start
+    # hits for the '^' branch, plain hits for the other, and mid-line
+    # decoys (needle preceded by a byte) the anchors must veto.
+    a, b, c = rand_word(rng, 2, 5), rand_word(rng, 2, 5), rand_word(rng, 1, 4)
+    if rng.random() < 0.5:
+        pat = f"(^{a}|{b}){c}"
+    else:
+        pat = f"{a}({b}$|{c})"
+    inj = [
+        ("start", (a + c).encode()),   # true '^' hit: a+c at line start
+        ("start", (a + b).encode()),   # '$'-variant line-start decoy
+        f"q{a}{c}".encode(),           # mid/end decoys the anchors veto
+        (a + b).encode(),              # true '$' hit at line end
+        (b + c).encode(),              # unanchored-branch hit anywhere
+    ]
+    return dict(pattern=pat), re_oracle(pat.encode()), inj
+
+
 FAMILIES = {
     "literal": fam_literal,
     "class_seq": fam_class_seq,
@@ -179,6 +208,7 @@ FAMILIES = {
     "approx": fam_approx,
     "dollar_anchor": fam_dollar_anchor,
     "overcap_literal": fam_overcap_literal,
+    "mid_anchor": fam_mid_anchor,
 }
 
 

@@ -23,7 +23,12 @@ Semantics baked into the table (all chosen for the TPU scan):
 * **Non-consuming anchors**: '^' branches are reachable only at line start
   (initial state / after the reset); '$' is a second accept set
   ``accept_at_eol`` — a match iff the *next* byte is '\\n' (scans pad a
-  trailing '\\n', so end-of-input behaves as end-of-line).
+  trailing '\\n', so end-of-input behaves as end-of-line).  Anchors are
+  supported at ANY position (round 5): mid-pattern '^'/'$' become
+  position-gated epsilons (ls_eps closed over only in the start state —
+  every line-start position IS the start state under newline reset;
+  eol_eps folds into accept_at_eol), so '(^a|b)c' is exact and 'a^b'
+  compiles to a match-nothing automaton, both per GNU line semantics.
 * **Byte classes**: bytes are partitioned into equivalence classes so the
   device table is [n_states, n_classes] rather than [n_states, 256].
 """
@@ -452,9 +457,16 @@ class _Parser:
 
 @dataclass
 class _NfaState:
-    # char transitions: list of (mask, target); eps: list of targets
+    # char transitions: list of (mask, target); eps: list of targets.
+    # ls_eps / eol_eps carry mid-pattern anchors (round 5): an ls_eps
+    # edge is traversable only at a line start (offset 0 or right after
+    # '\n' — exactly the newline-reset start state's closure), an
+    # eol_eps edge only when the next byte is '\n' or end-of-input
+    # (folded into the accept_eol plane, like top-level '$').
     chars: list = field(default_factory=list)
     eps: list = field(default_factory=list)
+    ls_eps: list = field(default_factory=list)
+    eol_eps: list = field(default_factory=list)
 
 
 class _Nfa:
@@ -495,10 +507,19 @@ class _Nfa:
         if isinstance(node, Repeat):
             return self._build_repeat(node)
         if isinstance(node, Anchor):
-            raise RegexError(
-                f"'{node.kind}' anchor only supported at the {'start' if node.kind == '^' else 'end'}"
-                " of the pattern or an alternation branch"
-            )
+            # Mid-pattern anchors (round 5 — e.g. '(^a|b)c', 'a(b$|c)'):
+            # a zero-width fragment whose epsilon is position-gated.  The
+            # newline-reset scan represents both exactly: every line-start
+            # position maps to the start state (ls_eps edges are closed
+            # over only there), and EOL validity is the accept_eol plane
+            # (eol_eps edges fold into it at subset-construction time).
+            # Top-level anchors never reach here (_split_anchors pops
+            # them); patterns like 'a^b' simply compile to automata with
+            # no matches, exactly GNU grep's per-line semantics.
+            s, a = self.new_state(), self.new_state()
+            edges = self.states[s].ls_eps if node.kind == "^" else self.states[s].eol_eps
+            edges.append(a)
+            return s, a
         raise AssertionError(f"unknown node {node!r}")
 
     def _build_repeat(self, node: Repeat) -> tuple[int, int]:
@@ -691,7 +712,19 @@ def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
         e = eol_offs.astype(np.int64)
         arr = np.frombuffer(data, dtype=np.uint8)
         keep = (e == n) | (arr[np.minimum(e, n - 1)] == NL)
+        if n and arr[n - 1] == NL and table.accept_eol[table.start]:
+            # a trailing '\n' parks the scan in the start state at offset
+            # n; a zero-width accept there would be a phantom line GNU
+            # does not count (consuming matches cannot end at n — they
+            # would contain the '\n').  Drop it.
+            keep &= e != n
         eol_offs = e[keep]
+    # the byte-walk reports accepts only AFTER consuming a byte, so a
+    # zero-width accept at position 0 (empty FIRST line — '^$', '$^')
+    # never surfaces from the native pass; inject offset 0, which the
+    # line attribution maps to line 1 (matching re.finditer's end()==0).
+    if table.accept_eol[table.start] and (n == 0 or data[0] == NL):
+        eol_offs = np.concatenate([[0], eol_offs.astype(np.int64)])
     if not eol_offs.size:
         return offsets
     return np.unique(
@@ -756,15 +789,44 @@ def compile_dfa(
     n = len(nfa.states)
     closures: list[frozenset[int]] = [frozenset()] * n
 
-    def closure(seed: frozenset[int]) -> frozenset[int]:
+    def closure(seed: frozenset[int], ls: bool = False) -> frozenset[int]:
+        """Epsilon closure; ``ls=True`` additionally traverses ls_eps
+        edges (mid-pattern '^') — valid only for the start state, whose
+        context IS "at a line start": offset 0 and every post-'\\n'
+        position reset to it, and no other DFA state ever corresponds to
+        a line-start position."""
         stack, seen = list(seed), set(seed)
         while stack:
             s = stack.pop()
-            for t in nfa.states[s].eps:
+            nxt = nfa.states[s].eps
+            if ls:
+                nxt = nxt + nfa.states[s].ls_eps
+            for t in nxt:
                 if t not in seen:
                     seen.add(t)
                     stack.append(t)
         return frozenset(seen)
+
+    # Mid-pattern '$' (eol_eps edges): a state that can cross an eol edge
+    # and then reach an accept through eps/eol edges ONLY (no byte may be
+    # consumed after asserting end-of-line within a line) accepts at EOL.
+    # ls_eps edges are NOT traversed here: '$^' would need the match to
+    # span a newline, which per-line semantics (and GNU grep) exclude.
+    all_accepts = accepts_now | accepts_eol
+    eol_sources: set[int] = set()
+    for sid in range(len(nfa.states)):
+        targets = nfa.states[sid].eol_eps
+        if not targets:
+            continue
+        stack, seen = list(targets), set(targets)
+        while stack:
+            u = stack.pop()
+            for v in nfa.states[u].eps + nfa.states[u].eol_eps:
+                if v not in seen:
+                    seen.add(v)
+                    stack.append(v)
+        if seen & all_accepts:
+            eol_sources.add(sid)
 
     # --- byte classes -----------------------------------------------------
     # Two bytes are equivalent iff they belong to exactly the same set of
@@ -783,7 +845,7 @@ def compile_dfa(
     nl_cls = int(byte_to_cls[NL])
 
     # --- subset construction ---------------------------------------------
-    start_set = closure(frozenset({root}))
+    start_set = closure(frozenset({root}), ls=True)
     dfa_index: dict[frozenset[int], int] = {start_set: 0}
     order: list[frozenset[int]] = [start_set]
     rows: list[list[int]] = []
@@ -817,7 +879,32 @@ def compile_dfa(
     n_states = len(order)
     trans = np.asarray(rows, dtype=np.uint16)
     accept = np.array([bool(S & accepts_now) for S in order], dtype=bool)
-    accept_eol = np.array([bool(S & accepts_eol) for S in order], dtype=bool)
+    accept_eol = np.array(
+        [bool(S & accepts_eol) or bool(S & eol_sources) for S in order],
+        dtype=bool,
+    )
+    # EMPTY-line case: in the start state at EOL the position is a line
+    # start AND an end-of-line simultaneously, so chains mixing '$' and
+    # '^' in either order ('$^', '$(^|b)') hold there — and only there
+    # (no other DFA state is ever at a line start).  The eol_sources walk
+    # above deliberately excludes ls_eps (mid-line '$^' must stay dead),
+    # so re-walk from the start set with ALL non-consuming edge kinds.
+    if not accept_eol[0]:
+        stack = list(start_set)
+        seen = set(stack)
+        while stack:
+            u = stack.pop()
+            st_u = nfa.states[u]
+            for v in st_u.eps + st_u.ls_eps + st_u.eol_eps:
+                if v not in seen:
+                    seen.add(v)
+                    stack.append(v)
+        # An assertion-only accepting chain from line start is exactly
+        # "the empty line matches".  (If it needed no eol edge at all,
+        # accept[0] is already True and every line matches — setting the
+        # eol plane too is subsumed, not wrong.)
+        if seen & all_accepts:
+            accept_eol[0] = True
     return DfaTable(
         trans=trans,
         byte_to_cls=byte_to_cls,

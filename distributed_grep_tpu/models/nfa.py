@@ -300,8 +300,14 @@ def compile_device_filter(
     except RegexError:
         return None
     relaxed, _ = _relax_bounded(ast)
+    # Mid-pattern anchors strip to epsilon (language superset, same end
+    # offsets — see _strip_anchors): '(^a|b)c' filters as '(a|b)c', and
+    # the per-line host confirm re-applies the real assertions.  Without
+    # this the Glushkov builder rejects anchored bodies outright
+    # (_has_anchor) and such patterns would stay off the device.
     branches = [
-        (a_start, body) for a_start, body, _ in _dfa._split_anchors(relaxed)
+        (a_start, _strip_anchors(body))
+        for a_start, body, _ in _dfa._split_anchors(relaxed)
     ]
     total = sum(_count_positions(b) for _, b in branches)
     # Fits untruncated: keep the whole body (max selectivity — the filter
@@ -345,9 +351,50 @@ def _compile_from_ast(
     return _compile_from_branches(branches, pattern, max_positions)
 
 
+def _has_anchor(node) -> bool:
+    """True when `node` contains an Anchor anywhere (mid-pattern '^'/'$'
+    — _split_anchors only pops top-level ones).  The DFA's subset
+    construction represents these exactly via ls_eps/eol_eps edges
+    (models/dfa.py, round 5), but this bit-parallel position automaton
+    has no position-gated epsilon: its closure would silently treat the
+    anchored continuation as dead — an UNDER-approximation that is wrong
+    for the exact automaton and fatal for a filter (filters must only
+    over-approximate).  Such bodies are rejected here; the device filter
+    path strips the anchors instead (_strip_anchors — a superset)."""
+    if isinstance(node, _dfa.Anchor):
+        return True
+    if isinstance(node, _dfa.Concat):
+        return any(_has_anchor(p) for p in node.parts)
+    if isinstance(node, _dfa.Alt):
+        return any(_has_anchor(o) for o in node.options)
+    if isinstance(node, _dfa.Repeat):
+        return _has_anchor(node.node)
+    return False
+
+
+def _strip_anchors(node):
+    """Copy of the AST with every Anchor replaced by epsilon (an empty
+    Concat).  Anchors consume nothing, so removal keeps every exact
+    match's end offset while enlarging the language — a candidate FILTER
+    transform with the same contract as dropping a trailing '$'."""
+    if isinstance(node, _dfa.Anchor):
+        return _dfa.Concat([])
+    if isinstance(node, _dfa.Concat):
+        parts = [_strip_anchors(p) for p in node.parts]
+        parts = [p for p in parts if not (isinstance(p, _dfa.Concat) and not p.parts)]
+        return _dfa.Concat(parts)
+    if isinstance(node, _dfa.Alt):
+        return _dfa.Alt([_strip_anchors(o) for o in node.options])
+    if isinstance(node, _dfa.Repeat):
+        return _dfa.Repeat(_strip_anchors(node.node), node.min, node.max)
+    return node
+
+
 def _compile_from_branches(
     branches, pattern: str, max_positions: int
 ) -> GlushkovModel | None:
+    if any(_has_anchor(body) for _, body, *_ in branches):
+        return None  # mid-pattern anchors: DFA/native exact paths only
     nfa = _dfa._Nfa()
     root = nfa.new_state()  # line-start entry
     floating = nfa.new_state()  # unanchored restart entry (no self-loop edge:

@@ -564,3 +564,52 @@ def test_fuzz_dollar_anchor_device_filter(seed):
             f"mode={eng.mode} pattern={pattern!r}: "
             f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
         )
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_fuzz_mid_anchor_subset(seed):
+    """Round-5 family: MID-pattern anchors — '(^a|b)c', 'a(b$|c)' — are
+    in the subset compiler now (models/dfa.py ls_eps/eol_eps edges), so
+    these patterns scan linearly on the DFA/native path and ride the
+    anchor-stripped device filter (models/nfa._strip_anchors) instead of
+    falling back to Python re.  Fuzzed vs the re oracle on both
+    backends, with line-start/line-end needle injections (the positions
+    the anchors actually gate) plus mid-line decoys the confirm must
+    reject."""
+    rng = np.random.default_rng(11_000 + seed)
+    a = _gen_literal(rng, int(rng.integers(2, 5)))
+    b = _gen_literal(rng, int(rng.integers(2, 5)))
+    c = _gen_literal(rng, int(rng.integers(1, 4)))
+    variant = seed % 5
+    pattern = {
+        0: lambda: f"(^{a}|{b}){c}",
+        1: lambda: f"{a}({b}$|{c})",
+        2: lambda: f"(^{a}|{b}$|{c})",
+        3: lambda: f"(^{a}|{b})({c}$|{a})",
+        4: lambda: f"{a}^{b}",  # never matches — per-line semantics
+    }[variant]()
+    rx = re.compile(pattern.encode())
+    data = _gen_corpus(rng, "words" if seed % 2 else "binary", 48 << 10, [])
+    lines = data.split(b"\n")
+    for _ in range(4):  # line-START hits/decoys for the '^' branches
+        i = int(rng.integers(0, len(lines)))
+        lines[i] = (a + c).encode() + b" " + lines[i]
+    for _ in range(4):  # line-END hits for the '$' branches
+        i = int(rng.integers(0, len(lines)))
+        lines[i] = lines[i] + b" " + (a + b).encode()
+    for _ in range(4):  # mid-line decoys: same bytes, anchors must veto
+        i = int(rng.integers(0, len(lines)))
+        lines[i] = lines[i][:1] + (a + c + a + b).encode() + lines[i][1:]
+    data = b"\n".join(lines)
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert eng.mode != "re", (
+            f"seed={seed} pattern={pattern!r} fell back to Python re"
+        )
+        assert got == want, (
+            f"seed={seed} variant={variant} backend={backend} "
+            f"mode={eng.mode} pattern={pattern!r}: "
+            f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+        )

@@ -520,3 +520,83 @@ def test_reference_scan_eol_vectorized_matches_oracle():
             m.end() for m in re.finditer(rx, data, re.M)
         )
         assert sorted(int(o) for o in got) == want, pat
+
+
+def test_mid_pattern_anchors_exact_in_dfa():
+    """Round 5: mid-pattern '^'/'$' anchors compile into the subset DFA
+    via position-gated epsilons (models/dfa ls_eps/eol_eps) — exactly
+    the newline-reset scan's semantics — instead of raising into the
+    Python-re fallback.  Checked per line vs the re oracle."""
+    import re as _re
+
+    data = (b"ac here\nxac\nbc mid\nzbc\nac\nempty\n\nfoo then\nfoo\n"
+            b"bar foo\nABCD\nxABCD\n")
+    cases = [
+        r"(^a|b)c", r"a^b", r"x$y", r"foo(bar$|o)?", r"(foo$|bar)",
+        r"a(^|x)c", r"(^|f)oo", r"(^ac|bc$)", r"(a$|b)c", r"(^AB|BC)D",
+    ]
+    nl = np.flatnonzero(np.frombuffer(data, np.uint8) == 10)
+    for pat in cases:
+        table = dfa_mod.compile_dfa(pat)
+        offs = np.asarray(dfa_mod.reference_scan(table, data), np.int64)
+        got = set((np.searchsorted(nl, offs - 1, side="left") + 1).tolist())
+        want = {
+            i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+            if _re.search(pat.encode(), ln)
+        }
+        assert got == want, f"{pat!r}: +{got - want} -{want - got}"
+
+
+def test_mid_pattern_anchors_glushkov_rejects_filter_strips():
+    """The bit-parallel Glushkov automaton has no position-gated epsilon,
+    so exact compiles of mid-anchor bodies must return None (a silent
+    compile would UNDER-approximate — fatal for a filter); the device
+    filter path strips the anchors instead (superset) and the candidate
+    lines cover every exact match line."""
+    for pat in (r"(^a|b)c", r"a(b$|c)d", r"(^ab|cd$)"):
+        assert nfa_mod.try_compile_glushkov(pat) is None, pat
+        m = nfa_mod.compile_device_filter(pat)
+        assert m is not None, pat
+
+    table = dfa_mod.compile_dfa(r"(^ac|bc$)")
+    filt = nfa_mod.compile_device_filter(r"(^ac|bc$)")
+    data = make_text(80, inject=[(3, b"ac lead"), (9, b"tail bc"),
+                                 (14, b"xacx mid decoy"), (21, b"bcx")])
+    nl = np.flatnonzero(np.frombuffer(data, np.uint8) == 10)
+
+    def lines_of(offs):
+        o = np.asarray(offs, np.int64)
+        return set((np.searchsorted(nl, o - 1, side="left") + 1).tolist())
+
+    exact = lines_of(dfa_mod.reference_scan(table, data))
+    cand = lines_of(nfa_mod.scan_reference(filt, data))
+    assert exact <= cand
+    assert exact  # the injections really produced anchored matches
+
+
+def test_mixed_anchor_chains_match_empty_lines():
+    """'$^'-ordered chains hold on EMPTY lines (the position is a line
+    start AND an end-of-line simultaneously) — models/dfa marks
+    accept_eol on the start state via a mixed non-consuming walk, and
+    reference_scan injects the position-0 zero-width accept the native
+    byte-walk cannot report (plus drops the trailing-'\\n' phantom).
+    Pinned engine-level and oracle-level (round-5 review finding)."""
+    import re as _re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    datasets = [b"\nab\n\nx\n", b"ab\n\n", b"\n", b"ab\nx\n", b"ab"]
+    for pat in (r"$^", r"$(^|b)", r"(a|^)(b|$)", r"^$"):
+        for data in datasets:
+            want = {i for i, ln in enumerate(data.split(b"\n")[: -1 if data.endswith(b"\n") else None], 1)
+                    if _re.search(pat.encode(), ln)}
+            got_oracle = dfa_mod.matched_lines(dfa_mod.compile_dfa(pat), data)
+            assert got_oracle == want, (
+                f"oracle {pat!r} on {data!r}: got {got_oracle} want {want}"
+            )
+            eng = GrepEngine(pat, backend="cpu")
+            got = set(eng.scan(data).matched_lines.tolist())
+            assert got == want, (
+                f"engine {pat!r} on {data!r} mode={eng.mode}: "
+                f"got {got} want {want}"
+            )
