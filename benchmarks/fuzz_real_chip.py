@@ -197,6 +197,17 @@ def fam_mid_anchor(rng):
     return dict(pattern=pat), re_oracle(pat.encode()), inj
 
 
+def fam_word_boundary(rng):
+    # round-5: \b/\B strip for the device NFA filter (superset), with
+    # candidate lines re-confirmed under the original semantics.
+    # Injections plant word-bounded hits and glued decoys the confirm
+    # must reject.
+    w = rand_word(rng, 3, 7)
+    pat = {0: rf"\b{w}\b", 1: rf"\b{w}", 2: rf"{w}\B"}[int(rng.integers(0, 3))]
+    inj = [w.encode(), f"x{w}".encode(), f"{w}x9".encode(), f".{w}.".encode()]
+    return dict(pattern=pat), re_oracle(pat.encode()), inj
+
+
 FAMILIES = {
     "literal": fam_literal,
     "class_seq": fam_class_seq,
@@ -209,6 +220,7 @@ FAMILIES = {
     "dollar_anchor": fam_dollar_anchor,
     "overcap_literal": fam_overcap_literal,
     "mid_anchor": fam_mid_anchor,
+    "word_boundary": fam_word_boundary,
 }
 
 

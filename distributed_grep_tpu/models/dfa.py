@@ -301,6 +301,18 @@ class _Parser:
             self.pos += 1
             return Anchor("$")
         if c == ord("\\"):
+            nxt = self.src[self.pos + 1] if self.pos + 1 < len(self.src) else None
+            if nxt in (ord("b"), ord("B")):
+                # Word boundaries parse into Anchor nodes (round 5).  The
+                # automaton subset cannot express them (the match needs a
+                # byte of lookahead the scan planes don't carry — see
+                # _Nfa.build), but parsing them lets the device-filter
+                # path STRIP them (models/nfa._strip_anchors: a language
+                # superset at the same end offsets) and re-confirm
+                # candidate lines, so '\berror\b' rides the Pallas NFA
+                # filter instead of the pure per-line re loop.
+                self.pos += 2
+                return Anchor(chr(nxt))
             return Char(self._fold(self._escape()))
         if c in (ord("*"), ord("+"), ord("?"), ord("{"), ord("}")):
             # '{' not opening a valid bound is literal, like grep
@@ -379,8 +391,10 @@ class _Parser:
         if c == ord("b") and in_class:
             return _mask_of(8)  # [\b] = backspace, like re
         if c in (ord("b"), ord("B"), ord("A"), ord("Z"), ord("z"), ord("G")):
-            # zero-width assertions beyond ^: same story — defer to re
-            # (inside a class these are invalid in re too)
+            # zero-width assertions beyond ^/$/\b: defer to re (inside a
+            # class these are invalid in re too).  \b/\B never reach here
+            # at atom level — _atom parses them into Anchor nodes first
+            # (round 5) so the device-filter path can strip+confirm them.
             raise RegexError(f"\\{chr(c)} assertion is not supported "
                              "by the automaton subset")
         return _mask_of(c)  # escaped literal (metachars, punctuation, ...)
@@ -516,6 +530,15 @@ class _Nfa:
             # Top-level anchors never reach here (_split_anchors pops
             # them); patterns like 'a^b' simply compile to automata with
             # no matches, exactly GNU grep's per-line semantics.
+            if node.kind not in ("^", "$"):
+                # \b/\B: wordness of the NEXT byte is one byte of
+                # lookahead the accept planes don't carry — no exact
+                # table form.  Raising routes the engine to its re
+                # fallback, where the device rescue strips the anchors
+                # into a filter and re-confirms candidate lines.
+                raise RegexError(
+                    f"\\{node.kind} assertion has no exact automaton form"
+                )
             s, a = self.new_state(), self.new_state()
             edges = self.states[s].ls_eps if node.kind == "^" else self.states[s].eol_eps
             edges.append(a)

@@ -625,6 +625,21 @@ class GrepEngine:
                         )
                     except RegexError:
                         filt = None
+                    if filt is None:
+                        # \b/\B word boundaries (round 5): no exact
+                        # automaton form at all, but the device filter
+                        # strips zero-width assertions (language superset
+                        # at the same end offsets) — '\berror\b' then
+                        # scans as 'error' on the Pallas kernel and every
+                        # candidate line is re-confirmed below, the same
+                        # contract as the expansion-cap rescue.
+                        from distributed_grep_tpu.models.nfa import (
+                            compile_device_filter,
+                        )
+
+                        filt = compile_device_filter(
+                            pattern, ignore_case=ignore_case
+                        )
                     if filt is not None:
                         log.info(
                             "pattern %r rescued onto the device NFA filter "

@@ -613,3 +613,47 @@ def test_fuzz_mid_anchor_subset(seed):
             f"mode={eng.mode} pattern={pattern!r}: "
             f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
         )
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_fuzz_word_boundary_filter(seed):
+    """Round-5 family: \\b/\\B word boundaries — stripped for the device
+    filter (superset at the same end offsets), candidate lines
+    re-confirmed with the original semantics.  Injections place needles
+    with word and non-word neighbors on both sides so the confirm has
+    true positives AND boundary-violating decoys on every draw."""
+    rng = np.random.default_rng(12_000 + seed)
+    w = _gen_literal(rng, int(rng.integers(3, 7)))
+    variant = seed % 4
+    pattern = {
+        0: lambda: rf"\b{w}\b",
+        1: lambda: rf"\b{w}",
+        2: lambda: rf"{w}\B",
+        3: lambda: rf"\B{w}\b",
+    }[variant]()
+    rx = re.compile(pattern.encode())
+    # corpus kind decorrelated from the variant cycle (seed % 4) so every
+    # variant runs on BOTH corpus kinds across the seed range
+    data = _gen_corpus(rng, "words" if (seed // 4) % 2 else "binary",
+                       48 << 10, [])
+    lines = data.split(b"\n")
+    wb = w.encode()
+    for dec in (b" %s " % wb, b"x%s" % wb, b"%sx" % wb, b"9%s_" % wb,
+                b".%s." % wb, wb):
+        for _ in range(3):
+            i = int(rng.integers(0, len(lines)))
+            lines[i] = lines[i] + b" " + dec
+    data = b"\n".join(lines)
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        if backend == "device":
+            assert eng.mode == "nfa", (
+                f"seed={seed} pattern={pattern!r} missed the filter rescue"
+            )
+        assert got == want, (
+            f"seed={seed} variant={variant} backend={backend} "
+            f"mode={eng.mode} pattern={pattern!r}: "
+            f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+        )

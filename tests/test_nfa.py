@@ -600,3 +600,40 @@ def test_mixed_anchor_chains_match_empty_lines():
                 f"engine {pat!r} on {data!r} mode={eng.mode}: "
                 f"got {got} want {want}"
             )
+
+
+def test_word_boundary_device_filter_strip_confirm():
+    """Round 5: \\b/\\B parse into Anchor nodes; no exact automaton form
+    exists (the accept planes carry no next-byte wordness), so the
+    device rescue strips them into a filter (superset, same end offsets)
+    and re-confirms candidate lines — '\\berror\\b' scans as 'error' on
+    the Pallas NFA kernel.  Exact vs the re oracle on both backends."""
+    import re as _re
+
+    from distributed_grep_tpu.models.dfa import RegexError
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    # no exact compile anywhere; the filter strips and compiles
+    for pat in (r"\berror\b", r"wordy\B", r"\b[ew]or\w+\b"):
+        with pytest.raises(RegexError):
+            dfa_mod.compile_dfa(pat)
+        assert nfa_mod.try_compile_glushkov(pat) is None, pat
+        assert nfa_mod.compile_device_filter(pat) is not None, pat
+    # [\b] stays backspace (a Char), like re
+    assert isinstance(dfa_mod._Parser(r"[\b]", False).parse(), dfa_mod.Char)
+
+    data = (b"error here\nxerrors\nsuberror\nan error\nerror\nb2c ok\n"
+            b"b2cx\nword boundary\nwordy\n" * 40)
+    for pat in (r"\berror\b", r"\bword", r"wordy\B", r"\Berror",
+                r"b2c\b", r"\b[ew]or\w+\b"):
+        want = [i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+                if _re.search(pat.encode(), ln)]
+        for kw in (dict(backend="cpu"), dict(interpret=True)):
+            eng = GrepEngine(pat, **kw)
+            eng._accel_cached = True
+            got = eng.scan(data).matched_lines.tolist()
+            assert got == want, (
+                f"{pat!r} {kw} mode={eng.mode}: "
+                f"{sorted(set(got) ^ set(want))[:5]}"
+            )
+        assert GrepEngine(pat, interpret=True).mode == "nfa", pat
