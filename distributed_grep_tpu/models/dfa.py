@@ -302,6 +302,17 @@ class _Parser:
             return Anchor("$")
         if c == ord("\\"):
             nxt = self.src[self.pos + 1] if self.pos + 1 < len(self.src) else None
+            if nxt in (ord("A"), ord("Z")):
+                # Per-line semantics make these exact synonyms of the
+                # line anchors: a line-string contains no '\n', so \A is
+                # start-of-line and \Z is end-of-line (verified
+                # equivalent under the per-line re oracle).  GNU grep -E
+                # has no \A/\Z, so CLI parity is unaffected; library
+                # callers get them for free instead of the re fallback.
+                # \z stays deferred: Python re rejects it too, so there
+                # is no oracle to be compatible with.
+                self.pos += 2
+                return Anchor("^" if nxt == ord("A") else "$")
             if nxt in (ord("b"), ord("B")):
                 # Word boundaries parse into Anchor nodes (round 5).  The
                 # automaton subset cannot express them (the match needs a

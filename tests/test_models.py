@@ -237,9 +237,14 @@ def test_backrefs_and_assertions_reject_to_re_fallback():
     from distributed_grep_tpu.models.dfa import RegexError, compile_dfa
     from distributed_grep_tpu.ops.engine import GrepEngine
 
-    for pat in (r"(ab)\1", r"\bword\b", r"a\Z", r"x\Bd"):
+    # round 5: \b/\B parse into Anchor nodes (device filter+confirm) and
+    # \A/\Z map to the line anchors — only backrefs and \z/\G still
+    # reject at parse; \b-containing patterns reject at NFA build (no
+    # exact table form), never scanning for literal 'bwordb'
+    for pat in (r"(ab)\1", r"\bword\b", r"x\Bd"):
         with pytest.raises(RegexError):
             compile_dfa(pat)
+    compile_dfa(r"a\Z")  # == 'a$' under per-line semantics
     eng = GrepEngine(r"\bword\b", backend="cpu")
     assert eng.mode == "re"
     assert eng.scan(b"a word x\nwords\nbwordb\n").matched_lines.tolist() == [1]

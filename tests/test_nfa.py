@@ -637,3 +637,28 @@ def test_word_boundary_device_filter_strip_confirm():
                 f"{sorted(set(got) ^ set(want))[:5]}"
             )
         assert GrepEngine(pat, interpret=True).mode == "nfa", pat
+
+
+def test_string_anchors_map_to_line_anchors():
+    """\\A and \\Z are exact synonyms of '^'/'$' under per-line matching
+    (a line-string contains no newline), so they compile into the
+    automaton subset instead of deferring to re; \\z stays deferred
+    (Python re rejects it — no oracle to agree with)."""
+    import re as _re
+
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    data = b"foo bar\nxfoo\nbarfoo\nfoo\nmid foo end\n" * 20
+    for pat in (r"\Afoo", r"foo\Z", r"(\Afoo|bar\Z)"):
+        want = [i for i, ln in enumerate(data.split(b"\n")[:-1], 1)
+                if _re.search(pat.encode(), ln)]
+        for kw in (dict(backend="cpu"), dict(interpret=True)):
+            eng = GrepEngine(pat, **kw)
+            eng._accel_cached = True
+            assert eng.mode != "re", (pat, kw)
+            got = eng.scan(data).matched_lines.tolist()
+            assert got == want, (pat, kw, eng.mode)
+    # \z defers to the re fallback, which rejects it — the same invalid-
+    # pattern error a user gets from re.compile (CLI: exit 2)
+    with pytest.raises(_re.error):
+        GrepEngine(r"foo\z", backend="cpu")
