@@ -53,6 +53,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metrics", action="store_true", help="print job metrics to stderr")
 
 
+def _validate_regex(rx: str):
+    """re.compile after POSIX-class expansion — the user-facing validity
+    check.  Expansion can itself reject (unknown [:name:], like GNU's
+    "Unknown character class name"); both failures surface as the same
+    invalid-pattern diagnostic (exit 2)."""
+    import re
+
+    from distributed_grep_tpu.models.dfa import RegexError, expand_posix_classes
+
+    try:
+        re.compile(expand_posix_classes(rx))
+    except RegexError as e:
+        raise re.error(str(e)) from e
+
+
 def _grep_stdin_stream(args: argparse.Namespace, patterns) -> int:
     """GNU-streaming stdin grep (round 5): one in-process split fed from
     incremental pipe reads through the same engine the job path uses.
@@ -234,7 +249,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
         else:
             for rx in args.e_patterns:
                 try:
-                    re.compile(rx)
+                    _validate_regex(rx)
                 except re.error as e:
                     print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
                     return 2
@@ -280,7 +295,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
             decoded = [ln.decode("utf-8", "surrogateescape") for ln in raw]
             for rx in decoded:
                 try:
-                    re.compile(rx)
+                    _validate_regex(rx)
                 except re.error as e:
                     print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
                     return 2
@@ -311,7 +326,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
     # even when every line compiled on its own
     if patterns is None and args.pattern is not None:
         try:
-            re.compile(args.pattern)
+            _validate_regex(args.pattern)
         except re.error as e:
             print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
             return 2
@@ -821,7 +836,11 @@ def _print_only_matching(res, args, patterns, matched, offsets=None,
     # byte-wise semantics, incl. ASCII-only -i folding — the str-typed
     # fallback previously Unicode-folded, so `-o -i` could select
     # different substrings than `-o -i -m N` — round-5 review).
-    wrapped = wrap_mode(base.encode("utf-8", "surrogateescape"), mode)
+    from distributed_grep_tpu.models.dfa import expand_posix_classes
+
+    # POSIX classes expand before re sees them (re misparses [[:digit:]])
+    wrapped = wrap_mode(
+        expand_posix_classes(base.encode("utf-8", "surrogateescape")), mode)
     rx_b = re.compile(wrapped, flags)
 
     if offsets is None and matched is None and res.fileline_sorted:

@@ -74,7 +74,11 @@ def build_confirm(
         ]
         base = b"(?:" + b"|".join(re.escape(p) for p in norm) + b")"
     else:
-        base = (
+        from distributed_grep_tpu.models.dfa import expand_posix_classes
+
+        # POSIX classes must expand before re sees them (re misparses
+        # [[:digit:]]; models/dfa.expand_posix_classes docstring)
+        base = expand_posix_classes(
             pattern.encode("utf-8", "surrogateescape")
             if isinstance(pattern, str) else bytes(pattern)
         )
@@ -130,9 +134,15 @@ def configure(
             patterns=norm, ignore_case=ignore_case, mode=_line_mode
         )
     else:
+        from distributed_grep_tpu.models.dfa import expand_posix_classes
+
         _ac_tables = None
         _ac_confirm = None
-        _pattern = re.compile(wrap_mode(pattern, _line_mode), flags)
+        # expand POSIX classes for re (this app IS re-based by design —
+        # the reference mirror); keeps it line-identical to the TPU app
+        _pattern = re.compile(
+            wrap_mode(expand_posix_classes(pattern), _line_mode), flags
+        )
     _configured_with = key
 
 

@@ -76,6 +76,199 @@ _WORD = _DIGIT | _class_mask("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWX
 _SPACE = _class_mask(" \t\r\x0b\x0c")
 
 
+def _range_mask(lo: int, hi: int) -> int:
+    m = 0
+    for b in range(lo, hi + 1):
+        m |= 1 << b
+    return m
+
+
+_UPPER = _range_mask(ord("A"), ord("Z"))
+_LOWER = _range_mask(ord("a"), ord("z"))
+_ALPHA = _UPPER | _LOWER
+# POSIX bracket classes ([[:digit:]] etc.) in the C locale — GNU grep -E
+# supports these and Python re does NOT, so they must compile into the
+# automaton subset (there is no re fallback that could host them).  ASCII
+# byte definitions; space/cntrl exclude '\n' (never matchable within a
+# line — the same semantics-preserving exclusion as '.'/\s above).
+_POSIX_CLASSES = {
+    "alpha": _ALPHA,
+    "digit": _DIGIT,
+    "alnum": _ALPHA | _DIGIT,
+    "upper": _UPPER,
+    "lower": _LOWER,
+    "space": _SPACE,
+    "blank": _class_mask(" \t"),
+    "punct": (_range_mask(33, 47) | _range_mask(58, 64)
+              | _range_mask(91, 96) | _range_mask(123, 126)),
+    "print": _range_mask(32, 126),
+    "graph": _range_mask(33, 126),
+    "cntrl": (_range_mask(0, 31) | _mask_of(127)) & ~_mask_of(NL),
+    "xdigit": _DIGIT | _range_mask(ord("A"), ord("F"))
+              | _range_mask(ord("a"), ord("f")),
+}
+
+
+def _mask_to_class_text(mask: int) -> bytes:
+    """Class-body text (\\xHH / \\xHH-\\xHH runs) denoting `mask` — valid
+    inside a bracket expression for BOTH this module's parser and
+    Python re."""
+    parts = []
+    b = 0
+    while b < 256:
+        if mask >> b & 1:
+            lo = b
+            while b < 256 and mask >> b & 1:
+                b += 1
+            hi = b - 1
+            parts.append(b"\\x%02x" % lo if lo == hi
+                         else b"\\x%02x-\\x%02x" % (lo, hi))
+        else:
+            b += 1
+    return b"".join(parts)
+
+
+_POSIX_EXPANSIONS = {k: _mask_to_class_text(v) for k, v in _POSIX_CLASSES.items()}
+
+
+def _scan_collating(src: bytes, i: int) -> tuple[int, int]:
+    """``src[i:i+2]`` is ``[.`` or ``[=`` inside a bracket expression:
+    a POSIX collating symbol / equivalence class.  In the C locale only
+    the trivial single-character forms exist — ``[.c.]`` / ``[=c=]``
+    denote the character itself; anything longer (or empty) is GNU's
+    "Invalid collation character", exit 2 (GNU-verified).  Returns
+    (byte, index past the closing ``.]``/``=]``)."""
+    d = src[i + 1]  # ord('.') or ord('=')
+    end = src.find(bytes([d, ord("]")]), i + 2)
+    if end < 0:
+        raise RegexError(f"unterminated '[{chr(d)}' at {i}")
+    if end != i + 3:  # exactly one character between the delimiters
+        raise RegexError("invalid collation character")
+    return src[i + 2], end + 2
+
+
+def _scan_posix_class(src: bytes, i: int) -> tuple[str, int]:
+    """``src[i:i+2] == b'[:'`` inside a bracket expression: scan the
+    class name.  Returns (name, index just past ':]').  Raises on an
+    unterminated '[:' or an unknown name — GNU rejects both with exit 2
+    ("Unmatched [ ..." / "Unknown character class name").  The ONE
+    scanner shared by the parser and expand_posix_classes, so the
+    validator and the automaton cannot drift."""
+    end = src.find(b":]", i + 2)
+    if end < 0:
+        raise RegexError(f"unterminated '[:' at {i}")
+    name = src[i + 2:end].decode("ascii", "replace")
+    if name not in _POSIX_CLASSES:
+        raise RegexError(f"unknown POSIX class [:{name}:]")
+    return name, end + 2
+
+
+def _reject_single_bracket_class(src: bytes, open_pos: int) -> None:
+    """GNU errors on the `[:name:]` single-bracket form ("character
+    class syntax is [[:space:]], not [:space:]"): a bracket expression
+    whose content starts with ':' AND whose closing ']' is preceded by
+    ':'.  `[:a]` (no ':]' close) stays a literal member class, like GNU,
+    and the negated form `[^:name:]` rejects exactly like the plain one
+    (GNU-verified).  ``open_pos`` indexes the '['."""
+    j = open_pos + 1
+    if j < len(src) and src[j] == ord("^"):
+        j += 1
+    if j >= len(src) or src[j] != ord(":"):
+        return
+    close = src.find(b"]", j + 1)
+    if close > j + 1 and src[close - 1] == ord(":"):
+        raise RegexError(
+            "character class syntax is [[:name:]], not [:name:]"
+        )
+
+
+def expand_posix_classes(pattern):
+    """Rewrite POSIX bracket classes ([[:digit:]] etc.) into \\xHH-range
+    form understood by BOTH this module's parser and Python re.
+
+    This is the single translation point for every code path that hands
+    the user's pattern to re for SEMANTICS — the -w/-x confirm regexes,
+    the CLI's -o matcher, apps/grep.py's reference-mirror matcher, the
+    engine's re fallback: Python re has no POSIX classes and silently
+    misparses ``[[:digit:]]`` as the character set {[ : d i g t}, so any
+    unexpanded handoff would diverge from GNU.  Outside bracket
+    expressions ``[:name:]`` has no special meaning and is left alone;
+    a well-formed ``[:name:]`` with an unknown name raises RegexError
+    (GNU errors on those too).  Accepts str or bytes and returns the
+    same type."""
+    is_str = isinstance(pattern, str)
+    src = pattern.encode("utf-8", "surrogateescape") if is_str else bytes(pattern)
+    out = bytearray()
+    i, n = 0, len(src)
+    in_class = False
+    # previous in-class token kind — "none" (just opened / after ^ or a
+    # leading ]), "member" (char, escaped pair, class, collating symbol),
+    # "dash" (a '-' that follows a member, i.e. a potential range
+    # operator).  Tracked so the range-adjacency guards can't be fooled
+    # by escaped bytes the way raw last-byte peeking was (round-5
+    # review: '[a\\-[:digit:]]' vs '[\\^-[:digit:]]').
+    prev = "none"
+    while i < n:
+        c = src[i]
+        if c == 0x5C and i + 1 < n:  # backslash escape, either context
+            out += src[i:i + 2]
+            i += 2
+            if in_class:
+                prev = "member"
+            continue
+        if not in_class:
+            if c == ord("["):
+                _reject_single_bracket_class(src, i)  # [:name:] like GNU
+            out.append(c)
+            i += 1
+            if c == ord("["):
+                in_class = True
+                prev = "none"
+                # leading '^' and a first ']' are literal class members
+                if i < n and src[i] == ord("^"):
+                    out.append(src[i])
+                    i += 1
+                if i < n and src[i] == ord("]"):
+                    out.append(src[i])
+                    i += 1
+                    prev = "member"
+            continue
+        if c == ord("[") and i + 1 < n and src[i + 1] in (
+            ord(":"), ord("."), ord("=")
+        ):
+            # dash just before: [a-[:digit:]] is GNU "Invalid range end"
+            # (a LEADING '-' as in [-[:digit:]] stays a literal member)
+            if prev == "dash" and src[i + 1] == ord(":"):
+                raise RegexError("invalid range: POSIX class as range end")
+            if src[i + 1] == ord(":"):
+                name, i = _scan_posix_class(src, i)
+                out += _POSIX_EXPANSIONS[name]
+                # dash just after: [[:digit:]-z] is GNU "Invalid range
+                # end" ([[:digit:]-] with the literal dash stays fine)
+                if (i + 1 < n and src[i] == ord("-")
+                        and src[i + 1] != ord("]")):
+                    raise RegexError(
+                        "invalid range: POSIX class as range start"
+                    )
+            else:
+                # [.c.] / [=c=]: the character itself (C locale);
+                # emit \xHH so re can't misread metacharacters
+                byte, i = _scan_collating(src, i)
+                out += b"\\x%02x" % byte
+            prev = "member"
+            continue
+        if c == ord("]"):
+            in_class = False
+        elif c == ord("-"):
+            prev = "dash" if prev == "member" else "member"
+        else:
+            prev = "member"
+        out.append(c)
+        i += 1
+    res = bytes(out)
+    return res.decode("utf-8", "surrogateescape") if is_str else res
+
+
 # --------------------------------------------------------------------- AST
 
 @dataclass
@@ -413,6 +606,7 @@ class _Parser:
     def _char_class(self) -> int:
         start = self.pos
         assert self.src[self.pos] == ord("[")
+        _reject_single_bracket_class(self.src, start)  # [:name:] like GNU
         self.pos += 1
         negate = False
         if self._peek() == ord("^"):
@@ -428,7 +622,45 @@ class _Parser:
                 self.pos += 1
                 break
             first = False
-            if c == ord("\\"):
+            if (
+                c == ord("[")
+                and self.pos + 1 < len(self.src)
+                and self.src[self.pos + 1] in (ord("."), ord("="))
+            ):
+                # [.c.] / [=c=]: trivial C-locale collating forms — the
+                # character itself; longer names reject (_scan_collating)
+                byte, self.pos = _scan_collating(self.src, self.pos)
+                m = _mask_of(byte)
+                # fall through to the range logic: [[.a.]-z] is a valid
+                # range in GNU (the collating symbol is its character)
+            elif (
+                c == ord("[")
+                and self.pos + 1 < len(self.src)
+                and self.src[self.pos + 1] == ord(":")
+            ):
+                # POSIX bracket class [:name:] (GNU grep -E supports
+                # these; Python re does not, so the re fallback can't —
+                # round 5).  C-locale / ASCII byte definitions; '\n' is
+                # excluded from the classes that would contain it
+                # (space, cntrl) — a pattern can never consume '\n'
+                # under per-line semantics, so exclusion is
+                # semantics-preserving (same argument as '.').
+                name, after = _scan_posix_class(self.src, self.pos)
+                mask |= _POSIX_CLASSES[name]
+                self.pos = after
+                # a class can't be a range endpoint ([[:digit:]-z] is
+                # GNU's "Invalid range end", exit 2; a trailing literal
+                # '-' as in [[:digit:]-] stays fine)
+                if (
+                    self._peek() == ord("-")
+                    and self.pos + 1 < len(self.src)
+                    and self.src[self.pos + 1] != ord("]")
+                ):
+                    raise RegexError(
+                        "invalid range: POSIX class as range start"
+                    )
+                continue
+            elif c == ord("\\"):
                 m = self._escape(in_class=True)
             else:
                 self.pos += 1
@@ -442,7 +674,24 @@ class _Parser:
             ):
                 self.pos += 1
                 hi_c = self._peek()
-                if hi_c == ord("\\"):
+                if (
+                    hi_c == ord("[")
+                    and self.pos + 1 < len(self.src)
+                    and self.src[self.pos + 1] == ord(":")
+                ):
+                    # [a-[:digit:]]: GNU "Invalid range end", exit 2
+                    raise RegexError(
+                        "invalid range: POSIX class as range end"
+                    )
+                if (
+                    hi_c == ord("[")
+                    and self.pos + 1 < len(self.src)
+                    and self.src[self.pos + 1] in (ord("."), ord("="))
+                ):
+                    # [a-[.z.]]: the collating symbol is its character
+                    byte, self.pos = _scan_collating(self.src, self.pos)
+                    hi_m = _mask_of(byte)
+                elif hi_c == ord("\\"):
                     hi_m = self._escape(in_class=True)
                 else:
                     self.pos += 1

@@ -606,9 +606,19 @@ class GrepEngine:
                 # unsupported syntax): host re fallback, like the reference.
                 log.info("pattern %r -> host re fallback (%s)", pattern, e)
                 flags = _re.IGNORECASE if ignore_case else 0
+                from distributed_grep_tpu.models.dfa import (
+                    expand_posix_classes,
+                )
+
+                # POSIX classes must expand before re sees them (re
+                # misparses [[:digit:]]); matters for e.g. a \b pattern
+                # whose body uses them — the rescue confirms candidate
+                # lines with this matcher
                 self._re_fallback = _re.compile(
-                    pattern.encode("utf-8", "surrogateescape")
-                    if isinstance(pattern, str) else pattern, flags
+                    expand_posix_classes(
+                        pattern.encode("utf-8", "surrogateescape")
+                        if isinstance(pattern, str) else bytes(pattern)
+                    ), flags
                 )
                 self.mode = "re"
                 if backend == "device":

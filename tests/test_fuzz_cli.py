@@ -374,3 +374,41 @@ def test_fuzz_cli_short_pattern_sets(seed, tmp_path, capsys):
     assert _parse_ours(out) == _parse_gnu(gout, paths, 2), \
         f"seed={seed} pats={pats}"
     assert rc == grc
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_cli_posix_classes(seed, tmp_path, capsys):
+    """POSIX bracket classes ([[:digit:]] etc., round 5) vs GNU grep -E:
+    Python re cannot oracle these (it misparses [:name:] as member
+    chars), so GNU itself is the oracle — selection, -c, and -w across
+    positive and negated classes, plus the unknown-name error."""
+    rng = np.random.default_rng(17_000 + seed)
+    paths = _make_files(rng, tmp_path)
+    cls = ["digit", "alpha", "upper", "lower", "alnum", "punct",
+           "space", "xdigit"][int(rng.integers(0, 8))]
+    pattern = {
+        0: lambda: f"[[:{cls}:]]+",
+        1: lambda: f"[^[:{cls}:]]",
+        2: lambda: f"[[:{cls}:]_-]+",
+        3: lambda: f"x[[:{cls}:]]",
+    }[seed % 4]()
+    rc, out = _run_ours(["grep", "-E", pattern, *paths], capsys)
+    grc, gout = _run_gnu(["-E", "-n", pattern, *paths])
+    got = _parse_ours(out)
+    want = _parse_gnu(gout, paths, 2)
+    assert got == want, f"seed={seed} pattern={pattern!r}"
+    assert rc == grc
+    # -w wraps the confirm regex around the expanded class
+    rc, out = _run_ours(["grep", "-E", "-c", "-w", pattern, *paths], capsys)
+    grc, gout = _run_gnu(["-E", "-c", "-w", pattern, *paths])
+    assert sorted(out) == sorted(gout), f"seed={seed} -c -w {pattern!r}"
+    assert rc == grc
+
+
+def test_cli_posix_class_unknown_name_errors(tmp_path, capsys):
+    """[[:junk:]] is an invalid-pattern error (exit 2), like GNU."""
+    f = tmp_path / "a.txt"
+    f.write_text("abc\n")
+    rc, _ = _run_ours(["grep", "-E", "[[:junk:]]", str(f)], capsys)
+    grc, _ = _run_gnu(["-E", "[[:junk:]]", str(f)])
+    assert rc == grc == 2
