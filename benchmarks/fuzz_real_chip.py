@@ -197,6 +197,26 @@ def fam_mid_anchor(rng):
     return dict(pattern=pat), re_oracle(pat.encode()), inj
 
 
+def fam_posix_classes(rng):
+    # round-5: POSIX bracket classes compile into the automaton subset
+    # (re can't host them); oracle = re of the expanded form, which the
+    # CLI fuzz pins against GNU.  Drawn with literal tails/repeats so
+    # the engine routes across shift_and/nfa/dfa modes.
+    from distributed_grep_tpu.models.dfa import expand_posix_classes
+
+    names = ["digit", "alpha", "upper", "lower", "alnum", "punct", "xdigit"]
+    nm = names[int(rng.integers(0, len(names)))]
+    w = rand_word(rng, 2, 5)
+    pat = {
+        0: lambda: f"{w}[[:{nm}:]]",
+        1: lambda: f"[[:{nm}:]]{{2,4}}{w}",
+        2: lambda: f"{w}[^[:{nm}:]]{w[:2]}",
+    }[int(rng.integers(0, 3))]()
+    inj = [f"{w}7".encode(), f"{w}Q".encode(), f"99{w}".encode(),
+           f"{w}.{w[:2]}".encode()]
+    return dict(pattern=pat), re_oracle(expand_posix_classes(pat).encode()), inj
+
+
 def fam_word_boundary(rng):
     # round-5: \b/\B strip for the device NFA filter (superset), with
     # candidate lines re-confirmed under the original semantics.
@@ -221,6 +241,7 @@ FAMILIES = {
     "overcap_literal": fam_overcap_literal,
     "mid_anchor": fam_mid_anchor,
     "word_boundary": fam_word_boundary,
+    "posix_classes": fam_posix_classes,
 }
 
 

@@ -657,3 +657,47 @@ def test_fuzz_word_boundary_filter(seed):
             f"mode={eng.mode} pattern={pattern!r}: "
             f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
         )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_posix_classes(seed):
+    """Round-5 family: POSIX bracket classes in random combination with
+    literals/ranges/negation, on both backends.  Oracle = Python re of
+    the EXPANDED pattern (models/dfa.expand_posix_classes — itself
+    pinned against GNU by test_fuzz_cli_posix_classes and the edge-shape
+    unit tests)."""
+    from distributed_grep_tpu.models.dfa import expand_posix_classes
+
+    rng = np.random.default_rng(13_000 + seed)
+    names = ["digit", "alpha", "upper", "lower", "alnum", "punct",
+             "space", "xdigit", "blank", "graph"]
+
+    def piece():
+        nm = names[int(rng.integers(0, len(names)))]
+        r = rng.random()
+        if r < 0.4:
+            body = f"[:{nm}:]"
+        elif r < 0.6:
+            body = f"[:{nm}:]{_gen_literal(rng, 1)}"
+        elif r < 0.8:
+            body = f"^[:{nm}:]"  # negated (falls through to repetition)
+        else:
+            body = f"[:{nm}:]_-"
+        rep = {0: "", 1: "+", 2: "?", 3: "{1,3}"}[int(rng.integers(0, 4))]
+        return f"[{body}]{rep}"
+
+    pattern = _gen_literal(rng, int(rng.integers(0, 3))) + "".join(
+        piece() for _ in range(int(rng.integers(1, 4)))
+    )
+    rx = re.compile(expand_posix_classes(pattern).encode())
+    data = _gen_corpus(rng, "words" if (seed // 4) % 2 else "binary",
+                       48 << 10, [])
+    want = _oracle_lines(rx, data)
+    for backend in ("device", "cpu"):
+        eng = GrepEngine(pattern, backend=backend)
+        got = set(eng.scan(data).matched_lines.tolist())
+        assert got == want, (
+            f"seed={seed} backend={backend} mode={eng.mode} "
+            f"pattern={pattern!r}: "
+            f"+{sorted(got - want)[:5]} -{sorted(want - got)[:5]}"
+        )
