@@ -218,6 +218,10 @@ def cmd_grep(args: argparse.Namespace) -> int:
 
     from distributed_grep_tpu.runtime.job import run_job
 
+    # -R implies -r everywhere (cwd default, stdin gating, the walk);
+    # the dereference flag itself only changes symlink handling
+    if getattr(args, "dereference_recursive", False):
+        args.recursive = True
     if args.fixed_strings and args.extended_regexp:
         print("error: -E and -F are conflicting matchers", file=sys.stderr)
         return 2
@@ -453,7 +457,8 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # test_fuzz_cli.py::test_exclude_dir_slash_glob_matches_gnu).
         return any(fnmatch.fnmatch(name, g) for g in excl_dirs)
 
-    if args.recursive:
+    deref_recursive = getattr(args, "dereference_recursive", False)
+    if args.recursive or deref_recursive:
         expanded: list[str] = []
         walk_bad: list[str] = []
         for f in args.files:
@@ -466,17 +471,60 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 # unlike a post-hoc rglob filter that stats every file
                 # under it.  Files collect per root then sort, preserving
                 # the global lexicographic order the rglob walk produced.
+                # -R (GNU --dereference-recursive) follows symlinked
+                # dirs/files met during the descent, with a global
+                # (dev, ino) visited set: each real directory is
+                # searched ONCE, which both breaks symlink cycles and
+                # collapses multi-route duplicates.  (GNU searches a dir
+                # reachable via two sibling symlinks once per route —
+                # unrepresentable here, since this CLI displays resolved
+                # absolute paths, so per-route duplicates would print as
+                # identical lines; the matched (file, line) SET is equal
+                # either way.)  Plain -r follows symlinks only when they
+                # ARE the command-line argument — os.walk with
+                # followlinks=False already never descends symlinked
+                # dirs, and symlinked files are skipped below
+                # (GNU-verified semantics).
                 collected: list[Path] = []
-                for root, dirnames, filenames in _os.walk(pf):
+                seen_dirs: set[tuple[int, int]] = set()
+                if deref_recursive:
+                    try:
+                        st = _os.stat(pf)
+                        seen_dirs.add((st.st_dev, st.st_ino))
+                    except OSError:
+                        pass
+                for root, dirnames, filenames in _os.walk(
+                    pf, followlinks=deref_recursive
+                ):
                     if excl_dirs:
                         dirnames[:] = [d for d in dirnames
                                        if not _dir_excluded(d)]
+                    if deref_recursive:
+                        keep = []
+                        for d in dirnames:
+                            try:
+                                st = _os.stat(_os.path.join(root, d))
+                            except OSError:
+                                continue  # vanished mid-walk
+                            key = (st.st_dev, st.st_ino)
+                            if key in seen_dirs:
+                                continue  # cycle / already visited
+                            seen_dirs.add(key)
+                            keep.append(d)
+                        dirnames[:] = keep
                     collected.extend(
                         Path(root) / name for name in filenames
                     )
                 for sub in sorted(collected):
+                    if deref_recursive and sub.is_symlink() and not sub.exists():
+                        # GNU -R reports dangling symlinks met during
+                        # the descent ("No such file...") and exits 2
+                        walk_bad.append(str(sub))
+                        continue
                     if not sub.is_file() or not _included(sub.name):
                         continue  # is_file(): skip dangling symlinks etc.
+                    if not deref_recursive and sub.is_symlink():
+                        continue  # plain -r: skip symlinked files (GNU)
                     sp = str(sub)
                     if not _os.access(sp, _os.R_OK):
                         # unreadable files found in the tree get the same
@@ -1089,6 +1137,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="no output; exit 0 iff any line is selected (grep -q)")
     p.add_argument("-r", "--recursive", action="store_true",
                    help="descend into directory arguments (grep -r)")
+    p.add_argument("-R", "--dereference-recursive", action="store_true",
+                   help="like -r, but follow all symlinks (grep -R); "
+                        "directory cycles are pruned silently")
     p.add_argument("-b", "--byte-offset", action="store_true",
                    help="print each line's starting byte offset (grep -b)")
     p.add_argument("-h", "--no-filename", action="store_true",

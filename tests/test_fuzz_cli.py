@@ -412,3 +412,57 @@ def test_cli_posix_class_unknown_name_errors(tmp_path, capsys):
     rc, _ = _run_ours(["grep", "-E", "[[:junk:]]", str(f)], capsys)
     grc, _ = _run_gnu(["-E", "[[:junk:]]", str(f)])
     assert rc == grc == 2
+
+
+def test_recursive_symlink_semantics_match_gnu(tmp_path, capsys):
+    """-r skips symlinked files and dirs met during descent; -R follows
+    both (with directory-cycle pruning); a command-line symlink dir is
+    followed by both — GNU-verified semantics.  Compared on RESOLVED
+    (path, line) sets: our display normalizes to absolute resolved
+    paths, GNU prints traversal paths."""
+    import os
+    from pathlib import Path
+
+    d = tmp_path / "d"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.txt").write_text("hit one\n")
+    (tmp_path / "real.txt").write_text("hit two\n")
+    os.symlink("../real.txt", d / "link.txt")
+    other = tmp_path / "other"
+    other.mkdir()
+    (other / "b.txt").write_text("hit three\n")
+    os.symlink("../other", d / "linkdir")
+    os.symlink(".", d / "sub" / "self")  # cycle: -R must terminate
+
+    def resolved(pairs):
+        return {(str(Path(p).resolve()), ln) for p, ln, _ in pairs}
+
+    for flag in ("-r", "-R"):
+        rc, out = _run_ours(["grep", flag, "hit", str(d)], capsys)
+        grc, gout = _run_gnu([flag, "-n", "hit", str(d)])
+        got = resolved(_parse_ours(out))
+        want = set()
+        for line in gout:  # tmp_path contains no ':', split is safe
+            p, ln, _text = line.split(":", 2)
+            want.add((str(Path(p).resolve()), int(ln)))
+        assert got == want, f"{flag}: {got ^ want}"
+        assert rc == grc == 0
+
+
+def test_dereference_recursive_dangling_symlink_exits_2(tmp_path, capsys):
+    """-R reports dangling symlinks met during descent and exits 2, like
+    GNU; plain -r skips them silently (they're symlinked files)."""
+    import os
+
+    d = tmp_path / "d"
+    d.mkdir()
+    (d / "a.txt").write_text("hit\n")
+    os.symlink("no-such-target", d / "dangle")
+    # -c, not -q: GNU -q exits 0 the moment any match exists, even when
+    # an error was also detected (and so do we — probed both)
+    rc, _ = _run_ours(["grep", "-R", "-c", "hit", str(d)], capsys)
+    grc, _ = _run_gnu(["-R", "-c", "hit", str(d)])
+    assert rc == grc == 2
+    rc, _ = _run_ours(["grep", "-r", "-c", "hit", str(d)], capsys)
+    grc, _ = _run_gnu(["-r", "-c", "hit", str(d)])
+    assert rc == grc == 0
