@@ -28,7 +28,10 @@ def _has_backref(rx: str) -> bool:
     scanning text, so octal escapes inside character classes ("[\\1]") and
     literal '(?P=' inside classes are not false positives.  Only called
     on patterns re.compile already accepted."""
-    import re._parser as parser
+    try:
+        import re._parser as parser  # 3.11+
+    except ImportError:
+        import sre_parse as parser  # 3.10: same tree, pre-rename module
 
     def walk(node) -> bool:
         if isinstance(node, parser.SubPattern):
@@ -487,6 +490,7 @@ def cmd_grep(args: argparse.Namespace) -> int:
                 # (GNU-verified semantics).
                 collected: list[Path] = []
                 seen_dirs: set[tuple[int, int]] = set()
+                seen_files: set[str] = set()
                 if deref_recursive:
                     try:
                         st = _os.stat(pf)
@@ -525,6 +529,23 @@ def cmd_grep(args: argparse.Namespace) -> int:
                         continue  # is_file(): skip dangling symlinks etc.
                     if not deref_recursive and sub.is_symlink():
                         continue  # plain -r: skip symlinked files (GNU)
+                    if deref_recursive:
+                        # -R file dedup: a file reachable both directly
+                        # and via a file symlink is scanned/printed ONCE.
+                        # Keyed on the RESOLVED path — exactly what this
+                        # CLI displays — so per-route duplicates (which
+                        # would print as identical lines; GNU prints each
+                        # route under its own traversal path) collapse,
+                        # while HARD links keep printing separately like
+                        # GNU (distinct resolved paths, distinct files).
+                        try:
+                            key = str(sub.resolve())
+                        except OSError:
+                            pass  # vanished mid-walk; access check below
+                        else:
+                            if key in seen_files:
+                                continue
+                            seen_files.add(key)
                     sp = str(sub)
                     if not _os.access(sp, _os.R_OK):
                         # unreadable files found in the tree get the same

@@ -1006,7 +1006,9 @@ def reference_scan(table: DfaTable, data: bytes) -> np.ndarray:
     # zero-width accept at position 0 (empty FIRST line — '^$', '$^')
     # never surfaces from the native pass; inject offset 0, which the
     # line attribution maps to line 1 (matching re.finditer's end()==0).
-    if table.accept_eol[table.start] and (n == 0 or data[0] == NL):
+    # n > 0 only: empty input has ZERO lines, so there is no line 1 for a
+    # zero-width match to land on (GNU reports no match on an empty file).
+    if table.accept_eol[table.start] and n > 0 and data[0] == NL:
         eol_offs = np.concatenate([[0], eol_offs.astype(np.int64)])
     if not eol_offs.size:
         return offsets

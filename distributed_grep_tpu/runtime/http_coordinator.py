@@ -34,13 +34,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils.config import JobConfig
-from distributed_grep_tpu.utils.io import (
-    WorkDir,
-    atomic_write_from_stream,
-    resolve_input_path,
-)
+from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 
@@ -63,7 +60,8 @@ def long_poll_window_s(config: JobConfig) -> float:
 class CoordinatorServer:
     def __init__(self, config: JobConfig, resume: bool = False):
         self.config = config
-        self.workdir = WorkDir(config.work_dir)
+        self.store = make_store(config.store)
+        self.workdir = WorkDir(config.work_dir, store=self.store)
         resume_entries = None
         if resume:
             if config.journal:
@@ -84,6 +82,7 @@ class CoordinatorServer:
             journal=journal,
             resume_entries=resume_entries,
             metrics=self.metrics,
+            commit_resolver=self.workdir.resolve_task_commit,
         )
         self._httpd = ThreadingHTTPServer(
             (config.coordinator_host, config.coordinator_port), _make_handler(self)
@@ -218,10 +217,12 @@ def _make_handler(server: CoordinatorServer):
             return self.rfile.read(length) if length else b""
 
         def _receive_file(self, dst) -> None:
-            """Stream the PUT body straight to a temp file + rename commit —
-            the body never materializes in coordinator memory."""
+            """Stream the PUT body straight through the work dir's store
+            commit protocol (temp+rename on posix, part+record on
+            non-atomic) — the body never materializes in coordinator
+            memory."""
             length = int(self.headers.get("Content-Length", 0))
-            atomic_write_from_stream(dst, self.rfile, length, BLOCK_BYTES)
+            server.store.put_from_stream(dst, self.rfile, length, BLOCK_BYTES)
 
         def _drain_body(self) -> None:
             """Discard a request body in bounded blocks (404 paths must not
@@ -273,8 +274,11 @@ def _make_handler(server: CoordinatorServer):
                     self._send_file(p)
                 elif self.path.startswith("/data/intermediate/"):
                     name = _safe_name(self.path[len("/data/intermediate/") :])
-                    p = workdir.root / "intermediate" / name
-                    if not p.exists():
+                    # resolve through the store: on a non-atomic store the
+                    # logical name maps to the winning committed attempt —
+                    # a torn or uncommitted part is never served
+                    p = server.store.resolve(workdir.root / "intermediate" / name)
+                    if p is None:
                         self._send_json({"error": f"no such file: {name}"}, 404)
                         return
                     self._send_file(p)
@@ -308,6 +312,26 @@ def _make_handler(server: CoordinatorServer):
                 elif self.path.startswith("/data/out/"):
                     name = _safe_name(self.path[len("/data/out/") :])
                     self._receive_file(workdir.root / "out" / name)
+                    self._send_json({"ok": True})
+                elif self.path.startswith("/data/commit/"):
+                    # per-task commit record publication (runtime/store.py):
+                    # name is "<kind>-<task_id>.<attempt>", body the payload
+                    name = _safe_name(self.path[len("/data/commit/") :])
+                    kind_tid, _, attempt = name.partition(".")
+                    kind, _, tid = kind_tid.rpartition("-")
+                    if kind not in ("map", "reduce") or not tid.isdigit() or not attempt:
+                        self._drain_body()
+                        self._send_json({"error": f"bad commit name: {name}"}, 400)
+                        return
+                    if int(self.headers.get("Content-Length", 0)) > 1 << 20:
+                        self._drain_body()
+                        self._send_json({"error": "commit record too large"}, 413)
+                        return
+                    body = self._read_body()
+                    server.store.commit_task(
+                        workdir.commits_dir(), kind, int(tid), attempt,
+                        json.loads(body or b"{}"),
+                    )
                     self._send_json({"ok": True})
                 else:
                     self._drain_body()  # bounded drain so the 404 gets through

@@ -188,6 +188,17 @@ class HttpTransport:
     def write_output(self, name: str, data: bytes) -> None:
         self._request("PUT", f"/data/out/{urllib.parse.quote(name)}", data)
 
+    def publish_task_commit(self, kind: str, task_id: int, attempt: str,
+                            payload: dict) -> None:
+        """Publish the per-task commit record (runtime/store.py) on the
+        coordinator's store — the durable commit the scheduler registers
+        from, sent BEFORE the finished RPC."""
+        name = f"{kind}-{task_id}.{attempt}"
+        self._request(
+            "PUT", f"/data/commit/{urllib.parse.quote(name)}",
+            json.dumps(payload).encode("utf-8"),
+        )
+
     def write_output_from_file(self, name: str, path: str) -> None:
         """Streaming PUT: the body is a file object sent in blocks with an
         explicit Content-Length (http.client streams ~8 KB at a time), so a

@@ -378,8 +378,11 @@ def run_job(
     app: LoadedApplication | None = None,
     resume: bool = False,
     fault_hooks_per_worker: list[dict] | None = None,
+    store_faults_per_worker: list[dict] | None = None,
 ) -> JobResult:
-    workdir = WorkDir(config.work_dir)
+    from distributed_grep_tpu.runtime.store import FaultStore, make_store
+
+    workdir = WorkDir(config.work_dir, store=make_store(config.store))
     if app is None:
         app = load_application(config.application, **config.effective_app_options())
 
@@ -407,12 +410,19 @@ def run_job(
         journal=journal,
         resume_entries=resume_entries,
         metrics=metrics,
+        commit_resolver=workdir.resolve_task_commit,
     )
 
     def worker_main(idx: int) -> None:
         hooks = (fault_hooks_per_worker or [{}] * n_workers)[idx]
+        # store-level crash injection (CrashPoint hooks) wraps only THIS
+        # worker's commit path; the shared workdir store stays clean for
+        # the others and for the scheduler's commit resolution.
+        sfaults = (store_faults_per_worker or [{}] * n_workers)[idx]
+        store = FaultStore(workdir.store, sfaults) if sfaults else None
         loop = WorkerLoop(
-            LocalTransport(scheduler, workdir, rpc_timeout_s=config.rpc_timeout_s),
+            LocalTransport(scheduler, workdir,
+                           rpc_timeout_s=config.rpc_timeout_s, store=store),
             app,
             metrics=metrics,
             fault_hooks=hooks,

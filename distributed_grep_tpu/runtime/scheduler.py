@@ -55,6 +55,7 @@ class Scheduler:
         journal: Optional[TaskJournal] = None,
         resume_entries: Optional[list[dict]] = None,
         metrics: Optional[Metrics] = None,
+        commit_resolver: Optional[Any] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -62,6 +63,13 @@ class Scheduler:
         self.app_options = dict(app_options or {})
         self.journal = journal
         self.metrics = metrics or Metrics()
+        # commit_resolver(kind, task_id) -> winning task commit record
+        # payload or None (WorkDir.resolve_task_commit, runtime/store.py).
+        # When a record exists it — not the finished-RPC args — is the unit
+        # of truth for what a completed task produced: a re-executed
+        # straggler whose late RPC races the sweeper's re-issue can then
+        # never register parts its winning attempt did not commit.
+        self.commit_resolver = commit_resolver
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -93,6 +101,18 @@ class Scheduler:
         self._sweeper.start()
 
     # ------------------------------------------------------------------ replay
+    def _resolve_commit(self, kind: str, task_id: int):
+        """The winning task commit record payload, or None (no resolver /
+        no record).  Resolver failures count as 'no record' — the RPC-args
+        path still works, so a broken commits dir degrades, not crashes."""
+        if self.commit_resolver is None:
+            return None
+        try:
+            return self.commit_resolver(kind, task_id)
+        except Exception:  # noqa: BLE001 — degrade to RPC-args truth
+            log.exception("commit record resolution failed for %s %d", kind, task_id)
+            return None
+
     def _replay(self, entries: list[dict]) -> None:
         """Apply journal entries so a restarted coordinator skips done work."""
         for e in entries:
@@ -112,14 +132,36 @@ class Scheduler:
                             t.file,
                         )
                         continue
+                    parts = e.get("parts", [])
+                    if e.get("has_record"):
+                        # This completion was committed via a task commit
+                        # record — re-resolve it as the unit of truth.  A
+                        # journal entry whose record vanished is stale
+                        # (someone swept the commits dir): re-run the task
+                        # rather than trust unverifiable state.
+                        record = self._resolve_commit("map", tid)
+                        if record is None:
+                            log.warning(
+                                "journal says map task %d committed via record "
+                                "but no valid record resolves; re-running", tid,
+                            )
+                            continue
+                        # malformed record (no "parts"): keep the journal's
+                        parts = record.get("parts", parts)
                     if t.state is not TaskState.COMPLETED:
                         t.state = TaskState.COMPLETED
-                        self._register_map_outputs(tid, e.get("parts", []))
+                        self._register_map_outputs(tid, parts)
                         if tid in self._map_queue:
                             self._map_queue.remove(tid)
             elif e.get("kind") == "reduce_done":
                 tid = e["task_id"]
                 if 0 <= tid < len(self.reduce_tasks):
+                    if e.get("has_record") and self._resolve_commit("reduce", tid) is None:
+                        log.warning(
+                            "journal says reduce task %d committed via record "
+                            "but no valid record resolves; re-running", tid,
+                        )
+                        continue
                     t = self.reduce_tasks[tid]
                     t.state = TaskState.COMPLETED
                     if tid in self._reduce_queue:
@@ -211,16 +253,28 @@ class Scheduler:
     # ------------------------------------------------------------- completion
     def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
         """Idempotent map commit (coordinator.go:126-148)."""
+        record = self._resolve_commit("map", args.task_id)
         with self._cond:
             task = self.map_tasks[args.task_id]
             if task.state is TaskState.COMPLETED:
                 return rpc.TaskFinishedReply(ok=True)  # duplicate absorbed (:131-134)
             task.state = TaskState.COMPLETED
             self._maps_completed += 1
-            self._register_map_outputs(args.task_id, args.produced_parts)
+            # The task commit record (published before this RPC) is the
+            # unit of truth for the produced partitions; the RPC args are
+            # the fallback for transports without commit records — and for
+            # a malformed record missing "parts" (the data plane accepts
+            # any small JSON body; malformed degrades, never crashes).
+            parts = args.produced_parts
+            if record is not None and "parts" in record:
+                parts = record["parts"]
+            self._register_map_outputs(args.task_id, parts)
             self.metrics.inc("map_completed")
             if self.journal:
-                self.journal.map_completed(args.task_id, task.file, args.produced_parts)
+                self.journal.map_completed(
+                    args.task_id, task.file, parts,
+                    has_record=record is not None,
+                )
             log.info(
                 "map task %d done (%d/%d)",
                 args.task_id, self._maps_completed, len(self.map_tasks),
@@ -238,6 +292,7 @@ class Scheduler:
                     self.reduce_tasks[r].task_files.append(name)
 
     def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        record = self._resolve_commit("reduce", args.task_id)
         with self._cond:
             task = self.reduce_tasks[args.task_id]
             if task.state is not TaskState.COMPLETED:
@@ -245,7 +300,9 @@ class Scheduler:
                 self._reduces_completed += 1
                 self.metrics.inc("reduce_completed")
                 if self.journal:
-                    self.journal.reduce_completed(args.task_id)
+                    self.journal.reduce_completed(
+                        args.task_id, has_record=record is not None
+                    )
                 log.info(
                     "reduce task %d done (%d/%d)",
                     args.task_id, self._reduces_completed, self.n_reduce,

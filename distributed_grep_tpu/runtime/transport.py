@@ -15,12 +15,7 @@ from typing import Protocol
 
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.scheduler import Scheduler
-from distributed_grep_tpu.utils.io import (
-    WorkDir,
-    atomic_write,
-    atomic_write_from_file,
-    resolve_input_path,
-)
+from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
 
 
 class Transport(Protocol):
@@ -41,6 +36,11 @@ class Transport(Protocol):
     # an output without loading it whole (the streaming-reduce counterpart
     # of write_output).  The worker falls back to write_output when a
     # transport lacks it (runtime/worker.py).
+    # Optional: publish_task_commit(kind, task_id, attempt, payload) —
+    # publish the per-task commit record (runtime/store.py) after all of a
+    # task's blobs are durable and before the finished RPC.  The worker
+    # skips it on transports without one (custom test transports keep the
+    # RPC args as the registration source).
 
 
 class LocalTransport:
@@ -50,10 +50,14 @@ class LocalTransport:
     # download-leg liveness pump for this transport (worker.py)
     is_local = True
 
-    def __init__(self, scheduler: Scheduler, workdir: WorkDir, rpc_timeout_s: float = 30.0):
+    def __init__(self, scheduler: Scheduler, workdir: WorkDir,
+                 rpc_timeout_s: float = 30.0, store=None):
         self.scheduler = scheduler
         self.workdir = workdir
         self.rpc_timeout_s = rpc_timeout_s
+        # store override: fault-injection wraps THIS worker's commit path
+        # without touching the shared workdir store other workers use
+        self.store = store if store is not None else workdir.store
 
     def assign_task(self, args: rpc.AssignTaskArgs) -> rpc.AssignTaskReply:
         return self.scheduler.assign_task(args, timeout=self.rpc_timeout_s)
@@ -80,13 +84,19 @@ class LocalTransport:
         return resolve_input_path(filename, self.workdir), False
 
     def write_intermediate(self, name: str, data: bytes) -> None:
-        atomic_write(self.workdir.root / "intermediate" / name, data)
+        self.store.put(self.workdir.root / "intermediate" / name, data)
 
     def read_intermediate(self, name: str) -> bytes:
-        return (self.workdir.root / "intermediate" / name).read_bytes()
+        return self.store.get(self.workdir.root / "intermediate" / name)
 
     def write_output(self, name: str, data: bytes) -> None:
-        atomic_write(self.workdir.root / "out" / name, data)
+        self.store.put(self.workdir.root / "out" / name, data)
 
     def write_output_from_file(self, name: str, path: str) -> None:
-        atomic_write_from_file(self.workdir.root / "out" / name, path)
+        self.store.put_from_file(self.workdir.root / "out" / name, path)
+
+    def publish_task_commit(self, kind: str, task_id: int, attempt: str,
+                            payload: dict) -> None:
+        self.store.commit_task(
+            self.workdir.commits_dir(), kind, task_id, attempt, payload
+        )

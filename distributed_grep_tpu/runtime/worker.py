@@ -154,9 +154,24 @@ class WorkerLoop:
                 self._run_reduce(reply)
             # anything else ("retry"): long-poll window expired — loop again
 
+    def _publish_commit(self, kind: str, task_id: int, attempt: str,
+                        payload: dict) -> None:
+        """Publish the per-task commit record (runtime/store.py) — the
+        durable commit on stores without atomic rename, published after
+        every blob of the task is durable and BEFORE the finished RPC, so
+        the record (not the RPC, not raw file existence) is the unit of
+        truth the scheduler registers from.  Transports without the hook
+        (custom test transports) keep RPC-args registration."""
+        publish = getattr(self.transport, "publish_task_commit", None)
+        if publish is not None:
+            publish(kind, task_id, attempt, payload)
+
     # ------------------------------------------------------------------- map
     def _run_map(self, a: rpc.AssignTaskReply) -> None:
+        from distributed_grep_tpu.runtime.store import new_attempt_id
+
         t0 = time.perf_counter()
+        attempt = new_attempt_id()
         self.app.configure(**a.app_options)
         # Streaming boundary: an app exposing map_path_fn receives a local
         # file path and reads it in bounded chunks (engine.scan_file) —
@@ -261,6 +276,7 @@ class WorkerLoop:
                     f"mr-{a.task_id}-{r}", shuffle.encode_records(kvs)
                 )
                 produced.append(r)
+        self._publish_commit("map", a.task_id, attempt, {"parts": produced})
         self._fault("before_map_finished")
         self.transport.map_finished(
             rpc.TaskFinishedArgs(
@@ -274,7 +290,10 @@ class WorkerLoop:
     def _run_reduce(self, a: rpc.AssignTaskReply) -> None:
         import os
 
+        from distributed_grep_tpu.runtime.store import new_attempt_id
+
         t0 = time.perf_counter()
+        attempt = new_attempt_id()
         self.app.configure(**a.app_options)
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
@@ -337,6 +356,9 @@ class WorkerLoop:
             if sink.spill_count:
                 self.metrics.inc("reduce_spills", sink.spill_count)
             sink.close()
+        self._publish_commit(
+            "reduce", a.task_id, attempt, {"output": f"mr-out-{a.task_id}"}
+        )
         self.transport.reduce_finished(
             rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
         )

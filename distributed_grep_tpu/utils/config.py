@@ -17,6 +17,11 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
 
+# Valid JobConfig.store names.  MUST mirror runtime/store.py STORES — a
+# literal here (not an import) because constructing a JobConfig must not
+# drag in the whole runtime package; test_store_faults pins the two in sync.
+STORE_NAMES = frozenset({"posix", "nonatomic"})
+
 
 @dataclass
 class JobConfig:
@@ -28,6 +33,11 @@ class JobConfig:
 
     # --- Where data lives (replaces /tmp/mr-data + /tmp/mr + SFTP) ---------
     work_dir: str = "/tmp/dgrep"  # shared-FS data plane root
+    # Commit semantics for the work dir's blobs (runtime/store.py):
+    # "posix" — temp+fsync+rename (the reference's protocol);
+    # "nonatomic" — object-store emulation: no rename, visibility via
+    # attempt-scoped part files + self-checksummed commit records.
+    store: str = "posix"
 
     # --- Control plane -----------------------------------------------------
     coordinator_host: str = "127.0.0.1"
@@ -59,6 +69,10 @@ class JobConfig:
     def __post_init__(self) -> None:
         if self.n_reduce <= 0:
             raise ValueError(f"n_reduce must be positive, got {self.n_reduce}")
+        if self.store not in STORE_NAMES:
+            raise ValueError(
+                f"store must be one of {sorted(STORE_NAMES)}, got {self.store!r}"
+            )
         self.mesh_shape = tuple(self.mesh_shape)
         self.mesh_axes = tuple(self.mesh_axes)
 

@@ -1,84 +1,19 @@
-"""IO helpers: atomic commits, chunked reads, work-dir layout.
+"""IO helpers: chunked reads and the work-dir layout.
 
 The reference's exactly-once story rests on write-to-temp + os.Rename as the
 atomic commit (worker.go:103, worker.go:169); re-executed tasks overwrite
-idempotently.  We keep exactly that design.  The work-dir layout replaces the
-reference's /tmp/mr-data (host) + /tmp/mr (remote) + SFTP star topology
-(coordinator.go:306-309, worker.go:19) with a single shared-FS root.
+idempotently.  That protocol now lives in runtime/store.py as PosixStore —
+one of two pluggable commit layers (NonAtomicStore emulates object-store
+semantics, where rename does not exist); every data-plane write goes through
+a Store.  The work-dir layout replaces the reference's /tmp/mr-data (host) +
+/tmp/mr (remote) + SFTP star topology (coordinator.go:306-309, worker.go:19)
+with a single shared root whose commit semantics come from its Store.
 """
 
 from __future__ import annotations
 
-import os
-import shutil
-import tempfile
 from pathlib import Path
 from typing import Iterator
-
-
-def atomic_write(path: str | Path, data: bytes) -> None:
-    """Write-to-temp-then-rename: the reference's commit protocol."""
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)  # atomic on POSIX; duplicate executions are safe
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
-def atomic_write_from_file(path: str | Path, src: str | Path,
-                           chunk_bytes: int = 1 << 20) -> None:
-    """Chunked copy-to-temp-then-rename: the atomic commit for outputs too
-    large to hold in memory (the streaming-reduce path)."""
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
-    try:
-        with os.fdopen(fd, "wb") as out, open(src, "rb") as f:
-            shutil.copyfileobj(f, out, chunk_bytes)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
-def atomic_write_from_stream(path: str | Path, stream, length: int,
-                             chunk_bytes: int = 1 << 20) -> None:
-    """Read exactly ``length`` bytes from a stream into a temp file in
-    bounded blocks, then rename-commit — the data-plane PUT receiver
-    (bodies larger than RAM never materialize).  Raises ConnectionError on
-    a short read so callers treat a died peer as a failed upload."""
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp")
-    try:
-        with os.fdopen(fd, "wb") as out:
-            remaining = length
-            while remaining > 0:
-                block = stream.read(min(chunk_bytes, remaining))
-                if not block:
-                    raise ConnectionError(
-                        f"short body: {remaining} of {length} bytes missing"
-                    )
-                out.write(block)
-                remaining -= len(block)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 def read_chunks(path: str | Path, chunk_bytes: int, overlap: int = 0) -> Iterator[tuple[int, bytes]]:
@@ -126,11 +61,24 @@ class WorkDir:
     intermediate/   mr-<map_task>-<r> shuffle files (coordinator.go:136-142)
     out/            mr-out-<r> final outputs (worker.go:169, coordinator.go:152)
     journal/        coordinator's durable task-commit journal
+    commits/        per-task commit records — the unit of truth on stores
+                    without atomic rename (runtime/store.py)
+
+    ``store`` supplies the commit semantics for intermediate/out blobs
+    (PosixStore by default — today's temp+fsync+rename).  Readers must go
+    through the store (list_outputs does): on a NonAtomicStore the
+    directories hold .part./.commit. attempt files, and only the store
+    knows which attempt won.
     """
 
-    def __init__(self, root: str | Path):
+    def __init__(self, root: str | Path, store=None):
+        if store is None:
+            from distributed_grep_tpu.runtime.store import PosixStore
+
+            store = PosixStore()
+        self.store = store
         self.root = Path(root)
-        for sub in ("inputs", "intermediate", "out", "journal"):
+        for sub in ("inputs", "intermediate", "out", "journal", "commits"):
             (self.root / sub).mkdir(parents=True, exist_ok=True)
 
     def intermediate_path(self, map_task: int, reduce_part: int) -> Path:
@@ -142,12 +90,24 @@ class WorkDir:
     def journal_path(self) -> Path:
         return self.root / "journal" / "tasks.jsonl"
 
+    def commits_dir(self) -> Path:
+        return self.root / "commits"
+
+    def resolve_task_commit(self, kind: str, task_id: int):
+        """The winning task commit record ({"parts": ...} payload dict), or
+        None — the scheduler's unit of truth for completed work."""
+        return self.store.resolve_task_commit(self.commits_dir(), kind, task_id)
+
     def clear(self) -> None:
         """Remove all job state (fresh-job reset of a reused work dir)."""
-        for sub in ("inputs", "intermediate", "out", "journal"):
+        for sub in ("inputs", "intermediate", "out", "journal", "commits"):
             for p in (self.root / sub).iterdir():
                 if p.is_file():
                     p.unlink()
 
     def list_outputs(self) -> list[Path]:
-        return sorted((self.root / "out").glob("mr-out-*"))
+        """Concrete paths of the committed mr-out-* blobs, sorted by logical
+        name.  On a PosixStore these ARE mr-out-<r>; on a NonAtomicStore
+        they are the winning .part. files — readers get exactly one fully
+        committed attempt per output either way."""
+        return self.store.list_committed(self.root / "out", "mr-out-*")

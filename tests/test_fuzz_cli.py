@@ -419,7 +419,10 @@ def test_recursive_symlink_semantics_match_gnu(tmp_path, capsys):
     both (with directory-cycle pruning); a command-line symlink dir is
     followed by both — GNU-verified semantics.  Compared on RESOLVED
     (path, line) sets: our display normalizes to absolute resolved
-    paths, GNU prints traversal paths."""
+    paths, GNU prints traversal paths.  The set comparison alone would
+    mask per-route duplicates (two routes to one file resolve to
+    identical lines) — the multiset check below closes that hole: every
+    real file is scanned and printed exactly once under -R."""
     import os
     from pathlib import Path
 
@@ -433,6 +436,13 @@ def test_recursive_symlink_semantics_match_gnu(tmp_path, capsys):
     (other / "b.txt").write_text("hit three\n")
     os.symlink("../other", d / "linkdir")
     os.symlink(".", d / "sub" / "self")  # cycle: -R must terminate
+    # a file reachable BOTH directly and via a sibling file symlink: GNU
+    # prints each route under its traversal path; our resolved display
+    # must print the real file once (ADVICE round-5 medium)
+    os.symlink("a.txt", d / "alias.txt")
+    # ...but HARD links are distinct files at distinct resolved paths:
+    # both must print, like GNU (dedup is per resolved path, not inode)
+    os.link(d / "a.txt", d / "hard.txt")
 
     def resolved(pairs):
         return {(str(Path(p).resolve()), ln) for p, ln, _ in pairs}
@@ -440,7 +450,12 @@ def test_recursive_symlink_semantics_match_gnu(tmp_path, capsys):
     for flag in ("-r", "-R"):
         rc, out = _run_ours(["grep", flag, "hit", str(d)], capsys)
         grc, gout = _run_gnu([flag, "-n", "hit", str(d)])
-        got = resolved(_parse_ours(out))
+        parsed = _parse_ours(out)
+        # no duplicate (path, line) records — a resolved-set comparison
+        # cannot see these, so assert on the multiset directly
+        keys = [(str(Path(p).resolve()), ln) for p, ln, _ in parsed]
+        assert len(keys) == len(set(keys)), f"{flag}: duplicate output lines"
+        got = resolved(parsed)
         want = set()
         for line in gout:  # tmp_path contains no ':', split is safe
             p, ln, _text = line.split(":", 2)

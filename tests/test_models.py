@@ -102,6 +102,21 @@ def test_dfa_match_offsets_exact():
     np.testing.assert_array_equal(offsets, [3, 7])
 
 
+def test_dfa_eol_empty_input_no_phantom_line():
+    """ADVICE round-5 low: empty input has ZERO lines, so '^$'-style
+    zero-width EOL accepts must not report a phantom line-1 match (GNU
+    reports no match on an empty file).  Library callers (the CLI
+    short-circuits empty inputs) and oracle uses hit this path."""
+    for pattern in ("^$", "$", "x$|^$"):
+        table = compile_dfa(pattern)
+        assert reference_scan(table, b"").size == 0, pattern
+        assert matched_lines(table, b"") == set(), pattern
+    # ...while an actual empty first line still matches (the n > 0 arm)
+    table = compile_dfa("^$")
+    assert matched_lines(table, b"\nabc\n") == {1}
+    assert matched_lines(table, b"\n") == {1}
+
+
 def test_dfa_rejects_newline_patterns():
     with pytest.raises(NewlineInPattern):
         compile_dfa(r"a\nb")
