@@ -1054,7 +1054,10 @@ def cmd_coordinator(args: argparse.Namespace) -> int:
     from distributed_grep_tpu.runtime.http_coordinator import serve_coordinator
 
     cfg = JobConfig.load(args.config)
-    serve_coordinator(cfg, resume=args.resume)
+    status = serve_coordinator(cfg, resume=args.resume)
+    # stdout contract: exactly one JSON line naming the committed outputs
+    # (scripts and the multi-process tests parse it)
+    print(json.dumps({"outputs": status["outputs"]}))
     return 0
 
 
@@ -1062,6 +1065,34 @@ def cmd_worker(args: argparse.Namespace) -> int:
     from distributed_grep_tpu.runtime.http_transport import run_http_worker
 
     run_http_worker(addr=args.addr, n_parallel=args.slots)
+    return 0
+
+
+def cmd_trace_export(args: argparse.Namespace) -> int:
+    """Render a job's events.jsonl (the span pipeline's persisted event
+    log, utils/spans.py) as Chrome trace_event JSON — loadable in Perfetto
+    (ui.perfetto.dev), chrome://tracing, and TensorBoard's trace viewer,
+    next to the jax.profiler device trace from DGREP_TRACE_DIR."""
+    from pathlib import Path
+
+    from distributed_grep_tpu.utils.spans import EventLog, export_chrome_trace
+
+    path = Path(args.events)
+    if path.is_dir():  # a work dir: the log lives at its root
+        path = path / EventLog.FILENAME
+    if not path.exists():
+        print(f"error: no event log at {path} (run the job with "
+              f"JobConfig.spans=true or DGREP_SPANS=1)", file=sys.stderr)
+        return 2
+    events = EventLog.read(path)
+    doc = export_chrome_trace(events)
+    if args.out and args.out != "-":
+        Path(args.out).write_text(json.dumps(doc))
+        print(f"{len(doc['traceEvents'])} trace events -> {args.out}",
+              file=sys.stderr)
+    else:
+        json.dump(doc, sys.stdout)
+        print()
     return 0
 
 
@@ -1217,6 +1248,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--addr", required=True, help="coordinator http address host:port")
     p.add_argument("--timeout", type=float, default=5.0)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser(
+        "trace-export",
+        help="render a job's events.jsonl span log as Chrome trace JSON "
+             "(Perfetto/TensorBoard-loadable)",
+    )
+    p.add_argument("events",
+                   help="path to events.jsonl, or the job work dir "
+                        "containing it")
+    p.add_argument("-o", "--out", default="-",
+                   help="output file (default: stdout)")
+    p.set_defaults(fn=cmd_trace_export)
 
     p = sub.add_parser("worker", help="connect to a coordinator and process tasks")
     p.add_argument("--addr", required=True, help="coordinator http address host:port")

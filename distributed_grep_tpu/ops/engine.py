@@ -69,6 +69,7 @@ from distributed_grep_tpu.models.shift_and import (
     try_compile_shift_and,
 )
 from distributed_grep_tpu.ops import lines as lines_mod
+from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils.logging import get_logger
 
 # A cold XLA/Mosaic compile through a tunneled TPU runs ~20-40 s with no
@@ -825,6 +826,12 @@ class GrepEngine:
         a runtime failure detector keeps a tight liveness window over long
         scans (runtime/worker.py wires it to the heartbeat RPC)."""
         self._nl_local.stash = None
+        # Span-pipeline telemetry (utils/spans.py): each scan becomes one
+        # structured per-scan record — mode, bytes, duration, and the
+        # engine.stats counters (candidates, confirm seconds, fallback
+        # flags) that previously died with the process.  active() is one
+        # thread-local read when the pipeline is off.
+        t0 = _time_mod.perf_counter() if spans_mod.active() else None
         res = self._scan_impl(data, progress)
         # Nullable-at-'$' patterns (accept_eol at the line-start state,
         # e.g. '^$', '^ *$', 'x?$'): the empty match is valid at every
@@ -850,6 +857,14 @@ class GrepEngine:
             ml = np.union1d(ml, lines_mod.empty_line_numbers(data, nl))
             res = ScanResult(
                 ml.astype(np.int64), int(ml.size), res.bytes_scanned
+            )
+        if t0 is not None:
+            # after the EOL fix-up: the record's match count must equal the
+            # ScanResult the caller actually receives
+            spans_mod.scan_record(
+                mode=self.mode, n_bytes=len(data),
+                seconds=_time_mod.perf_counter() - t0,
+                stats=self.stats, matches=res.n_matches,
             )
         return res
 
@@ -886,6 +901,8 @@ class GrepEngine:
                 "device backend responsive again -> leaving host-degraded "
                 "mode (retry window %.0fs)", DEVICE_RETRY_S,
             )
+            spans_mod.instant("device_recovered", cat="engine",
+                              retry_window_s=DEVICE_RETRY_S)
             self._device_broken = False
             self._pallas_broken = False
             self._fdr_broken = False
@@ -992,6 +1009,8 @@ class GrepEngine:
         every retry window: deep probe succeeds, this engine un-demotes,
         fails deterministically again, re-poisons — round-4 review)."""
         self._device_broken = True
+        spans_mod.instant("device_demoted", cat="engine",
+                          transport_evidence=bool(transport_evidence))
         if transport_evidence:
             _report_device_sick()  # process-wide: starts the shared retry window
         else:

@@ -37,6 +37,7 @@ from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
@@ -73,6 +74,16 @@ class CoordinatorServer:
         # else on the coordinator's filesystem.
         self.input_allowlist = frozenset(config.input_files)
         self.metrics = Metrics()
+        # Span pipeline (utils/spans.py): when on, worker-shipped spans and
+        # the scheduler's own decisions persist as events.jsonl in the work
+        # dir (resume appends — one job, one log across restarts).
+        self.event_log = (
+            spans_mod.EventLog(
+                self.workdir.root / spans_mod.EventLog.FILENAME,
+                fresh=not resume,
+            )
+            if spans_mod.enabled(config.spans) else None
+        )
         self.scheduler = Scheduler(
             files=list(config.input_files),
             n_reduce=config.n_reduce,
@@ -83,6 +94,7 @@ class CoordinatorServer:
             resume_entries=resume_entries,
             metrics=self.metrics,
             commit_resolver=self.workdir.resolve_task_commit,
+            event_log=self.event_log,
         )
         self._httpd = ThreadingHTTPServer(
             (config.coordinator_host, config.coordinator_port), _make_handler(self)
@@ -117,6 +129,8 @@ class CoordinatorServer:
         time.sleep(linger_s)
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self.event_log is not None:
+            self.event_log.close()
 
     # --- RPC dispatch ------------------------------------------------------
     def handle_rpc(self, verb: str, payload: dict) -> dict:
@@ -134,7 +148,7 @@ class CoordinatorServer:
         elif verb == rpc.Verb.HEARTBEAT:
             args = rpc.HeartbeatArgs(**payload)
             self.scheduler.heartbeat(
-                args.task_type, args.task_id, grace_s=args.grace_s
+                args.task_type, args.task_id, grace_s=args.grace_s, args=args
             )
             reply = rpc.HeartbeatReply()
         else:
@@ -154,6 +168,13 @@ class CoordinatorServer:
                 "completed": sum(t.state is TaskState.COMPLETED for t in s.reduce_tasks),
             },
             "metrics": self.metrics.snapshot(),
+            # per-worker liveness + heartbeat-shipped Metrics aggregates
+            # (bytes_scanned/gbps per worker when the span pipeline is on;
+            # liveness alone otherwise), and every in-flight task's
+            # heartbeat age / grace window — stragglers visible before the
+            # timeout sweeper fires.
+            "workers": s.worker_status(),
+            "in_flight": s.inflight_status(),
         }
 
 
@@ -360,12 +381,25 @@ def _safe_name(name: str) -> str:
 
 def serve_coordinator(config: JobConfig, resume: bool = False) -> dict:
     """Blocking entry point for the CLI: serve until the job completes,
-    print output file list + metrics, then shut down."""
+    then shut down.  Returns the final /status dict plus the committed
+    output paths under "outputs" — the CLI (cmd_coordinator) owns the
+    stdout contract of printing them as one JSON line."""
     server = CoordinatorServer(config, resume=resume)
     server.start()
     server.wait_done()
     status = server.status()
-    log.info("job complete: %s", json.dumps(status["metrics"].get("counters", {})))
+    # The full metrics snapshot — counters AND per-phase timings AND the
+    # computed gbps() headline (0.0 here when workers are remote processes:
+    # their scan counters live in status["workers"], shipped via heartbeat
+    # piggyback) — not just the counters dict the old completion line kept.
+    log.info(
+        "job complete: %s",
+        json.dumps({
+            **status["metrics"],
+            "throughput_GBps": round(server.metrics.gbps(), 3),
+            "workers": status["workers"],
+        }, sort_keys=True),
+    )
     server.shutdown()
-    print(json.dumps({"outputs": [str(p) for p in server.workdir.list_outputs()]}))
+    status["outputs"] = [str(p) for p in server.workdir.list_outputs()]
     return status

@@ -93,7 +93,7 @@ class HttpTransport:
             **self._rpc(rpc.Verb.REDUCE_NEXT_FILE, rpc.to_dict(args))
         )
 
-    def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> float | None:
         """Advisory stamp; never raises — transport failure surfaces
         through the task's own RPCs.  Plain stamps are single-shot (a
         missed one costs at most one sweep window, and a retry budget
@@ -101,21 +101,34 @@ class HttpTransport:
         stamped); GRACE stamps get a short bounded retry, because a lost
         grace declaration costs the whole silent phase it covers — the
         caller is about to block on a compile anyway, so a few seconds of
-        retry cannot stall anything the compile wasn't already stalling."""
+        retry cannot stall anything the compile wasn't already stalling.
+
+        Returns the measured round trip of the successful POST (seconds) —
+        retry sleeps excluded, so it is the clean RTT sample the span
+        pipeline's clock sync wants — or None when every attempt failed."""
         attempts = 3 if args.grace_s > 0 else 1
-        body = json.dumps(rpc.to_dict(args)).encode("utf-8")
         for i in range(attempts):
+            if args.sent_at > 0:
+                # re-stamp per attempt: a retry shipping the FIRST
+                # attempt's sent_at would feed the clock sync a timestamp
+                # stale by the failed attempt's timeout, skewing the
+                # worker's offset estimate by seconds (spans_seq is
+                # unchanged, so the span batch still dedups)
+                args.sent_at = time.time()
+            body = json.dumps(rpc.to_dict(args)).encode("utf-8")
             try:
                 req = urllib.request.Request(
                     f"{self.base}/rpc/{rpc.Verb.HEARTBEAT}", data=body,
                     method="POST",
                 )
                 req.add_header("Content-Type", "application/json")
+                t0 = time.monotonic()
                 with urllib.request.urlopen(req, timeout=5.0):
-                    return
+                    return time.monotonic() - t0
             except Exception:  # noqa: BLE001 — advisory by contract
                 if i + 1 < attempts:
                     time.sleep(0.5)
+        return None
 
     # ---------------------------------------------------------- data plane
     def read_input(self, filename: str) -> bytes:
@@ -268,6 +281,8 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
         raise SystemExit(1)
     app = load_application(config.application, **config.app_options)
 
+    from distributed_grep_tpu.utils import spans as spans_mod
+
     def run_loop(slot: int) -> None:
         loop = WorkerLoop(
             HttpTransport(addr, rpc_timeout_s=config.rpc_timeout_s),
@@ -276,6 +291,11 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
             # config.spill_dir is a coordinator-host path; HTTP workers only
             # honor it when explicitly set (operators ensure it exists)
             spill_dir=config.spill_dir,
+            # span pipeline: the coordinator's /config decides (its side
+            # persists events.jsonl; a worker shipping spans nobody stores
+            # would be pure payload), DGREP_SPANS forces on for debugging
+            spans_enabled=spans_mod.enabled(config.spans),
+            job_id=config.effective_job_id(),
         )
         try:
             loop.run()

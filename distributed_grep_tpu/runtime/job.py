@@ -17,6 +17,7 @@ from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.transport import LocalTransport
 from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
+from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.config import JobConfig
 from distributed_grep_tpu.utils.io import WorkDir
@@ -401,6 +402,16 @@ def run_job(
         journal = TaskJournal(workdir.journal_path())
 
     metrics = Metrics()
+    # Span pipeline (utils/spans.py): same wiring as the HTTP coordinator —
+    # the scheduler persists worker-shipped spans + its own decisions to
+    # events.jsonl in the work dir; off by default (no file, no payload).
+    spans_on = spans_mod.enabled(config.spans)
+    event_log = (
+        spans_mod.EventLog(
+            workdir.root / spans_mod.EventLog.FILENAME, fresh=not resume
+        )
+        if spans_on else None
+    )
     scheduler = Scheduler(
         files=list(config.input_files),
         n_reduce=config.n_reduce,
@@ -411,6 +422,7 @@ def run_job(
         resume_entries=resume_entries,
         metrics=metrics,
         commit_resolver=workdir.resolve_task_commit,
+        event_log=event_log,
     )
 
     def worker_main(idx: int) -> None:
@@ -428,6 +440,8 @@ def run_job(
             fault_hooks=hooks,
             reduce_memory_bytes=config.reduce_memory_bytes,
             spill_dir=config.spill_dir or str(Path(config.work_dir) / "spill"),
+            spans_enabled=spans_on,
+            job_id=config.effective_job_id(),
         )
         try:
             loop.run()
@@ -458,6 +472,8 @@ def run_job(
             t.join(timeout=10.0)
     if journal:
         journal.close()
+    if event_log is not None:
+        event_log.close()
 
     return JobResult(
         output_files=workdir.list_outputs(),

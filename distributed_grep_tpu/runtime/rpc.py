@@ -68,6 +68,16 @@ class TaskFinishedArgs:
     # Reduce partitions for which this map task actually produced records —
     # the coordinator registers only files that exist (coordinator.go:139-141).
     produced_parts: list[int] = field(default_factory=list)
+    # Span-pipeline piggyback (utils/spans.py): the worker's final span
+    # flush for this task, plus a counters snapshot.  Optional fields with
+    # defaults, ELIDED from the wire when empty (to_dict below) — old
+    # workers and span-disabled runs produce byte-identical payloads.
+    # spans_seq is the worker's batch counter for this flush: transport
+    # retries reship the same (worker_id, spans_seq) and the coordinator
+    # persists it once.
+    spans: list[dict] = field(default_factory=list)
+    spans_seq: int = -1
+    metrics: dict[str, float] | None = None
 
 
 @dataclass
@@ -96,6 +106,17 @@ class HeartbeatArgs:
     # this many seconds" (cold device compile).  0 = plain stamp, which
     # also CLEARS any previously declared grace.
     grace_s: float = 0.0
+    # Span-pipeline piggyback (utils/spans.py), elided from the wire when
+    # empty: buffered span/event records, a Metrics counters snapshot
+    # (bytes_scanned/gbps aggregates for GET /status), and the clock-sync
+    # observations (worker wall-clock at send + measured RTT of the
+    # previous heartbeat) the coordinator's ClockSync estimates per-worker
+    # offsets from.  spans_seq: see TaskFinishedArgs (retry dedup key).
+    spans: list[dict] = field(default_factory=list)
+    spans_seq: int = -1
+    metrics: dict[str, float] | None = None
+    sent_at: float = 0.0  # worker wall clock (time.time()) at send; 0 = off
+    rtt_s: float = -1.0  # previous heartbeat's round trip; -1 = unknown
 
 
 @dataclass
@@ -115,8 +136,23 @@ _TYPES = {
 }
 
 
+# Optional piggyback fields elided from serialized messages when they hold
+# their defaults: a span-disabled run's payloads stay byte-identical to the
+# pre-span protocol, and a new worker talking to an old coordinator (which
+# constructs args via cls(**payload) and would choke on unknown keys) only
+# fails when the pipeline is actually switched on.
+_ELIDE_DEFAULTS: dict[str, Any] = {
+    "spans": [], "spans_seq": -1, "metrics": None,
+    "sent_at": 0.0, "rtt_s": -1.0,
+}
+
+
 def to_dict(msg: Any) -> dict:
-    return dataclasses.asdict(msg)
+    d = dataclasses.asdict(msg)
+    for k, default in _ELIDE_DEFAULTS.items():
+        if k in d and d[k] == default:
+            del d[k]
+    return d
 
 
 def from_dict(cls_name: str, payload: dict) -> Any:

@@ -30,6 +30,7 @@ condition variable and notifies on every state change.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Optional
 
@@ -38,6 +39,7 @@ from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.types import MapTask, ReduceTask, TaskState
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
+from distributed_grep_tpu.utils.spans import ClockSync, EventLog
 
 log = get_logger("scheduler")
 
@@ -56,6 +58,7 @@ class Scheduler:
         resume_entries: Optional[list[dict]] = None,
         metrics: Optional[Metrics] = None,
         commit_resolver: Optional[Any] = None,
+        event_log: Optional[EventLog] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -70,6 +73,24 @@ class Scheduler:
         # straggler whose late RPC races the sweeper's re-issue can then
         # never register parts its winning attempt did not commit.
         self.commit_resolver = commit_resolver
+        # Span pipeline (utils/spans.py): when an event log is wired in,
+        # worker-shipped span records persist to events.jsonl and the
+        # scheduler's own decisions (assignments, timeout re-enqueues,
+        # commit registrations) are logged as coordinator-row events.
+        # None = pipeline off: no file, no extra work on any RPC.
+        self.event_log = event_log
+        self._pending_events: list[dict] = []  # staged under the lock,
+        # written by _flush_events after release
+        self._span_seqs: dict[int, set[int]] = {}  # worker -> persisted
+        # batch seqs (retry dedup, _persist_spans)
+        self._span_seq_lock = threading.Lock()
+        self._clock = ClockSync()
+        # Per-worker liveness + shipped-metrics table (workers join
+        # implicitly, so rows appear at first assignment/heartbeat):
+        # worker_id -> {"seen": monotonic, "task": "map:3"|None,
+        #               "metrics": last piggybacked counters snapshot,
+        #               "clock_offset_s": ..., "rtt_s": ...}
+        self.workers: dict[int, dict] = {}
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -178,12 +199,148 @@ class Scheduler:
             self._maps_completed, self._reduces_completed,
         )
 
+    # ----------------------------------------------------------- observability
+    def _event(self, name: str, **args) -> None:
+        """Coordinator-row event (no worker tag -> tid 0 in trace-export).
+        No-op without an event log.  Call sites hold the scheduler lock, so
+        the record is only STAGED here; `_flush_events` writes it to disk
+        after the lock is released — a slow work-dir filesystem must not
+        stall every RPC handler behind a flush inside the global lock."""
+        if self.event_log is None:
+            return
+        self._pending_events.append({
+            "t": "instant", "name": name, "cat": "sched",
+            "ts": time.time(), **({"args": args} if args else {}),
+        })
+
+    def _flush_events(self) -> None:
+        """Write staged coordinator events outside the scheduler lock.
+        Never raises — telemetry must not take the control plane down."""
+        if self.event_log is None:
+            return
+        with self._lock:
+            if not self._pending_events:
+                return
+            pending, self._pending_events = self._pending_events, []
+        self._persist_spans(pending)
+
+    def _persist_spans(self, recs: list[dict], worker_id: int = -1,
+                       seq: int = -1) -> None:
+        """Persist a span batch.  (worker_id, seq) is the worker's batch
+        counter: a transport-level RPC retry (grace-heartbeat re-POSTs,
+        the finished RPC's 15 s retry loop) reships the SAME batch after
+        the coordinator may already have processed it — dedup here keeps
+        events.jsonl covering each attempt exactly once."""
+        if self.event_log is None or not recs:
+            return
+        if seq >= 0 and worker_id >= 0:
+            with self._span_seq_lock:
+                seen = self._span_seqs.setdefault(worker_id, set())
+                if seq in seen:
+                    return
+                seen.add(seq)
+        try:
+            self.event_log.write_many(recs)
+        except Exception:  # noqa: BLE001
+            log.exception("event log write failed")
+
+    def _worker_seen(self, worker_id: int, task: str | None = ...,
+                     metrics: dict | None = None) -> None:
+        """Stamp a worker row (call under the lock).  `task` semantics:
+        unspecified (Ellipsis) keeps the current in-flight marker."""
+        if worker_id < 0:
+            return
+        info = self.workers.setdefault(worker_id, {"task": None})
+        info["seen"] = time.monotonic()
+        if task is not ...:
+            info["task"] = task
+        if metrics is not None:
+            info["metrics"] = metrics
+
+    def _observe_clock(self, args: rpc.HeartbeatArgs,
+                       recv_at: float) -> None:
+        """Fold a heartbeat's clock observation in (recv_at = the wall
+        clock at RPC arrival, captured by the caller before any span
+        persistence or lock wait); persist a worker_clock record when the
+        estimate moves >5 ms (trace-export reads the LAST record per
+        worker)."""
+        prev = self._clock.offsets.get(args.worker_id)
+        off = self._clock.observe(
+            args.worker_id, args.sent_at, recv_at, args.rtt_s
+        )
+        if off is None:
+            return
+        info = self.workers.get(args.worker_id)
+        if info is not None:
+            info["clock_offset_s"] = off
+            info["rtt_s"] = self._clock.rtts.get(args.worker_id)
+        if self.event_log is not None and (
+            prev is None or abs(off - prev) > 0.005
+        ):
+            # staged like _event: callers hold the scheduler lock
+            self._pending_events.append({
+                "t": "worker_clock", "worker": args.worker_id,
+                "offset_s": round(off, 6),
+                "rtt_s": round(self._clock.rtts.get(args.worker_id, 0.0), 6),
+                "ts": time.time(),
+            })
+
+    def worker_status(self) -> dict:
+        """Per-worker liveness + shipped aggregates for GET /status: last
+        heartbeat age, in-flight task, and the latest piggybacked Metrics
+        counters (bytes_scanned / gbps / retries / spills)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for wid, info in sorted(self.workers.items()):
+                row: dict = {
+                    "last_heartbeat_age_s": round(now - info["seen"], 3),
+                    "task": info.get("task"),
+                }
+                if info.get("metrics") is not None:
+                    row["metrics"] = info["metrics"]
+                if info.get("clock_offset_s") is not None:
+                    row["clock_offset_s"] = round(info["clock_offset_s"], 6)
+                out[str(wid)] = row
+            return out
+
+    def inflight_status(self) -> list[dict]:
+        """Every IN_PROGRESS task with its heartbeat age and any active
+        grace window — stragglers visible before the sweeper fires."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for kind, table in (("map", self.map_tasks),
+                                ("reduce", self.reduce_tasks)):
+                for t in table:
+                    if t.state is TaskState.IN_PROGRESS:
+                        age = now - t.timestamp
+                        row = {
+                            "type": kind, "task_id": t.task_id,
+                            "attempts": t.attempts,
+                            "heartbeat_age_s": round(age, 3),
+                        }
+                        if t.grace_s:
+                            row["grace_s"] = t.grace_s
+                            row["grace_remaining_s"] = round(
+                                max(0.0, t.grace_s - age), 3
+                            )
+                        out.append(row)
+        return out
+
     # ----------------------------------------------------------------- assign
     def assign_task(self, args: rpc.AssignTaskArgs, timeout: float = 30.0) -> rpc.AssignTaskReply:
         """Long-poll for work.  Blocks until a task is available, the job is
         done (reply JOB_DONE), or `timeout` elapses (reply JOB_DONE only if
         actually done; otherwise an empty retry reply with task_id == -2)."""
         deadline = _Deadline(timeout)
+        try:
+            return self._assign_task_locked(args, deadline)
+        finally:
+            self._flush_events()
+
+    def _assign_task_locked(self, args: rpc.AssignTaskArgs,
+                            deadline: "_Deadline") -> rpc.AssignTaskReply:
         with self._cond:
             worker_id = args.worker_id
             if worker_id < 0:
@@ -211,6 +368,9 @@ class Scheduler:
                     task.heartbeat()
                     task.attempts += 1
                     self.metrics.inc("map_assigned")
+                    self._worker_seen(worker_id, task=f"map:{tid}")
+                    self._event("assign_map", task=tid, worker=worker_id,
+                                attempt=task.attempts, file=task.file)
                     log.debug("assign map task %d (%s) -> worker %d", tid, task.file, worker_id)
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.MAP,
@@ -232,6 +392,9 @@ class Scheduler:
                     task.heartbeat()
                     task.attempts += 1
                     self.metrics.inc("reduce_assigned")
+                    self._worker_seen(worker_id, task=f"reduce:{tid}")
+                    self._event("assign_reduce", task=tid, worker=worker_id,
+                                attempt=task.attempts)
                     log.debug("assign reduce task %d -> worker %d", tid, worker_id)
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.REDUCE,
@@ -254,7 +417,16 @@ class Scheduler:
     def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
         """Idempotent map commit (coordinator.go:126-148)."""
         record = self._resolve_commit("map", args.task_id)
+        self._persist_spans(args.spans, args.worker_id, args.spans_seq)
+        try:
+            return self._map_finished_locked(args, record)
+        finally:
+            self._flush_events()
+
+    def _map_finished_locked(self, args: rpc.TaskFinishedArgs,
+                             record) -> rpc.TaskFinishedReply:
         with self._cond:
+            self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
             task = self.map_tasks[args.task_id]
             if task.state is TaskState.COMPLETED:
                 return rpc.TaskFinishedReply(ok=True)  # duplicate absorbed (:131-134)
@@ -275,6 +447,9 @@ class Scheduler:
                     args.task_id, task.file, parts,
                     has_record=record is not None,
                 )
+            self._event("map_committed", task=args.task_id,
+                        worker=args.worker_id, parts=len(parts),
+                        has_record=record is not None)
             log.info(
                 "map task %d done (%d/%d)",
                 args.task_id, self._maps_completed, len(self.map_tasks),
@@ -293,7 +468,16 @@ class Scheduler:
 
     def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
         record = self._resolve_commit("reduce", args.task_id)
+        self._persist_spans(args.spans, args.worker_id, args.spans_seq)
+        try:
+            return self._reduce_finished_locked(args, record)
+        finally:
+            self._flush_events()
+
+    def _reduce_finished_locked(self, args: rpc.TaskFinishedArgs,
+                                record) -> rpc.TaskFinishedReply:
         with self._cond:
+            self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
             task = self.reduce_tasks[args.task_id]
             if task.state is not TaskState.COMPLETED:
                 task.state = TaskState.COMPLETED
@@ -303,6 +487,9 @@ class Scheduler:
                     self.journal.reduce_completed(
                         args.task_id, has_record=record is not None
                     )
+                self._event("reduce_committed", task=args.task_id,
+                            worker=args.worker_id,
+                            has_record=record is not None)
                 log.info(
                     "reduce task %d done (%d/%d)",
                     args.task_id, self._reduces_completed, self.n_reduce,
@@ -335,22 +522,47 @@ class Scheduler:
                 self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
 
     # -------------------------------------------------------------- liveness
-    def heartbeat(self, task_type: str, task_id: int,
-                  grace_s: float = 0.0) -> None:
+    def heartbeat(self, task_type: str, task_id: int, grace_s: float = 0.0,
+                  args: rpc.HeartbeatArgs | None = None) -> None:
         """UpdateTimestamp (coordinator.go:176-182), plus the grace rider:
         a nonzero grace_s declares a silent phase (cold device compile) so
         the sweeper allows max(task_timeout_s, grace_s) before re-enqueue;
         any later stamp clears it.  Only IN_PROGRESS tasks accept stamps —
         a straggler's late heartbeat must not resurrect a task the sweeper
         already re-enqueued (its eventual completion is still absorbed
-        idempotently)."""
+        idempotently).
+
+        ``args`` is the full HeartbeatArgs when the transport has one
+        (span-pipeline piggyback: buffered spans persist to the event log,
+        the metrics snapshot lands in the worker table, and sent_at/rtt_s
+        feed the per-worker ClockSync).  The positional form stays for
+        direct callers/tests."""
+        # receive time FIRST: the offset estimate prices the request
+        # transit at rtt/2, so recv_at must be the POST arrival, not
+        # arrival + span-persist + lock-wait (a systematic late bias the
+        # EWMA could never average away)
+        recv_at = time.time()
+        if args is not None:
+            self._persist_spans(args.spans, args.worker_id, args.spans_seq)
         with self._cond:
+            if args is not None:
+                self._worker_seen(args.worker_id, metrics=args.metrics)
+                self._observe_clock(args, recv_at)
             table = self.map_tasks if task_type == "map" else self.reduce_tasks
             if 0 <= task_id < len(table):
                 task = table[task_id]
                 if task.state is TaskState.IN_PROGRESS:
-                    task.heartbeat(grace_s=max(0.0, float(grace_s)))
+                    g = max(0.0, float(grace_s))
+                    if args is not None and g > 0 and task.grace_s != g:
+                        # only on the transition: a retried grace stamp
+                        # (response lost, re-POST) re-declares the same
+                        # window and must not duplicate the event
+                        self._event("grace_declared", task=task_id,
+                                    type=task_type, worker=args.worker_id,
+                                    grace_s=g)
+                    task.heartbeat(grace_s=g)
                     self.metrics.inc("heartbeats")
+        self._flush_events()
 
     def _sweep_loop(self) -> None:
         """Failure detector (coordinator.go:97-124): re-enqueue stale tasks."""
@@ -371,6 +583,8 @@ class Scheduler:
                         task.state = TaskState.UNASSIGNED
                         self._map_queue.append(task.task_id)
                         self.metrics.inc("map_retries")
+                        self._event("task_timeout", type="map",
+                                    task=task.task_id, attempt=task.attempts)
                         self._cond.notify_all()
                 for task in self.reduce_tasks:
                     if (
@@ -382,7 +596,10 @@ class Scheduler:
                         task.state = TaskState.UNASSIGNED
                         self._reduce_queue.append(task.task_id)
                         self.metrics.inc("reduce_retries")
+                        self._event("task_timeout", type="reduce",
+                                    task=task.task_id, attempt=task.attempts)
                         self._cond.notify_all()
+            self._flush_events()
             _time.sleep(self.sweep_interval_s)
 
     # ------------------------------------------------------------- predicates

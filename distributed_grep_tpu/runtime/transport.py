@@ -71,8 +71,18 @@ class LocalTransport:
     def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply:
         return self.scheduler.reduce_next_file(args, timeout=self.rpc_timeout_s)
 
-    def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
-        self.scheduler.heartbeat(args.task_type, args.task_id, grace_s=args.grace_s)
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> float:
+        # full args through: the span-pipeline piggyback (buffered spans,
+        # metrics snapshot, clock-sync observations) rides the same stamp.
+        # Returns an RTT sample like HttpTransport (the worker treats a
+        # non-float return as "no valid sample") — 0.0 here, NOT the
+        # handler duration: same process, same clock, zero transit; timing
+        # the synchronous call would fold event-log flush time into the
+        # offset estimate and shift the worker's trace row negative.
+        self.scheduler.heartbeat(
+            args.task_type, args.task_id, grace_s=args.grace_s, args=args
+        )
+        return 0.0
 
     def read_input(self, filename: str) -> bytes:
         return resolve_input_path(filename, self.workdir).read_bytes()

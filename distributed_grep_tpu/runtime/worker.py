@@ -27,6 +27,7 @@ from distributed_grep_tpu.apps.loader import LoadedApplication
 from distributed_grep_tpu.runtime import rpc, shuffle
 from distributed_grep_tpu.runtime.extsort import ExternalReducer
 from distributed_grep_tpu.runtime.transport import Transport
+from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
@@ -47,6 +48,8 @@ class WorkerLoop:
         fault_hooks: Optional[dict[str, Callable[[], None]]] = None,
         reduce_memory_bytes: int = 128 << 20,
         spill_dir: Optional[str] = None,
+        spans_enabled: Optional[bool] = None,
+        job_id: str = "",
     ):
         self.transport = transport
         self.app = app
@@ -57,6 +60,16 @@ class WorkerLoop:
         # RAM-backed tmpfs, which would defeat the reduce memory cap.
         self.spill_dir = spill_dir
         self.worker_id = -1
+        # Span pipeline (utils/spans.py): None defers to the DGREP_SPANS
+        # env var; run_job/run_http_worker pass JobConfig.spans explicitly.
+        # Off means NO buffer exists — every emit site no-ops and RPC
+        # payloads keep their pre-span shape (rpc._ELIDE_DEFAULTS).
+        if spans_enabled is None:
+            spans_enabled = spans_mod.env_enabled()
+        self.spans = spans_mod.SpanBuffer() if spans_enabled else None
+        self.job_id = job_id
+        self._hb_rtt = -1.0  # last heartbeat round trip (ClockSync feed)
+        self._assign_wait_s = 0.0
 
     def _fault(self, point: str) -> None:
         hook = self.fault_hooks.get(point)
@@ -80,11 +93,31 @@ class WorkerLoop:
         hb = getattr(self.transport, "heartbeat", None)
         if hb is None:
             return
+        args = rpc.HeartbeatArgs(
+            task_type=task_type, task_id=task_id,
+            worker_id=self.worker_id, grace_s=grace_s,
+        )
+        if self.spans is not None:
+            # Piggyback: buffered spans flush on the stamp the worker was
+            # sending anyway (a failed stamp loses this batch — telemetry
+            # is best-effort by the same contract as the stamp itself);
+            # sent_at + the previous round trip feed the coordinator's
+            # per-worker clock-offset estimate.
+            args.spans_seq, args.spans = self.spans.drain_batch()
+            args.metrics = self.metrics.piggyback()
+            args.sent_at = time.time()
+            args.rtt_s = self._hb_rtt
         try:
-            hb(rpc.HeartbeatArgs(
-                task_type=task_type, task_id=task_id,
-                worker_id=self.worker_id, grace_s=grace_s,
-            ))
+            rtt = hb(args)
+            # Transports that measure return the successful POST's round
+            # trip as a float (retry sleeps excluded).  Anything else —
+            # None from a stamp that exhausted its attempts, or a custom
+            # transport without measurement — is NOT a valid sample: keep
+            # the previous value rather than poison the clock sync with
+            # timeout+retry wall time (a 16 s "RTT" would skew the
+            # worker's whole trace row by seconds).
+            if isinstance(rtt, float):
+                self._hb_rtt = rtt
         except Exception:  # noqa: BLE001 — advisory by contract
             pass
 
@@ -143,8 +176,17 @@ class WorkerLoop:
     def run(self) -> None:
         """The infinite task loop (worker.go:126-178), with a clean exit."""
         while True:
+            t_wait = time.monotonic()
             reply = self.transport.assign_task(rpc.AssignTaskArgs(worker_id=self.worker_id))
+            # idle wait for work — reported as an arg on the task span
+            self._assign_wait_s = time.monotonic() - t_wait
             self.worker_id = reply.worker_id
+            if self.spans is not None:
+                # buffer-synthesized records (drop reports) render on this
+                # worker's row now that the coordinator named it
+                self.spans.base_tags.update(
+                    job=self.job_id, worker=self.worker_id
+                )
             if reply.assignment == rpc.Assignment.JOB_DONE:
                 log.info("worker %d: job done, exiting", self.worker_id)
                 return
@@ -164,14 +206,60 @@ class WorkerLoop:
         (custom test transports) keep RPC-args registration."""
         publish = getattr(self.transport, "publish_task_commit", None)
         if publish is not None:
-            publish(kind, task_id, attempt, payload)
+            with spans_mod.span(f"{kind}:commit", cat=kind):
+                publish(kind, task_id, attempt, payload)
+
+    def _task_ctx(self, kind: str, task_id: int, attempt: str):
+        """The span pipeline's ambient task context for one attempt — a
+        nullcontext when the pipeline is off, so every emit site below
+        no-ops (utils/spans.active)."""
+        if self.spans is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return spans_mod.task_context(
+            self.spans, job=self.job_id, worker=self.worker_id,
+            task=task_id, attempt=attempt, kind=kind,
+        )
+
+    def _finished_args(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedArgs:
+        """Attach the final span flush + metrics snapshot to a finished
+        RPC — the last chance to ship this attempt's telemetry (a worker
+        may exit before any further heartbeat), so unlike the heartbeat's
+        FLUSH_MAX batches this drains EVERYTHING (bounded by the buffer
+        cap + one drop report)."""
+        if self.spans is not None:
+            args.spans_seq, args.spans = self.spans.drain_batch(
+                limit=self.spans.cap + 1
+            )
+            args.metrics = self.metrics.piggyback()
+        return args
 
     # ------------------------------------------------------------------- map
     def _run_map(self, a: rpc.AssignTaskReply) -> None:
         from distributed_grep_tpu.runtime.store import new_attempt_id
 
         t0 = time.perf_counter()
+        t0_wall = time.time()
         attempt = new_attempt_id()
+        with self._task_ctx("map", a.task_id, attempt):
+            produced = self._map_attempt(a, attempt, t0)
+            spans_mod.complete(
+                "map:task", t0_wall, time.time() - t0_wall, cat="map",
+                assign_wait_s=round(self._assign_wait_s, 6),
+            )
+            self._fault("before_map_finished")
+            self.transport.map_finished(self._finished_args(
+                rpc.TaskFinishedArgs(
+                    task_id=a.task_id, worker_id=self.worker_id,
+                    produced_parts=produced,
+                )
+            ))
+        self.metrics.inc("map_tasks")
+        self.metrics.observe("map_task_total", time.perf_counter() - t0)
+
+    def _map_attempt(self, a: rpc.AssignTaskReply, attempt: str,
+                     t0: float) -> list[int]:
         self.app.configure(**a.app_options)
         # Streaming boundary: an app exposing map_path_fn receives a local
         # file path and reads it in bounded chunks (engine.scan_file) —
@@ -216,13 +304,15 @@ class WorkerLoop:
                 import os
 
                 with download_guard(), \
-                        trace.annotate(f"map_read:{a.task_id}"):
+                        trace.annotate(f"map_read:{a.task_id}"), \
+                        spans_mod.span("map:read", cat="map", file=a.filename):
                     path, is_temp = self.transport.read_input_path(a.filename)
                 try:
                     self._fault("after_map_read")
                     n_bytes = os.path.getsize(path)
                     with self.metrics.timer("map_compute"), \
                             trace.annotate(f"map_compute:{a.task_id}"), \
+                            spans_mod.span("map:compute", cat="map"), \
                             compute_guard():
                         records = self.app.map_path_fn(a.filename, str(path))
                 finally:
@@ -231,11 +321,13 @@ class WorkerLoop:
                 self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
             else:
                 with download_guard(), \
-                        trace.annotate(f"map_read:{a.task_id}"):
+                        trace.annotate(f"map_read:{a.task_id}"), \
+                        spans_mod.span("map:read", cat="map", file=a.filename):
                     contents = self.transport.read_input(a.filename)
                 self._fault("after_map_read")
                 with self.metrics.timer("map_compute"), \
                         trace.annotate(f"map_compute:{a.task_id}"), \
+                        spans_mod.span("map:compute", cat="map"), \
                         compute_guard():
                     records = self.app.map_fn(a.filename, contents)
                 self.metrics.record_scan(len(contents), time.perf_counter() - t0)
@@ -266,7 +358,7 @@ class WorkerLoop:
                     return contextlib.nullcontext()
             return self._pumping("map", a.task_id, pump_s)
 
-        with shuffle_guard():
+        with shuffle_guard(), spans_mod.span("map:shuffle", cat="map"):
             buckets = shuffle.bucketize(records, a.n_reduce)
             self._fault("before_map_commit")
             produced: list[int] = []
@@ -277,23 +369,30 @@ class WorkerLoop:
                 )
                 produced.append(r)
         self._publish_commit("map", a.task_id, attempt, {"parts": produced})
-        self._fault("before_map_finished")
-        self.transport.map_finished(
-            rpc.TaskFinishedArgs(
-                task_id=a.task_id, worker_id=self.worker_id, produced_parts=produced
-            )
-        )
-        self.metrics.inc("map_tasks")
-        self.metrics.observe("map_task_total", time.perf_counter() - t0)
+        return produced
 
     # ---------------------------------------------------------------- reduce
     def _run_reduce(self, a: rpc.AssignTaskReply) -> None:
-        import os
-
         from distributed_grep_tpu.runtime.store import new_attempt_id
 
         t0 = time.perf_counter()
+        t0_wall = time.time()
         attempt = new_attempt_id()
+        with self._task_ctx("reduce", a.task_id, attempt):
+            self._reduce_attempt(a, attempt)
+            spans_mod.complete(
+                "reduce:task", t0_wall, time.time() - t0_wall, cat="reduce",
+                assign_wait_s=round(self._assign_wait_s, 6),
+            )
+            self.transport.reduce_finished(self._finished_args(
+                rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
+            ))
+        self.metrics.inc("reduce_tasks")
+        self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
+
+    def _reduce_attempt(self, a: rpc.AssignTaskReply, attempt: str) -> None:
+        import os
+
         self.app.configure(**a.app_options)
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
@@ -337,6 +436,7 @@ class WorkerLoop:
             progress_stride = 4096
         try:
             files_processed = 0
+            t_shuffle = time.time()
             while True:
                 r = self.transport.reduce_next_file(
                     rpc.ReduceNextFileArgs(
@@ -351,7 +451,14 @@ class WorkerLoop:
                 sink.add_many(shuffle.decode_records(data))
                 files_processed += 1
                 self._fault("after_reduce_file")
-            self._write_reduce_output(a, chunks(), progress_stride)
+            # the streaming shuffle leg: long-poll waits included (reduce
+            # runs concurrently with maps, so much of this is pipeline wait)
+            spans_mod.complete(
+                "reduce:shuffle", t_shuffle, time.time() - t_shuffle,
+                cat="reduce", files=files_processed,
+            )
+            with spans_mod.span("reduce:compute", cat="reduce"):
+                self._write_reduce_output(a, chunks(), progress_stride)
         finally:
             if sink.spill_count:
                 self.metrics.inc("reduce_spills", sink.spill_count)
@@ -359,11 +466,6 @@ class WorkerLoop:
         self._publish_commit(
             "reduce", a.task_id, attempt, {"output": f"mr-out-{a.task_id}"}
         )
-        self.transport.reduce_finished(
-            rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
-        )
-        self.metrics.inc("reduce_tasks")
-        self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
 
     def _write_reduce_output(self, a: rpc.AssignTaskReply, chunks,
                              progress_stride: int) -> None:

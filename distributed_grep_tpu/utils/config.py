@@ -49,6 +49,16 @@ class JobConfig:
     sweep_interval_s: float = 1.0  # coordinator.go:122
     journal: bool = True  # durable task-commit journal for coordinator resume
 
+    # --- Observability (utils/spans.py) ------------------------------------
+    # Span/event pipeline: workers ship per-task-attempt spans piggybacked
+    # on Heartbeat/TaskFinished RPCs; the coordinator persists them as
+    # events.jsonl in the work dir (render with `dgrep trace-export`).
+    # Off by default — disabled runs add zero RPC payload and write no
+    # files.  The DGREP_SPANS env var forces on regardless of this flag.
+    spans: bool = False
+    # Span job tag; "" derives it from the work dir's basename.
+    job_id: str = ""
+
     # --- Worker resources --------------------------------------------------
     # Reduce-side grouping memory cap: records past this spill to sorted
     # on-disk runs and merge-stream (runtime/extsort.py).  The reference
@@ -75,6 +85,11 @@ class JobConfig:
             )
         self.mesh_shape = tuple(self.mesh_shape)
         self.mesh_axes = tuple(self.mesh_axes)
+
+    def effective_job_id(self) -> str:
+        """The span pipeline's job tag: the explicit job_id, else the work
+        dir's basename (stable across coordinator restarts of one job)."""
+        return self.job_id or Path(self.work_dir).name
 
     def effective_app_options(self) -> dict:
         """app_options with the top-level mesh knobs merged in (explicit

@@ -50,6 +50,18 @@ class Metrics:
         secs = self.counters.get("scan_seconds", 0.0)
         return (self.counters.get("bytes_scanned", 0.0) / 1e9 / secs) if secs else 0.0
 
+    def piggyback(self) -> dict:
+        """Compact counters snapshot for the heartbeat span-pipeline
+        piggyback (runtime/rpc.py): every counter plus the computed gbps
+        headline — small enough to ship on each stamp, rich enough for
+        GET /status per-worker aggregates."""
+        with self._lock:
+            out = dict(self.counters)
+        if out.get("scan_seconds"):
+            # 6 digits: tiny jobs (a few KB) must not round to 0.0
+            out["gbps"] = round(self.gbps(), 6)
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             out = {

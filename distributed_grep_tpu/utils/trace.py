@@ -11,6 +11,14 @@ in the same timeline.
 Everything is a no-op unless tracing is switched on — either by passing
 `trace_dir` explicitly or via the DGREP_TRACE_DIR environment variable —
 so the hot paths pay nothing in production.
+
+This module covers the DEVICE side of the observability story; the
+cross-process control-plane side is utils/spans.py (worker→coordinator
+span shipping, events.jsonl, `dgrep trace-export`).  Both render into the
+same Perfetto/TensorBoard viewers, and `annotate`'s region names match the
+span names the worker emits (map:read/map:compute per task id), so the
+exported span rows line up with the jax.profiler device rows when a run
+enables both DGREP_TRACE_DIR and the span pipeline.
 """
 
 from __future__ import annotations
