@@ -628,6 +628,19 @@ def cmd_grep(args: argparse.Namespace) -> int:
         },
         n_reduce=args.n_reduce or 10,
     )
+    if len(cfg.input_files) > 1:
+        # Cross-file batching (round 6): a grep -r over a source tree is
+        # the many-small-files regime — group sub-threshold files into
+        # multi-file map splits (runtime/job.plan_map_splits) so one map
+        # task, and one packed device dispatch per window
+        # (GrepEngine.scan_batch), covers many files instead of each
+        # paying its own task + scan.  Exact per-file results either way;
+        # DGREP_BATCH_BYTES overrides (0 disables).  Pays on the cpu
+        # engine too (one native pass + one task commit per window), so
+        # it is not gated on the backend.
+        from distributed_grep_tpu.ops.layout import DEFAULT_BATCH_BYTES
+
+        cfg.batch_bytes = DEFAULT_BATCH_BYTES
     if cfg.app_options.get("backend") != "cpu":
         # device backend (explicit tpu, auto, or --max-errors): mid-task
         # heartbeats (worker progress callbacks + the app's declared

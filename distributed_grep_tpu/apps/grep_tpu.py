@@ -167,6 +167,34 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     if _engine is None:
         raise RuntimeError("grep_tpu used before configure() — no pattern set")
     result = _engine.scan(contents, progress=_progress_fn())
+    return _records_for(filename, contents, result)
+
+
+def map_batch_fn(items) -> list[KeyValue]:
+    """Batched map (round 6): many small splits in ONE call — the engine
+    packs them into shared device dispatches (GrepEngine.scan_batch /
+    ops/layout.BatchPacker), so a multi-file map split pays one kernel
+    pass per DGREP_BATCH_BYTES window instead of one host scan per file.
+    ``items`` is a list of (filename, contents) pairs; the records are
+    identical to per-file map_fn calls (the packed scan is exact at file
+    granularity — every blob is newline-terminated in the packed layout,
+    and the engine's confirm/stitch pass owns stripe/segment edges)."""
+    if _engine is None:
+        raise RuntimeError("grep_tpu used before configure() — no pattern set")
+    records: list[KeyValue] = []
+    _engine.scan_batch(
+        items, progress=_progress_fn(),
+        emit=lambda name, data, res: records.extend(
+            _records_for(name, data, res)
+        ),
+    )
+    return records
+
+
+def _records_for(filename: str, contents: bytes, result) -> list[KeyValue]:
+    """Everything after the scan — -w/-x confirm, -v, count/presence
+    collapse, columnar batch build — shared by map_fn (one scan per call)
+    and map_batch_fn (one packed scan, per-file demuxed results)."""
     emit = result.matched_lines  # int64 ndarray, stays vectorized throughout
     nl = None
     if _confirm is not None and emit.size:

@@ -37,6 +37,11 @@ class LoadedApplication:
     # optional streaming entry: receives a local file path instead of bytes
     # (the worker then spools/streams the split — splits larger than RAM)
     map_path_fn: Callable[[str, str], list[KeyValue]] | None = None
+    # optional batched entry: receives a LIST of (filename, contents)
+    # pairs for a multi-file map split (runtime/job.plan_map_splits) and
+    # may amortize work across them (grep_tpu packs them into shared
+    # device dispatches).  Apps without one get map_fn called per member.
+    map_batch_fn: Callable[[list], list[KeyValue]] | None = None
     # optional streaming reduce: receives a value ITERATOR — hot keys never
     # materialize their value list (runtime/extsort.py); must agree with
     # reduce_fn on every input
@@ -114,6 +119,7 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
             f"(or Map/Reduce); got map={map_fn!r} reduce={reduce_fn!r}"
         )
     map_path_fn = getattr(module, "map_path_fn", None)
+    map_batch_fn = getattr(module, "map_batch_fn", None)
     reduce_stream_fn = getattr(module, "reduce_stream_fn", None)
     app = LoadedApplication(
         name=spec,
@@ -121,6 +127,7 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
         reduce_fn=reduce_fn,
         module=module,
         map_path_fn=map_path_fn if callable(map_path_fn) else None,
+        map_batch_fn=map_batch_fn if callable(map_batch_fn) else None,
         reduce_stream_fn=reduce_stream_fn if callable(reduce_stream_fn) else None,
     )
     if options:

@@ -101,6 +101,10 @@ class _DaemonPool:
         from concurrent.futures import Future
 
         fut = Future()
+        # prune settled futures: a long-lived pool (the engine's persistent
+        # reader slot submits once per chunk forever) must not grow this
+        # cancel-bookkeeping list without bound
+        self._futs = [f for f in self._futs if not f.done()]
         self._futs.append(fut)
         self._q.put((fut, fn, args))
         return fut

@@ -199,6 +199,32 @@ def _probe_device_blocking() -> bool:
 
 log = get_logger("engine")
 
+# Persistent read-ahead pools for scan_file's disk/scan overlap (round 6):
+# ONE one-slot daemon pool per scanning thread, PROCESS-wide — shared by
+# every engine, so neither constructing engines in a loop nor scanning
+# thousands of files spawns threads (the old per-file ThreadPoolExecutor
+# measured real overhead on a 2,000-file grep -r, round-5 note).  Entries
+# for dead threads are pruned (pool shut down via its sentinel) on the
+# next pool creation, so a process that churns worker threads does not
+# accumulate idle daemon readers.
+_reader_pools: dict = {}
+_reader_pools_lock = _threading_mod.Lock()
+
+
+def _thread_reader_pool():
+    me = _threading_mod.get_ident()
+    with _reader_pools_lock:
+        pool = _reader_pools.get(me)
+        if pool is None:
+            live = {t.ident for t in _threading_mod.enumerate()}
+            for ident in [k for k in _reader_pools if k not in live]:
+                _reader_pools.pop(ident).shutdown(wait=False)
+            from distributed_grep_tpu.ops.device_scan import _DaemonPool
+
+            pool = _DaemonPool(1, thread_name_prefix="dgrep-read")
+            _reader_pools[me] = pool
+        return pool
+
 # Coarse span path: above this many candidate lines per segment, per-line
 # Python confirm would crawl — one native DFA pass over the whole segment
 # (C, ~GB/s, vectorized line mapping) resolves everything instead.
@@ -249,6 +275,12 @@ class GrepEngine:
         # latency-bound (~ms on PCIe, ~100 ms through a tunnel) while the
         # exact host scanners do sub-MB inputs in <= low ms — the grep -r
         # many-small-files regime.  None = DGREP_DEVICE_MIN_BYTES or 1 MB.
+        batch_bytes: int | None = None,  # scan_batch packing window: small
+        # inputs accumulate until the packed buffer reaches this size, then
+        # flush as ONE dispatch (ops/layout.BatchPacker) — the cross-file
+        # batching that puts the many-small-files regime back on the
+        # kernels.  None = DGREP_BATCH_BYTES or 32 MB; 0 disables packing
+        # (scan_batch then degrades to per-item scans).
     ):
         if (pattern is None) == (patterns is None):
             raise ValueError("exactly one of pattern / patterns is required")
@@ -287,6 +319,16 @@ class GrepEngine:
             device_min_bytes if device_min_bytes is not None
             else int(_os.environ.get("DGREP_DEVICE_MIN_BYTES", 1 << 20))
         )
+        if batch_bytes is not None:
+            self.batch_bytes = int(batch_bytes)
+        else:
+            # ONE parse for the env override, shared with the map-split
+            # planner (JobConfig.effective_batch_bytes) — a stricter parse
+            # here would crash worker engines on an env var the planner
+            # already shrugged off
+            from distributed_grep_tpu.ops.layout import env_batch_bytes
+
+            self.batch_bytes = env_batch_bytes()
         self.ignore_case = ignore_case
 
         self.shift_and: ShiftAndModel | None = None
@@ -948,8 +990,32 @@ class GrepEngine:
                 and pallas_nfa.eligible(self.glushkov)
             ):
                 return self._host_scan(self._scan_re, data, progress)
-        if (
-            len(data) < self.device_min_bytes
+        if self._small_for_device(len(data)):
+            # Host OR pending-batch (round 6): a sub-threshold input that
+            # arrives through plain scan() takes the exact host engines —
+            # round-trip-latency-bound on a real accelerator (~ms over
+            # PCIe, ~100 ms through a tunnel) while native memmem / AC-DFA
+            # banks, or the re loop for the DFA-less NFA rescue, finish in
+            # <= low ms.  The same input arriving through scan_batch()
+            # instead JOINS a pending packed batch (ops/layout.BatchPacker)
+            # and reaches the kernels as part of one amortized dispatch —
+            # "host always" is no longer the only small-input story.
+            # XLA-on-CPU "devices" are not gated (dispatch is ~µs there,
+            # and the CI suite's device-path coverage runs on them).
+            res = self._host_scan(self._host_scanner(), data, progress)
+            self.stats["small_host_scan"] = True  # AFTER: scanners reset stats
+            return res
+        return self._scan_device(data, progress=progress)
+
+    def _small_for_device(self, n_bytes: int) -> bool:
+        """True when a PLAIN scan() of this size should reroute to the
+        exact host engines rather than pay its own device dispatch.
+        scan_batch's pack-vs-solo split uses the size threshold alone:
+        packing amortizes dispatch overhead on every backend (interpret
+        engines and XLA-on-CPU included), so it is not gated on
+        _accel_backend the way the solo-host reroute is."""
+        return (
+            n_bytes < self.device_min_bytes
             and not self._interpret  # CI interpret engines exist to
             # exercise the kernels — never reroute them
             and self.mesh is None  # a mesh engine EXISTS to run the
@@ -959,16 +1025,7 @@ class GrepEngine:
             # Python recurrence; the device wins at any size
             and self._host_scanner() is not None
             and self._accel_backend()
-        ):
-            # Sub-threshold inputs are round-trip-latency-bound on a real
-            # accelerator (~ms over PCIe, ~100 ms through a tunnel) while
-            # the EXACT host engines — native memmem / AC-DFA banks, or the
-            # re loop for the DFA-less NFA rescue — finish in <= low ms:
-            # the grep -r many-small-files regime.  XLA-on-CPU "devices"
-            # are not gated (dispatch is ~µs there, and the CI suite's
-            # device-path coverage runs on them).
-            return self._host_scan(self._host_scanner(), data, progress)
-        return self._scan_device(data, progress=progress)
+        )
 
     def _device_responsive(self) -> bool:
         """Shared device verdict (see _device_probe_state): probes on
@@ -1092,6 +1149,15 @@ class GrepEngine:
         self.stats = {"end_offsets": end_offsets}
         return ScanResult(ml, n_matches, len(data))
 
+    def _reader_pool(self):
+        """The calling thread's persistent one-slot read-ahead pool —
+        PROCESS-wide per scanning thread (see _thread_reader_pool), so
+        constructing engines in a loop (fuzz sweeps, a worker
+        reconfiguring per job) reuses one reader instead of accumulating
+        pools, and concurrent worker slots never queue reads behind each
+        other."""
+        return _thread_reader_pool()
+
     def scan_file(self, path, chunk_bytes: int | None = None, emit=None,
                   progress=None, stop_after_match: bool = False,
                   stop=None, emit_chunk=None) -> ScanResult:
@@ -1154,20 +1220,20 @@ class GrepEngine:
                 return self._v
 
         # The one-slot reader thread exists to overlap disk with scan —
-        # pointless (and measurably expensive: one thread spawn per file
-        # in a 2,000-file grep -r) for files that fit in a single chunk.
+        # pointless for files that fit in a single chunk.
         # BufferedReader.read(n) returns short only at EOF, so a full
         # block is the one case where more data may follow: the pool is
-        # created lazily at the first full block.
-        rpool = None
+        # touched lazily at the first full block.  The pool itself is
+        # PERSISTENT per scanning thread (round 6, _reader_pool): the old
+        # per-file ThreadPoolExecutor paid a thread spawn + join per
+        # multi-chunk file — measured real overhead on a 2,000-file
+        # grep -r (round-5 note).
+        pending = None  # the in-flight read future, if any
 
         def submit_read():
-            nonlocal rpool
-            if rpool is None:
-                from concurrent.futures import ThreadPoolExecutor
-
-                rpool = ThreadPoolExecutor(1)  # all reads, in file order
-            return rpool.submit(f.read, chunk_target)
+            nonlocal pending
+            pending = self._reader_pool().submit(f.read, chunk_target)
+            return pending
 
         try:
             f = open(path, "rb")
@@ -1228,9 +1294,15 @@ class GrepEngine:
                 if final:
                     break
         finally:
-            # the in-flight read must not outlive the file handle
-            if rpool is not None:
-                rpool.shutdown(wait=True, cancel_futures=True)
+            # The in-flight read must not outlive the file handle: cancel
+            # a still-queued read, await one already running (bounded by a
+            # single chunk read — what the old per-file pool shutdown also
+            # waited for).  The pool itself stays alive for the next file.
+            if pending is not None and not pending.cancel():
+                try:
+                    pending.result()
+                except Exception:  # noqa: BLE001 — handle closes next
+                    pass
             try:
                 f.close()
             except NameError:
@@ -1238,6 +1310,115 @@ class GrepEngine:
         self.stats["end_offsets"] = end_offsets
         self.stats["read_wait_seconds"] = read_wait
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
+
+    # ------------------------------------------------- cross-file batching
+    def scan_batch(self, items, progress=None, emit=None):
+        """Scan many inputs, packing small ones into shared dispatches.
+
+        ``items`` is an iterable of ``(name, data)`` where ``data`` is the
+        input's bytes (or a filesystem path, read whole — callers with
+        splits at or above device_min_bytes should stream those through
+        scan_file themselves).  Inputs below device_min_bytes accumulate
+        in a BatchPacker (ops/layout.py) and flush as ONE scan over the
+        packed newline-terminated buffer whenever the next input would
+        overflow ``batch_bytes``; larger inputs flush the pending batch
+        (order is preserved) and scan solo.  Exactness at file granularity
+        rides the two invariants the codebase already pins: every DFA
+        '\\n' column is the start state (file boundaries are line starts,
+        so every kernel family is exact there) and the host
+        confirm/stitch pass owns stripe/segment boundaries — see the
+        layout-module notes.
+
+        Returns ``[(name, ScanResult)]`` in input order; matched_lines are
+        per-file 1-based, bytes_scanned is the ORIGINAL blob length.
+        ``emit(name, data, result)``, when given, is called per input
+        while its blob is still in memory (the grep apps build their
+        output records there).
+
+        Telemetry lands in ``engine.stats`` after the call —
+        ``batched_files``, ``batch_dispatches``, ``solo_dispatches``,
+        ``dispatches_saved`` (= batched_files - batch_dispatches) and
+        ``batch_fill_ratio`` (mean packed-buffer fill vs batch_bytes) —
+        and each packed flush emits a ``scan:batch`` span on the span
+        pipeline (utils/spans.py), so trace-export shows packed
+        dispatches on the worker rows."""
+        from distributed_grep_tpu.ops.layout import BatchPacker, packed_size
+
+        cap = max(0, int(self.batch_bytes))
+        packer = BatchPacker(cap) if cap > 0 else None
+        out: list = []
+        bstats = {
+            "batched_files": 0, "batch_dispatches": 0,
+            "solo_dispatches": 0, "fill_sum": 0.0,
+        }
+
+        def handle(name, data, res) -> None:
+            if emit is not None:
+                emit(name, data, res)
+            out.append((name, res))
+
+        def flush() -> None:
+            if packer is None:
+                return
+            batch = packer.pack()
+            if batch is None:
+                return
+            if len(batch) == 1:
+                # nothing amortized: scan the original blob (no synthesized
+                # terminator in bytes_scanned, no demux) and count it solo
+                bstats["solo_dispatches"] += 1
+                handle(batch.names[0], batch.blobs[0],
+                       self.scan(batch.blobs[0], progress=progress))
+                return
+            t0 = _time_mod.perf_counter()
+            t0_wall = _time_mod.time()
+            res = self.scan(batch.data, progress=progress)
+            per_file = batch.demux(res.matched_lines)
+            bstats["batched_files"] += len(batch)
+            bstats["batch_dispatches"] += 1
+            bstats["fill_sum"] += len(batch.data) / cap
+            if spans_mod.active():
+                spans_mod.complete(
+                    "scan:batch", t0_wall,
+                    _time_mod.perf_counter() - t0, cat="engine",
+                    mode=self.mode, files=len(batch),
+                    bytes=len(batch.data), matches=res.n_matches,
+                    fill_ratio=round(len(batch.data) / cap, 6),
+                )
+            for name, blob, lines in zip(batch.names, batch.blobs, per_file):
+                handle(name, blob, ScanResult(
+                    lines.astype(np.int64), int(lines.size), len(blob)
+                ))
+
+        for name, data in items:
+            if not isinstance(data, (bytes, bytearray, memoryview)):
+                with open(_os.fspath(data), "rb") as f:
+                    data = f.read()
+            data = bytes(data)
+            small = len(data) < self.device_min_bytes
+            if packer is None or not small or packed_size(data) > cap:
+                flush()  # order-preserving: pending smalls go first
+                bstats["solo_dispatches"] += 1
+                handle(name, data, self.scan(data, progress=progress))
+                continue
+            if not packer.fits(data):
+                flush()
+            packer.add(name, data)
+        flush()
+        # AFTER the last scan (each scan resets the thread's stats dict):
+        # the batch counters describe the whole scan_batch call.
+        st = self.stats
+        st["batched_files"] = bstats["batched_files"]
+        st["batch_dispatches"] = bstats["batch_dispatches"]
+        st["solo_dispatches"] = bstats["solo_dispatches"]
+        st["dispatches_saved"] = (
+            bstats["batched_files"] - bstats["batch_dispatches"]
+        )
+        st["batch_fill_ratio"] = (
+            round(bstats["fill_sum"] / bstats["batch_dispatches"], 6)
+            if bstats["batch_dispatches"] else 0.0
+        )
+        return out
 
     # ---------------------------------------------------------- host engines
     def _scan_re(self, data: bytes) -> ScanResult:

@@ -81,3 +81,150 @@ def to_device_array(data: bytes, layout: Layout) -> np.ndarray:
     buf = np.full(layout.padded, NL, dtype=np.uint8)
     buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
     return np.ascontiguousarray(buf.reshape(layout.lanes, layout.chunk).T)
+
+
+# ----------------------------------------------------- cross-file batching
+#
+# The many-small-files regime (grep -r over a source tree): every file
+# below device_min_bytes would pay a full dispatch round-trip on its own,
+# so the scan never reaches the kernels at all.  Packing many
+# newline-terminated blobs into ONE buffer amortizes a single dispatch
+# across all of them — exactly Hyperscan's one-database-many-payloads
+# amortization and MapReduce's small-inputs-into-splits batching.
+#
+# Why the packed scan is exact at file granularity: every blob is
+# terminated with '\n' in the packed buffer (synthesized when the file
+# lacks one — which adds no line: grep -n counts the unterminated tail as
+# a line already), so no line ever spans a file boundary.  Every DFA
+# table's '\n' column is the start state (the invariant stripe/segment
+# boundaries already rely on), '^' sees a true line start at each file's
+# first byte, '$' sees a true line end at each file's last line, the
+# approx recurrence resets its rows at '\n' (an errorful match can never
+# span a newline), and the filter families' host confirm/stitch pass
+# operates per line — lines are bit-identical to the per-file layout, so
+# the per-file verdicts are too.  Demux is pure line arithmetic over the
+# cumulative per-file line counts.
+
+
+# The default packing window, shared by every site that opts into
+# batching (GrepEngine's cap fallback, the CLI's cfg.batch_bytes): one
+# constant, so the "one packed dispatch per window" contract cannot
+# drift between direct engine users and CLI jobs.  32 MB ≈ half a scan
+# segment: big enough to amortize dispatch across thousands of small
+# files, small enough that a batch never adds a second segment compile.
+DEFAULT_BATCH_BYTES = 32 << 20
+
+
+def env_batch_bytes(fallback: int = DEFAULT_BATCH_BYTES) -> int:
+    """Parse the DGREP_BATCH_BYTES override, ONE way for its two readers
+    (GrepEngine's packing cap and JobConfig.effective_batch_bytes — the
+    map-split planner): unset or unparseable -> ``fallback``, else the
+    clamped integer (0 disables).  A divergent parse would let the
+    planner hand out batched splits whose worker engines then crash on
+    the same env var."""
+    import os
+
+    env = os.environ.get("DGREP_BATCH_BYTES")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass  # malformed override: both readers fall back identically
+    return fallback
+
+
+@dataclass
+class PackedBatch:
+    """One packed scan buffer plus the per-file offset tables to demux it."""
+
+    data: bytes  # concatenated newline-terminated blobs
+    names: list  # caller-supplied per-file identifiers, input order
+    blobs: list  # the ORIGINAL blobs (no synthesized terminator)
+    # cumulative tables, length len(names)+1 with [0] == 0:
+    byte_starts: np.ndarray  # packed byte offset where each file begins
+    # (demux below is pure LINE arithmetic — byte_starts exists for
+    # diagnostics and future byte-addressed consumers like -o/-b)
+    line_starts: np.ndarray  # packed line count before each file begins
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def demux(self, matched_lines: np.ndarray) -> list[np.ndarray]:
+        """Split packed-buffer 1-based matched line numbers (sorted, as a
+        ScanResult carries them) into per-file LOCAL 1-based line arrays,
+        input order.  File i owns global lines
+        (line_starts[i], line_starts[i+1]]."""
+        matched = np.asarray(matched_lines, dtype=np.int64)
+        splits = np.searchsorted(matched, self.line_starts, side="right")
+        return [
+            matched[splits[i] : splits[i + 1]] - self.line_starts[i]
+            for i in range(len(self.names))
+        ]
+
+
+def packed_size(blob: bytes) -> int:
+    """Bytes `blob` occupies in a packed buffer: its length plus the
+    synthesized '\\n' terminator when it lacks one.  Empty blobs occupy
+    zero bytes — appending a terminator would manufacture a phantom empty
+    line that '^$'-style patterns would match."""
+    if not blob:
+        return 0
+    return len(blob) + (0 if blob.endswith(b"\n") else 1)
+
+
+class BatchPacker:
+    """Accumulate small newline-terminated blobs for one packed dispatch.
+
+    ``add`` never splits a blob across batches: callers check ``fits``
+    first and flush (``pack``) when the next blob would overflow
+    ``max_bytes``.  ``pack`` returns the PackedBatch and resets the packer
+    for the next round."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._names: list = []
+        self._blobs: list = []
+        self._total = 0  # packed bytes including synthesized terminators
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def fits(self, blob: bytes) -> bool:
+        """Whether `blob` joins the CURRENT batch: always true for the
+        first blob (a blob is never split), else capacity-bounded."""
+        return not self._names or self._total + packed_size(blob) <= self.max_bytes
+
+    def add(self, name, blob: bytes) -> None:
+        self._names.append(name)
+        self._blobs.append(blob)
+        self._total += packed_size(blob)
+
+    def pack(self) -> PackedBatch | None:
+        """Build the packed buffer + offset tables; None when empty."""
+        if not self._names:
+            return None
+        names, blobs = self._names, self._blobs
+        self._names, self._blobs, self._total = [], [], 0
+        pieces: list[bytes] = []
+        byte_starts = np.zeros(len(names) + 1, dtype=np.int64)
+        line_starts = np.zeros(len(names) + 1, dtype=np.int64)
+        pos = 0
+        lines = 0
+        for i, blob in enumerate(blobs):
+            byte_starts[i] = pos
+            line_starts[i] = lines
+            if blob:
+                pieces.append(blob)
+                n = packed_size(blob)
+                if n > len(blob):
+                    pieces.append(b"\n")
+                pos += n
+                # grep -n line count: every packed blob ends with '\n', so
+                # the count is exactly its newline count
+                lines += blob.count(b"\n") + (0 if blob.endswith(b"\n") else 1)
+        byte_starts[-1] = pos
+        line_starts[-1] = lines
+        return PackedBatch(
+            data=b"".join(pieces), names=names, blobs=blobs,
+            byte_starts=byte_starts, line_starts=line_starts,
+        )

@@ -84,8 +84,15 @@ class CoordinatorServer:
             )
             if spans_mod.enabled(config.spans) else None
         )
+        from distributed_grep_tpu.runtime.job import plan_map_splits
+
         self.scheduler = Scheduler(
-            files=list(config.input_files),
+            # batched multi-file splits (cross-file device batching): the
+            # member files stay in input_allowlist, so the data plane
+            # serves them individually like any other split
+            files=plan_map_splits(
+                list(config.input_files), config.effective_batch_bytes()
+            ),
             n_reduce=config.n_reduce,
             task_timeout_s=config.task_timeout_s,
             sweep_interval_s=config.sweep_interval_s,

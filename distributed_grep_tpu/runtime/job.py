@@ -366,6 +366,61 @@ class JobResult:
         return [f"{k} {v}" for k, v in self.iter_results_sorted()]
 
 
+def plan_map_splits(
+    input_files: list[str],
+    batch_bytes: int,
+    small_bytes: int | None = None,
+) -> list:
+    """Group consecutive small input files into multi-file map splits —
+    MapReduce's batch-small-inputs-into-splits move (Dean & Ghemawat §3.1)
+    applied to the grep -r regime: one map task (and, through
+    GrepEngine.scan_batch, one packed device dispatch per window) covers
+    many sub-threshold files instead of each paying a task + dispatch.
+
+    Returns a mixed list the Scheduler accepts: plain paths for files at
+    or above ``small_bytes`` (they keep their own task — and the
+    streaming map_path_fn), lists of paths for batched groups whose
+    packed size fits ``batch_bytes``.  Consecutive-only grouping keeps
+    the plan deterministic and the members in input (display) order.
+    ``batch_bytes`` <= 0 disables grouping; ``small_bytes`` defaults to
+    the engine's device_min_bytes default (DGREP_DEVICE_MIN_BYTES or
+    1 MB) so "too small for its own dispatch" means the same thing on
+    both sides."""
+    import os
+
+    if batch_bytes <= 0 or len(input_files) < 2:
+        return list(input_files)
+    if small_bytes is None:
+        small_bytes = int(os.environ.get("DGREP_DEVICE_MIN_BYTES", 1 << 20))
+    out: list = []
+    group: list[str] = []
+    group_bytes = 0
+
+    def close() -> None:
+        nonlocal group, group_bytes
+        if group:
+            out.append(group[0] if len(group) == 1 else group)
+            group, group_bytes = [], 0
+
+    for f in input_files:
+        try:
+            size = os.path.getsize(f)
+        except OSError:
+            size = None  # unreadable/vanished: keep its own task — the
+            # map attempt surfaces the error exactly as it does today
+        if size is None or size >= small_bytes:
+            close()
+            out.append(f)
+            continue
+        packed = size + 1  # + the possibly-synthesized '\n' terminator
+        if group and group_bytes + packed > batch_bytes:
+            close()
+        group.append(f)
+        group_bytes += packed
+    close()
+    return out
+
+
 def collate_outputs(workdir: WorkDir) -> dict:
     """Merge all mr-out-* files into one key->value dict.  Routed through
     JobResult.results so the RESULTS_MATERIALIZE_LIMIT guard applies —
@@ -413,7 +468,9 @@ def run_job(
         if spans_on else None
     )
     scheduler = Scheduler(
-        files=list(config.input_files),
+        files=plan_map_splits(
+            list(config.input_files), config.effective_batch_bytes()
+        ),
         n_reduce=config.n_reduce,
         task_timeout_s=config.task_timeout_s,
         sweep_interval_s=config.sweep_interval_s,

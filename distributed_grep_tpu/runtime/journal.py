@@ -88,11 +88,16 @@ class TaskJournal:
         os.fsync(self._f.fileno())
 
     def map_completed(self, task_id: int, file: str, produced_parts: list[int],
-                      has_record: bool = False) -> None:
+                      has_record: bool = False,
+                      files: list[str] | None = None) -> None:
         entry = {"kind": "map_done", "task_id": task_id, "file": file,
                  "parts": produced_parts}
         if has_record:
             entry["has_record"] = True
+        if files:
+            # batched multi-file split: replay must match the member list,
+            # not just the display label (scheduler._replay)
+            entry["files"] = list(files)
         self.record(entry)
 
     def reduce_completed(self, task_id: int, has_record: bool = False) -> None:

@@ -51,6 +51,13 @@ class AssignTaskArgs:
 class AssignTaskReply:
     assignment: str = Assignment.JOB_DONE
     filename: str = ""
+    # Multi-file map split (runtime/job.plan_map_splits — cross-file
+    # batching of the many-small-files regime): the member files of a
+    # batched split, in order.  Empty for ordinary single-file tasks
+    # (elided from the wire — old peers interop until batching is used);
+    # when set, ``filename`` carries the split's display label, not a
+    # readable path.
+    filenames: list[str] = field(default_factory=list)
     task_id: int = -1
     n_reduce: int = 0
     worker_id: int = -1
@@ -143,7 +150,7 @@ _TYPES = {
 # fails when the pipeline is actually switched on.
 _ELIDE_DEFAULTS: dict[str, Any] = {
     "spans": [], "spans_seq": -1, "metrics": None,
-    "sent_at": 0.0, "rtt_s": -1.0,
+    "sent_at": 0.0, "rtt_s": -1.0, "filenames": [],
 }
 
 

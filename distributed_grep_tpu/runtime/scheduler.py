@@ -44,8 +44,21 @@ from distributed_grep_tpu.utils.spans import ClockSync, EventLog
 log = get_logger("scheduler")
 
 
+def _split_label(members: tuple[str, ...]) -> str:
+    """Display/journal label for a batched multi-file map split —
+    deterministic for a given member list, so journal replay of the same
+    job plan recognizes its own entries."""
+    return f"{members[0]} (+{len(members) - 1} batched)"
+
+
 class Scheduler:
-    """Transport-agnostic coordinator state machine (thread-safe)."""
+    """Transport-agnostic coordinator state machine (thread-safe).
+
+    ``files`` entries are either a single input path (one map task per
+    file, the reference shape — coordinator.go:329-333) or a list of
+    paths: a batched multi-file split (runtime/job.plan_map_splits packs
+    the many-small-files regime so one map task — and one packed device
+    dispatch — covers many sub-threshold files)."""
 
     def __init__(
         self,
@@ -96,9 +109,19 @@ class Scheduler:
         self._cond = threading.Condition(self._lock)
 
         # Task tables (MapData/ReduceData, helper_types.go:150-161).
-        self.map_tasks: list[MapTask] = [MapTask(i, f) for i, f in enumerate(files)]
+        self.map_tasks: list[MapTask] = []
+        for i, f in enumerate(files):
+            if isinstance(f, (list, tuple)):
+                members = tuple(str(m) for m in f)
+                self.map_tasks.append(
+                    MapTask(i, _split_label(members), files=members)
+                )
+            else:
+                self.map_tasks.append(MapTask(i, f))
         self.reduce_tasks: list[ReduceTask] = [ReduceTask(i) for i in range(n_reduce)]
-        self.file_to_task: dict[str, int] = {f: i for i, f in enumerate(files)}
+        self.file_to_task: dict[str, int] = {
+            t.file: t.task_id for t in self.map_tasks
+        }
 
         # Work queues (the buffered channels, coordinator.go:329-337).
         self._map_queue: deque[int] = deque(range(len(files)))
@@ -141,9 +164,16 @@ class Scheduler:
                 tid = e["task_id"]
                 if 0 <= tid < len(self.map_tasks):
                     t = self.map_tasks[tid]
-                    if t.file != e.get("file"):
+                    files_e = e.get("files")
+                    if t.file != e.get("file") or (
+                        # batched split: the member list must match too (a
+                        # re-planned batch with the same first file and
+                        # count — e.g. member sizes changed between runs —
+                        # is a DIFFERENT split and must re-run)
+                        files_e is not None and tuple(files_e) != t.files
+                    ):
                         # Input list changed/reordered since the journal was
-                        # written: this entry describes a different file, so
+                        # written: this entry describes a different split, so
                         # the task must run again.
                         log.warning(
                             "journal entry for map task %d names %r but task file "
@@ -375,6 +405,7 @@ class Scheduler:
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.MAP,
                         filename=task.file,
+                        filenames=list(task.files),  # batched split members
                         task_id=tid,
                         n_reduce=self.n_reduce,
                         worker_id=worker_id,
@@ -446,6 +477,7 @@ class Scheduler:
                 self.journal.map_completed(
                     args.task_id, task.file, parts,
                     has_record=record is not None,
+                    files=list(task.files) or None,
                 )
             self._event("map_committed", task=args.task_id,
                         worker=args.worker_id, parts=len(parts),

@@ -300,7 +300,40 @@ class WorkerLoop:
             return self._pumping("map", a.task_id, pump_s)
 
         try:
-            if use_path:
+            if a.filenames:
+                # Batched multi-file split (cross-file device batching,
+                # runtime/job.plan_map_splits): every member is below the
+                # small-input threshold by construction, so whole-bytes
+                # reads are bounded by the batch window.  Apps exposing
+                # map_batch_fn amortize the scan across members (grep_tpu
+                # packs them into shared device dispatches); others get
+                # map_fn per member — still one task, one commit, one
+                # journal entry instead of len(members) of each.
+                with download_guard(), \
+                        trace.annotate(f"map_read:{a.task_id}"), \
+                        spans_mod.span("map:read", cat="map",
+                                       file=a.filename,
+                                       files=len(a.filenames)):
+                    blobs = [
+                        (name, self.transport.read_input(name))
+                        for name in a.filenames
+                    ]
+                self._fault("after_map_read")
+                n_bytes = sum(len(b) for _, b in blobs)
+                with self.metrics.timer("map_compute"), \
+                        trace.annotate(f"map_compute:{a.task_id}"), \
+                        spans_mod.span("map:compute", cat="map"), \
+                        compute_guard():
+                    batch_fn = self.app.map_batch_fn
+                    if batch_fn is not None:
+                        records = batch_fn(blobs)
+                    else:
+                        records = [
+                            r for name, b in blobs
+                            for r in self.app.map_fn(name, b)
+                        ]
+                self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
+            elif use_path:
                 import os
 
                 with download_guard(), \

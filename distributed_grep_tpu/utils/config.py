@@ -44,6 +44,17 @@ class JobConfig:
     coordinator_port: int = 1234  # coordinator.go:193
     rpc_timeout_s: float = 60.0  # client-side long-poll ceiling
 
+    # --- Cross-file batching (runtime/job.plan_map_splits) ------------------
+    # Group consecutive small input files (below the engine's
+    # device_min_bytes threshold) into multi-file map splits whose packed
+    # size fits this many bytes — one map task, and through
+    # GrepEngine.scan_batch one packed device dispatch per window, covers
+    # many sub-threshold files (the grep -r many-small-files regime).
+    # 0/None = one task per file (the reference shape).  The
+    # DGREP_BATCH_BYTES env var overrides (0 disables) — see
+    # effective_batch_bytes.
+    batch_bytes: int | None = None
+
     # --- Fault tolerance ---------------------------------------------------
     task_timeout_s: float = 10.0  # coordinator.go:105,:114
     sweep_interval_s: float = 1.0  # coordinator.go:122
@@ -91,6 +102,17 @@ class JobConfig:
         dir's basename (stable across coordinator restarts of one job)."""
         return self.job_id or Path(self.work_dir).name
 
+    def effective_batch_bytes(self) -> int:
+        """The map-split batching window actually in force: the
+        DGREP_BATCH_BYTES env var wins (operator override, 0 disables),
+        else this config's batch_bytes; 0 = batching off.  The env parse
+        is SHARED with the engine's packing cap (ops/layout
+        env_batch_bytes) so the planner and the worker engines can never
+        disagree on a malformed override."""
+        from distributed_grep_tpu.ops.layout import env_batch_bytes
+
+        return env_batch_bytes(max(0, int(self.batch_bytes or 0)))
+
     def effective_app_options(self) -> dict:
         """app_options with the top-level mesh knobs merged in (explicit
         app_options win) — the options the runtime actually hands to the
@@ -101,6 +123,15 @@ class JobConfig:
         if self.mesh_shape:
             out.setdefault("mesh_shape", list(self.mesh_shape))
             out.setdefault("mesh_axes", list(self.mesh_axes))
+        bb = self.effective_batch_bytes()
+        if bb:
+            # the packing window must reach the worker ENGINES too (via
+            # grep_tpu's engine_opts) — without this, plan_map_splits
+            # would build e.g. 256 MB splits whose engine still flushed
+            # every 32 MB default, breaking the one-dispatch-per-window
+            # contract.  Apps without engine knobs ignore it (**_ catch-
+            # alls / no configure hook).
+            out.setdefault("batch_bytes", bb)
         return out
 
     # --- (De)serialization -------------------------------------------------

@@ -1,0 +1,93 @@
+"""Performance-contract proofs (pytest marker ``perf``, CPU-runnable,
+standalone like ``faults``/``obs``): N small files must produce FAR fewer
+than N device dispatches on the batched path — the whole point of
+cross-file batching (ISSUE 3 acceptance: dispatch count ≪ file count).
+
+Dispatches are counted at the real boundary — ops/device_scan.scan_device,
+the one entry every device-path scan funnels through — on a CPU-interpret
+engine (the production Pallas kernel path, interpret mode), not from the
+engine's own telemetry, so the assertion cannot be satisfied by a
+miscounting counter.
+
+Standalone: ``python -m pytest tests/test_perf.py -q``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.ops import device_scan
+from distributed_grep_tpu.ops.engine import GrepEngine
+
+pytestmark = pytest.mark.perf
+
+N_FILES = 64
+
+
+def _small_files() -> list[tuple[str, bytes]]:
+    rng = np.random.default_rng(11)
+    words = [b"the", b"volcano", b"of", b"needle", b"and", b"hello"]
+    out = []
+    for i in range(N_FILES):
+        lines = []
+        for _ in range(40):
+            k = int(rng.integers(2, 6))
+            lines.append(b" ".join(
+                words[int(rng.integers(0, len(words)))] for _ in range(k)
+            ))
+        out.append((f"f{i:03d}", b"\n".join(lines) + b"\n"))
+    return out
+
+
+def _counting(monkeypatch):
+    """Wrap the real scan_device with a call counter (the engine resolves
+    it from the module at each call, so the patch is seen)."""
+    calls: list[int] = []
+    orig = device_scan.scan_device
+
+    def counted(eng, data, progress=None):
+        calls.append(len(data))
+        return orig(eng, data, progress=progress)
+
+    monkeypatch.setattr(device_scan, "scan_device", counted)
+    return calls
+
+
+def test_batched_dispatch_count_far_below_file_count(monkeypatch):
+    calls = _counting(monkeypatch)
+    eng = GrepEngine("hello", interpret=True, batch_bytes=1 << 20)
+    got = eng.scan_batch(_small_files())
+    stats = dict(eng.stats)
+    n_dispatches = len(calls)
+    # the contract: dispatches ≪ files (here: everything packs into ONE)
+    assert n_dispatches * 8 <= N_FILES, (n_dispatches, N_FILES)
+    assert stats["batched_files"] == N_FILES
+    assert stats["batch_dispatches"] == n_dispatches
+    assert stats["dispatches_saved"] == N_FILES - n_dispatches
+    # and the packed dispatch actually scanned everything
+    assert sum(calls) == sum(
+        len(b) + (0 if b.endswith(b"\n") else 1) for _, b in _small_files()
+    )
+    assert sum(r.n_matches for _, r in got) > 0
+
+
+def test_unbatched_baseline_pays_one_dispatch_per_file(monkeypatch):
+    """The counter-factual the batched path is measured against: per-file
+    scan() on the same interpret engine dispatches once per file."""
+    calls = _counting(monkeypatch)
+    files = _small_files()[:8]  # 8 files suffice to pin the 1:1 shape
+    eng = GrepEngine("hello", interpret=True)
+    for _, blob in files:
+        eng.scan(blob)
+    assert len(calls) == len(files)
+
+
+def test_batched_results_equal_per_file_on_interpret_engine():
+    files = _small_files()
+    eng = GrepEngine("hello", interpret=True, batch_bytes=1 << 20)
+    got = eng.scan_batch(files)
+    blobs = dict(files)
+    for name, res in got:
+        solo = eng.scan(blobs[name])
+        assert np.array_equal(res.matched_lines, solo.matched_lines), name
