@@ -1,0 +1,241 @@
+"""Probe: does a SWAR-packed shift-and kernel (4 corpus bytes per i32 lane
+element) beat the 231 GB/s unpacked coarse kernel on this chip?
+
+Motivation (round-6 VERDICT top_next): the production shift-and kernel
+(ops/pallas_scan.py) is pinned at the VPU ALU roofline — every per-byte
+vector op runs on i32 tiles carrying ONE corpus byte per 4-byte lane
+element, so 3/4 of each ALU slot moves widened zeros.  The SWAR variant
+(ops/pallas_scan.swar_shift_and_scan_words) packs FOUR STRIPES per u32
+lane element (byte-interleaved — the u8 corpus bitcast to u32 over the
+lane axis), keeps each stripe's automaton in its own byte of the state
+tile, and detects byte-class hits with the EXACT packed zero-byte test
+
+    y  = x ^ (v * 0x01010101)
+    t  = y | ((y | 0x80808080) - 0x01010101)   # bit7 clear iff byte == v
+    nz = ~t & 0x80808080
+
+(borrow-free, unlike classic Mycroft `(y-1) & ~y & 0x80`, whose
+cross-byte borrows over-report) — pure i32 arithmetic, no narrow-dtype
+compares, so it dodges every Mosaic crash recorded in CLAUDE.md.
+
+Why the alternative "4 CONSECUTIVE bytes of one stripe per u32" packing
+was rejected without a probe: the shift-and recurrence is serial in the
+byte index, so consecutive-byte packing still needs one B-mask tile PER
+BYTE — the per-class hit extraction costs as many vector ops as the
+compares it replaces, and nothing shrinks.  Stripe-interleaved packing is
+the classic SWAR form: 4 INDEPENDENT automata advance per op.
+
+Op-count analysis (per 4 corpus bytes, C single-value classes):
+  unpacked: 4 x [C x (cmp + select-or) + 3 shift-and + 1 accumulate]
+            ~ 4 x (2C + 4) vector ops
+  packed:   C x (xor + or + sub + or + not-and = 6)
+            + C x (shift + sub + and + or = 4 mask build)
+            + 3 shift-and + 1 accumulate
+            ~ 10C + 4 vector ops
+  ratio at C=3: 40 / 34 ~ 1.2x; at C=6: 64 / 64 ~ 1.0x — BUT the packed
+  tile carries 4x the corpus bytes per op, so bytes/op is 4 x (34/40)
+  ~ 3.4x at C=3.  Accounting honestly per BYTE: unpacked ~ 2C+4 = 10
+  ops/byte at C=3, packed ~ (10C+4)/4 = 8.5 ops/byte — plus the packed
+  path loads u32 directly (no u8 -> i32 widen) and writes 1/4 the output
+  words.  Predicted ~1.2-1.5x at C=3, shrinking as C grows.  The probe
+  exists because this arithmetic ignores Mosaic scheduling; only a slope
+  number decides.
+
+Eligibility (models/shift_and.swar_values): pattern length <= 8 (state +
+match bit per byte), every checked class a set of exact byte VALUES
+(equality only — ranges have no cheap packed form), <= 16 values total.
+Wildcards (the rare-class filter) are free, as in the unpacked kernel.
+
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/probe_swar.py exact
+    ... probe_swar.py slope          # packed vs unpacked GB/s, 64 MB
+    ... probe_swar.py slope --unrolls 8,16,32
+    ... probe_swar.py exact --interpret   # CI smoke (CPU, small corpus)
+
+Each probe prints one JSON line per measurement; run under a subprocess
+guard — a Mosaic internal error can abort the process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+
+PATTERNS = [
+    # (pattern, ignore_case, filtered) — 'volcano' is the headline config;
+    # filtered=True probes the production rare-class-filter shape (3
+    # checked classes), False the full 7-class model; 'function' pins the
+    # length-8 / match-bit-0x80 edge; ignore_case doubles the values.
+    ("volcano", False, True),
+    ("volcano", False, False),
+    ("volcano", True, True),
+    ("function", False, False),
+]
+
+
+def _corpus(n: int) -> bytes:
+    rng = np.random.default_rng(0)
+    data = rng.integers(32, 127, size=n, dtype=np.uint8)
+    data[rng.integers(0, n, size=n // 80)] = 0x0A
+    for lit in (b"volcano", b"function"):
+        needle = np.frombuffer(lit, np.uint8)
+        for p in rng.integers(0, n - 16, size=1000):
+            data[p : p + len(needle)] = needle
+    return data.tobytes()
+
+
+def _model(pattern: str, ignore_case: bool, filtered: bool):
+    from distributed_grep_tpu.models.shift_and import (
+        filtered_for_device,
+        try_compile_shift_and,
+    )
+
+    m = try_compile_shift_and(pattern, ignore_case=ignore_case)
+    assert m is not None
+    if filtered:
+        f = filtered_for_device(m)
+        if f is not None:
+            return f
+    return m
+
+
+def _layout(data: bytes, target_lanes: int = 16384):
+    from distributed_grep_tpu.ops import layout as layout_mod
+    from distributed_grep_tpu.ops import pallas_scan
+
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=max(target_lanes,
+                                    pallas_scan.SWAR_LANES_PER_BLOCK),
+        min_chunk=512, lane_multiple=pallas_scan.SWAR_LANES_PER_BLOCK,
+        chunk_multiple=512,
+    )
+    return lay, layout_mod.to_device_array(data, lay)
+
+
+def probe_exact(interpret: bool, mb: int) -> int:
+    """Compile both kernels for real and compare stripe-level candidate
+    flags bit-exactly across every pattern shape.  Returns #failures."""
+    from distributed_grep_tpu.models.shift_and import swar_values
+    from distributed_grep_tpu.ops import pallas_scan
+
+    data = _corpus(mb << 20)
+    lay, arr = _layout(data)
+    failures = 0
+    for pattern, ic, filtered in PATTERNS:
+        m = _model(pattern, ic, filtered)
+        assert swar_values(m) is not None, (pattern, ic, filtered)
+        t0 = time.time()
+        try:
+            wp = np.asarray(pallas_scan.swar_shift_and_scan_words(
+                arr, m, interpret=interpret or None
+            ))
+        except Exception as e:  # noqa: BLE001 — report, continue
+            failures += 1
+            print(json.dumps({
+                "probe": "swar_exact", "pattern": pattern, "ic": ic,
+                "filtered": filtered, "ok": False,
+                "error": str(e).replace("\n", " ")[:200],
+            }), flush=True)
+            continue
+        dt = time.time() - t0
+        wu = np.asarray(pallas_scan.shift_and_scan_words(
+            arr, m, interpret=interpret or None, coarse=True
+        ))
+        nw = lay.chunk // 32
+        fu = wu.reshape(nw, lay.lanes) != 0
+        wpf = wp.reshape(nw, lay.lanes // 4)
+        fp = np.zeros_like(fu)
+        for k in range(4):
+            fp[:, k::4] = ((wpf >> np.uint32(8 * k)) & np.uint32(0xFF)) != 0
+        ok = bool(np.array_equal(fu, fp))
+        if not ok:
+            failures += 1
+        print(json.dumps({
+            "probe": "swar_exact", "pattern": pattern, "ic": ic,
+            "filtered": filtered, "ok": ok, "spans": int(fu.sum()),
+            "compile_s": round(dt, 1),
+        }), flush=True)
+    return failures
+
+
+def probe_slope(mb: int, unrolls: list[int]) -> None:
+    """Slope-time packed vs unpacked on the same corpus (utils/slope.py —
+    naive timing through the tunnel reports ~0, CLAUDE.md)."""
+    import jax.numpy as jnp
+
+    from distributed_grep_tpu.ops import pallas_scan
+    from distributed_grep_tpu.utils.slope import slope_per_pass
+
+    data = _corpus(mb << 20)
+    lay, arr = _layout(data)
+    import jax
+
+    # 512 '\n' pad rows: each chained rep scans an i-dependent window, or
+    # XLA hoists the loop-invariant scan and reps time like one
+    # (utils/slope.py docstring — the repo's timing invariant).
+    pad_rows = 512
+    pad = np.full((pad_rows, lay.lanes), 0x0A, dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(np.concatenate([np.asarray(arr), pad],
+                                                    axis=0)))
+    for pattern, ic, filtered in PATTERNS:
+        m = _model(pattern, ic, filtered)
+        for unroll in unrolls:
+            def packed_scan(win, m=m, unroll=unroll):
+                return jnp.count_nonzero(
+                    pallas_scan.swar_shift_and_scan_words(
+                        win, m, interpret=False, unroll=unroll
+                    )
+                )
+
+            def unpacked_scan(win, m=m):
+                return jnp.count_nonzero(pallas_scan.shift_and_scan_words(
+                    win, m, interpret=False, coarse=True
+                ))
+
+            for name, fn in (("swar", packed_scan),
+                             ("unpacked", unpacked_scan)):
+                if name == "unpacked" and unroll != unrolls[0]:
+                    continue  # the baseline's unroll is fixed at 32
+                per_pass, cnt = slope_per_pass(
+                    dev, lay.chunk, pad_rows, fn, r1=2, r2=10,
+                    measurements=3,
+                )
+                gbs = lay.chunk * lay.lanes / per_pass / 1e9
+                print(json.dumps({
+                    "probe": f"swar_slope_{name}", "pattern": pattern,
+                    "ic": ic, "filtered": filtered, "unroll": unroll,
+                    "gbs": round(gbs, 1), "count": int(cnt),
+                }), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", choices=["exact", "slope"])
+    ap.add_argument("--interpret", action="store_true",
+                    help="force interpret mode (CI smoke; CPU)")
+    ap.add_argument("--mb", type=int, default=None)
+    ap.add_argument("--unrolls", default="32,16,8")
+    args = ap.parse_args()
+
+    import jax
+
+    print("backend:", jax.default_backend(), flush=True)
+    if args.which == "exact":
+        return 1 if probe_exact(args.interpret, args.mb or
+                                (8 if args.interpret else 32)) else 0
+    probe_slope(args.mb or 64, [int(u) for u in args.unrolls.split(",")])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,9 @@ Differences from the reference, on purpose:
   matches.  Here the job config calls ``configure(pattern=...)`` before any
   map task runs.
 * Input is bytes, decoded permissively (grep must survive non-UTF8 corpora).
-* Line numbers are 1-based like grep -n (the reference is 0-based via
-  ``range`` index; 1-based is what users of grep expect and what our tests
-  compare against).
+
+Line numbers are 1-based like grep -n — SAME as the reference, whose Map
+emits ``line_number+1`` over its 0-based ``range`` index (grep.go:25).
 """
 
 from __future__ import annotations

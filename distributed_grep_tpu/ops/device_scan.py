@@ -297,6 +297,22 @@ def scan_device(eng, data: bytes, progress=None) -> ScanResult:
         and pallas_ok
         and pallas_scan.eligible(eng.shift_and)
     )
+    # SWAR packed variant (round 6, DGREP_SWAR=1): 4 stripes per u32 lane
+    # element for byte-sized automata with equality-only classes.  BOTH
+    # the full model and the rare-class filter must be eligible — the
+    # mid-scan defeat guard swaps filtered -> full without re-planning
+    # the (packed) segment layout.  Mesh mode keeps the unpacked kernel
+    # (sharded_kernels has no packed wiring yet).
+    use_swar = (
+        use_pallas_sa
+        and eng.mesh is None
+        and pallas_scan.swar_enabled()
+        and pallas_scan.swar_eligible(eng.shift_and)
+        and (eng._sa_filtered is None
+             or pallas_scan.swar_eligible(eng._sa_filtered))
+    )
+    if use_swar:
+        st["swar"] = 1
     # NFA mode without a real TPU (or over budget) falls back to the XLA
     # DFA path — same tables, interpreter-free.
     use_pallas_nfa = (
@@ -464,15 +480,22 @@ def scan_device(eng, data: bytes, progress=None) -> ScanResult:
         # the plane lives instead of copying it to the default device.
         ctx = jax.default_device(dev) if dev is not None else nullcontext()
         with ctx:
-            if sparse_kind == "span_words":
+            if sparse_kind in ("span_words", "span_words_packed"):
                 # Coarse shift-and: nonzero words name 32-byte spans
                 # that contain >= 1 candidate match end (exact at span
                 # granularity for the full model; a superset when the
                 # rare-class filter ran).  Map spans to their
                 # overlapping lines, confirm each line once on host —
-                # overlapped with the next segment's device scan.
-                idx, _ = scan_jnp.sparse_nonzero(payload)
-                starts = sparse_mod.span_starts_from_sparse_words(idx, lay)
+                # overlapped with the next segment's device scan.  The
+                # SWAR variant packs 4 stripes per word; its decoder
+                # demuxes byte-plane flags to the same span starts.
+                idx, vals = scan_jnp.sparse_nonzero(payload)
+                if sparse_kind == "span_words_packed":
+                    starts = sparse_mod.span_starts_from_packed_words(
+                        idx, vals, lay
+                    )
+                else:
+                    starts = sparse_mod.span_starts_from_sparse_words(idx, lay)
                 if starts.size:
                     g0 = starts + seg_start  # global span starts
                     g1 = np.minimum(g0 + 32, len(data))
@@ -635,7 +658,13 @@ def scan_device(eng, data: bytes, progress=None) -> ScanResult:
     def _prepare(i: int, seg_start: int):
         seg_bytes = data[seg_start : seg_start + seg]
         if use_pallas:
-            lane_mult = mesh_mult if use_mesh else pallas_scan.LANES_PER_BLOCK
+            if use_mesh:
+                lane_mult = mesh_mult
+            elif use_swar:
+                # packed lanes tile in 4096-u32 blocks = 16384 stripes
+                lane_mult = pallas_scan.SWAR_LANES_PER_BLOCK
+            else:
+                lane_mult = pallas_scan.LANES_PER_BLOCK
             lay = layout_mod.choose_layout(
                 len(seg_bytes),
                 target_lanes=max(eng.target_lanes, lane_mult),
@@ -792,12 +821,19 @@ def scan_device(eng, data: bytes, progress=None) -> ScanResult:
                                 coarse=True, interpret=interp_flag,
                             )
                             psum_totals.append(pt)
+                            kind = "span_words"
+                        elif use_swar:
+                            words = pallas_scan.swar_shift_and_scan_words(
+                                arr, sa_filtered or eng.shift_and,
+                                interpret=interp_flag,
+                            )
+                            kind = "span_words_packed"
                         else:
                             words = pallas_scan.shift_and_scan_words(
                                 arr, sa_filtered or eng.shift_and,
                                 coarse=True, interpret=interp_flag,
                             )
-                        kind = "span_words"
+                            kind = "span_words"
                     elif use_pallas_approx:
                         if use_mesh:
                             words, pt = shk.sharded_approx_words(

@@ -165,13 +165,36 @@ class LineBatch:
             for n, t in zip(self.linenos.tolist(), self.texts())
         )
 
+    def format_lines_bytes(self, sep: str = "\t") -> bytes:
+        """``format_lines`` as the BYTES the reduce writer lands on disk
+        (utf-8/surrogateescape-encoded) — native one-pass formatter when
+        libdgrep is available and the slab is strictly valid UTF-8 (then
+        the Python path's utf-8/replace decode is the identity and the
+        native copy is byte-equal); anything else takes the Python path."""
+        from distributed_grep_tpu.utils.native import format_batch
+
+        prefix = (self.filename + " (line number #").encode(
+            "utf-8", "surrogateescape"
+        )
+        out = format_batch(
+            prefix, self.linenos, self.offsets, self.slab,
+            sep.encode("ascii"),
+        )
+        if out is not None:
+            return out
+        return self.format_lines(sep).encode("utf-8", "surrogateescape")
+
 
 def gather_ranges(
     arr: np.ndarray, starts: np.ndarray, ends: np.ndarray
 ) -> tuple[bytes, np.ndarray]:
-    """Concatenate arr[starts[i]:ends[i]] for all i — vectorized (one
-    cumsum-built index gather, no per-range Python slicing).  Returns
-    (slab bytes, int64 offsets[n+1])."""
+    """Concatenate arr[starts[i]:ends[i]] for all i.  Native memcpy loop
+    when libdgrep is available (the numpy cumsum-index gather below moves
+    ~10 bytes of index traffic per output byte — it was the dense job's
+    single hottest host pass, BASELINE.md round 6); the numpy fallback is
+    bit-identical.  Returns (slab bytes, int64 offsets[n+1])."""
+    from distributed_grep_tpu.utils.native import gather_ranges_native
+
     starts = np.asarray(starts, dtype=np.int64)
     ends = np.asarray(ends, dtype=np.int64)
     lens = ends - starts
@@ -180,6 +203,9 @@ def gather_ranges(
     total = int(offsets[-1])
     if total == 0:
         return b"", offsets
+    slab = gather_ranges_native(arr, starts, ends, offsets, total)
+    if slab is not None:
+        return slab, offsets
     # idx[j] = delta of the source index at output byte j: +1 within a
     # range, and at each range head a jump from the previous range's last
     # byte to this range's start.  Empty ranges contribute no output
@@ -384,15 +410,27 @@ class IdentityCollator:
         streams.append(iter(self._mem))
         return heapq.merge(*streams, key=self._sort_key)
 
-    def iter_output_chunks(self):
-        """The mr-out text, streamed in display order: one string per
-        batch (batched formatting) or per loose KeyValue."""
+    def iter_output_blocks(self):
+        """The mr-out content, streamed in display order as WRITER-READY
+        pieces: bytes per batch (native one-pass formatter,
+        ``LineBatch.format_lines_bytes``) and str per loose KeyValue —
+        the reduce writer encodes str pieces utf-8/surrogateescape, so
+        both land identical bytes."""
         for item in self.merged():
             if isinstance(item, LineBatch):
                 if len(item):
-                    yield item.format_lines()
+                    yield item.format_lines_bytes()
             else:
                 yield f"{item.key}\t{item.value}\n"
+
+    def iter_output_chunks(self):
+        """The mr-out text, streamed in display order: one string per
+        batch (batched formatting) or per loose KeyValue."""
+        for block in self.iter_output_blocks():
+            yield (
+                block.decode("utf-8", "surrogateescape")
+                if isinstance(block, bytes) else block
+            )
 
     def close(self) -> None:
         import shutil

@@ -263,15 +263,28 @@ class JobResult:
         """Display output as bytes BLOCKS in (file, line) order — same
         bytes as iter_display_bytes_sorted joined, bigger pieces.
 
-        Fast path (round 5): when every record names the SAME file (the
-        single-input grep job — the common CLI shape and the dense
-        receipt) and total output fits DISPLAY_VECTOR_CAP, the merge is
-        one vectorized pass: line numbers parse as (n, 10) digit-window
-        arithmetic, ordering is one argsort, and the output slab is one
-        gather — no per-record Python at all.  Everything else streams
-        through the record merge unchanged."""
+        Fast path (round 6): when total output fits DISPLAY_VECTOR_CAP,
+        the merge runs natively (libdgrep dgrep_merge_display — a k-way
+        merge over the pre-sorted mr-out buffers with the Python merge's
+        exact ordering, surrogateescape-codepoint path compare included;
+        multi-file jobs take it too).  A job with any non-grep-shaped
+        record, or without libdgrep, falls to the round-5 vectorized
+        single-path pass, then to the streaming record merge — all three
+        produce identical bytes."""
         total = sum(p.stat().st_size for p in self.output_files)
         if 0 < total <= self.DISPLAY_VECTOR_CAP:
+            from distributed_grep_tpu.utils import native
+
+            # availability gated BEFORE reading: a no-native install must
+            # not materialize the whole output set just to fall back
+            if self.fileline_sorted and native.merge_display_available():
+                block = native.merge_display(
+                    [p.read_bytes() for p in self.output_files]
+                )
+                if block is not None:
+                    if block:
+                        yield block
+                    return
             block = self._single_path_display_block()
             if block is not None:
                 yield block

@@ -453,7 +453,7 @@ class WorkerLoop:
                 memory_limit_bytes=self.reduce_memory_bytes,
                 spill_dir=self.spill_dir,
             )
-            chunks = sink.iter_output_chunks
+            chunks = sink.iter_output_blocks  # bytes per batch, str per KV
             progress_stride = 64  # chunks are whole batches: coarse
         else:
             sink = ExternalReducer(
@@ -513,12 +513,17 @@ class WorkerLoop:
                                      dir=self.spill_dir or None)
         try:
             progress = self._progress_fn("reduce", a.task_id, a.task_timeout_s)
+            # Binary spool: columnar sinks yield pre-encoded bytes blocks
+            # (native formatter); str chunks encode utf-8/surrogateescape —
+            # exactly what the old text-mode writer did per write.
             with self.metrics.timer("reduce_compute"), \
                     trace.annotate(f"reduce_compute:{a.task_id}"), \
-                    os.fdopen(fd, "w", encoding="utf-8",
-                              errors="surrogateescape", newline="") as out:
+                    os.fdopen(fd, "wb") as out:
                 for i, chunk in enumerate(chunks):
-                    out.write(chunk)
+                    out.write(
+                        chunk if isinstance(chunk, bytes)
+                        else chunk.encode("utf-8", "surrogateescape")
+                    )
                     if i % progress_stride == 0:
                         progress()
             self._fault("before_reduce_commit")

@@ -91,6 +91,34 @@ def _try_load() -> ctypes.CDLL | None:
             ctypes.c_size_t,
             ctypes.c_uint32,
         ]
+    if hasattr(lib, "dgrep_gather_ranges"):
+        lib.dgrep_gather_ranges.restype = None
+        lib.dgrep_gather_ranges.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.dgrep_format_batch.restype = ctypes.c_int64
+        lib.dgrep_format_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_uint8,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.dgrep_merge_display.restype = ctypes.c_int64
+        lib.dgrep_merge_display.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
     if hasattr(lib, "dgrep_confirm_build"):
         lib.dgrep_confirm_build.restype = ctypes.c_void_p
         lib.dgrep_confirm_build.argtypes = [
@@ -309,6 +337,108 @@ class ConfirmSet:
                         break
             out_b[i] = hit
         return out_b
+
+
+# --- Columnar merge/print hot loops (round 6) ------------------------------
+
+def gather_ranges_native(
+    arr: np.ndarray, starts: np.ndarray, ends: np.ndarray,
+    offsets: np.ndarray, total: int,
+) -> bytes | None:
+    """Native arr[starts[i]:ends[i]] concatenation (the LineBatch slab
+    rebuild), or None when libdgrep is unavailable — the caller
+    (runtime/columnar.gather_ranges) keeps the numpy fallback.  ``offsets``
+    /``total`` are the caller's cumsum (it needs them anyway)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_gather_ranges"):
+        return None
+    if arr.dtype != np.uint8 or arr.ndim != 1:
+        return None  # starts/ends are ELEMENT indices; C indexes bytes
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    out = np.empty(int(total), dtype=np.uint8)
+    lib.dgrep_gather_ranges(
+        arr.ctypes.data_as(ctypes.c_char_p),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        starts.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out.tobytes()
+
+
+def merge_display_available() -> bool:
+    """True when the native display merge exists — callers check BEFORE
+    materializing file contents, so a no-native install doesn't read the
+    whole output set just to learn it must stream instead."""
+    lib = _try_load()
+    return lib is not None and hasattr(lib, "dgrep_merge_display")
+
+
+def format_batch(
+    prefix: bytes, linenos: np.ndarray, offsets: np.ndarray, slab: bytes,
+    sep: bytes = b"\t",
+) -> bytes | None:
+    """The mr-out text form of one LineBatch as BYTES —
+    ``b"<prefix><N>)<sep><line>\\n"`` per record, byte-identical to
+    ``LineBatch.format_lines`` encoded utf-8/surrogateescape.  None when
+    libdgrep is unavailable OR the slab is not strictly valid UTF-8 (the
+    Python path's utf-8/replace decode is then not the identity; caller
+    falls back)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_format_batch"):
+        return None
+    if len(sep) != 1:
+        return None  # C writes exactly one sep byte; fall back otherwise
+    n = int(linenos.size)
+    if n == 0:
+        return b""
+    linenos = np.ascontiguousarray(linenos, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    cap = n * (len(prefix) + 23) + len(slab)
+    out = np.empty(cap, dtype=np.uint8)
+    wrote = lib.dgrep_format_batch(
+        prefix,
+        len(prefix),
+        linenos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        slab,
+        n,
+        sep[0],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if wrote < 0:
+        return None  # -2: slab needs utf-8/replace; -1: cannot happen (cap)
+    return out[:wrote].tobytes()
+
+
+def merge_display(bufs: list[bytes]) -> bytes | None:
+    """K-way merge of pre-sorted mr-out buffers into final display bytes
+    (first '\\t' -> ' ' per record, '\\n'-terminated), ordered by
+    (path, line) with paths compared as Python str (surrogateescape
+    codepoints) and ties broken by buffer order — byte-identical to
+    ``JobResult.iter_display_bytes_sorted``.  None when libdgrep is
+    unavailable or any line is not grep-key-shaped (caller falls back)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_merge_display"):
+        return None
+    data = b"".join(bufs)
+    off = np.zeros(len(bufs) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in bufs], out=off[1:])
+    # + n_bufs: a buffer whose final line lacks '\n' gains one on output
+    out = np.empty(max(1, len(data) + len(bufs)), dtype=np.uint8)
+    wrote = lib.dgrep_merge_display(
+        data,
+        off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(bufs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if wrote < 0:
+        return None
+    return out[:wrote].tobytes()
 
 
 # Big inputs fan the DFA scan across threads; newline-aligned chunking keeps

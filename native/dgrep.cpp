@@ -408,3 +408,299 @@ void dgrep_confirm_scan(const void* handle, const uint8_t* data, size_t len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Columnar merge/print hot loops (round 6).  The match-dense output path
+// moves LineBatch slabs (runtime/columnar.py) around as bytes; the three
+// per-record Python/numpy passes that still dominated the dense print job
+// (BASELINE.md round-6 profile) become plain memcpy/merge loops here:
+//
+//   * gather_ranges   — concatenate arr[starts[i]:ends[i]] (the slab
+//                       rebuild under LineBatch.select / make_batch /
+//                       the display gather; numpy's cumsum-index gather
+//                       moved ~10 bytes of index traffic per output byte).
+//   * format_batch    — the mr-out text form "<prefix>N)<sep><line>\n"
+//                       per record (LineBatch.format_lines).  Refuses
+//                       non-UTF-8 slabs (-2): the Python path decodes
+//                       utf-8/replace, so only strictly-valid slabs copy
+//                       through byte-identically; the caller falls back.
+//   * merge_display   — k-way merge of pre-sorted mr-out buffers into the
+//                       final display bytes (tab -> space), ordered by
+//                       (path, line) where paths compare as Python str —
+//                       surrogateescape codepoints, NOT raw bytes (see
+//                       se_cmp below; runtime/job._iter_records_bytes_sorted
+//                       documents why byte order would misorder exotic
+//                       filenames).  Refuses (-1) on any line that is not
+//                       grep-key-shaped; the caller falls back.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// out must hold sum(ends[i] - starts[i]) bytes (the caller's cumsum).
+void dgrep_gather_ranges(const uint8_t* data, const int64_t* starts,
+                         const int64_t* ends, size_t n, uint8_t* out) {
+    uint8_t* p = out;
+    for (size_t i = 0; i < n; ++i) {
+        int64_t len = ends[i] - starts[i];
+        if (len <= 0) continue;
+        memcpy(p, data + starts[i], (size_t)len);
+        p += len;
+    }
+}
+
+// Strict UTF-8 validation (RFC 3629: no overlongs, no surrogates, max
+// U+10FFFF) — exactly the inputs Python's utf-8 decode accepts, i.e. the
+// inputs for which decode('utf-8','replace') then encode('utf-8') is the
+// identity.  Returns 1 when valid.
+int dgrep_utf8_valid(const uint8_t* p, size_t len) {
+    const uint8_t* end = p + len;
+    while (p < end) {
+        uint8_t b = *p;
+        if (b < 0x80) { ++p; continue; }
+        if (b >= 0xC2 && b <= 0xDF) {
+            if (end - p < 2 || (p[1] & 0xC0) != 0x80) return 0;
+            p += 2; continue;
+        }
+        if (b >= 0xE0 && b <= 0xEF) {
+            if (end - p < 3 || (p[1] & 0xC0) != 0x80 ||
+                (p[2] & 0xC0) != 0x80) return 0;
+            if (b == 0xE0 && p[1] < 0xA0) return 0;        // overlong
+            if (b == 0xED && p[1] > 0x9F) return 0;        // surrogate
+            p += 3; continue;
+        }
+        if (b >= 0xF0 && b <= 0xF4) {
+            if (end - p < 4 || (p[1] & 0xC0) != 0x80 ||
+                (p[2] & 0xC0) != 0x80 || (p[3] & 0xC0) != 0x80) return 0;
+            if (b == 0xF0 && p[1] < 0x90) return 0;        // overlong
+            if (b == 0xF4 && p[1] > 0x8F) return 0;        // > U+10FFFF
+            p += 4; continue;
+        }
+        return 0;  // lone continuation byte or 0xC0/0xC1/0xF5+
+    }
+    return 1;
+}
+
+// Write "<prefix><decimal lineno>)<sep><line>\n" per record — byte-for-byte
+// LineBatch.format_lines as encoded by the reduce writer (utf-8/
+// surrogateescape), PROVIDED every LINE is strictly valid UTF-8 (checked
+// per line range, NOT whole-slab: the Python path decodes per line, and
+// two invalid line tails/heads can concatenate into valid slab bytes —
+// whole-slab validity does not imply per-line identity.  The prefix
+// needs no check — the Python path writes the filename's
+// surrogateescape bytes verbatim either way).  Returns bytes written,
+// -1 when out_cap is too small, -2 when some line needs Python's
+// utf-8/replace semantics (caller falls back).
+int64_t dgrep_format_batch(const uint8_t* prefix, size_t prefix_len,
+                           const int64_t* linenos, const int64_t* offsets,
+                           const uint8_t* slab, size_t n, uint8_t sep,
+                           uint8_t* out, size_t out_cap) {
+    if (n == 0) return 0;
+    for (size_t i = 0; i < n; ++i)
+        if (!dgrep_utf8_valid(slab + offsets[i],
+                              (size_t)(offsets[i + 1] - offsets[i])))
+            return -2;
+    uint8_t* p = out;
+    uint8_t* cap = out + out_cap;
+    char digits[24];
+    for (size_t i = 0; i < n; ++i) {
+        int nd = 0;
+        uint64_t v = (uint64_t)linenos[i];
+        do { digits[nd++] = (char)('0' + v % 10); v /= 10; } while (v);
+        int64_t line_len = offsets[i + 1] - offsets[i];
+        if (p + prefix_len + nd + 3 + line_len > cap) return -1;
+        memcpy(p, prefix, prefix_len);
+        p += prefix_len;
+        while (nd) *p++ = (uint8_t)digits[--nd];
+        *p++ = ')';
+        *p++ = sep;
+        memcpy(p, slab + offsets[i], (size_t)line_len);
+        p += line_len;
+        *p++ = '\n';
+    }
+    return (int64_t)(p - out);
+}
+
+}  // extern "C"
+
+// --- surrogateescape string comparison -------------------------------------
+// Python's display merge orders records by the DECODED path
+// (utf-8/surrogateescape -> str), compared by codepoint.  Codepoint order
+// diverges from byte order exactly where a valid multi-byte sequence
+// (codepoint < U+DC00) meets a surrogate-escaped raw byte (0xDC00 + b >=
+// 0xDC80), so the native merge must decode to compare.
+
+static inline int se_is_cont(uint8_t b) { return (b & 0xC0) == 0x80; }
+
+// Decode ONE codepoint at p (strict UTF-8; any invalid byte becomes
+// 0xDC00 + byte and advances 1, the surrogateescape handler's behavior).
+static inline uint32_t se_next(const uint8_t* p, const uint8_t* end,
+                               int* adv) {
+    uint8_t b = p[0];
+    if (b < 0x80) { *adv = 1; return b; }
+    if (b >= 0xC2 && b <= 0xDF && end - p >= 2 && se_is_cont(p[1])) {
+        *adv = 2;
+        return ((uint32_t)(b & 0x1F) << 6) | (p[1] & 0x3F);
+    }
+    if (b >= 0xE0 && b <= 0xEF && end - p >= 3 && se_is_cont(p[1]) &&
+        se_is_cont(p[2]) && !(b == 0xE0 && p[1] < 0xA0) &&
+        !(b == 0xED && p[1] > 0x9F)) {
+        *adv = 3;
+        return ((uint32_t)(b & 0x0F) << 12) |
+               ((uint32_t)(p[1] & 0x3F) << 6) | (p[2] & 0x3F);
+    }
+    if (b >= 0xF0 && b <= 0xF4 && end - p >= 4 && se_is_cont(p[1]) &&
+        se_is_cont(p[2]) && se_is_cont(p[3]) &&
+        !(b == 0xF0 && p[1] < 0x90) && !(b == 0xF4 && p[1] > 0x8F)) {
+        *adv = 4;
+        return ((uint32_t)(b & 0x07) << 18) |
+               ((uint32_t)(p[1] & 0x3F) << 12) |
+               ((uint32_t)(p[2] & 0x3F) << 6) | (p[3] & 0x3F);
+    }
+    *adv = 1;
+    return 0xDC00u + b;
+}
+
+// Compare two byte strings as their surrogateescape-decoded str forms.
+// Fast path: scan to the first differing byte; byte-equal strings are
+// equal.  Everywhere else — including the full-common-prefix case, where
+// "shorter sorts first" would be WRONG if the shorter string ends
+// mid-sequence of the longer's valid UTF-8 codepoint (b"foo\xC3" decodes
+// to U+DCC3 and sorts AFTER b"foo\xC3\xA9"'s U+00E9) — back up to a safe
+// decode boundary in the common prefix (every non-continuation byte is a
+// true boundary — valid sequences have continuation-only interiors and
+// invalid bytes decode standalone; after skipping <= 3 continuation
+// bytes, an adjacent lead byte is included so a codepoint straddling the
+// divergence decodes whole) and compare decoded codepoints from there;
+// the decode loop's exhaustion handling yields codepoint-prefix order.
+static int se_cmp(const uint8_t* a, size_t alen,
+                  const uint8_t* b, size_t blen) {
+    size_t common = alen < blen ? alen : blen;
+    size_t i = 0;
+    while (i < common && a[i] == b[i]) ++i;
+    if (i == common && alen == blen) return 0;
+    size_t j = i;
+    int k = 0;
+    while (j > 0 && k < 3 && se_is_cont(a[j - 1])) { --j; ++k; }
+    if (j > 0 && a[j - 1] >= 0xC0) --j;
+    const uint8_t *pa = a + j, *pb = b + j;
+    const uint8_t *ea = a + alen, *eb = b + blen;
+    while (pa < ea && pb < eb) {
+        int adva, advb;
+        uint32_t ca = se_next(pa, ea, &adva);
+        uint32_t cb = se_next(pb, eb, &advb);
+        if (ca != cb) return ca < cb ? -1 : 1;
+        pa += adva;
+        pb += advb;
+    }
+    if (pa < ea) return 1;
+    if (pb < eb) return -1;
+    return 0;
+}
+
+// --- k-way display merge ---------------------------------------------------
+
+struct DgrepMergeCursor {
+    const uint8_t* pos;        // next unread byte of this buffer
+    const uint8_t* end;
+    const uint8_t* line;       // current record's line start
+    size_t line_len;           // excluding '\n'
+    const uint8_t* path;       // parsed key: path bytes
+    size_t path_len;
+    uint64_t lineno;
+    size_t tab;                // offset of '\t' in line, or line_len
+    int idx;                   // buffer index (merge tie-break, heapq order)
+};
+
+static const uint8_t DGREP_KEY_MARKER[] = " (line number #";
+static const size_t DGREP_KEY_MARKER_LEN = sizeof(DGREP_KEY_MARKER) - 1;
+
+// Advance to the cursor's next nonempty line and parse its grep key.
+// Returns 1 on a record, 0 at end-of-buffer, -1 on a non-grep-shaped line.
+static int dgrep_merge_advance(DgrepMergeCursor* c) {
+    for (;;) {
+        if (c->pos >= c->end) return 0;
+        const uint8_t* nl = (const uint8_t*)memchr(
+            c->pos, '\n', (size_t)(c->end - c->pos));
+        const uint8_t* eol = nl ? nl : c->end;
+        const uint8_t* line = c->pos;
+        c->pos = nl ? nl + 1 : c->end;
+        size_t len = (size_t)(eol - line);
+        if (len == 0) continue;  // skip empty lines (the Python merge does)
+        const uint8_t* tab = (const uint8_t*)memchr(line, '\t', len);
+        size_t key_len = tab ? (size_t)(tab - line) : len;
+        // key must end "...#<digits>)" with the marker before the digits
+        if (key_len < DGREP_KEY_MARKER_LEN + 2 || line[key_len - 1] != ')')
+            return -1;
+        size_t d = key_len - 1;  // scan digits backwards
+        while (d > 0 && line[d - 1] >= '0' && line[d - 1] <= '9') --d;
+        if (d == key_len - 1 || d < DGREP_KEY_MARKER_LEN) return -1;
+        if (memcmp(line + d - DGREP_KEY_MARKER_LEN, DGREP_KEY_MARKER,
+                   DGREP_KEY_MARKER_LEN) != 0)
+            return -1;
+        if (key_len - 1 - d > 19) return -1;  // int64 overflow guard
+        uint64_t v = 0;
+        for (size_t q = d; q < key_len - 1; ++q) v = v * 10 + (line[q] - '0');
+        c->line = line;
+        c->line_len = len;
+        c->path = line;
+        c->path_len = d - DGREP_KEY_MARKER_LEN;
+        c->lineno = v;
+        c->tab = tab ? (size_t)(tab - line) : len;
+        return 1;
+    }
+}
+
+// (path, lineno, idx) ordering — paths by surrogateescape codepoints.
+static int dgrep_merge_less(const DgrepMergeCursor* x,
+                            const DgrepMergeCursor* y) {
+    int c;
+    if (x->path_len == y->path_len &&
+        memcmp(x->path, y->path, x->path_len) == 0)
+        c = 0;
+    else
+        c = se_cmp(x->path, x->path_len, y->path, y->path_len);
+    if (c) return c < 0;
+    if (x->lineno != y->lineno) return x->lineno < y->lineno;
+    return x->idx < y->idx;
+}
+
+extern "C" {
+
+// Merge n_bufs pre-sorted mr-out buffers (concatenated in `data`,
+// boundaries in buf_off[n_bufs + 1]) into display bytes: each record's
+// line with its first '\t' replaced by ' ', plus '\n', in (path, line)
+// order.  out needs up to buf_off[n_bufs] + n_bufs bytes: a buffer
+// whose final line lacks a terminating '\n' gains one on output.
+// Returns the output length, or -1 when any line is not grep-shaped
+// (caller falls back to the Python merge).
+int64_t dgrep_merge_display(const uint8_t* data, const int64_t* buf_off,
+                            int32_t n_bufs, uint8_t* out) {
+    std::vector<DgrepMergeCursor> cur;
+    cur.reserve((size_t)n_bufs);
+    for (int32_t i = 0; i < n_bufs; ++i) {
+        DgrepMergeCursor c;
+        c.pos = data + buf_off[i];
+        c.end = data + buf_off[i + 1];
+        c.idx = i;
+        int r = dgrep_merge_advance(&c);
+        if (r < 0) return -1;
+        if (r) cur.push_back(c);
+    }
+    uint8_t* p = out;
+    while (!cur.empty()) {
+        size_t best = 0;
+        for (size_t i = 1; i < cur.size(); ++i)
+            if (dgrep_merge_less(&cur[i], &cur[best])) best = i;
+        DgrepMergeCursor* c = &cur[best];
+        memcpy(p, c->line, c->line_len);
+        if (c->tab < c->line_len) p[c->tab] = ' ';
+        p += c->line_len;
+        *p++ = '\n';
+        int r = dgrep_merge_advance(c);
+        if (r < 0) return -1;
+        if (!r) cur.erase(cur.begin() + (ptrdiff_t)best);
+    }
+    return (int64_t)(p - out);
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""SWAR packed shift-and kernel: bit-exactness fuzz family (round 6).
+
+The packed kernel (ops/pallas_scan.swar_shift_and_scan_words — 4 stripes
+per u32 lane element, one byte-plane automaton per stripe) claims BIT-EXACT
+candidate words vs the unpacked coarse kernel, via the exact packed
+zero-byte class detect (not classic Mycroft, whose borrows over-report).
+This family pins that claim the fuzz-harness way: random eligible
+patterns x random corpora (binary corpora included — bytes 0x00/0x7F/
+0x80/0xFF sit exactly on the detect's borrow/sign borders), comparing
+
+  1. kernel words: packed byte-plane flags == unpacked coarse word flags
+     per stripe, bit for bit;
+  2. engine lines: final matched_lines with DGREP_SWAR=1 == DGREP_SWAR=0
+     (the whole route: packed layout choice, span decode, line confirm).
+
+Failures reproduce from the printed seed.  Standalone:
+
+    python -m pytest tests/test_fuzz_swar.py -m swar -q
+
+Interpret mode is slow, so draws are few and small; the kernel-level
+check runs at the minimum packed layout (16384 lanes x 512 chunk = 8 MB).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.models.shift_and import (
+    filtered_for_device,
+    swar_values,
+    try_compile_shift_and,
+)
+from distributed_grep_tpu.ops import layout as layout_mod
+from distributed_grep_tpu.ops import pallas_scan
+
+pytestmark = pytest.mark.swar
+
+ALPHABET = "etaoin srhld.u01"  # common prose bytes + space/digits/punct
+
+
+def _gen_pattern(rng) -> tuple[str, bool]:
+    n = int(rng.integers(1, 9))  # SWAR_MAX_SYMBOLS = 8
+    pat = "".join(ALPHABET[int(rng.integers(0, len(ALPHABET)))]
+                  for _ in range(n)).replace(".", "x")
+    return pat, bool(rng.integers(0, 2))
+
+
+def _corpus(rng, n: int, binary: bool, needles: list[bytes]) -> bytes:
+    if binary:
+        data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    else:
+        data = rng.integers(32, 127, size=n, dtype=np.uint8)
+    data[rng.integers(0, n, size=max(1, n // 80))] = 0x0A
+    for lit in needles:
+        nd = np.frombuffer(lit, np.uint8)
+        if nd.size == 0 or nd.size + 1 >= n:
+            continue
+        for p in rng.integers(0, n - nd.size - 1, size=200):
+            data[p : p + nd.size] = nd
+    return data.tobytes()
+
+
+def _stripe_flags_unpacked(arr, model, lay):
+    wu = np.asarray(pallas_scan.shift_and_scan_words(
+        arr, model, interpret=True, coarse=True
+    ))
+    return wu.reshape(lay.chunk // 32, lay.lanes) != 0
+
+
+def _stripe_flags_packed(arr, model, lay):
+    wp = np.asarray(pallas_scan.swar_shift_and_scan_words(
+        arr, model, interpret=True
+    ))
+    wpf = wp.reshape(lay.chunk // 32, lay.lanes // 4)
+    out = np.zeros((lay.chunk // 32, lay.lanes), dtype=bool)
+    for k in range(4):
+        out[:, k::4] = ((wpf >> np.uint32(8 * k)) & np.uint32(0xFF)) != 0
+    return out
+
+
+@pytest.mark.parametrize("seed", [3001, 3002, 3003])
+def test_fuzz_swar_kernel_words_bit_exact(seed):
+    rng = np.random.default_rng(seed)
+    pat, ic = _gen_pattern(rng)
+    model = try_compile_shift_and(pat, ignore_case=ic)
+    assert model is not None and swar_values(model) is not None, (seed, pat)
+    binary = bool(rng.integers(0, 2))
+    data = _corpus(rng, 16384 * 512, binary,
+                   [pat.encode(), pat.upper().encode()])
+    lay = layout_mod.choose_layout(
+        len(data), target_lanes=16384, min_chunk=512,
+        lane_multiple=pallas_scan.SWAR_LANES_PER_BLOCK, chunk_multiple=512,
+    )
+    arr = layout_mod.to_device_array(data, lay)
+    for name, m in [("full", model), ("filtered", filtered_for_device(model))]:
+        if m is None or swar_values(m) is None:
+            continue
+        fu = _stripe_flags_unpacked(arr, m, lay)
+        fp = _stripe_flags_packed(arr, m, lay)
+        assert np.array_equal(fu, fp), (
+            f"seed={seed} pat={pat!r} ic={ic} binary={binary} {name}: "
+            f"packed {int(fp.sum())} vs unpacked {int(fu.sum())} spans"
+        )
+
+
+@pytest.mark.parametrize("seed", [3101, 3102])
+def test_fuzz_swar_engine_lines_identical(seed, monkeypatch):
+    from distributed_grep_tpu.ops.engine import GrepEngine
+
+    rng = np.random.default_rng(seed)
+    pat, ic = _gen_pattern(rng)
+    assert swar_values(try_compile_shift_and(pat, ignore_case=ic)) is not None
+    data = _corpus(rng, 1 << 20, bool(rng.integers(0, 2)),
+                   [pat.encode()])
+    monkeypatch.setenv("DGREP_SWAR", "1")
+    e1 = GrepEngine(pat, ignore_case=ic, interpret=True)
+    a = e1.scan(data).matched_lines
+    assert e1.stats.get("swar") == 1, "SWAR route did not engage"
+    monkeypatch.setenv("DGREP_SWAR", "0")
+    b = GrepEngine(pat, ignore_case=ic, interpret=True).scan(data).matched_lines
+    assert np.array_equal(a, b), (
+        f"seed={seed} pat={pat!r} ic={ic}: {a.size} vs {b.size} lines"
+    )
+
+
+def test_swar_eligibility_boundaries():
+    """The gate itself: ranges, length 9, value budget -> ineligible;
+    wildcarded filter models and length-8 match-bit-0x80 -> eligible."""
+    assert swar_values(try_compile_shift_and("function")) is not None  # len 8
+    assert swar_values(try_compile_shift_and("functions")) is None  # len 9
+    assert swar_values(try_compile_shift_and("h[ae]llo")) is not None  # 2 vals
+    assert swar_values(try_compile_shift_and("h[a-e]llo")) is None  # range
+    m = try_compile_shift_and("volcano", ignore_case=True)
+    assert m is not None and swar_values(m) is not None  # 14 values
+    m8 = try_compile_shift_and("function", ignore_case=True)
+    assert m8 is not None and swar_values(m8) is not None  # 16 == budget
+    mo = try_compile_shift_and("[abc][abc][abc][abc][abc][abc]")
+    assert mo is not None and swar_values(mo) is None  # 18 values > 16

@@ -1,0 +1,266 @@
+"""Native columnar merge/print loops (round 6): byte-identity pins.
+
+libdgrep's dgrep_gather_ranges / dgrep_format_batch / dgrep_merge_display
+replace the three remaining per-record Python/numpy passes of the
+match-dense output path.  Exactness story:
+
+* gather_ranges: pure memcpy — pinned against the numpy cumsum gather.
+* format_batch: copies slab bytes verbatim, which equals the Python
+  path's decode('utf-8','replace') -> encode ONLY for strictly-valid
+  UTF-8 slabs; invalid slabs must take the Python fallback (pinned both
+  ways, plus the surrogateescape filename prefix round-trip).
+* merge_display: must order by the DECODED path (surrogateescape
+  codepoints) like the Python heapq merge — raw byte order diverges
+  exactly where a valid multi-byte sequence meets an escaped raw byte —
+  and must refuse (fall back) on any non-grep-shaped record.
+
+The e2e test pins the whole route: a job's mr-out files and display
+bytes with the native loops == with every native loop disabled, spill
+path included.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.runtime import columnar
+from distributed_grep_tpu.runtime.columnar import LineBatch
+from distributed_grep_tpu.runtime.job import JobResult
+from distributed_grep_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="libdgrep unavailable"
+)
+
+
+def _py_gather(arr, starts, ends):
+    lens = ends - starts
+    offsets = np.zeros(starts.size + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    if total == 0:
+        return b"", offsets
+    ne = np.flatnonzero(lens > 0)
+    s, l = starts[ne], lens[ne]
+    idx = np.ones(total, dtype=np.int64)
+    idx[0] = s[0]
+    if ne.size > 1:
+        heads = offsets[ne[1:]]
+        idx[heads] = s[1:] - (s[:-1] + l[:-1] - 1)
+    src = np.cumsum(idx)
+    return arr[src].tobytes(), offsets
+
+
+def test_gather_ranges_native_vs_numpy():
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 256, size=65536, dtype=np.uint8)
+    starts = np.sort(rng.integers(0, 60000, size=300)).astype(np.int64)
+    ends = np.minimum(starts + rng.integers(0, 200, size=300), 65536).astype(
+        np.int64
+    )
+    ends[::7] = starts[::7]  # empty ranges interleaved
+    slab, off = columnar.gather_ranges(arr, starts, ends)
+    pslab, poff = _py_gather(arr, starts, ends)
+    assert slab == pslab and np.array_equal(off, poff)
+
+
+def _batch(filename, lines, linenos):
+    offs = np.zeros(len(lines) + 1, dtype=np.int64)
+    np.cumsum([len(ln) for ln in lines], out=offs[1:])
+    return LineBatch(
+        filename=filename,
+        linenos=np.asarray(linenos, dtype=np.int64),
+        offsets=offs,
+        slab=b"".join(lines),
+    )
+
+
+@pytest.mark.parametrize("filename", [
+    "plain.txt",
+    "dir/uni-é中.txt",          # multi-byte UTF-8 name
+    "raw-\udc80\udcff.bin",              # surrogateescaped raw bytes
+])
+def test_format_batch_byte_identical(filename):
+    b = _batch(filename,
+               [b"hello", b"w\xc3\xb6rld", b"", b"a\tb", b"x" * 300],
+               [1, 9, 42, 4567, 10 ** 14])
+    assert b.format_lines_bytes() == b.format_lines().encode(
+        "utf-8", "surrogateescape"
+    )
+
+
+def test_format_batch_invalid_utf8_falls_back_identically():
+    # lone continuation, truncated sequence, surrogate encoding, 0xFF —
+    # all force the Python utf-8/replace path; output must still equal it
+    b = _batch("f", [b"a\x80b", b"\xe2\x82", b"\xed\xa0\x80", b"\xff"],
+               [1, 2, 3, 4])
+    want = b.format_lines().encode("utf-8", "surrogateescape")
+    assert b.format_lines_bytes() == want
+    assert b"\xef\xbf\xbd" in want  # the replacement char actually appears
+
+
+def test_format_batch_per_line_validation_not_whole_slab():
+    # round-6 review repro: two individually-invalid lines whose bytes
+    # CONCATENATE into valid UTF-8 ('abc\xC3' + '\xA9def' == 'abcédef').
+    # The Python path decodes PER LINE (each gets a U+FFFD); whole-slab
+    # validation would copy the raw bytes and break byte-identity.
+    b = _batch("f", [b"abc\xc3", b"\xa9def"], [1, 2])
+    want = b.format_lines().encode("utf-8", "surrogateescape")
+    assert b.format_lines_bytes() == want
+    assert want.count(b"\xef\xbf\xbd") == 2
+
+
+def test_format_batch_empty():
+    b = _batch("f", [], [])
+    assert b.format_lines_bytes() == b"" == b.format_lines().encode()
+
+
+def _mr_out(recs):
+    return b"".join(k + b"\t" + v + b"\n" for k, v in recs)
+
+
+def _oracle_merge(tmp_path, bufs):
+    files = []
+    for i, buf in enumerate(bufs):
+        p = tmp_path / f"mr-out-{i}"
+        p.write_bytes(buf)
+        files.append(p)
+    res = JobResult(output_files=files, fileline_sorted=True)
+    return b"".join(res.iter_display_bytes_sorted())
+
+
+def test_merge_display_multi_file_and_surrogate_order(tmp_path):
+    # '\xc3\xa9' (e-acute, U+00E9) vs raw '\x80' (U+DC80 decoded): byte
+    # order says 0x80 < 0xC3, codepoint order says U+00E9 < U+DC80 — the
+    # native merge must take the codepoint side, like the Python merge.
+    bufs = [
+        _mr_out([(b"a.txt (line number #1)", b"x"),
+                 (b"a.txt (line number #10)", b"y"),
+                 (b"\xc3\xa9.txt (line number #2)", b"acc")]),
+        _mr_out([(b"a.txt (line number #2)", b"z"),
+                 (b"\x80.txt (line number #1)", b"raw")]),
+        b"",
+        b"\n",
+    ]
+    got = native.merge_display(bufs)
+    assert got is not None and got == _oracle_merge(tmp_path, bufs)
+
+
+def test_merge_display_tab_and_notab_values(tmp_path):
+    bufs = [
+        _mr_out([(b"f (line number #1)", b"v\twith\ttabs"),
+                 (b"f (line number #3)", b"")]),
+        # record without a '\t' at all (key-only line)
+        b"f (line number #2)\n",
+    ]
+    got = native.merge_display(bufs)
+    assert got is not None and got == _oracle_merge(tmp_path, bufs)
+
+
+def test_merge_display_byte_prefix_is_not_codepoint_prefix(tmp_path):
+    # round-6 review repro: b'foo\xC3' decodes to 'foo\udcc3' (U+DCC3)
+    # and must sort AFTER b'foo\xC3\xA9' ('fooé', U+00E9) — the naive
+    # "shorter byte-prefix first" rule returns the reverse.
+    bufs = [
+        _mr_out([(b"foo\xc3 (line number #1)", b"short")]),
+        _mr_out([(b"foo\xc3\xa9 (line number #1)", b"long")]),
+    ]
+    got = native.merge_display(bufs)
+    assert got is not None and got == _oracle_merge(tmp_path, bufs)
+    assert got.index(b"long") < got.index(b"short")
+
+
+def test_merge_display_unterminated_final_line(tmp_path):
+    # output gains a '\n' the input lacked — the capacity math must allow
+    # it (len(data) + n_bufs), and bytes must equal the Python merge
+    bufs = [b"f (line number #2)\tv\nf (line number #10)\tw"]
+    got = native.merge_display(bufs)
+    assert got is not None and got == _oracle_merge(tmp_path, bufs)
+    assert len(got) == len(bufs[0]) + 1
+
+
+def test_merge_display_refuses_foreign_records():
+    ok = _mr_out([(b"f (line number #1)", b"v")])
+    assert native.merge_display([ok, b"wordcount-key\t3\n"]) is None
+    assert native.merge_display([b"f (line number #x)\tv\n"]) is None
+    assert native.merge_display([b"f (line number #)\tv\n"]) is None
+    # 20-digit line number: int64 overflow guard -> Python fallback
+    assert native.merge_display(
+        [b"f (line number #99999999999999999999)\tv\n"]
+    ) is None
+
+
+def test_display_blocks_sorted_native_equals_fallbacks(tmp_path, monkeypatch):
+    rng = np.random.default_rng(9)
+    bufs = []
+    for i in range(4):
+        linenos = np.sort(rng.choice(10 ** 6, size=500, replace=False)) + 1
+        recs = [(b"big.txt (line number #%d)" % n,
+                 b"line-%d" % n) for n in linenos]
+        bufs.append(_mr_out(recs))
+    files = []
+    for i, buf in enumerate(bufs):
+        p = tmp_path / f"mr-out-{i}"
+        p.write_bytes(buf)
+        files.append(p)
+    res = JobResult(output_files=files, fileline_sorted=True)
+    got_native = b"".join(res.display_blocks_sorted())
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.merge_display", lambda bufs: None
+    )
+    got_vector = b"".join(res.display_blocks_sorted())  # round-5 numpy pass
+    got_stream = b"".join(res.iter_display_bytes_sorted())
+    assert got_native == got_vector == got_stream
+
+
+def test_job_output_native_vs_python_paths_with_spill(tmp_path, monkeypatch):
+    """E2E: mr-out files AND display bytes are byte-identical with the
+    native loops on vs all off — spill path included (2 MB reduce cap
+    forces IdentityCollator spill runs)."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    rng = np.random.default_rng(21)
+    data = rng.integers(32, 127, size=6 << 20, dtype=np.uint8)
+    data[rng.integers(0, data.size, size=data.size // 60)] = 0x0A
+    needle = np.frombuffer(b"the", np.uint8)
+    for p in rng.integers(0, data.size - 8, size=30000):
+        data[p : p + 3] = needle
+    # some non-UTF-8 line content too: the formatter must fall back there
+    for p in rng.integers(0, data.size - 8, size=500):
+        data[p] = 0xFF
+    src = tmp_path / "corpus.bin"
+    src.write_bytes(data.tobytes())
+
+    def run(tag):
+        wd = tmp_path / f"job-{tag}"
+        cfg = JobConfig(
+            application="distributed_grep_tpu.apps.grep_tpu",
+            input_files=[str(src)],
+            work_dir=str(wd), n_reduce=4, journal=False,
+            reduce_memory_bytes=128 << 10,  # force spill runs
+            app_options={"pattern": "the", "backend": "cpu"},
+        )
+        res = run_job(cfg, n_workers=2)
+        outs = {p.name: p.read_bytes() for p in res.output_files}
+        disp = b"".join(res.display_blocks_sorted())
+        return outs, disp, res.metrics
+
+    outs_native, disp_native, m = run("native")
+    assert m["counters"].get("reduce_spills", 0) > 0, "spill did not engage"
+
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.gather_ranges_native",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.format_batch",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.merge_display", lambda bufs: None
+    )
+    outs_py, disp_py, _ = run("python")
+    assert outs_native == outs_py
+    assert disp_native == disp_py

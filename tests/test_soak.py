@@ -122,6 +122,197 @@ def test_soak_end_to_end_job_with_resume(tmp_path):
     assert rss1 - rss0 < 1_500_000  # KB
 
 
+# ----------------------------------------------------------------- mini-soak
+#
+# Always-on CI tier (round-6 VERDICT item 8): the rolling-window protocol —
+# generator thread writing splits ahead of the scan, reaper deleting each
+# split once its map commit hits the journal, mid-run crash + journal
+# resume, exact per-split counts — pinned CONTINUOUSLY at a <60 s scale
+# (~256 MB, 16 splits, window 4) instead of only at manual
+# DGREP_SOAK_ROLLING time.  Runs in the normal suite; also standalone:
+#
+#     python -m pytest tests/test_soak.py -m soak_mini -q
+MINI_SPLIT_BYTES = 16 * 1000 * 1000
+MINI_SPLITS = 16
+MINI_WINDOW = 4
+
+
+@pytest.mark.soak_mini
+def test_mini_soak_rolling_window(tmp_path):
+    import resource
+    import shutil
+    import threading
+
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.runtime.worker import WorkerKilled
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    split_bytes, n_splits, window = MINI_SPLIT_BYTES, MINI_SPLITS, MINI_WINDOW
+    rng = np.random.default_rng(11)
+
+    template = tmp_path / "template.bin"
+    block = rng.integers(32, 127, size=split_bytes, dtype=np.uint8)
+    block[rng.integers(0, block.size, size=block.size // 80)] = 0x0A
+    template.write_bytes(block.tobytes())
+
+    files = [str(tmp_path / f"mini{i:03d}.bin") for i in range(n_splits)]
+    for p in files:  # placeholders: the worker stats the path pre-app
+        open(p, "wb").close()
+
+    state = {"generated": 0, "deleted": 0, "stop": False, "gen_error": None}
+    cv = threading.Condition()
+    oracle: dict[str, int] = {}
+    disk_peak = {"bytes": 0}
+
+    def generate() -> None:
+        try:
+            for i, p in enumerate(files):
+                with cv:
+                    cv.wait_for(
+                        lambda: state["stop"]
+                        or state["generated"] - state["deleted"] < window
+                    )
+                    if state["stop"]:
+                        return
+                tmp = p + ".tmp"
+                shutil.copyfile(template, tmp)
+                n_needles = int(rng.integers(3, 40))
+                with open(tmp, "r+b") as f:
+                    for pos in rng.integers(
+                        0, split_bytes - 64, size=n_needles
+                    ):
+                        f.seek(int(pos))
+                        f.write(NEEDLE)
+                with open(tmp, "rb") as fh:
+                    out = subprocess.run(
+                        ["grep", "-c", "-a", NEEDLE.decode()], stdin=fh,
+                        capture_output=True, text=True,
+                    )
+                oracle[p] = int(out.stdout.strip() or 0)
+                os.replace(tmp, p)
+                open(p + ".ready", "wb").close()
+                with cv:
+                    state["generated"] = i + 1
+                    resident = state["generated"] - state["deleted"]
+                    disk_peak["bytes"] = max(
+                        disk_peak["bytes"], resident * split_bytes
+                    )
+                    cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — surfaced by the main thread
+            with cv:
+                state["gen_error"] = e
+                state["stop"] = True
+                cv.notify_all()
+
+    from distributed_grep_tpu.utils.io import WorkDir
+
+    journal_path = WorkDir(str(tmp_path / "job")).journal_path()
+
+    def reap() -> None:
+        from distributed_grep_tpu.runtime.journal import TaskJournal
+
+        reaped: set[str] = set()
+        while True:
+            with cv:
+                if state["stop"] and state["deleted"] >= state["generated"]:
+                    return
+            for e in TaskJournal.replay(journal_path):
+                if e.get("kind") == "map_done":
+                    p = e.get("file")
+                    if p and p not in reaped and os.path.exists(p):
+                        os.unlink(p)
+                        os.path.exists(p + ".ready") and os.unlink(p + ".ready")
+                        reaped.add(p)
+                        with cv:
+                            state["deleted"] = len(reaped)
+                            cv.notify_all()
+            with cv:
+                if state["stop"]:
+                    return
+            time.sleep(0.2)
+
+    app_py = tmp_path / "mini_rolling_app.py"
+    app_py.write_text(
+        "import os, time\n"
+        "from distributed_grep_tpu.apps import grep_tpu as base\n"
+        "configure = base.configure\n"
+        "reduce_fn = base.reduce_fn\n"
+        "reduce_is_identity = True\n"
+        "set_progress = base.set_progress\n"
+        "map_fn = base.map_fn\n"
+        "def map_path_fn(filename, path):\n"
+        "    fn = base._progress_fn()\n"
+        "    t0 = time.monotonic()\n"
+        "    while not os.path.exists(filename + '.ready'):\n"
+        "        if time.monotonic() - t0 > 120:\n"
+        "            raise RuntimeError('generator stalled')\n"
+        "        fn and fn()\n"
+        "        time.sleep(0.1)\n"
+        "    return base.map_path_fn(filename, path)\n"
+    )
+    cfg = JobConfig(
+        input_files=files,
+        application=str(app_py),
+        app_options={"pattern": NEEDLE.decode(), "backend": "cpu"},
+        n_reduce=4,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=30.0,
+        sweep_interval_s=0.2,
+    )
+
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    t_job = time.perf_counter()
+    gen_t = threading.Thread(target=generate, name="mini-gen", daemon=True)
+    reap_t = threading.Thread(target=reap, name="mini-reap", daemon=True)
+    gen_t.start()
+    reap_t.start()
+
+    kill_after = max(1, n_splits // 3)
+    done = {"n": 0}
+
+    def die_midway():
+        done["n"] += 1
+        if done["n"] > kill_after:
+            raise WorkerKilled()
+
+    try:
+        with pytest.raises(RuntimeError, match="all workers exited"):
+            run_job(cfg, n_workers=1,
+                    fault_hooks_per_worker=[{"before_map_finished": die_midway}])
+        res = run_job(cfg, n_workers=2, resume=True)
+    finally:
+        with cv:
+            state["stop"] = True
+            cv.notify_all()
+    gen_t.join(timeout=30)
+    if state["gen_error"] is not None:
+        raise state["gen_error"]
+    wall = time.perf_counter() - t_job
+
+    assigned = res.metrics["counters"]["map_assigned"]
+    assert assigned <= n_splits - kill_after, (
+        f"resume re-ran completed work: {assigned} assigned after "
+        f"{kill_after} were journaled"
+    )
+
+    counts = dict.fromkeys(files, 0)
+    from distributed_grep_tpu.runtime.job import GREP_KEY_RE
+
+    for key, _v in res.iter_results():
+        m = GREP_KEY_RE.match(key)
+        assert m and m.group(1) in counts
+        counts[m.group(1)] += 1
+    assert counts == oracle
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    reap_t.join(timeout=30)
+    print(f"\nmini-soak: {n_splits * split_bytes / 1e6:.0f} MB in "
+          f"{wall:.0f}s, RSS growth {(rss1-rss0)/1024:.0f} MB, disk peak "
+          f"{disk_peak['bytes']/1e6:.0f} MB, "
+          f"{sum(oracle.values())} lines exact across {n_splits} splits")
+    assert wall < 60, f"mini-soak over its time budget: {wall:.0f}s"
+    assert disk_peak["bytes"] <= (window + 1) * split_bytes
+
+
 # --------------------------------------------------------------- rolling 100G
 ROLL = os.environ.get("DGREP_SOAK_ROLLING", "")
 _mr = re.fullmatch(r"(\d+)G", ROLL)
