@@ -315,10 +315,15 @@ class GrepEngine:
                 )
         self.target_lanes = target_lanes
         self.segment_bytes = segment_bytes
-        self.device_min_bytes = (
-            device_min_bytes if device_min_bytes is not None
-            else int(_os.environ.get("DGREP_DEVICE_MIN_BYTES", 1 << 20))
-        )
+        if device_min_bytes is not None:
+            self.device_min_bytes = device_min_bytes
+        else:
+            # ONE parse (ops/layout.env_device_min_bytes), shared with the
+            # map-split planner's "small file" bound — the two sides of
+            # the batching contract can't drift on a malformed override
+            from distributed_grep_tpu.ops.layout import env_device_min_bytes
+
+            self.device_min_bytes = env_device_min_bytes()
         if batch_bytes is not None:
             self.batch_bytes = int(batch_bytes)
         else:

@@ -115,6 +115,27 @@ def to_device_array(data: bytes, layout: Layout) -> np.ndarray:
 DEFAULT_BATCH_BYTES = 32 << 20
 
 
+DEFAULT_DEVICE_MIN_BYTES = 1 << 20
+
+
+def env_device_min_bytes(fallback: int = DEFAULT_DEVICE_MIN_BYTES) -> int:
+    """Parse the DGREP_DEVICE_MIN_BYTES override, ONE way for its two
+    readers (GrepEngine's small-input host branch and the map-split
+    planner's "small file" bound, runtime/job.plan_map_splits): unset or
+    unparseable -> ``fallback``.  A divergent parse would let the planner
+    batch files the engine then refuses to treat as small — same failure
+    mode env_batch_bytes below guards for the packing window."""
+    import os
+
+    env = os.environ.get("DGREP_DEVICE_MIN_BYTES")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass  # malformed override: both readers fall back identically
+    return fallback
+
+
 def env_batch_bytes(fallback: int = DEFAULT_BATCH_BYTES) -> int:
     """Parse the DGREP_BATCH_BYTES override, ONE way for its two readers
     (GrepEngine's packing cap and JobConfig.effective_batch_bytes — the

@@ -404,7 +404,11 @@ def plan_map_splits(
     if batch_bytes <= 0 or len(input_files) < 2:
         return list(input_files)
     if small_bytes is None:
-        small_bytes = int(os.environ.get("DGREP_DEVICE_MIN_BYTES", 1 << 20))
+        # the engine's small-input bound, parsed the ONE way both readers
+        # share (ops/layout.env_device_min_bytes)
+        from distributed_grep_tpu.ops.layout import env_device_min_bytes
+
+        small_bytes = env_device_min_bytes()
     out: list = []
     group: list[str] = []
     group_bytes = 0
