@@ -1147,6 +1147,15 @@ class _GlobFilterAction(argparse.Action):
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "analyze":
+        # Project invariant checker (analysis/): AST-walked rules encoding
+        # the contracts CLAUDE.md documents as prose.  Dispatched before
+        # the main parser so analysis/checker.py stays the single owner of
+        # the checker's flags (REMAINDER can't forward leading options).
+        from distributed_grep_tpu.analysis.checker import main as analyze_main
+
+        return analyze_main(argv[1:])
     parser = argparse.ArgumentParser(prog="distributed_grep_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -1273,6 +1282,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", "--out", default="-",
                    help="output file (default: stdout)")
     p.set_defaults(fn=cmd_trace_export)
+
+    # listed for --help discoverability; the real dispatch (with the
+    # checker's own flags) happens above, before this parser runs
+    sub.add_parser("analyze",
+                   help="project invariant checker (exit 1 on violations; "
+                        "see `analyze --help` for rules/baseline/knobs)")
 
     p = sub.add_parser("worker", help="connect to a coordinator and process tasks")
     p.add_argument("--addr", required=True, help="coordinator http address host:port")

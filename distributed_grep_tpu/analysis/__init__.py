@@ -1,0 +1,9 @@
+"""Project static analysis: machine-checked invariants (rules.py), the
+DGREP_* env-knob registry (knobs.py), and the ``analyze`` CLI driver
+(checker.py).  RE2/Hyperscan-style: constructs the execution engine can't
+honor are rejected at check time, not discovered in a prod job."""
+
+from distributed_grep_tpu.analysis.checker import run_analysis
+from distributed_grep_tpu.analysis.rules import RULES, Project, Violation
+
+__all__ = ["run_analysis", "RULES", "Project", "Violation"]

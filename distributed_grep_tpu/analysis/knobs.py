@@ -1,0 +1,111 @@
+"""Registry of every ``DGREP_*`` environment knob: name -> (owner module,
+default, one-line doc).
+
+This is the single source of truth rule R4 (``env-knobs``) enforces: each
+knob may be READ (``os.environ.get`` / ``os.getenv`` / ``os.environ[...]``)
+in exactly one module — its owner — so two call sites can never parse the
+same override differently (the failure mode DGREP_BATCH_BYTES already
+guards against via ``ops/layout.env_batch_bytes``: a planner that accepts
+a malformed value its worker engines then crash on).  Other modules that
+need a knob's value import the owner's accessor.
+
+The registry doubles as generated operator docs: ``python -m
+distributed_grep_tpu analyze --knobs`` renders it as a markdown table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Knob:
+    owner: str  # package-relative module path, e.g. "ops/engine.py"
+    default: str  # human-readable default
+    doc: str  # one line: what the knob controls
+
+
+KNOBS: dict[str, Knob] = {
+    "DGREP_COMPILE_GRACE_S": Knob(
+        "ops/engine.py", "90",
+        "Heartbeat grace window declared per fresh device-compile shape "
+        "(cold XLA/Mosaic compiles run 20-40 s with no progress).",
+    ),
+    "DGREP_DEVICE_PROBE_S": Knob(
+        "ops/engine.py", "30",
+        "First-touch device responsiveness wall: jax backend init is "
+        "time-boxed on a side thread (a wedged tunnel hangs it in C).",
+    ),
+    "DGREP_DEVICE_STALL_S": Knob(
+        "ops/engine.py", "300",
+        "Mid-scan per-segment stall wall before the scan degrades to the "
+        "exact host engines.",
+    ),
+    "DGREP_DEVICE_RETRY_S": Knob(
+        "ops/engine.py", "600",
+        "How often a degraded engine re-probes the device (0 disables); "
+        "the verdict is process-global.",
+    ),
+    "DGREP_DEVICE_MIN_BYTES": Knob(
+        "ops/layout.py", "1048576",
+        "Inputs below this host-scan when the default backend is a real "
+        "accelerator; also the map-split planner's 'small file' bound "
+        "(one parse, ops/layout.env_device_min_bytes).",
+    ),
+    "DGREP_BATCH_BYTES": Knob(
+        "ops/layout.py", "33554432",
+        "Cross-file packing window for sub-threshold inputs (0 disables); "
+        "one parse (ops/layout.env_batch_bytes) shared by the planner and "
+        "the engine packing cap.",
+    ),
+    "DGREP_NO_CALIBRATE": Knob(
+        "ops/device_scan.py", "unset",
+        "1 disables the FDR tuner's init confirm probe + post-scan retune "
+        "(deterministic CI).",
+    ),
+    "DGREP_CONFIRM_THREADS": Knob(
+        "models/fdr.py", "min(8, cpu_count)",
+        "Declared confirm-thread fan of the deployment; prices the FDR "
+        "filter/confirm trade.",
+    ),
+    "DGREP_SWAR": Knob(
+        "ops/pallas_scan.py", "unset",
+        "1 routes eligible short equality-class patterns through the SWAR "
+        "packed shift-and kernel (default off: no real-chip receipt yet).",
+    ),
+    "DGREP_SPOOL_DIR": Knob(
+        "runtime/http_transport.py", "system temp dir",
+        "Directory HTTP workers spool oversized task payloads to.",
+    ),
+    "DGREP_SPANS": Knob(
+        "utils/spans.py", "unset",
+        "Force the span/event observability pipeline on (operator "
+        "override of JobConfig.spans).",
+    ),
+    "DGREP_TRACE_DIR": Knob(
+        "utils/trace.py", "unset",
+        "Directory for the jax.profiler device trace; also enables "
+        "annotate() regions.",
+    ),
+    "DGREP_LOG": Knob(
+        "utils/logging.py", "INFO",
+        "Log level for the structured control-plane logger.",
+    ),
+    "DGREP_NATIVE_LIB": Knob(
+        "utils/native.py", "unset",
+        "Absolute path of the libdgrep build to load instead of "
+        "native/libdgrep.so (sanitizer builds: libdgrep-asan.so / "
+        "libdgrep-tsan.so); a set-but-unloadable path raises instead of "
+        "silently degrading to the Python fallbacks.",
+    ),
+}
+
+
+def knob_docs() -> str:
+    """The registry as a markdown table — the generated operator docs."""
+    rows = ["| knob | owner | default | controls |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        rows.append(f"| `{name}` | `{k.owner}` | {k.default} | {k.doc} |")
+    return "\n".join(rows) + "\n"

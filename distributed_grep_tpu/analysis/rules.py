@@ -1,0 +1,698 @@
+"""The invariant rules: each encodes one correctness contract this repo
+previously documented only as CLAUDE.md prose (or enforced as a grep test).
+
+Every rule walks real ASTs (no regex-over-source false positives from
+strings or comments) and reports ``Violation(rule, path, line, message)``
+records.  Rules are registered in ``RULES``; the checker (checker.py) runs
+them over a project root — the installed package by default, a fixture
+mini-tree in tests/test_analysis.py, which pins each rule against both
+false negatives (fires on a known-bad snippet) and false positives (stays
+silent on this repo).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # project-root-relative, posix separators
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Project:
+    """Parsed view of a source tree; trees are parsed once and shared."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self._cache: dict[str, ast.Module | None] = {}
+        self._files: list[str] | None = None
+
+    def files(self) -> list[str]:
+        if self._files is None:
+            self._files = sorted(
+                p.relative_to(self.root).as_posix()
+                for p in self.root.rglob("*.py")
+                if "__pycache__" not in p.parts
+            )
+        return self._files
+
+    def tree(self, rel: str) -> ast.Module | None:
+        if rel not in self._cache:
+            try:
+                src = (self.root / rel).read_text(encoding="utf-8",
+                                                  errors="surrogateescape")
+                self._cache[rel] = ast.parse(src)
+            except (OSError, SyntaxError, ValueError):
+                # ValueError: ast.parse raises UnicodeEncodeError on
+                # surrogateescape-decoded non-UTF-8 source — skip the
+                # file like a SyntaxError, don't abort the whole run
+                self._cache[rel] = None
+        return self._cache[rel]
+
+
+# --------------------------------------------------------------- AST helpers
+
+def _last_name(node: ast.AST) -> str:
+    """Trailing identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted form of a Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _str_consts(node: ast.AST) -> Iterator[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+        elif isinstance(n, ast.Constant) and isinstance(n.value, bytes):
+            yield n.value.decode("latin-1")
+
+
+def _scope_assignments(scope: ast.AST) -> dict[str, list[ast.expr]]:
+    """name -> assigned value expressions, within one function/module scope
+    (nested function bodies are NOT descended — they are their own scope)."""
+    out: dict[str, list[ast.expr]] = {}
+
+    def visit(body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign) and stmt.value is not None:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, []).append(stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    out.setdefault(stmt.target.id, []).append(stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                if isinstance(stmt.target, ast.Name):
+                    out.setdefault(stmt.target.id, []).append(stmt.value)
+            # descend statement bodies that stay in this scope
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    visit(sub)
+            for h in getattr(stmt, "handlers", []) or []:
+                visit(h.body)
+
+    visit(scope.body)  # type: ignore[attr-defined]
+    return out
+
+
+def _enclosing_scopes(tree: ast.Module) -> list[tuple[ast.AST, list[ast.AST]]]:
+    """[(scope_node, [calls and other nodes directly in that scope])] for
+    the module and every (possibly nested) function."""
+    scopes: list[tuple[ast.AST, list[ast.AST]]] = []
+
+    def collect(scope: ast.AST) -> None:
+        nodes: list[ast.AST] = []
+        stack = list(getattr(scope, "body", []))
+        funcs: list[ast.AST] = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append(n)
+                continue
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        scopes.append((scope, nodes))
+        for f in funcs:
+            collect(f)
+
+    collect(tree)
+    return scopes
+
+
+# ------------------------------------------------------------------- rule R1
+
+_RE_PATTERN_FUNCS = {"compile", "search", "match", "fullmatch", "finditer",
+                     "findall", "sub", "subn", "split"}
+_SANITIZERS = {"expand_posix_classes", "escape"}
+
+
+def _re_aliases(tree: ast.Module) -> set[str]:
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "re":
+                    names.add(a.asname or "re")
+    return names
+
+
+def _expr_sanitized(expr: ast.expr, env: dict[str, list[ast.expr]],
+                    visited: set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _last_name(node.func) in _SANITIZERS:
+            return True
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id not in visited:
+            visited.add(node.id)
+            for v in env.get(node.id, ()):
+                if _expr_sanitized(v, env, visited):
+                    return True
+    return False
+
+
+def _resolves_to_literal(expr: ast.expr, env: dict[str, list[ast.expr]],
+                         visited: set[str] | None = None) -> bool:
+    """True when the pattern is built from constants alone — an
+    app-internal literal the author wrote, not a user pattern.  Names
+    resolve through the scope's assignments, so a hoisted module constant
+    (``_WORD = rb"[A-Za-z]+"`` ... ``re.findall(_WORD, ...)``) stays
+    exempt; any Call/Attribute, or a name with no all-literal assignment,
+    makes it computed."""
+    if visited is None:
+        visited = set()
+    if any(isinstance(n, (ast.Call, ast.Attribute)) for n in ast.walk(expr)):
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            if node.id in visited:
+                continue
+            visited.add(node.id)
+            vals = env.get(node.id)
+            if not vals or not all(
+                    _resolves_to_literal(v, env, visited) for v in vals):
+                return False
+    return True
+
+
+def rule_posix_expand(project: Project) -> Iterator[Violation]:
+    """R1: every ``re`` handoff of a non-literal pattern must route through
+    ``models/dfa.expand_posix_classes`` (or ``re.escape`` for literals).
+    Python's re misparses POSIX bracket classes ('[[:digit:]]' matches
+    ':digit' members), so an unexpanded handoff silently changes the
+    language the confirm/fallback matcher accepts."""
+    for rel in project.files():
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        aliases = _re_aliases(tree)
+        if not aliases:
+            continue
+        module_env = _scope_assignments(tree)
+        for scope, nodes in _enclosing_scopes(tree):
+            env = dict(module_env)
+            if scope is not tree:
+                env.update(_scope_assignments(scope))
+            for node in nodes:
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _RE_PATTERN_FUNCS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in aliases
+                        and node.args):
+                    continue
+                pat = node.args[0]
+                if _resolves_to_literal(pat, env):
+                    continue
+                if _expr_sanitized(pat, env, set()):
+                    continue
+                yield Violation(
+                    "posix-expand", rel, node.lineno,
+                    f"re.{node.func.attr} on a computed pattern with no "
+                    f"expand_posix_classes/re.escape on any path to it — "
+                    f"POSIX bracket classes would be misparsed by re",
+                )
+
+
+# ------------------------------------------------------------------- rule R2
+
+_RAW_READERS = {"glob", "iglob", "rglob", "listdir", "scandir", "iterdir"}
+
+
+def rule_store_resolve(project: Project) -> Iterator[Violation]:
+    """R2: no raw ``glob``/``listdir``/``open`` over work-dir ``mr-*``
+    artifacts outside runtime/store.py.  On non-atomic stores, commit
+    RECORDS are the unit of truth — a raw directory scan sees torn
+    ``.part.*`` files and duplicate attempts; readers must resolve through
+    ``WorkDir.list_outputs`` / ``store.get``."""
+    for rel in project.files():
+        if rel == "runtime/store.py":
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_reader = (
+                (isinstance(fn, ast.Name) and fn.id == "open")
+                or (isinstance(fn, ast.Attribute) and fn.attr in _RAW_READERS)
+            )
+            if not is_reader:
+                continue
+            hit = next(
+                (s for a in list(node.args)
+                 + [k.value for k in node.keywords]
+                 for s in _str_consts(a) if "mr-" in s),
+                None,
+            )
+            if hit is not None:
+                name = _last_name(fn) or "open"
+                yield Violation(
+                    "store-resolve", rel, node.lineno,
+                    f"raw {name}() over {hit!r}: mr-* artifacts must "
+                    f"resolve through the work dir's Store "
+                    f"(WorkDir.list_outputs / store.get) — commit records, "
+                    f"not file existence, are the unit of truth",
+                )
+
+
+# ------------------------------------------------------------------- rule R3
+
+_R3_SCOPE = ("runtime/", "apps/")
+_R3_FILES = ("__main__.py",)
+_UTF8 = {None, "utf-8", "utf8", "UTF-8", "UTF8"}
+
+
+def rule_surrogateescape(project: Project) -> Iterator[Violation]:
+    """R3: str<->bytes conversions on the data plane (runtime/, apps/, the
+    CLI) must state an ``errors=`` policy.  Pattern and path bytes
+    round-trip via surrogateescape everywhere (display decodes use
+    'replace' deliberately); a bare .encode()/.decode() is a latent
+    UnicodeError on the first non-UTF-8 filename or pattern byte.
+    json.dumps(...).encode(...) is exempt (ASCII by construction), as are
+    non-UTF-8 codecs (declared fixed-alphabet data, e.g. ascii hex)."""
+    for rel in project.files():
+        if not (rel.startswith(_R3_SCOPE) or rel in _R3_FILES):
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("encode", "decode")):
+                continue
+            encoding = None
+            if node.args and isinstance(node.args[0], ast.Constant):
+                encoding = node.args[0].value
+            for k in node.keywords:
+                if k.arg == "encoding" and isinstance(k.value, ast.Constant):
+                    encoding = k.value.value
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                encoding = "<dynamic>"  # can't prove it's utf-8: still flag
+            if isinstance(encoding, str) and encoding not in _UTF8 \
+                    and encoding != "<dynamic>":
+                continue  # ascii/latin-1 etc: fixed-alphabet by declaration
+            has_errors = len(node.args) >= 2 or any(
+                k.arg == "errors" for k in node.keywords)
+            if has_errors:
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Call) and _last_name(recv.func) == "dumps":
+                continue  # json.dumps output is ASCII by construction
+            yield Violation(
+                "surrogateescape", rel, node.lineno,
+                f".{node.func.attr}() without an errors= policy on a "
+                f"data-plane path — pattern/path bytes round-trip via "
+                f"surrogateescape (display output uses 'replace')",
+            )
+
+
+# ------------------------------------------------------------------- rule R4
+
+def _env_reads(tree: ast.Module) -> Iterator[tuple[str, int]]:
+    """(var, line) for each environment READ with a resolvable key.
+    Key constants resolve through EVERY scope's assignments (module
+    ``_ENV_VAR = ...`` indirection and function-local names alike); a
+    name assigned several string constants yields each — over-reporting
+    beats a knob read hidden behind a local variable."""
+    consts: dict[str, set[str]] = {}
+    for scope, _ in _enclosing_scopes(tree):
+        for name, exprs in _scope_assignments(scope).items():
+            for e in exprs:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    consts.setdefault(name, set()).add(e.value)
+
+    def resolve(arg: ast.expr) -> set[str]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return {arg.value}
+        if isinstance(arg, ast.Name):
+            return consts.get(arg.id, set())
+        return set()
+
+    for node in ast.walk(tree):
+        keys: set[str] = set()
+        if isinstance(node, ast.Call) and node.args:
+            dn = _dotted(node.func)
+            if dn.endswith("environ.get") or _last_name(node.func) == "getenv":
+                keys = resolve(node.args[0])
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, ast.Load)
+              and _last_name(node.value) == "environ"):
+            keys = resolve(node.slice)
+        for var in sorted(keys):
+            yield var, node.lineno
+
+
+def rule_env_knobs(project: Project) -> Iterator[Violation]:
+    """R4: each DGREP_* env knob is read by exactly one owner module — the
+    one registered in analysis/knobs.py (which doubles as the generated
+    operator docs).  Two parsers of one knob can disagree on a malformed
+    override (the DGREP_BATCH_BYTES failure mode env_batch_bytes guards);
+    an unregistered knob is undocumented and unowned."""
+    from distributed_grep_tpu.analysis.knobs import KNOBS
+
+    seen: dict[str, list[tuple[str, int]]] = {}
+    for rel in project.files():
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for var, line in _env_reads(tree):
+            if var.startswith("DGREP_"):
+                seen.setdefault(var, []).append((rel, line))
+    for var in sorted(seen):
+        knob = KNOBS.get(var)
+        for rel, line in seen[var]:
+            if knob is None:
+                yield Violation(
+                    "env-knobs", rel, line,
+                    f"unregistered env knob {var}: add it (owner, default, "
+                    f"doc) to analysis/knobs.py KNOBS",
+                )
+            elif rel != knob.owner:
+                yield Violation(
+                    "env-knobs", rel, line,
+                    f"{var} read outside its owner module {knob.owner} — "
+                    f"import the owner's accessor instead of re-parsing "
+                    f"the env var",
+                )
+    # stale registry entries: the owner module exists but never reads the
+    # knob (fixture mini-trees without the owner file stay silent)
+    for var, knob in KNOBS.items():
+        if var in seen:
+            continue
+        if (project.root / knob.owner).exists():
+            yield Violation(
+                "env-knobs", knob.owner, 1,
+                f"registered env knob {var} is never read by its owner "
+                f"{knob.owner}: stale registry entry in analysis/knobs.py",
+            )
+
+
+# ------------------------------------------------------------------- rule R5
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    return any(_last_name(d if not isinstance(d, ast.Call) else d.func)
+               == "dataclass" for d in node.decorator_list)
+
+
+def _field_default(expr: ast.expr | None):
+    """(known, value): the field's declared default, when statically
+    derivable.  field(default_factory=list/dict) -> []/{}."""
+    if expr is None:
+        return False, None
+    if isinstance(expr, ast.Constant):
+        return True, expr.value
+    if isinstance(expr, ast.Call) and _last_name(expr.func) == "field":
+        for k in expr.keywords:
+            if k.arg == "default_factory":
+                factory = _last_name(k.value)
+                if factory == "list":
+                    return True, []
+                if factory == "dict":
+                    return True, {}
+            if k.arg == "default" and isinstance(k.value, ast.Constant):
+                return True, k.value.value
+    return False, None
+
+
+def _is_optional_ann(ann: ast.expr | None) -> bool:
+    if ann is None:
+        return False
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Constant) and n.value is None:
+            return True
+        if isinstance(n, ast.Name) and n.id == "Optional":
+            return True
+        # annotations arrive as strings under `from __future__ import
+        # annotations`-style quoting: "dict | None"
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and "None" in n.value:
+            return True
+    return False
+
+
+def rule_rpc_elide(project: Project, rel: str = "runtime/rpc.py"
+                   ) -> Iterator[Violation]:
+    """R5: wire-compat reflection over the RPC schema.  Every
+    Optional-default field on the rpc dataclasses must appear in
+    ``_ELIDE_DEFAULTS`` (else a span-disabled run's payloads grow keys old
+    peers choke on), every elide key must exist as a field, and the
+    registered elide default must EQUAL the field's declared default on
+    every dataclass carrying it (drift silently un-elides the field)."""
+    tree = project.tree(rel)
+    if tree is None:
+        return
+    elide: dict[str, object] | None = None
+    elide_line = 1
+    for node in ast.walk(tree):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if (targets
+                and any(isinstance(t, ast.Name) and t.id == "_ELIDE_DEFAULTS"
+                        for t in targets)
+                and isinstance(node.value, ast.Dict)):
+            elide, elide_line = {}, node.lineno
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    try:
+                        elide[k.value] = ast.literal_eval(v)
+                    except ValueError:
+                        elide[k.value] = _field_default(v)[1]
+    if elide is None:
+        yield Violation("rpc-elide", rel, 1,
+                        "no _ELIDE_DEFAULTS dict literal found")
+        return
+    field_names: set[str] = set()
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and _is_dataclass(cls)):
+            continue
+        for stmt in cls.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                continue
+            name = stmt.target.id
+            field_names.add(name)
+            known, default = _field_default(stmt.value)
+            if (_is_optional_ann(stmt.annotation) and stmt.value is not None
+                    and name not in elide):
+                yield Violation(
+                    "rpc-elide", rel, stmt.lineno,
+                    f"Optional-default field {cls.name}.{name} missing from "
+                    f"_ELIDE_DEFAULTS: span-disabled payloads would grow a "
+                    f"key old peers reject",
+                )
+            if name in elide and known and elide[name] != default:
+                yield Violation(
+                    "rpc-elide", rel, stmt.lineno,
+                    f"_ELIDE_DEFAULTS[{name!r}] == {elide[name]!r} but "
+                    f"{cls.name}.{name} defaults to {default!r}: elision "
+                    f"would silently stop matching the wire default",
+                )
+    for key in sorted(set(elide) - field_names):
+        yield Violation(
+            "rpc-elide", rel, elide_line,
+            f"_ELIDE_DEFAULTS key {key!r} is not a field on any rpc "
+            f"dataclass: dead elision entry",
+        )
+
+
+# ------------------------------------------------------------------- rule R6
+
+_NARROW = {"int8", "uint8", "int16", "uint16"}
+_PROBED_GATHER_CEILING = 64  # benchmarks/probe_gather_ceiling.py, 2026-08-01
+_PROBED_DOMAINS = {128, 256, 512, 1024}
+_PROBED_UNROLLS = {1, 2, 4, 8, 16, 32}  # divisors of 32 (pallas_scan gate)
+
+
+def _narrow_cast_in(expr: ast.expr) -> str | None:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            if (isinstance(n.func, ast.Attribute) and n.func.attr == "astype"
+                    and n.args and _last_name(n.args[0]) in _NARROW):
+                return _last_name(n.args[0])
+            if _last_name(n.func) in _NARROW:
+                return _last_name(n.func)
+    return None
+
+
+def _return_value_consts(fn: ast.FunctionDef) -> Iterator[tuple[int, int]]:
+    """(value, line) for int constants a return statement can evaluate to
+    (IfExp arms flattened; condition subtrees are NOT scanned)."""
+    def arms(e: ast.expr) -> Iterator[ast.expr]:
+        if isinstance(e, ast.IfExp):
+            yield from arms(e.body)
+            yield from arms(e.orelse)
+        else:
+            yield e
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for arm in arms(node.value):
+                if isinstance(arm, ast.Constant) and isinstance(arm.value,
+                                                                int):
+                    yield arm.value, arm.lineno
+
+
+def rule_mosaic_ceilings(project: Project) -> Iterator[Violation]:
+    """R6: the Mosaic compile ceilings measured on real v5e hardware
+    (BASELINE.md rounds 4-5), checked statically instead of discovered as
+    kernel compile crashes: no u8/i8/i16 vector compares in Pallas kernel
+    bodies ('Target does not support this comparison'), gather plans
+    bounded by the probed MAX_GATHERS=64 ceiling, unroll factors within
+    the probed divisor-of-32 set, FDR domains within the probed set."""
+    pallas = [f for f in project.files()
+              if f.startswith("ops/pallas_") and f.endswith(".py")]
+    for rel in pallas:
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                for operand in [node.left, *node.comparators]:
+                    dt = _narrow_cast_in(operand)
+                    if dt:
+                        yield Violation(
+                            "mosaic-ceilings", rel, node.lineno,
+                            f"{dt} vector compare in a Pallas kernel file: "
+                            f"Mosaic rejects sub-i32 vector cmpi on this "
+                            f"target (probed round 4, probe_narrow.py) — "
+                            f"widen to i32 first",
+                        )
+                        break
+            if isinstance(node, ast.Call):
+                for k in node.keywords:
+                    if (k.arg == "unroll"
+                            and isinstance(k.value, ast.Constant)
+                            and isinstance(k.value.value, int)
+                            and k.value.value not in _PROBED_UNROLLS):
+                        yield Violation(
+                            "mosaic-ceilings", rel, node.lineno,
+                            f"unroll={k.value.value} outside the probed set "
+                            f"{sorted(_PROBED_UNROLLS)}",
+                        )
+            if isinstance(node, ast.FunctionDef) and node.name == "unroll_for":
+                for val, line in _return_value_consts(node):
+                    if val not in _PROBED_UNROLLS:
+                        yield Violation(
+                            "mosaic-ceilings", rel, line,
+                            f"unroll_for returns {val}, outside the probed "
+                            f"set {sorted(_PROBED_UNROLLS)}",
+                        )
+    fdr = project.tree("models/fdr.py")
+    if fdr is not None:
+        for name, exprs in _scope_assignments(fdr).items():
+            for e in exprs:
+                if name == "MAX_GATHERS" and isinstance(e, ast.Constant):
+                    if e.value > _PROBED_GATHER_CEILING:
+                        yield Violation(
+                            "mosaic-ceilings", "models/fdr.py", e.lineno,
+                            f"MAX_GATHERS={e.value} exceeds the probed "
+                            f"compile ceiling {_PROBED_GATHER_CEILING} "
+                            f"(probe_gather_ceiling.py) — re-probe on a "
+                            f"real chip before raising",
+                        )
+                if name == "DOMAINS" and isinstance(e, (ast.Tuple, ast.List)):
+                    for el in e.elts:
+                        if (isinstance(el, ast.Constant)
+                                and isinstance(el.value, int)
+                                and el.value not in _PROBED_DOMAINS):
+                            yield Violation(
+                                "mosaic-ceilings", "models/fdr.py", el.lineno,
+                                f"DOMAINS entry {el.value} outside the "
+                                f"probed power-of-two set "
+                                f"{sorted(_PROBED_DOMAINS)}",
+                            )
+
+
+# ------------------------------------------------------------------- rule R7
+
+_LOG_ROOTS = ("runtime/", "utils/", "parallel/")
+_LOG_EXEMPT = "utils/logging.py"
+
+
+def rule_logging(project: Project) -> Iterator[Violation]:
+    """R7: control-plane modules (runtime/, utils/, parallel/) log via
+    utils.logging.get_logger only — no bare print() (stdout is a DATA
+    contract: bench.py's one-JSON-line, the CLI's user output), no root
+    logging.getLogger.  Migrated from the grep-based obs test; AST-walked,
+    so prints in nested expressions are caught too."""
+    for rel in project.files():
+        if not rel.startswith(_LOG_ROOTS):
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and node.func.id == "print":
+                    yield Violation(
+                        "logging", rel, node.lineno,
+                        "bare print() on a control-plane path (use "
+                        "utils.logging.get_logger)",
+                    )
+                elif (rel != _LOG_EXEMPT
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "getLogger"
+                      and _last_name(node.func.value) == "logging"):
+                    yield Violation(
+                        "logging", rel, node.lineno,
+                        "root-logger use (want utils.logging.get_logger)",
+                    )
+            elif isinstance(node, ast.Assign):
+                if (any(isinstance(t, ast.Name) and t.id == "log"
+                        for t in node.targets)
+                        and not (isinstance(node.value, ast.Call)
+                                 and _last_name(node.value.func)
+                                 == "get_logger")):
+                    yield Violation(
+                        "logging", rel, node.lineno,
+                        "log defined without get_logger",
+                    )
+
+
+# ------------------------------------------------------------------ registry
+
+RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
+    "posix-expand": rule_posix_expand,
+    "store-resolve": rule_store_resolve,
+    "surrogateescape": rule_surrogateescape,
+    "env-knobs": rule_env_knobs,
+    "rpc-elide": rule_rpc_elide,
+    "mosaic-ceilings": rule_mosaic_ceilings,
+    "logging": rule_logging,
+}
+
+RULE_DOCS: dict[str, str] = {
+    name: (fn.__doc__ or "").strip().splitlines()[0].rstrip(".")
+    for name, fn in RULES.items()
+}

@@ -1,0 +1,368 @@
+"""Tier-1 gate + per-rule fixtures for the project invariant checker
+(distributed_grep_tpu/analysis/).
+
+Two directions per rule: it must FIRE on a known-bad snippet (no false
+negatives — a rule that silently stopped matching is worse than no rule)
+and stay SILENT on this repo with an EMPTY baseline (no false positives —
+every pre-existing violation was fixed in the PR that landed the
+analyzer, not inventoried).
+
+Standalone-runnable:  python -m pytest tests/ -q -m lint
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.analysis import RULES, Project, run_analysis
+from distributed_grep_tpu.analysis.checker import main as analyze_main
+from distributed_grep_tpu.analysis.knobs import KNOBS, knob_docs
+
+pytestmark = pytest.mark.lint
+
+
+def _mk(root: Path, rel: str, src: str) -> None:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src, encoding="utf-8")
+
+
+def _hits(root: Path, rule: str) -> list:
+    return [v for v in run_analysis(root=root, rules=[rule])]
+
+
+# ------------------------------------------------------------ the tier-1 gate
+
+def test_repo_is_clean_with_empty_baseline():
+    """The acceptance invariant: `analyze` exits 0 on the repo with NO
+    baseline.  Any new violation fails tier-1 here, with the rule's
+    file:line diagnostics in the assertion."""
+    violations = run_analysis()
+    assert not violations, "\n" + "\n".join(v.render() for v in violations)
+
+
+def test_cli_analyze_subcommand_green(capsys):
+    from distributed_grep_tpu.__main__ import main
+
+    assert main(["analyze"]) == 0
+    assert main(["analyze", "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert doc["count"] == 0 and doc["violations"] == []
+
+
+# ------------------------------------------------------------------ R1 posix
+
+def test_posix_expand_fires_on_raw_user_pattern(tmp_path):
+    _mk(tmp_path, "apps/x.py",
+        "import re\n"
+        "def f(user_pattern):\n"
+        "    return re.compile(user_pattern)\n")
+    (v,) = _hits(tmp_path, "posix-expand")
+    assert v.path == "apps/x.py" and v.line == 3
+    assert "expand_posix_classes" in v.message
+
+
+def test_posix_expand_fires_through_alias_and_search(tmp_path):
+    _mk(tmp_path, "ops/x.py",
+        "import re as _re\n"
+        "def f(p, data):\n"
+        "    return _re.search(p, data)\n")
+    (v,) = _hits(tmp_path, "posix-expand")
+    assert v.line == 3
+
+
+def test_posix_expand_exempts_hoisted_literal_constant(tmp_path):
+    """The wordcount._WORD shape: an app-internal literal hoisted into a
+    named constant is still a literal, not a user pattern."""
+    _mk(tmp_path, "apps/w.py",
+        "import re\n"
+        "_WORD = rb'[A-Za-z0-9]+'\n"
+        "def f(text):\n"
+        "    return re.findall(_WORD, text)\n")
+    assert not _hits(tmp_path, "posix-expand")
+
+
+def test_posix_expand_silent_on_sanitized_and_literal(tmp_path):
+    _mk(tmp_path, "apps/ok.py",
+        "import re\n"
+        "from distributed_grep_tpu.models.dfa import expand_posix_classes\n"
+        "WORD = re.compile(rb'[A-Za-z]+')\n"  # app-internal literal
+        "def f(p):\n"
+        "    return re.compile(expand_posix_classes(p))\n"
+        "def g(p, mode):\n"
+        "    base = wrap(expand_posix_classes(p), mode)\n"
+        "    return re.compile(base)\n"  # sanitized via the assignment
+        "def h(lits):\n"
+        "    return re.compile(b'|'.join(re.escape(x) for x in lits))\n")
+    assert not _hits(tmp_path, "posix-expand")
+
+
+# ------------------------------------------------------------------ R2 store
+
+def test_store_resolve_fires_on_raw_glob_and_open(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "import glob\n"
+        "def f(d):\n"
+        "    a = glob.glob(d + '/mr-out-*')\n"
+        "    b = open(f'{d}/mr-0-1')\n"
+        "    return a, b\n")
+    got = _hits(tmp_path, "store-resolve")
+    assert [v.line for v in got] == [3, 4]
+    assert all("unit of truth" in v.message for v in got)
+
+
+def test_store_resolve_exempts_store_py_and_plain_paths(tmp_path):
+    _mk(tmp_path, "runtime/store.py",
+        "from pathlib import Path\n"
+        "def resolve(d):\n"
+        "    return sorted(Path(d).glob('mr-out-*'))\n")
+    _mk(tmp_path, "runtime/ok.py",
+        "def f(p):\n"
+        "    return open(p)\n")  # no mr-* literal: not a raw artifact read
+    assert not _hits(tmp_path, "store-resolve")
+
+
+# ---------------------------------------------------------------- R3 unicode
+
+def test_surrogateescape_fires_on_bare_utf8_conversions(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "def f(p):\n"
+        "    return p.encode('utf-8'), p.encode(), b'x'.decode('utf-8')\n")
+    got = _hits(tmp_path, "surrogateescape")
+    assert len(got) == 3 and all(v.line == 2 for v in got)
+
+
+def test_surrogateescape_exemptions(tmp_path):
+    _mk(tmp_path, "apps/ok.py",
+        "import json\n"
+        "def f(p, obj):\n"
+        "    a = p.encode('utf-8', 'surrogateescape')\n"
+        "    b = p.encode('utf-8', errors='surrogateescape')\n"
+        "    c = b'x'.decode('utf-8', errors='replace')\n"
+        "    d = json.dumps(obj).encode('utf-8')\n"  # ASCII by construction
+        "    e = b'ff'.decode('ascii')\n"  # fixed-alphabet codec
+        "    return a, b, c, d, e\n")
+    _mk(tmp_path, "models/out_of_scope.py",
+        "def f(p):\n"
+        "    return p.encode('utf-8')\n")  # models/ is not the data plane
+    assert not _hits(tmp_path, "surrogateescape")
+
+
+# ------------------------------------------------------------------ R4 knobs
+
+def test_env_knobs_fires_on_unregistered_and_wrong_owner(tmp_path):
+    _mk(tmp_path, "ops/x.py",
+        "import os\n"
+        "A = os.environ.get('DGREP_BOGUS', '1')\n"
+        "B = os.environ.get('DGREP_LOG')\n")
+    got = _hits(tmp_path, "env-knobs")
+    msgs = "\n".join(v.message for v in got)
+    assert "unregistered env knob DGREP_BOGUS" in msgs
+    assert "DGREP_LOG read outside its owner" in msgs
+
+
+def test_env_knobs_fires_on_stale_registry_entry(tmp_path):
+    _mk(tmp_path, "utils/logging.py", "x = 1\n")  # owner exists, no read
+    got = _hits(tmp_path, "env-knobs")
+    assert any("DGREP_LOG is never read" in v.message for v in got)
+
+
+def test_env_knobs_resolves_module_constant_keys(tmp_path):
+    _mk(tmp_path, "utils/spans.py",
+        "import os\n"
+        "_ENV_VAR = 'DGREP_SPANS'\n"
+        "def enabled():\n"
+        "    return os.environ.get(_ENV_VAR, '') not in ('', '0')\n")
+    assert not _hits(tmp_path, "env-knobs")
+    # ...and the same indirect read elsewhere is still caught
+    _mk(tmp_path, "runtime/x.py",
+        "import os\n"
+        "_V = 'DGREP_SPANS'\n"
+        "y = os.environ.get(_V)\n")
+    got = _hits(tmp_path, "env-knobs")
+    assert any("DGREP_SPANS read outside its owner" in v.message
+               for v in got)
+
+
+def test_env_knobs_resolves_function_local_keys(tmp_path):
+    """A knob read hidden behind a function-local name is still a read."""
+    _mk(tmp_path, "runtime/x.py",
+        "import os\n"
+        "def f():\n"
+        "    var = 'DGREP_TOTALLY_BOGUS'\n"
+        "    return os.environ.get(var)\n")
+    got = _hits(tmp_path, "env-knobs")
+    assert any("DGREP_TOTALLY_BOGUS" in v.message for v in got)
+
+
+def test_knob_registry_docs_cover_every_knob():
+    docs = knob_docs()
+    for name, knob in KNOBS.items():
+        assert name in docs and knob.owner in docs
+
+
+# ------------------------------------------------------------------- R5 rpc
+
+_RPC_FIXTURE = """\
+from dataclasses import dataclass, field
+from typing import Any
+@dataclass
+class A:
+    x: int = 1
+    m: dict | None = None
+    spans: list = field(default_factory=list)
+_ELIDE_DEFAULTS: dict[str, Any] = {'spans': [], 'gone': None, 'x': 5}
+"""
+
+
+def test_rpc_elide_fires_on_missing_drift_and_dead_keys(tmp_path):
+    _mk(tmp_path, "runtime/rpc.py", _RPC_FIXTURE)
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "rpc-elide"))
+    assert "Optional-default field A.m missing" in msgs
+    assert "_ELIDE_DEFAULTS['x'] == 5 but A.x defaults to 1" in msgs
+    assert "key 'gone' is not a field" in msgs
+
+
+def test_rpc_elide_silent_on_consistent_schema(tmp_path):
+    _mk(tmp_path, "runtime/rpc.py",
+        "from dataclasses import dataclass, field\n"
+        "from typing import Any\n"
+        "@dataclass\n"
+        "class A:\n"
+        "    x: int = 1\n"
+        "    m: dict | None = None\n"
+        "    spans: list = field(default_factory=list)\n"
+        "_ELIDE_DEFAULTS: dict[str, Any] = {'spans': [], 'm': None}\n")
+    assert not _hits(tmp_path, "rpc-elide")
+
+
+# ---------------------------------------------------------------- R6 mosaic
+
+def test_mosaic_fires_on_narrow_compare_and_bad_unroll(tmp_path):
+    _mk(tmp_path, "ops/pallas_x.py",
+        "import jax.numpy as jnp\n"
+        "def kernel(a, b, run):\n"
+        "    m = a.astype(jnp.int8) == b\n"
+        "    n = jnp.uint16(3) < b\n"
+        "    run(a, unroll=7)\n"
+        "    return m, n\n"
+        "def unroll_for(model):\n"
+        "    return 5 if model else 8\n")
+    got = _hits(tmp_path, "mosaic-ceilings")
+    msgs = "\n".join(v.message for v in got)
+    assert "int8 vector compare" in msgs and "uint16 vector compare" in msgs
+    assert "unroll=7 outside the probed set" in msgs
+    assert "unroll_for returns 5" in msgs
+
+
+def test_mosaic_fires_on_fdr_ceiling_breach(tmp_path):
+    _mk(tmp_path, "models/fdr.py",
+        "MAX_GATHERS = 96\nDOMAINS = (128, 384)\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "mosaic-ceilings"))
+    assert "MAX_GATHERS=96 exceeds the probed compile ceiling 64" in msgs
+    assert "DOMAINS entry 384" in msgs
+
+
+def test_mosaic_silent_on_widened_compares(tmp_path):
+    _mk(tmp_path, "ops/pallas_ok.py",
+        "import jax.numpy as jnp\n"
+        "def kernel(ref, lo, run):\n"
+        "    b = ref.astype(jnp.int32)\n"
+        "    m = (b >= lo) & (b == 97)\n"  # i32 compares: the probed floor
+        "    run(b, unroll=16)\n"
+        "    return m | (b.astype(jnp.uint8) & 1)\n")  # cast OUTSIDE compare
+    assert not _hits(tmp_path, "mosaic-ceilings")
+
+
+# --------------------------------------------------------------- R7 logging
+
+def test_logging_fires_on_print_and_root_logger(tmp_path):
+    _mk(tmp_path, "parallel/x.py",
+        "import logging\n"
+        "log = logging.getLogger('x')\n"
+        "def f():\n"
+        "    print('hi')\n")
+    got = _hits(tmp_path, "logging")
+    msgs = "\n".join(v.message for v in got)
+    assert "bare print()" in msgs and "root-logger" in msgs \
+        and "without get_logger" in msgs
+
+
+def test_logging_scope_and_get_logger_exemptions(tmp_path):
+    _mk(tmp_path, "utils/y.py",
+        "from distributed_grep_tpu.utils.logging import get_logger\n"
+        "log = get_logger('y')\n")
+    _mk(tmp_path, "apps/z.py", "print('cli output is fine here')\n")
+    assert not _hits(tmp_path, "logging")
+
+
+# --------------------------------------------- suppression + CLI plumbing
+
+def test_pragma_suppresses_named_rule_only(tmp_path):
+    _mk(tmp_path, "parallel/x.py",
+        "def f():\n"
+        "    print('deliberate')  # analyze-ok: logging\n")
+    assert not _hits(tmp_path, "logging")
+    _mk(tmp_path, "parallel/y.py",
+        "def f():\n"
+        "    print('deliberate')  # analyze-ok: other-rule\n")
+    assert any(v.path == "parallel/y.py"
+               for v in _hits(tmp_path, "logging"))
+
+
+def test_baseline_roundtrip_and_exit_codes(tmp_path, capsys):
+    _mk(tmp_path, "parallel/x.py", "def f():\n    print('x')\n")
+    root = str(tmp_path)
+    assert analyze_main(["--root", root, "--rule", "logging"]) == 1
+    base = tmp_path / "baseline.txt"
+    assert analyze_main(["--root", root, "--rule", "logging",
+                         "--write-baseline", str(base)]) == 0
+    capsys.readouterr()
+    assert analyze_main(["--root", root, "--rule", "logging",
+                         "--baseline", str(base)]) == 0
+    assert analyze_main(["--root", root, "--rule", "no-such-rule"]) == 2
+    # a typo'd baseline path is a clean usage error, not a traceback
+    assert analyze_main(["--root", root,
+                         "--baseline", str(tmp_path / "missing.txt")]) == 2
+    assert analyze_main(["--list-rules"]) == 0
+    assert analyze_main(["--knobs"]) == 0
+    out = capsys.readouterr().out
+    assert "DGREP_BATCH_BYTES" in out
+
+
+def test_json_output_shape(tmp_path, capsys):
+    _mk(tmp_path, "parallel/x.py", "def f():\n    print('x')\n")
+    assert analyze_main(["--root", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] >= 1
+    v = doc["violations"][0]
+    assert set(v) == {"rule", "path", "line", "message"}
+
+
+def test_every_rule_has_a_doc_line():
+    from distributed_grep_tpu.analysis.rules import RULE_DOCS
+
+    for name in RULES:
+        assert RULE_DOCS[name], name
+
+
+def test_project_tolerates_unparseable_file(tmp_path):
+    _mk(tmp_path, "runtime/broken.py", "def f(:\n")
+    # non-UTF-8 source: ast.parse raises UnicodeEncodeError on the
+    # surrogateescape-decoded text — skipped like a SyntaxError
+    (tmp_path / "runtime" / "binary.py").write_bytes(b'print("x\xff")\n')
+    assert Project(tmp_path).tree("runtime/broken.py") is None
+    assert Project(tmp_path).tree("runtime/binary.py") is None
+    assert run_analysis(root=tmp_path) == []
+
+
+def test_write_baseline_unwritable_path_is_clean_error(tmp_path, capsys):
+    _mk(tmp_path, "parallel/x.py", "def f():\n    print('x')\n")
+    rc = analyze_main(["--root", str(tmp_path), "--write-baseline",
+                       str(tmp_path / "no" / "such" / "dir" / "b.txt")])
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
