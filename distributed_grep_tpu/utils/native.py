@@ -28,12 +28,27 @@ def _try_load() -> ctypes.CDLL | None:
     global _lib, _load_attempted
     if _load_attempted:
         return _lib
+    override = os.environ.get("DGREP_NATIVE_LIB")
+    if override:
+        # Explicit build selection (sanitizer builds: libdgrep-asan.so /
+        # libdgrep-tsan.so from `make -C native sanitize|tsan`).  No make,
+        # no staleness check — and a load failure RAISES, on this call and
+        # every later one (_load_attempted stays False): a test that asked
+        # for the ASan build must never silently run the Python fallbacks.
+        lib = ctypes.CDLL(override)
+        _bind(lib)
+        _lib = lib
+        _load_attempted = True
+        return _lib
     _load_attempted = True
     src = _NATIVE_DIR / "dgrep.cpp"
-    stale = (
-        not _LIB_PATH.exists()
-        or (src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime)
-    )
+    makefile = _NATIVE_DIR / "Makefile"
+    newer_than_lib = [
+        p for p in (src, makefile)
+        if p.exists() and _LIB_PATH.exists()
+        and p.stat().st_mtime > _LIB_PATH.stat().st_mtime
+    ]
+    stale = not _LIB_PATH.exists() or bool(newer_than_lib)
     if stale:
         try:
             subprocess.run(
@@ -49,7 +64,12 @@ def _try_load() -> ctypes.CDLL | None:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError:
         return None
+    _bind(lib)
+    _lib = lib
+    return _lib
 
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.dgrep_fnv32a.restype = ctypes.c_uint32
     lib.dgrep_fnv32a.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.dgrep_newline_index.restype = ctypes.c_size_t
@@ -139,8 +159,6 @@ def _try_load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint32,
         ]
-    _lib = lib
-    return _lib
 
 
 def native_available() -> bool:

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Best-effort C++ static analysis over libdgrep.  Runs whichever of
+# cppcheck / clang-tidy is installed and exits nonzero on findings; when
+# neither binary exists it no-ops with exit 0 (CI containers without the
+# tools must not fail the build — the Python-side `analyze` subcommand is
+# the always-on layer; this is the extra native-side pass).
+set -eu
+cd "$(dirname "$0")"
+
+ran=0
+if command -v cppcheck >/dev/null 2>&1; then
+    ran=1
+    # --error-exitcode makes findings fail; style/perf classes included.
+    cppcheck --std=c++17 --language=c++ \
+        --enable=warning,performance,portability \
+        --inline-suppr --error-exitcode=2 dgrep.cpp
+fi
+if command -v clang-tidy >/dev/null 2>&1; then
+    ran=1
+    clang-tidy dgrep.cpp --warnings-as-errors='*' -- -std=c++17 -x c++
+fi
+if [ "$ran" = 0 ]; then
+    echo "native/lint.sh: cppcheck/clang-tidy not installed; skipping" >&2
+fi

@@ -1,0 +1,224 @@
+"""Native-surface driver for the sanitizer builds (tests/test_sanitize.py).
+
+Run as a SUBPROCESS with the sanitizer runtime LD_PRELOADed and
+``DGREP_NATIVE_LIB`` pointing at ``libdgrep-asan.so`` / ``libdgrep-tsan.so``
+(utils/native.py loads exactly that build, and raises instead of silently
+degrading to the Python fallbacks).  Exercises every exported entry point
+against independent pure-Python oracles — including the buffer-regrow
+retry loops, the ignore_case fold, the short-pattern chain, and the
+threaded paths (MT DFA scan, the confirm pool) — then a threaded stress
+that shares one ConfirmSet / one DFA table across concurrent scans (the
+race surface TSan watches; the library's scan entry points are read-only
+by contract).
+
+    python tests/_native_sanitize_driver.py surface   # full sweep
+    python tests/_native_sanitize_driver.py stress    # threaded stress
+
+Exit 0 = every check passed and no sanitizer report fired (the builds run
+with halt-on-error, so a report is a nonzero exit).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+
+import numpy as np
+
+from distributed_grep_tpu.utils import native
+
+
+def check(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        sys.exit(1)
+
+
+def py_fnv32a(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+def py_dfa(data: bytes, table: np.ndarray, accept: np.ndarray,
+           start: int = 0) -> list[int]:
+    s = start
+    out = []
+    for i, b in enumerate(data):
+        s = int(table[s, b])
+        if accept[s]:
+            out.append(i + 1)
+    return out
+
+
+def literal_dfa(needle: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """KMP-style literal DFA with the '\\n'-resets-to-start invariant."""
+    m = len(needle)
+    table = np.zeros((m + 1, 256), dtype=np.uint16)
+    accept = np.zeros(m + 1, dtype=np.uint8)
+    accept[m] = 1
+    fail = [0] * (m + 1)
+    for s in range(m + 1):
+        for c in range(256):
+            if s < m and c == needle[s]:
+                table[s, c] = s + 1
+            elif s == 0:
+                table[s, c] = 0
+            else:
+                table[s, c] = table[fail[s], c]
+        if s < m:
+            fail[s + 1] = int(table[fail[s], needle[s]])
+    table[:, 0x0A] = table[0, 0x0A]  # newline reset (the MT-scan contract)
+    return table, accept
+
+
+def surface() -> None:
+    rng = random.Random(7)
+    data = bytes(rng.choice(b"abcnedle\n") for _ in range(200_000))
+
+    # --- fnv32a / partition (incl. non-UTF-8 surrogateescape keys) ---------
+    for key in (b"", b"k", b"hello world", b"\xff\xfe\x00raw", "unié",
+                "sur" + "\udcff"):
+        kb = key.encode("utf-8", "surrogateescape") if isinstance(key, str) \
+            else key
+        check(native.fnv32a(key) == py_fnv32a(kb), f"fnv32a {key!r}")
+        check(0 <= native.partition(key, 7) < 7, f"partition {key!r}")
+
+    # --- newline_index -----------------------------------------------------
+    nl = native.newline_index(data)
+    expect = np.flatnonzero(np.frombuffer(data, np.uint8) == 0x0A)
+    check(np.array_equal(nl, expect.astype(np.uint64)), "newline_index")
+    check(native.newline_index(b"").size == 0, "newline_index empty")
+
+    # --- literal_scan (overlaps + regrow: 'aa' in 'aaaa...' doubles) -------
+    hay = b"aa" * 8000 + data
+    ends = native.literal_scan(hay, b"aa")
+    py_ends, start = [], 0
+    while True:
+        i = hay.find(b"aa", start)
+        if i < 0:
+            break
+        py_ends.append(i + 2)
+        start = i + 1
+    check(ends.tolist() == py_ends, "literal_scan overlapping + regrow")
+    check(native.literal_scan(hay, b"").size == 0, "literal_scan empty")
+    check(native.literal_scan(b"ab", b"abc").size == 0, "needle > hay")
+
+    # --- dfa_scan / dfa_scan_mt (forced threads; bit-identity) -------------
+    table, accept = literal_dfa(b"nedle")
+    offs, final = native.dfa_scan(data, table, accept)
+    check(offs.tolist() == py_dfa(data, table, accept), "dfa_scan")
+    check(0 <= final < table.shape[0], "dfa_scan final state")
+    mt = native.dfa_scan_mt(data, table, accept, n_threads=4)
+    check(mt.tolist() == offs.tolist(), "dfa_scan_mt == sequential")
+
+    # --- ConfirmSet: folds, shorts, regrow-sized candidate sets ------------
+    pats = [b"nedle", b"ab", b"z", b"needle", b"\xff\xferaw"]
+    for ci in (False, True):
+        norm = [p.lower() if ci else p for p in pats]
+        cs = native.ConfirmSet(norm, ignore_case=ci)
+        ref = native.ConfirmSet(norm, ignore_case=ci, use_native=False)
+        cand = np.arange(0, len(data), 3, dtype=np.uint64)
+        got = cs.confirm(data, cand, n_threads=4)
+        want = ref.confirm(data, cand)
+        check(np.array_equal(got, want), f"ConfirmSet ci={ci}")
+        del cs, ref  # dgrep_confirm_free under the sanitizer
+
+    # --- gather_ranges -----------------------------------------------------
+    arr = np.frombuffer(data, np.uint8)
+    starts = np.asarray([0, 10, 5, 199_990, 7, 7], dtype=np.int64)
+    stops = np.asarray([5, 20, 5, 200_000, 6, 107], dtype=np.int64)
+    lens = np.maximum(stops - starts, 0)
+    offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    got_b = native.gather_ranges_native(arr, starts, stops, offsets,
+                                        int(offsets[-1]))
+    want_b = b"".join(data[a:b] for a, b in zip(starts.tolist(),
+                                                stops.tolist()) if b > a)
+    check(got_b == want_b, "gather_ranges")
+
+    # --- format_batch (valid UTF-8 + the -2 refusal path) ------------------
+    lines3 = [b"line one", b"line two!", b"\xc3\xa9 accents"]
+    packed = b"".join(lines3)
+    po = np.zeros(len(lines3) + 1, dtype=np.int64)
+    np.cumsum([len(ln) for ln in lines3], out=po[1:])
+    linenos = np.asarray([3, 11, 222], dtype=np.int64)
+    prefix = "f\udcffile (line number #".encode("utf-8", "surrogateescape")
+    got_f = native.format_batch(prefix, linenos, po, packed)
+    want_f = b"".join(
+        prefix + str(n).encode() + b")\t" + packed[po[i]:po[i + 1]] + b"\n"
+        for i, n in enumerate(linenos.tolist())
+    )
+    check(got_f == want_f, "format_batch bytes")
+    bad = native.format_batch(b"p (line number #", linenos[:1],
+                              np.asarray([0, 2], dtype=np.int64), b"\xff\xff")
+    check(bad is None, "format_batch refuses non-UTF-8 slab")
+
+    # --- merge_display (k-way, codepoint path order, tie-break) ------------
+    def rec(path: bytes, n: int, text: bytes) -> bytes:
+        return path + b" (line number #" + str(n).encode() + b")\t" + text
+
+    b1 = b"\n".join([rec(b"a.txt", 1, b"x"), rec(b"b.txt", 9, b"y")]) + b"\n"
+    b2 = b"\n".join([rec(b"a.txt", 2, b"z"), rec(b"b.txt", 9, b"w")])  # no \n
+    got_m = native.merge_display([b1, b2])
+    want_m = (rec(b"a.txt", 1, b"x").replace(b"\t", b" ") + b"\n"
+              + rec(b"a.txt", 2, b"z").replace(b"\t", b" ") + b"\n"
+              + rec(b"b.txt", 9, b"y").replace(b"\t", b" ") + b"\n"
+              + rec(b"b.txt", 9, b"w").replace(b"\t", b" ") + b"\n")
+    check(got_m == want_m, "merge_display order + tab->space + final NL")
+    # surrogateescape codepoint order: raw byte 0xFF sorts AFTER valid é
+    b3 = rec(b"f\xff.t", 1, b"raw") + b"\n"
+    b4 = rec(b"f\xc3\xa9.t", 1, b"acc") + b"\n"
+    got_o = native.merge_display([b3, b4])
+    check(got_o is not None and got_o.index(b"acc") < got_o.index(b"raw"),
+          "merge_display surrogateescape codepoint order")
+    check(native.merge_display([b"not a grep key\n"]) is None,
+          "merge_display refuses non-grep-shaped")
+
+    print("surface ok")
+
+
+def stress() -> None:
+    """Shared-state threaded stress: one DFA table + one ConfirmSet used
+    by concurrent scans, plus each scan internally fanning out threads —
+    the pthread surface TSan instruments."""
+    rng = random.Random(11)
+    data = bytes(rng.choice(b"xyneedle\n") for _ in range(400_000))
+    table, accept = literal_dfa(b"needle")
+    pats = [b"needle", b"ne", b"edle", b"x"]
+    cs = native.ConfirmSet(pats)
+    seq = native.dfa_scan_mt(data, table, accept, n_threads=1).tolist()
+    cand = np.arange(0, len(data), 2, dtype=np.uint64)
+    want_mask = cs.confirm(data, cand, n_threads=1)
+    errors: list[str] = []
+
+    def pound(idx: int) -> None:
+        for _ in range(6):
+            got = native.dfa_scan_mt(data, table, accept, n_threads=4)
+            if got.tolist() != seq:
+                errors.append(f"thread {idx}: dfa_scan_mt diverged")
+                return
+            mask = cs.confirm(data, cand, n_threads=4)
+            if not np.array_equal(mask, want_mask):
+                errors.append(f"thread {idx}: confirm diverged")
+                return
+
+    threads = [threading.Thread(target=pound, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    check(not errors, "; ".join(errors) or "stress")
+    print("stress ok")
+
+
+if __name__ == "__main__":
+    check(os.environ.get("DGREP_NATIVE_LIB", "") != "",
+          "driver needs DGREP_NATIVE_LIB")
+    check(native.native_available(), "native library failed to load")
+    mode = sys.argv[1] if len(sys.argv) > 1 else "surface"
+    {"surface": surface, "stress": stress}[mode]()

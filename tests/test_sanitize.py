@@ -1,0 +1,159 @@
+"""Sanitizer matrix over the native tier (ISSUE 5 leg 2): the whole
+libdgrep surface re-runs under ASan+UBSan and TSan builds — the only race
+detection the C++ side has (the MT DFA scanner and the confirm pool are
+pthread code reviewed by eyeball until now).
+
+Each case builds the instrumented library (``make -C native sanitize`` /
+``tsan``), then runs tests/_native_sanitize_driver.py in a SUBPROCESS with
+the sanitizer runtime LD_PRELOADed (a sanitized DSO cannot be dlopen'd
+into a plain process otherwise) and ``DGREP_NATIVE_LIB`` selecting the
+build — the utils/native.py override this PR adds.  halt-on-error is on,
+so any report is a nonzero exit; stderr is additionally screened for
+report markers.
+
+Standalone-runnable:  python -m pytest tests/ -q -m sanitize
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.sanitize
+
+REPO = Path(__file__).resolve().parents[1]
+NATIVE = REPO / "native"
+DRIVER = Path(__file__).parent / "_native_sanitize_driver.py"
+
+_REPORT_MARKERS = (
+    "ERROR: AddressSanitizer",
+    "runtime error:",  # UBSan
+    "WARNING: ThreadSanitizer",
+    "ERROR: LeakSanitizer",
+)
+
+
+def _cxx() -> str | None:
+    return shutil.which(os.environ.get("CXX", "g++"))
+
+
+def _runtime_so(name: str) -> str | None:
+    """Path of the sanitizer runtime to LD_PRELOAD, via the compiler."""
+    cxx = _cxx()
+    if cxx is None:
+        return None
+    out = subprocess.run([cxx, f"-print-file-name={name}"],
+                         capture_output=True, text=True).stdout.strip()
+    return out if out and "/" in out and Path(out).exists() else None
+
+
+def _build(target: str, lib: str) -> Path:
+    if _cxx() is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain in this container")
+    r = subprocess.run(["make", "-C", str(NATIVE), target],
+                       capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        pytest.skip(f"make {target} failed:\n{r.stdout}\n{r.stderr}")
+    return NATIVE / lib
+
+
+@pytest.fixture(scope="session")
+def asan_lib() -> Path:
+    if _runtime_so("libasan.so") is None:
+        pytest.skip("libasan runtime not found")
+    return _build("sanitize", "libdgrep-asan.so")
+
+
+@pytest.fixture(scope="session")
+def tsan_lib() -> Path:
+    if _runtime_so("libtsan.so") is None:
+        pytest.skip("libtsan runtime not found")
+    return _build("tsan", "libdgrep-tsan.so")
+
+
+def _run_driver(lib: Path, preload: str, mode: str,
+                extra_env: dict[str, str]) -> None:
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(REPO),
+        LD_PRELOAD=preload,
+        DGREP_NATIVE_LIB=str(lib),
+        JAX_PLATFORMS="cpu",
+        OPENBLAS_NUM_THREADS="1",  # uninstrumented BLAS pool: TSan noise
+        **extra_env,
+    )
+    r = subprocess.run([sys.executable, str(DRIVER), mode],
+                       capture_output=True, text=True, env=env, timeout=300)
+    output = r.stdout + r.stderr
+    assert r.returncode == 0, f"driver {mode} failed under {lib.name}:\n{output}"
+    for marker in _REPORT_MARKERS:
+        assert marker not in output, f"sanitizer report:\n{output}"
+    assert f"{mode} ok" in r.stdout
+
+
+_ASAN_ENV = {
+    # leak detection off: CPython interns/arenas are not leaks; abort (not
+    # exit) so a report can never be mistaken for a clean pass
+    "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+    "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+}
+_TSAN_ENV = {
+    # report_thread_leaks off: daemon helper threads (the engine's reader
+    # pool contract) are by-design never joined
+    "TSAN_OPTIONS": "halt_on_error=1:report_thread_leaks=0:exitcode=66",
+}
+
+
+def test_asan_ubsan_surface(asan_lib):
+    _run_driver(asan_lib, _runtime_so("libasan.so"), "surface", _ASAN_ENV)
+
+
+def test_asan_ubsan_threaded_stress(asan_lib):
+    _run_driver(asan_lib, _runtime_so("libasan.so"), "stress", _ASAN_ENV)
+
+
+def test_tsan_surface(tsan_lib):
+    _run_driver(tsan_lib, _runtime_so("libtsan.so"), "surface", _TSAN_ENV)
+
+
+def test_tsan_threaded_stress(tsan_lib):
+    """The pthread race matrix: concurrent scans sharing one DFA table and
+    one ConfirmSet, each internally fanning out worker threads."""
+    _run_driver(tsan_lib, _runtime_so("libtsan.so"), "stress", _TSAN_ENV)
+
+
+def test_native_lib_override_bad_path_raises():
+    """DGREP_NATIVE_LIB pointing nowhere must RAISE (subprocess: the load
+    verdict is cached process-wide) — an explicit build selection that
+    silently fell back to Python would make this whole matrix vacuous."""
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu",
+               DGREP_NATIVE_LIB="/nonexistent/libdgrep-missing.so")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from distributed_grep_tpu.utils import native\n"
+         "try:\n"
+         "    native.native_available()\n"
+         "except OSError:\n"
+         "    print('RAISED')\n"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0 and "RAISED" in r.stdout, r.stdout + r.stderr
+
+
+def test_plain_build_still_default():
+    """Without the override the ordinary libdgrep.so path stays in force
+    (subprocess, again because of the process-wide cache)."""
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    env.pop("DGREP_NATIVE_LIB", None)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from distributed_grep_tpu.utils import native\n"
+         "print('AVAIL', native.native_available())\n"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
