@@ -1,0 +1,154 @@
+"""Cold-vs-warm submit latency against the grep-as-a-service daemon.
+
+ISSUE 6's acceptance bar: a repeated pattern's second submit to a running
+daemon must be strictly faster than the first on this CPU box, because the
+cross-job compiled-model cache (ops/engine.cached_engine) skips engine
+construction — off-chip, AC-bank/model compile for a large literal set
+dominates a small job's wall, so the effect is CPU-measurable (on a real
+chip the same cache additionally skips the ~20-40 s first XLA/Mosaic
+compile per fresh shape key).
+
+    python benchmarks/service_warm.py [--patterns 1500] [--warm-reps 3]
+        [--check]
+
+Drives the REAL surface end to end: ServiceServer HTTP API (POST /jobs,
+GET /jobs/<id>), one in-process worker (deterministic warm path: the one
+worker's second configure must come from the cache, not a sibling's).
+Submits alternate between two equal-sized pattern sets A/B so every warm
+submit pays a real reconfigure THROUGH the cache (the app-level same-config
+short-circuit cannot answer it).  Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import string
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+# Runnable as `python benchmarks/...` from anywhere: the repo root joins
+# the FRONT of sys.path so the checkout being benchmarked always wins.
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+
+# CPU-pinned (CLAUDE.md environment rules): ASSIGN, never setdefault — a
+# tunneled-TPU default backend would price the submit path with device
+# dispatch latency, and this benchmark measures host-side model build —
+# AND pop the axon plugin factory: backend discovery calls every
+# registered factory even under jax_platforms=cpu, and a black-holed
+# tunnel blocks that call forever (same as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DGREP_NO_CALIBRATE", "1")
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+
+def _pattern_set(n: int, seed: int) -> list[str]:
+    rng = random.Random(seed)
+    out = set()
+    while len(out) < n:
+        out.add("".join(
+            rng.choice(string.ascii_lowercase)
+            for _ in range(rng.randint(5, 12))
+        ))
+    return sorted(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--patterns", type=int, default=1500,
+                    help="literal-set size per job (model build dominates)")
+    ap.add_argument("--warm-reps", type=int, default=3,
+                    help="warm submits per set; the MIN is reported")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless warm < cold")
+    args = ap.parse_args()
+
+    from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    root = Path(tempfile.mkdtemp(prefix="dgrep-svc-warm-"))
+    corpus = root / "corpus.txt"
+    corpus.write_bytes(b"".join(
+        f"line {i} with some words in it\n".encode() for i in range(2000)
+    ))
+
+    service = GrepService(work_root=root / "svc")
+    server = ServiceServer(service)
+    server.start()
+    service.start_local_workers(1)
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method: str, path: str, body: bytes | None = None) -> dict:
+        req = urllib.request.Request(f"{base}{path}", data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def submit_and_wait(patterns: list[str]) -> float:
+        cfg = JobConfig(
+            input_files=[str(corpus)],
+            application="distributed_grep_tpu.apps.grep_tpu",
+            app_options={"patterns": patterns, "backend": "cpu"},
+            n_reduce=2,
+            journal=False,
+        )
+        t0 = time.perf_counter()
+        job_id = call("POST", "/jobs", cfg.to_json().encode("utf-8"))["job_id"]
+        while True:
+            st = call("GET", f"/jobs/{job_id}")
+            if st["state"] in ("done", "failed", "cancelled"):
+                break
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+        if st["state"] != "done":
+            raise RuntimeError(f"job {job_id} ended {st['state']}: {st}")
+        return dt
+
+    set_a = _pattern_set(args.patterns, seed=1)
+    set_b = _pattern_set(args.patterns, seed=2)
+
+    # cold: first time each set is seen (engine constructed, cache miss)
+    cold_a = submit_and_wait(set_a)
+    cold_b = submit_and_wait(set_b)
+    # warm: alternate A/B so every submit reconfigures through the cache
+    warm = []
+    for _ in range(args.warm_reps):
+        warm.append(submit_and_wait(set_a))
+        warm.append(submit_and_wait(set_b))
+    cache = call("GET", "/status")["compile_cache"]
+    service.stop()
+    server.shutdown()
+
+    cold_s = min(cold_a, cold_b)
+    warm_s = min(warm)
+    rec = {
+        "bench": "service_warm",
+        "patterns": args.patterns,
+        "cold_s": round(cold_s, 4),
+        "warm_s": round(warm_s, 4),
+        "speedup": round(cold_s / warm_s, 3) if warm_s else 0.0,
+        "compile_cache_hits": int(cache.get("compile_cache_hits", 0)),
+        "compile_cache_misses": int(cache.get("compile_cache_misses", 0)),
+    }
+    print(json.dumps(rec))  # exactly one JSON line (driver contract shape)
+    if args.check and not warm_s < cold_s:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1081,6 +1081,118 @@ def cmd_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Grep-as-a-service daemon (runtime/service.py): a long-lived
+    multi-tenant coordinator serving a stream of jobs over persistent
+    workers and engines.  Blocks until SIGINT/SIGTERM; remote workers
+    attach with `worker --addr`, clients submit with `submit --addr`."""
+    import signal
+    import tempfile
+    import threading
+
+    from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
+
+    work_root = args.work_root or tempfile.mkdtemp(prefix="dgrep-svc-")
+    service = GrepService(
+        work_root=work_root,
+        max_jobs=args.max_jobs,
+        queue_depth=args.queue,
+        spans=args.spans,
+    )
+    server = ServiceServer(service, host=args.host, port=args.port)
+    server.start()
+    if args.workers:
+        service.start_local_workers(args.workers)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (tests drive the service directly)
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    server.shutdown()
+    service.stop()
+    # stdout contract (mirrors cmd_coordinator): exactly one JSON line —
+    # the final service status snapshot
+    print(json.dumps(service.status()))
+    return 0
+
+
+def cmd_submit(args: argparse.Namespace) -> int:
+    """Client for a running service daemon: POST the job, optionally wait
+    for completion, print exactly ONE JSON line (job_id/state/outputs)."""
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    if args.config:
+        cfg = JobConfig.load(args.config)
+    elif args.pattern is not None and args.files:
+        from pathlib import Path as _Path
+
+        cfg = JobConfig(
+            input_files=[str(_Path(f).resolve()) for f in args.files],
+            application="distributed_grep_tpu.apps.grep_tpu",
+            app_options={
+                "pattern": args.pattern,
+                "backend": args.backend,
+                **({"ignore_case": True} if args.ignore_case else {}),
+            },
+            n_reduce=args.n_reduce or 10,
+        )
+    else:
+        print("error: need --config, or PATTERN and FILE arguments",
+              file=sys.stderr)
+        return 2
+    base = args.addr if args.addr.startswith("http") else f"http://{args.addr}"
+
+    def call(method: str, path: str, body: bytes | None = None) -> dict:
+        req = urllib.request.Request(f"{base}{path}", data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=args.timeout) as r:
+            return json.loads(r.read())
+
+    try:
+        # to_json() is ensure_ascii json.dumps output: strict is exact
+        reply = call("POST", "/jobs", cfg.to_json().encode("utf-8", "strict"))
+    except urllib.error.HTTPError as e:
+        detail = e.read()[:500].decode("utf-8", "replace")
+        print(f"error: submit rejected ({e.code}): {detail}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot reach service at {args.addr}: {e}",
+              file=sys.stderr)
+        return 2
+    job_id = reply["job_id"]
+    if not args.wait:
+        print(json.dumps({"job_id": job_id, "state": "submitted"}))
+        return 0
+    deadline = _time.monotonic() + args.timeout
+    status: dict = {}
+    out = {"job_id": job_id, "state": "unknown"}
+    try:
+        # the job is admitted: from here every outcome — daemon restart
+        # mid-poll included — still prints exactly ONE JSON line
+        while _time.monotonic() < deadline:
+            status = call("GET", f"/jobs/{job_id}")
+            if status.get("state") in ("done", "failed", "cancelled"):
+                break
+            _time.sleep(0.2)
+        out["state"] = status.get("state", "unknown")
+        if status.get("state") == "done":
+            out["outputs"] = call("GET", f"/jobs/{job_id}/result")["outputs"]
+        elif status.get("error"):
+            out["error"] = status["error"]
+    except OSError as e:  # urllib.error.* are OSError subclasses
+        out["error"] = f"lost service at {args.addr}: {e}"
+    print(json.dumps(out))
+    return 0 if out["state"] == "done" else 1
+
+
 def cmd_trace_export(args: argparse.Namespace) -> int:
     """Render a job's events.jsonl (the span pipeline's persisted event
     log, utils/spans.py) as Chrome trace_event JSON — loadable in Perfetto
@@ -1293,6 +1405,56 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--addr", required=True, help="coordinator http address host:port")
     p.add_argument("--slots", type=int, default=1, help="parallel task slots")
     p.set_defaults(fn=cmd_worker)
+
+    p = sub.add_parser(
+        "serve",
+        help="grep-as-a-service daemon: persistent multi-tenant coordinator "
+             "serving a stream of jobs (submit with `submit --addr`)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = ephemeral; the bound port is "
+                        "logged at startup)")
+    p.add_argument("--work-root", default=None,
+                   help="root directory for per-job work dirs "
+                        "(default: a fresh temp dir)")
+    p.add_argument("--workers", type=int, default=2,
+                   help="in-process worker loops to attach (0 = none; "
+                        "remote workers attach via `worker --addr`)")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="concurrent running-job cap "
+                        "(DGREP_SERVICE_MAX_JOBS overrides)")
+    p.add_argument("--queue", type=int, default=None,
+                   help="queued-submission cap, admission control "
+                        "(DGREP_SERVICE_QUEUE overrides)")
+    p.add_argument("--spans", action="store_true",
+                   help="span pipeline for every job (per-job events.jsonl)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "submit",
+        help="submit a job to a running service daemon and print one JSON "
+             "line (job_id, state, outputs)",
+    )
+    p.add_argument("--addr", required=True, help="service http address host:port")
+    p.add_argument("--config", default=None,
+                   help="job config JSON (like `run --config`); otherwise "
+                        "give PATTERN and FILE arguments")
+    p.add_argument("pattern", nargs="?", default=None)
+    p.add_argument("files", nargs="*")
+    p.add_argument("-i", "--ignore-case", action="store_true")
+    p.add_argument("--backend", default="cpu", choices=["cpu", "device"],
+                   help="engine backend for the PATTERN/FILE form (default "
+                        "cpu: host scanners, no jax import on the workers; "
+                        "device engages the TPU path — and the warm-compile "
+                        "amortization — on accelerator deployments)")
+    p.add_argument("--n-reduce", type=int, default=None)
+    p.add_argument("--no-wait", dest="wait", action="store_false",
+                   help="return after submission instead of waiting for "
+                        "completion")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="overall wait budget in seconds (with waiting on)")
+    p.set_defaults(fn=cmd_submit, wait=True)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -91,6 +91,23 @@ KNOBS: dict[str, Knob] = {
         "utils/logging.py", "INFO",
         "Log level for the structured control-plane logger.",
     ),
+    "DGREP_SERVICE_MAX_JOBS": Knob(
+        "runtime/service.py", "4",
+        "Concurrent running-job cap of the grep-as-a-service daemon "
+        "(accessor: runtime/service.env_service_max_jobs).",
+    ),
+    "DGREP_SERVICE_QUEUE": Knob(
+        "runtime/service.py", "64",
+        "Queued-submission cap (admission control) of the service daemon; "
+        "submits beyond it answer 429 (accessor: env_service_queue).",
+    ),
+    "DGREP_MODEL_CACHE": Knob(
+        "ops/engine.py", "32",
+        "Entry cap of the cross-job compiled-model cache (0 disables; "
+        "accessor: ops/engine.env_model_cache_entries) — a cache hit "
+        "returns the same engine, skipping model compile and the "
+        "per-shape compile-grace path.",
+    ),
     "DGREP_NATIVE_LIB": Knob(
         "utils/native.py", "unset",
         "Absolute path of the libdgrep build to load instead of "

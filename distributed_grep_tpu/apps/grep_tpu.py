@@ -19,9 +19,10 @@ from __future__ import annotations
 import numpy as _np
 
 from distributed_grep_tpu.apps.base import KeyValue
-from distributed_grep_tpu.ops.engine import GrepEngine
+from distributed_grep_tpu.ops.engine import GrepEngine, cached_engine
 from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
 from distributed_grep_tpu.runtime.columnar import make_batch_from_lines
+from distributed_grep_tpu.utils import spans as _spans_mod
 
 # Reduce is values[0] and keys are unique per (file, line): the runtime's
 # identity-reduce collator may keep map output COLUMNAR end to end and
@@ -119,13 +120,22 @@ def configure(
            tuple(sorted(engine_opts.items())))
     if key == _configured_with:
         return
-    _engine = GrepEngine(
+    # Cross-job compiled-model cache (ops/engine.cached_engine): in the
+    # service regime a repeated pattern returns the SAME engine object —
+    # model compile, device-table uploads, and the per-shape compile-grace
+    # bookkeeping are all skipped on the hit.  Mesh engines bypass the
+    # cache (no stable key); the verdict instant lands on this task's
+    # trace row when the span pipeline is on.
+    _engine, cache_verdict = cached_engine(
         pattern if patterns is None else None,
         patterns=patterns,
         ignore_case=ignore_case,
         backend=backend,
         **engine_opts,  # type: ignore[arg-type]
     )
+    if cache_verdict != "off":
+        _spans_mod.instant(f"cache:{cache_verdict}", cat="engine",
+                           mode=_engine.mode)
     # grep -w / -x: the device scan stays on the raw pattern (its matched
     # lines are a SUPERSET of word/line matches — a word/line match is in
     # particular a substring match), and each candidate line is confirmed

@@ -164,6 +164,12 @@ def swap_fdr_plan(eng, pricing, reason: str) -> None:
             f"measured pricing ({e})"
         )
         eng._fdr_pricing = pricing
+        # the engine no longer answers for its construction args (mode
+        # changed under measured pricing): the cross-job cache must not
+        # hand this corpus-specific verdict to the next job
+        from distributed_grep_tpu.ops.engine import invalidate_cached_engine
+
+        invalidate_cached_engine(eng)
         return
     old = [(b.m, b.checks) for b in eng.fdr.banks]
     new = [(b.m, b.checks) for b in model.banks]
@@ -178,6 +184,12 @@ def swap_fdr_plan(eng, pricing, reason: str) -> None:
         eng._fdr_dev_tables = None
         eng._fdr_ep_dev_tables = None
         eng._model_gen += 1  # new plan = new kernel compile: re-grace
+        # model_gen bump = the cached entry's compiled model is stale for
+        # OTHER jobs (plan tuned under this corpus's measured candidate
+        # rates): evict so the next lookup recompiles from base pricing
+        from distributed_grep_tpu.ops.engine import invalidate_cached_engine
+
+        invalidate_cached_engine(eng)
     eng._fdr_pricing = pricing
 
 def maybe_retune_fdr(eng, n_bytes: int) -> None:

@@ -230,6 +230,154 @@ def _thread_reader_pool():
 # (C, ~GB/s, vectorized line mapping) resolves everything instead.
 SPAN_CONFIRM_LINE_LIMIT = 4096
 
+# ------------------------------------------------ cross-job model cache
+# The grep-as-a-service regime (runtime/service.py) reconfigures engines
+# per task as jobs multiplex over shared workers; without a cache every
+# pattern re-pays model compile (AC banks, FDR plans, Glushkov builds)
+# and — on a real chip — the ~20-40 s first XLA/Mosaic compile per fresh
+# (mode, mesh, model_gen, shape) key.  cached_engine() memoizes whole
+# engines by their construction arguments: a cache hit returns the SAME
+# engine object, so its _compiled_keys / jit caches / uploaded device
+# tables come along for free and the compile-grace path is skipped on
+# the repeat submit.  Engines are scan-thread-safe by construction
+# (thread-local stats/nl stash, per-thread reader pools — the same
+# contract concurrent worker slots already rely on), so sharing one
+# across jobs is the round-4 sharing story, widened.
+DEFAULT_MODEL_CACHE_ENTRIES = 32
+
+
+def env_model_cache_entries(default: int = DEFAULT_MODEL_CACHE_ENTRIES) -> int:
+    """Entry cap for the cross-job compiled-model cache — the ONE parser
+    of DGREP_MODEL_CACHE (0 disables caching; malformed keeps the
+    default, matching env_batch_bytes' shrug-off policy)."""
+    raw = _os.environ.get("DGREP_MODEL_CACHE")
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
+from collections import OrderedDict as _OrderedDict
+
+_model_cache_lock = _threading_mod.Lock()
+_model_cache: "_OrderedDict[tuple, GrepEngine]" = _OrderedDict()
+# Counters get their OWN lock: cached_engine holds _model_cache_lock across
+# a whole engine construction (seconds for big literal sets), and every
+# scan() stamps these counters into its stats — the stamp must never stall
+# behind another thread's compile.
+_model_cache_stats_lock = _threading_mod.Lock()
+_model_cache_stats = {
+    "compile_cache_hits": 0,
+    "compile_cache_misses": 0,
+    "compile_cache_evictions": 0,
+}
+
+
+def _count_cache(key: str, n: int = 1) -> None:
+    with _model_cache_stats_lock:
+        _model_cache_stats[key] += n
+
+
+def model_cache_counters() -> dict:
+    """Copy of the cache counters, or {} when the cache was never touched
+    (so zero-activity processes never grow stats/piggyback keys)."""
+    with _model_cache_stats_lock:
+        if not any(_model_cache_stats.values()):
+            return {}
+        return dict(_model_cache_stats)
+
+
+def model_cache_clear() -> None:
+    """Drop every cached engine and zero the counters (tests)."""
+    with _model_cache_lock:
+        _model_cache.clear()
+        with _model_cache_stats_lock:
+            for k in _model_cache_stats:
+                _model_cache_stats[k] = 0
+
+
+def invalidate_cached_engine(eng: "GrepEngine") -> None:
+    """Evict an engine whose compiled model changed underneath its cache
+    key — the FDR retune path (ops/device_scan.swap_fdr_plan) bumps
+    _model_gen when it adopts a recompiled plan, and that plan was tuned
+    under ONE corpus's measured candidate rates: the next job asking for
+    this pattern must start from the base pricing, not inherit another
+    corpus's calibration."""
+    with _model_cache_lock:
+        evicted = 0
+        for k in [k for k, v in _model_cache.items() if v is eng]:
+            del _model_cache[k]
+            evicted += 1
+    if evicted:
+        _count_cache("compile_cache_evictions", evicted)
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def cached_engine(
+    pattern: str | None = None,
+    *,
+    patterns: list[str] | None = None,
+    **kw,
+) -> tuple["GrepEngine", str]:
+    """A (possibly shared) engine for these construction args, plus the
+    cache verdict: "hit" (same object as a previous call — model compile
+    AND the per-shape compile-grace bookkeeping are skipped), "miss"
+    (constructed and cached), or "off" (cache disabled via
+    DGREP_MODEL_CACHE=0, or the args are uncacheable — mesh engines are
+    EXPLICITLY bypassed: jax.sharding.Mesh hashes by value, but equal-
+    shaped meshes over different device sets would collide, and a mesh
+    engine's sharded state is tied to ITS devices — always construct
+    fresh; an explicit devices= LIST is bypassed for the same reason,
+    while the symbolic devices="all" (the grep_tpu default) stays
+    cacheable).
+
+    Construction runs UNDER the cache lock: two workers racing the same
+    pattern serialize into one compile + one hit instead of two compiles
+    (the whole point in the service regime); distinct-pattern
+    constructions serialize too — the accepted cost, bounded by one
+    model compile."""
+    cap = env_model_cache_entries()
+    key: tuple | None = (
+        pattern,
+        _hashable(patterns) if patterns is not None else None,
+        _hashable(kw),
+    )
+    dev = kw.get("devices")
+    if kw.get("mesh") is not None or not (dev is None or isinstance(dev, str)):
+        key = None
+    else:
+        try:
+            hash(key)
+        except TypeError:
+            key = None
+    if cap <= 0 or key is None:
+        return GrepEngine(pattern, patterns=patterns, **kw), "off"
+    with _model_cache_lock:
+        eng = _model_cache.get(key)
+        if eng is not None:
+            _model_cache.move_to_end(key)
+            _count_cache("compile_cache_hits")
+            return eng, "hit"
+        eng = GrepEngine(pattern, patterns=patterns, **kw)
+        _model_cache[key] = eng
+        _count_cache("compile_cache_misses")
+        evicted = 0
+        while len(_model_cache) > cap:
+            _model_cache.popitem(last=False)
+            evicted += 1
+        if evicted:
+            _count_cache("compile_cache_evictions", evicted)
+        return eng, "miss"
+
 
 @dataclass
 class ScanResult:
@@ -905,6 +1053,13 @@ class GrepEngine:
             res = ScanResult(
                 ml.astype(np.int64), int(ml.size), res.bytes_scanned
             )
+        cc = model_cache_counters()
+        if cc:
+            # cross-job model-cache telemetry rides engine.stats (and from
+            # there the scan_record piggyback readers): stamped only when
+            # the cache has ever been touched, so cache-free processes
+            # keep their exact stats shape
+            self.stats.update(cc)
         if t0 is not None:
             # after the EOL fix-up: the record's match count must equal the
             # ScanResult the caller actually receives

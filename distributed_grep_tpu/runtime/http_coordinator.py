@@ -185,83 +185,111 @@ class CoordinatorServer:
         }
 
 
+class DataPlaneHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing for the one-shot coordinator and the service
+    daemon (runtime/service.py): JSON replies, block-streamed file GET with
+    prefix-Range resume, store-routed PUT bodies, bounded body drain, and
+    the per-task commit-record PUT.  Subclasses own routing (do_GET/PUT/
+    POST) and supply the store/work-dir context per request."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route through our logger, DEBUG only
+        log.debug("http: " + fmt, *args)
+
+    def _send_json(self, obj: dict, code: int = 200) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_file(self, path) -> None:
+        """Stream a file in BLOCK_BYTES chunks; honors a single
+        'Range: bytes=N-' prefix range (206 + Content-Range) so a
+        worker whose download died mid-body can resume instead of
+        refetching the whole split."""
+        import shutil
+
+        size = path.stat().st_size
+        start = 0
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            spec = rng[len("bytes="):].split(",")[0].strip()
+            lo, _, hi = spec.partition("-")
+            if lo.isdigit() and (not hi or hi.isdigit()):
+                start = int(lo)
+                # open-ended or to-EOF prefix ranges only, and only
+                # inside the file; anything else (incl. start >= size —
+                # a 206 with 'bytes N-(N-1)' would be malformed) falls
+                # back to a full 200, which the client handles by
+                # restarting its spool
+                if start >= size or (hi and int(hi) != size - 1):
+                    start = 0
+        with open(path, "rb") as f:
+            f.seek(start)
+            if start:
+                self.send_response(206)
+                self.send_header("Content-Range", f"bytes {start}-{size-1}/{size}")
+            else:
+                self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(size - start))
+            self.end_headers()
+            # headers are out: from here a failure must NOT write a JSON
+            # error into the half-sent body (the client's Range resume
+            # would silently splice those bytes into file content)
+            self._streaming_body = True
+            shutil.copyfileobj(f, self.wfile, BLOCK_BYTES)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _receive_file(self, store, dst) -> None:
+        """Stream the PUT body straight through the work dir's store
+        commit protocol (temp+rename on posix, part+record on
+        non-atomic) — the body never materializes in coordinator
+        memory."""
+        length = int(self.headers.get("Content-Length", 0))
+        store.put_from_stream(dst, self.rfile, length, BLOCK_BYTES)
+
+    def _drain_body(self) -> None:
+        """Discard a request body in bounded blocks (404 paths must not
+        buffer a multi-GB body just to answer)."""
+        remaining = int(self.headers.get("Content-Length", 0))
+        while remaining > 0:
+            block = self.rfile.read(min(BLOCK_BYTES, remaining))
+            if not block:
+                break
+            remaining -= len(block)
+
+    def _put_commit(self, store, commits_dir, name: str) -> None:
+        """Per-task commit record publication (runtime/store.py): name is
+        "<kind>-<task_id>.<attempt>", body the payload.  Sends the HTTP
+        reply itself (shared by the coordinator and service routes)."""
+        kind_tid, _, attempt = name.partition(".")
+        kind, _, tid = kind_tid.rpartition("-")
+        if kind not in ("map", "reduce") or not tid.isdigit() or not attempt:
+            self._drain_body()
+            self._send_json({"error": f"bad commit name: {name}"}, 400)
+            return
+        if int(self.headers.get("Content-Length", 0)) > 1 << 20:
+            self._drain_body()
+            self._send_json({"error": "commit record too large"}, 413)
+            return
+        body = self._read_body()
+        store.commit_task(
+            commits_dir, kind, int(tid), attempt, json.loads(body or b"{}"),
+        )
+        self._send_json({"ok": True})
+
+
 def _make_handler(server: CoordinatorServer):
     workdir = server.workdir
 
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, fmt, *args):  # route through our logger, DEBUG only
-            log.debug("http: " + fmt, *args)
-
-        def _send_json(self, obj: dict, code: int = 200) -> None:
-            body = json.dumps(obj).encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def _send_file(self, path) -> None:
-            """Stream a file in BLOCK_BYTES chunks; honors a single
-            'Range: bytes=N-' prefix range (206 + Content-Range) so a
-            worker whose download died mid-body can resume instead of
-            refetching the whole split."""
-            import shutil
-
-            size = path.stat().st_size
-            start = 0
-            rng = self.headers.get("Range")
-            if rng and rng.startswith("bytes="):
-                spec = rng[len("bytes="):].split(",")[0].strip()
-                lo, _, hi = spec.partition("-")
-                if lo.isdigit() and (not hi or hi.isdigit()):
-                    start = int(lo)
-                    # open-ended or to-EOF prefix ranges only, and only
-                    # inside the file; anything else (incl. start >= size —
-                    # a 206 with 'bytes N-(N-1)' would be malformed) falls
-                    # back to a full 200, which the client handles by
-                    # restarting its spool
-                    if start >= size or (hi and int(hi) != size - 1):
-                        start = 0
-            with open(path, "rb") as f:
-                f.seek(start)
-                if start:
-                    self.send_response(206)
-                    self.send_header("Content-Range", f"bytes {start}-{size-1}/{size}")
-                else:
-                    self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(size - start))
-                self.end_headers()
-                # headers are out: from here a failure must NOT write a JSON
-                # error into the half-sent body (the client's Range resume
-                # would silently splice those bytes into file content)
-                self._streaming_body = True
-                shutil.copyfileobj(f, self.wfile, BLOCK_BYTES)
-
-        def _read_body(self) -> bytes:
-            length = int(self.headers.get("Content-Length", 0))
-            return self.rfile.read(length) if length else b""
-
-        def _receive_file(self, dst) -> None:
-            """Stream the PUT body straight through the work dir's store
-            commit protocol (temp+rename on posix, part+record on
-            non-atomic) — the body never materializes in coordinator
-            memory."""
-            length = int(self.headers.get("Content-Length", 0))
-            server.store.put_from_stream(dst, self.rfile, length, BLOCK_BYTES)
-
-        def _drain_body(self) -> None:
-            """Discard a request body in bounded blocks (404 paths must not
-            buffer a multi-GB body just to answer)."""
-            remaining = int(self.headers.get("Content-Length", 0))
-            while remaining > 0:
-                block = self.rfile.read(min(BLOCK_BYTES, remaining))
-                if not block:
-                    break
-                remaining -= len(block)
-
+    class Handler(DataPlaneHandler):
         # --- POST /rpc/<verb> ---------------------------------------------
         def do_POST(self):
             try:
@@ -335,32 +363,15 @@ def _make_handler(server: CoordinatorServer):
             try:
                 if self.path.startswith("/data/intermediate/"):
                     name = _safe_name(self.path[len("/data/intermediate/") :])
-                    self._receive_file(workdir.root / "intermediate" / name)
+                    self._receive_file(server.store, workdir.root / "intermediate" / name)
                     self._send_json({"ok": True})
                 elif self.path.startswith("/data/out/"):
                     name = _safe_name(self.path[len("/data/out/") :])
-                    self._receive_file(workdir.root / "out" / name)
+                    self._receive_file(server.store, workdir.root / "out" / name)
                     self._send_json({"ok": True})
                 elif self.path.startswith("/data/commit/"):
-                    # per-task commit record publication (runtime/store.py):
-                    # name is "<kind>-<task_id>.<attempt>", body the payload
                     name = _safe_name(self.path[len("/data/commit/") :])
-                    kind_tid, _, attempt = name.partition(".")
-                    kind, _, tid = kind_tid.rpartition("-")
-                    if kind not in ("map", "reduce") or not tid.isdigit() or not attempt:
-                        self._drain_body()
-                        self._send_json({"error": f"bad commit name: {name}"}, 400)
-                        return
-                    if int(self.headers.get("Content-Length", 0)) > 1 << 20:
-                        self._drain_body()
-                        self._send_json({"error": "commit record too large"}, 413)
-                        return
-                    body = self._read_body()
-                    server.store.commit_task(
-                        workdir.commits_dir(), kind, int(tid), attempt,
-                        json.loads(body or b"{}"),
-                    )
-                    self._send_json({"ok": True})
+                    self._put_commit(server.store, workdir.commits_dir(), name)
                 else:
                     self._drain_body()  # bounded drain so the 404 gets through
                     self._send_json({"error": "not found"}, 404)

@@ -131,8 +131,15 @@ class HttpTransport:
         return None
 
     # ---------------------------------------------------------- data plane
+    def _data_path(self, kind: str, name: str) -> str:
+        """URL path of one data-plane object.  The service transport
+        (ServiceHttpTransport) overrides this with a job-scoped prefix —
+        every data-plane method routes through here so the two can never
+        diverge on an endpoint."""
+        return f"/data/{kind}/{urllib.parse.quote(name, safe='')}"
+
     def read_input(self, filename: str) -> bytes:
-        return self._request("GET", f"/data/input/{urllib.parse.quote(filename, safe='')}")
+        return self._request("GET", self._data_path("input", filename))
 
     def read_input_path(self, filename: str):
         """(local_path, is_temp): stream the split to a spool file so the
@@ -149,7 +156,7 @@ class HttpTransport:
         import tempfile
 
         spool_dir = os.environ.get("DGREP_SPOOL_DIR") or None
-        url = f"{self.base}/data/input/{urllib.parse.quote(filename, safe='')}"
+        url = f"{self.base}{self._data_path('input', filename)}"
         deadline: float | None = None
         tmp = tempfile.NamedTemporaryFile(
             prefix="dgrep-in-", dir=spool_dir, delete=False
@@ -193,13 +200,13 @@ class HttpTransport:
             raise
 
     def write_intermediate(self, name: str, data: bytes) -> None:
-        self._request("PUT", f"/data/intermediate/{urllib.parse.quote(name)}", data)
+        self._request("PUT", self._data_path("intermediate", name), data)
 
     def read_intermediate(self, name: str) -> bytes:
-        return self._request("GET", f"/data/intermediate/{urllib.parse.quote(name)}")
+        return self._request("GET", self._data_path("intermediate", name))
 
     def write_output(self, name: str, data: bytes) -> None:
-        self._request("PUT", f"/data/out/{urllib.parse.quote(name)}", data)
+        self._request("PUT", self._data_path("out", name), data)
 
     def publish_task_commit(self, kind: str, task_id: int, attempt: str,
                             payload: dict) -> None:
@@ -208,7 +215,7 @@ class HttpTransport:
         from, sent BEFORE the finished RPC."""
         name = f"{kind}-{task_id}.{attempt}"
         self._request(
-            "PUT", f"/data/commit/{urllib.parse.quote(name)}",
+            "PUT", self._data_path("commit", name),
             json.dumps(payload).encode("utf-8"),
         )
 
@@ -220,7 +227,7 @@ class HttpTransport:
         reopens the file from the start."""
         import http.client
 
-        url = f"{self.base}/data/out/{urllib.parse.quote(name)}"
+        url = f"{self.base}{self._data_path('out', name)}"
         size = os.path.getsize(path)
         deadline: float | None = None
         while True:
@@ -251,6 +258,29 @@ class HttpTransport:
         return json.loads(self._request("GET", "/status"))
 
 
+class ServiceHttpTransport(HttpTransport):
+    """HttpTransport against the service daemon (runtime/service.py): the
+    control plane is identical, but the data plane is scoped per job —
+    ``/data/<job>/<kind>/<name>`` — and follows the worker's current
+    assignment via bind_job (runtime/worker._bind_assignment).  A worker
+    attached this way serves a STREAM of jobs through one connection."""
+
+    def __init__(self, addr: str, rpc_timeout_s: float = 60.0):
+        super().__init__(addr, rpc_timeout_s=rpc_timeout_s)
+        self._job = ""
+
+    def bind_job(self, job_id: str) -> None:
+        self._job = job_id
+
+    def _data_path(self, kind: str, name: str) -> str:
+        if not self._job:
+            return super()._data_path(kind, name)
+        return (
+            f"/data/{urllib.parse.quote(self._job, safe='')}"
+            f"/{kind}/{urllib.parse.quote(name, safe='')}"
+        )
+
+
 def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     """CLI worker entry: fetch config, load the application, run task loops.
 
@@ -279,13 +309,24 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     except CoordinatorGone:
         log.error("no coordinator at %s", addr)
         raise SystemExit(1)
+    # Service daemon detection (runtime/service.py): its /status answers
+    # {"service": true}; such workers scope their data plane per job and
+    # resolve the application per assignment instead of from /config.
+    is_service = False
+    try:
+        is_service = bool(transport.fetch_status().get("service"))
+    except Exception:  # noqa: BLE001 — plain coordinator without /status? no
+        pass
     app = load_application(config.application, **config.app_options)
+    transport_cls = ServiceHttpTransport if is_service else HttpTransport
+    if is_service:
+        log.info("attached to a service daemon at %s", addr)
 
     from distributed_grep_tpu.utils import spans as spans_mod
 
     def run_loop(slot: int) -> None:
         loop = WorkerLoop(
-            HttpTransport(addr, rpc_timeout_s=config.rpc_timeout_s),
+            transport_cls(addr, rpc_timeout_s=config.rpc_timeout_s),
             app,
             reduce_memory_bytes=config.reduce_memory_bytes,
             # config.spill_dir is a coordinator-host path; HTTP workers only

@@ -50,6 +50,13 @@ class AssignTaskArgs:
 @dataclass
 class AssignTaskReply:
     assignment: str = Assignment.JOB_DONE
+    # Service multiplexing (runtime/service.py): the job this task belongs
+    # to, and the application module spec to run it with — a worker
+    # attached to the service daemon serves a STREAM of jobs, so both ride
+    # the assignment instead of the one-shot /config bootstrap.  Empty on
+    # single-job coordinators (elided from the wire — old peers interop).
+    job_id: str = ""
+    application: str = ""
     filename: str = ""
     # Multi-file map split (runtime/job.plan_map_splits — cross-file
     # batching of the many-small-files regime): the member files of a
@@ -71,6 +78,10 @@ class AssignTaskReply:
 @dataclass
 class TaskFinishedArgs:
     task_id: int
+    # Service multiplexing: which job's scheduler this completion belongs
+    # to (echoed from the assignment's job_id; empty = the single-job
+    # coordinator, elided from the wire).
+    job_id: str = ""
     worker_id: int = -1
     # Reduce partitions for which this map task actually produced records —
     # the coordinator registers only files that exist (coordinator.go:139-141).
@@ -96,6 +107,7 @@ class TaskFinishedReply:
 class ReduceNextFileArgs:
     task_id: int
     files_processed: int  # rpc.go:35 FilesProcessed — resume-safe cursor
+    job_id: str = ""  # service multiplexing (see TaskFinishedArgs)
 
 
 @dataclass
@@ -108,6 +120,7 @@ class ReduceNextFileReply:
 class HeartbeatArgs:
     task_type: str  # "map" | "reduce"
     task_id: int
+    job_id: str = ""  # service multiplexing (see TaskFinishedArgs)
     worker_id: int = -1
     # Declared silent-phase window: "expect no further stamps for up to
     # this many seconds" (cold device compile).  0 = plain stamp, which
@@ -151,6 +164,9 @@ _TYPES = {
 _ELIDE_DEFAULTS: dict[str, Any] = {
     "spans": [], "spans_seq": -1, "metrics": None,
     "sent_at": 0.0, "rtt_s": -1.0, "filenames": [],
+    # service multiplexing riders (runtime/service.py): absent from the
+    # wire on single-job coordinators, so pre-service peers interop
+    "job_id": "", "application": "",
 }
 
 

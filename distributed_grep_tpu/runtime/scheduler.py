@@ -72,6 +72,7 @@ class Scheduler:
         metrics: Optional[Metrics] = None,
         commit_resolver: Optional[Any] = None,
         event_log: Optional[EventLog] = None,
+        on_change: Optional[Any] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -92,6 +93,14 @@ class Scheduler:
         # commit registrations) are logged as coordinator-row events.
         # None = pipeline off: no file, no extra work on any RPC.
         self.event_log = event_log
+        # Assignability callback for a MULTIPLEXING layer above (the
+        # service daemon, runtime/service.py): its assign loop long-polls
+        # across many schedulers on its own condition variable, which this
+        # scheduler's internal notify cannot reach — called (outside the
+        # lock) whenever work may have BECOME assignable here: a map-phase
+        # completion (unlocks the reduce queue) or a timeout re-enqueue.
+        # None (single-job coordinators) costs nothing.
+        self.on_change = on_change
         self._pending_events: list[dict] = []  # staged under the lock,
         # written by _flush_events after release
         self._span_seqs: dict[int, set[int]] = {}  # worker -> persisted
@@ -445,6 +454,17 @@ class Scheduler:
                 self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
 
     # ------------------------------------------------------------- completion
+    def _notify_change(self) -> None:
+        """Wake the multiplexing layer's assign loop (see on_change).
+        Never raises — a broken callback must not fail a task commit."""
+        cb = self.on_change
+        if cb is None:
+            return
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — advisory wakeup only
+            log.exception("scheduler on_change callback failed")
+
     def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
         """Idempotent map commit (coordinator.go:126-148)."""
         record = self._resolve_commit("map", args.task_id)
@@ -453,6 +473,7 @@ class Scheduler:
             return self._map_finished_locked(args, record)
         finally:
             self._flush_events()
+            self._notify_change()  # map-phase completion unlocks reduces
 
     def _map_finished_locked(self, args: rpc.TaskFinishedArgs,
                              record) -> rpc.TaskFinishedReply:
@@ -601,6 +622,7 @@ class Scheduler:
         import time as _time
 
         while True:
+            requeued = False
             with self._cond:
                 if self._stopped or self._done_locked():
                     return
@@ -614,6 +636,7 @@ class Scheduler:
                         log.warning("map task %d timed out; re-enqueueing", task.task_id)
                         task.state = TaskState.UNASSIGNED
                         self._map_queue.append(task.task_id)
+                        requeued = True
                         self.metrics.inc("map_retries")
                         self._event("task_timeout", type="map",
                                     task=task.task_id, attempt=task.attempts)
@@ -627,11 +650,14 @@ class Scheduler:
                         log.warning("reduce task %d timed out; re-enqueueing", task.task_id)
                         task.state = TaskState.UNASSIGNED
                         self._reduce_queue.append(task.task_id)
+                        requeued = True
                         self.metrics.inc("reduce_retries")
                         self._event("task_timeout", type="reduce",
                                     task=task.task_id, attempt=task.attempts)
                         self._cond.notify_all()
             self._flush_events()
+            if requeued:
+                self._notify_change()  # re-enqueued work is assignable again
             _time.sleep(self.sweep_interval_s)
 
     # ------------------------------------------------------------- predicates

@@ -1,0 +1,1060 @@
+"""Grep-as-a-service: a persistent multi-tenant coordinator daemon.
+
+The reference runs one job per coordinator process (coordinator_launch.go
+builds one task table and exits when Done()); every request therefore pays
+process launch, engine construction, and — on a real chip — the ~20-40 s
+first XLA/Mosaic compile per fresh (mode, mesh, model_gen, shape) key.
+This module turns the HTTP coordinator into a long-lived daemon serving a
+STREAM of jobs over the same persistent workers and engines:
+
+* ``GrepService`` — the multiplexing core: a bounded job queue with
+  admission control (``DGREP_SERVICE_MAX_JOBS`` concurrent jobs,
+  ``DGREP_SERVICE_QUEUE`` queued submissions), one Scheduler + WorkDir +
+  journal + EventLog per job (exactly the single-job machinery, unchanged),
+  and a service-level AssignTask that round-robins ready tasks across the
+  running jobs' schedulers.  Workers attach ONCE and serve many jobs: each
+  assignment carries the job id and the application module spec
+  (rpc.AssignTaskReply.job_id/.application), task RPCs echo the job id
+  back, and the data plane is job-scoped (``/data/<job>/...``).
+* ``ServiceServer`` — the HTTP surface: ``POST /jobs`` (submit, returns
+  job_id), ``GET /jobs/<id>`` (state/progress/metrics), ``GET
+  /jobs/<id>/result``, ``POST /jobs/<id>/cancel``, service-level ``GET
+  /status`` (queue depth, running jobs, per-worker engine state incl. the
+  compiled-model-cache counters piggybacked on heartbeats), plus the
+  ``/rpc`` + ``/data`` planes workers drive.
+* ``ServiceLocalTransport`` — in-process workers for the daemon (the
+  ``dgrep serve --workers N`` default on a single host); HTTP workers
+  attach with ``dgrep worker --addr`` unchanged (run_http_worker detects
+  the service via /status and scopes its data plane per job).
+
+Exactly-once semantics are per job and unchanged: each job keeps its own
+work dir, journal, commit records, and timeout sweeper, so a worker death
+mid-job-A re-executes only A's attempt while job B streams on.  The
+cross-job compiled-model cache lives in ops/engine.cached_engine — a
+repeated pattern's second submit skips model compile and the per-shape
+compile-grace path, with hit/miss/eviction counters surfaced here.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from dataclasses import replace as _dc_replace
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.http_coordinator import (
+    DataPlaneHandler,
+    long_poll_window_s,
+)
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.scheduler import Scheduler, _Deadline
+from distributed_grep_tpu.runtime.store import make_store
+from distributed_grep_tpu.runtime.types import TaskState
+from distributed_grep_tpu.utils import spans as spans_mod
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
+from distributed_grep_tpu.utils.logging import get_logger
+from distributed_grep_tpu.utils.metrics import Metrics
+
+log = get_logger("service")
+
+DEFAULT_MAX_JOBS = 4
+DEFAULT_QUEUE_DEPTH = 64
+
+# Bounded daemon state over an unbounded job stream: terminal JobRecords
+# kept for /status + /jobs/<id> history (oldest-finished evicted beyond
+# this), worker-table rows dropped after this much heartbeat silence
+# (an attached idle worker refreshes at every long-poll retry, so only
+# truly departed workers age out), and per-worker span-seq dedup sets
+# pruned to a recency window (seqs are monotonic per worker buffer — a
+# retry of a batch thousands of seqs old cannot happen).
+_MAX_TERMINAL_RECORDS = 256
+_WORKER_EXPIRE_S = 3600.0
+_SPAN_SEQ_WINDOW = 4096
+
+# How long an idle service-level AssignTask waits between sweeps over the
+# running jobs' schedulers.  New-work transitions (submit, job start, map
+# phase completion, timeout re-enqueue) wake the wait early via the
+# schedulers' on_change hook, so this only bounds staleness for
+# transitions with no hook (nothing known today) — not assignment latency.
+_ASSIGN_SWEEP_S = 0.25
+
+
+def env_service_max_jobs(default: int = DEFAULT_MAX_JOBS) -> int:
+    """Concurrent running-job cap — the ONE parser of
+    DGREP_SERVICE_MAX_JOBS (operator override; malformed or < 1 keeps the
+    default, matching env_batch_bytes' shrug-off policy)."""
+    raw = os.environ.get("DGREP_SERVICE_MAX_JOBS")
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return default
+
+
+def env_service_queue(default: int = DEFAULT_QUEUE_DEPTH) -> int:
+    """Queued-submission cap (admission control) — the ONE parser of
+    DGREP_SERVICE_QUEUE.  0 means no queueing: submits beyond the running
+    cap are rejected outright."""
+    raw = os.environ.get("DGREP_SERVICE_QUEUE")
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
+class AdmissionError(RuntimeError):
+    """Submission rejected by admission control (queue full / shutdown)."""
+
+
+class JobState:
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    FAILED = "failed"
+
+
+_TERMINAL = (JobState.DONE, JobState.CANCELLED, JobState.FAILED)
+
+
+@dataclass
+class JobRecord:
+    """One submitted job's runtime state: exactly the single-job machinery
+    (scheduler, work dir, journal, event log), owned by the service."""
+
+    job_id: str
+    config: JobConfig
+    state: str = JobState.QUEUED
+    scheduler: Scheduler | None = None
+    workdir: WorkDir | None = None
+    journal: TaskJournal | None = None
+    event_log: spans_mod.EventLog | None = None
+    metrics: Metrics = field(default_factory=Metrics)
+    input_allowlist: frozenset = frozenset()
+    submitted_at: float = 0.0
+    started_at: float | None = None
+    finished_at: float | None = None
+    error: str = ""
+    outputs: list[str] = field(default_factory=list)
+    # map splits precomputed at SUBMIT time, outside the service lock:
+    # plan_map_splits stats every input file, and _start_job_locked runs
+    # under the lock every control-plane RPC contends on — one tenant's
+    # many-small-files submit must not stall every other tenant's
+    # heartbeats while the kernel walks its tree.
+    map_splits: list = field(default_factory=list)
+
+
+class GrepService:
+    """The multiplexing core: job queue + admission control + service-level
+    control plane dispatching onto per-job schedulers."""
+
+    def __init__(
+        self,
+        work_root: str | Path,
+        max_jobs: int | None = None,
+        queue_depth: int | None = None,
+        spans: bool = False,
+        task_timeout_s: float | None = None,
+        sweep_interval_s: float | None = None,
+        rpc_timeout_s: float = 60.0,
+    ):
+        self.work_root = Path(work_root)
+        self.work_root.mkdir(parents=True, exist_ok=True)
+        # env knobs win over constructor values (operator override — the
+        # same precedence as DGREP_BATCH_BYTES vs JobConfig.batch_bytes)
+        self.max_jobs = env_service_max_jobs(
+            max_jobs if max_jobs is not None else DEFAULT_MAX_JOBS
+        )
+        self.queue_depth = env_service_queue(
+            queue_depth if queue_depth is not None else DEFAULT_QUEUE_DEPTH
+        )
+        # Service-wide span switch: governs whether attached workers buffer
+        # spans at all (a worker attaches once, before any job exists, so
+        # the flag cannot be per-job on the worker side).  Per-job event
+        # logs additionally honor the job config's own spans flag.
+        self.spans = spans
+        # Per-job detector overrides (tests shrink them); None keeps each
+        # job config's own values.
+        self._task_timeout_s = task_timeout_s
+        self._sweep_interval_s = sweep_interval_s
+        self.rpc_timeout_s = rpc_timeout_s
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._jobs: dict[str, JobRecord] = {}
+        self._queue: list[str] = []  # submitted, awaiting a running slot
+        self._running: list[str] = []  # assign round-robin order
+        self._rr = 0
+        self._ids = itertools.count(1)
+        self._stopped = False
+        self.started_at = time.time()
+
+        # Service-global worker table and id allocator: per-job schedulers
+        # each allocate worker ids from 0, so the SERVICE must own identity
+        # for workers that serve many jobs (two jobs' "worker 0" would
+        # otherwise be different processes).
+        self._next_worker_id = 0
+        self.workers: dict[int, dict] = {}
+
+        # Span-batch dedup across RPC retries, service-level: batches are
+        # drained per WORKER buffer, and one batch may carry records from
+        # several jobs' attempts — dedup must happen before the per-job
+        # split, not inside any one job's scheduler.
+        self._span_seqs: dict[int, set[int]] = {}
+        self._span_seq_lock = threading.Lock()
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, config: JobConfig) -> str:
+        """Admit a job: validate, queue, start if a slot is free.  Raises
+        AdmissionError when the queue is full or the service is stopping,
+        ValueError for configs that could never complete (missing inputs
+        would re-enqueue their map task forever)."""
+        from distributed_grep_tpu.runtime.job import plan_map_splits
+
+        # admission FIRST: 429-destined submits in the overload regime —
+        # the exact traffic load-shedding exists for — must be rejected
+        # before this submit pays any filesystem walk over its inputs.
+        # Re-checked under the lock at enqueue: the walk window can race
+        # other submits past the cap.
+        self._check_admission_locked_or_raise()
+        missing = [f for f in config.input_files
+                   if not os.access(f, os.R_OK)]
+        if missing:
+            raise ValueError(f"unreadable input files: {missing}")
+        # splits depend only on (input_files, batch window) — stat the
+        # inputs here, outside the lock (see JobRecord.map_splits)
+        splits = plan_map_splits(
+            list(config.input_files), config.effective_batch_bytes()
+        )
+        with self._cond:
+            self._check_admission_locked_or_raise(locked=True)
+            job_id = f"job-{next(self._ids)}"
+            # The service owns job identity and placement: the work dir is
+            # ALWAYS <work_root>/<job_id> (two submits naming one work_dir
+            # would corrupt each other's commits) and the span job tag is
+            # the service job id.
+            cfg = _dc_replace(
+                config,
+                work_dir=str(self.work_root / job_id),
+                job_id=job_id,
+                **({"task_timeout_s": self._task_timeout_s}
+                   if self._task_timeout_s is not None else {}),
+                **({"sweep_interval_s": self._sweep_interval_s}
+                   if self._sweep_interval_s is not None else {}),
+            )
+            rec = JobRecord(job_id=job_id, config=cfg,
+                            submitted_at=time.time(), map_splits=splits)
+            self._jobs[job_id] = rec
+            self._queue.append(job_id)
+            self._maybe_start_locked()
+            self._cond.notify_all()
+        return job_id
+
+    def _check_admission_locked_or_raise(self, locked: bool = False) -> None:
+        if not locked:
+            with self._lock:
+                return self._check_admission_locked_or_raise(locked=True)
+        if self._stopped:
+            raise AdmissionError("service is shutting down")
+        if len(self._queue) >= max(0, self.queue_depth) and (
+            len(self._running) >= self.max_jobs
+        ):
+            raise AdmissionError(
+                f"admission control: {len(self._running)} running "
+                f"(cap {self.max_jobs}), {len(self._queue)} queued "
+                f"(cap {self.queue_depth})"
+            )
+
+    def _maybe_start_locked(self) -> None:
+        while self._queue and len(self._running) < self.max_jobs:
+            rec = self._jobs[self._queue.pop(0)]
+            try:
+                self._start_job_locked(rec)
+            except Exception as e:  # noqa: BLE001 — bad job, healthy service
+                log.exception("job %s failed to start", rec.job_id)
+                rec.state = JobState.FAILED
+                rec.error = str(e)
+                rec.finished_at = time.time()
+                # terminal without a close: bound the table on this path
+                # too (a read-only work_root fails EVERY start)
+                self._prune_terminal_locked()
+
+    def _start_job_locked(self, rec: JobRecord) -> None:
+        cfg = rec.config
+        store = make_store(cfg.store)
+        rec.workdir = WorkDir(cfg.work_dir, store=store)
+        rec.workdir.clear()  # job ids are unique, but stay defensive
+        rec.journal = (
+            TaskJournal(rec.workdir.journal_path()) if cfg.journal else None
+        )
+        spans_on = spans_mod.enabled(cfg.spans) or self.spans
+        rec.event_log = (
+            spans_mod.EventLog(
+                rec.workdir.root / spans_mod.EventLog.FILENAME, fresh=True
+            )
+            if spans_on else None
+        )
+        rec.input_allowlist = frozenset(cfg.input_files)
+        rec.metrics = Metrics()
+        rec.scheduler = Scheduler(
+            files=rec.map_splits,
+            n_reduce=cfg.n_reduce,
+            task_timeout_s=cfg.task_timeout_s,
+            sweep_interval_s=cfg.sweep_interval_s,
+            app_options=cfg.effective_app_options(),
+            journal=rec.journal,
+            metrics=rec.metrics,
+            commit_resolver=rec.workdir.resolve_task_commit,
+            event_log=rec.event_log,
+            on_change=self._wake,
+        )
+        rec.state = JobState.RUNNING
+        rec.started_at = time.time()
+        self._running.append(rec.job_id)
+        threading.Thread(
+            target=self._watch_job, args=(rec,), daemon=True,
+            name=f"svc-watch-{rec.job_id}",
+        ).start()
+        log.info(
+            "job %s started (%d map tasks, %d reduce, %d running, %d queued)",
+            rec.job_id, len(rec.scheduler.map_tasks), cfg.n_reduce,
+            len(self._running), len(self._queue),
+        )
+
+    def _watch_job(self, rec: JobRecord) -> None:
+        """Per-running-job completion watcher: finalize when the job's
+        scheduler reports done; bail when the job left RUNNING some other
+        way (cancel)."""
+        while True:
+            if rec.scheduler.wait_done(timeout=0.2):
+                break
+            with self._lock:
+                if rec.state is not JobState.RUNNING:
+                    return
+        self._finalize(rec)
+
+    def _finalize(self, rec: JobRecord) -> None:
+        # the scheduler is done: every reduce is committed, so the output
+        # listing is final — resolve it BEFORE taking the lock (store
+        # resolution reads commit records; one job's finalize must not
+        # stall every tenant's RPCs on that I/O).  Wasted work only if a
+        # cancel races us, in which case the locked section discards it.
+        outputs = [str(p) for p in rec.workdir.list_outputs()]
+        with self._cond:
+            if rec.state is not JobState.RUNNING:
+                return
+            rec.state = JobState.DONE
+            rec.finished_at = time.time()
+            rec.outputs = outputs
+            self._close_job_locked(rec)
+            self._maybe_start_locked()
+            self._cond.notify_all()
+        log.info(
+            "job %s done in %.3fs (%d outputs)", rec.job_id,
+            rec.finished_at - (rec.started_at or rec.finished_at),
+            len(rec.outputs),
+        )
+
+    def _close_job_locked(self, rec: JobRecord) -> None:
+        if rec.scheduler is not None:
+            rec.scheduler.stop()
+        if rec.journal is not None:
+            rec.journal.close()
+        if rec.event_log is not None:
+            rec.event_log.close()
+        if rec.job_id in self._running:
+            self._running.remove(rec.job_id)
+        self._prune_terminal_locked()
+
+    def _prune_terminal_locked(self) -> None:
+        """Bound the job table over an unbounded stream: keep the newest
+        _MAX_TERMINAL_RECORDS terminal records (status/result history),
+        evict the rest oldest-finished-first.  Evicted job ids answer 404
+        from then on — their committed outputs stay on disk under
+        <work_root>/<job_id>/out/."""
+        terminal = [r for r in self._jobs.values() if r.state in _TERMINAL]
+        excess = len(terminal) - _MAX_TERMINAL_RECORDS
+        if excess <= 0:
+            return
+        terminal.sort(key=lambda r: r.finished_at or 0.0)
+        for rec in terminal[:excess]:
+            del self._jobs[rec.job_id]
+
+    # ---------------------------------------------------------------- cancel
+    def cancel(self, job_id: str) -> str:
+        """Cancel a queued or running job; terminal jobs are left as they
+        are.  Running cancels stop the scheduler (workers mid-task finish
+        their attempt; the completion RPC is absorbed idempotently) and
+        never touch any OTHER job's state.  Returns the resulting state."""
+        rec = self.record(job_id)
+        with self._cond:
+            if rec.state is JobState.QUEUED:
+                self._queue.remove(job_id)
+                rec.state = JobState.CANCELLED
+                rec.finished_at = time.time()
+                # terminal without a close: bound the table here too (a
+                # submit-then-cancel client loop never reaches _close)
+                self._prune_terminal_locked()
+            elif rec.state is JobState.RUNNING:
+                rec.state = JobState.CANCELLED
+                rec.finished_at = time.time()
+                self._close_job_locked(rec)
+                self._maybe_start_locked()
+            self._cond.notify_all()
+        log.info("job %s cancelled", job_id)
+        return rec.state
+
+    # ------------------------------------------------------------- accessors
+    def record(self, job_id: str) -> JobRecord:
+        rec = self._jobs.get(job_id)
+        if rec is None:
+            raise KeyError(f"unknown job: {job_id}")
+        return rec
+
+    def wait_job(self, job_id: str, timeout: float | None = None) -> bool:
+        """Block until the job reaches a terminal state (tests/CLI)."""
+        rec = self.record(job_id)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while rec.state not in _TERMINAL:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=0.2 if remaining is None
+                                else min(0.2, remaining))
+        return True
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def _worker_seen(self, worker_id: int, job: str | None = ...,
+                     task: str | None = ..., metrics: dict | None = None) -> None:
+        if worker_id < 0:
+            return
+        with self._lock:
+            info = self.workers.setdefault(
+                worker_id, {"job": None, "task": None}
+            )
+            info["seen"] = time.monotonic()
+            if job is not ...:
+                info["job"] = job
+            if task is not ...:
+                info["task"] = task
+            if metrics is not None:
+                info["metrics"] = metrics
+
+    # ---------------------------------------------------------- control plane
+    def assign_task(self, args: rpc.AssignTaskArgs,
+                    timeout: float = 30.0) -> rpc.AssignTaskReply:
+        """Service-level long-poll: sweep the RUNNING jobs' schedulers
+        round-robin (fairness across tenants) with non-blocking per-job
+        polls; wait on the service condition between sweeps.  Replies
+        carry job_id + application so one attached worker serves every
+        job; JOB_DONE only on service shutdown — an idle service parks
+        workers in retry long-polls, it does not dismiss them."""
+        deadline = _Deadline(timeout)
+        with self._lock:
+            worker_id = args.worker_id
+            if worker_id < 0:
+                worker_id = self._next_worker_id
+                self._next_worker_id += 1
+                # a fresh attach is the natural moment to drop rows (and
+                # dedup sets) of workers long gone — attached-but-idle
+                # workers refresh their row every long-poll retry, so
+                # only the truly departed age past the expiry
+                now = time.monotonic()
+                stale = [
+                    wid for wid, info in self.workers.items()
+                    if now - info.get("seen", now) > _WORKER_EXPIRE_S
+                ]
+                for wid in stale:
+                    del self.workers[wid]
+                if stale:
+                    with self._span_seq_lock:
+                        for wid in stale:
+                            self._span_seqs.pop(wid, None)
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return rpc.AssignTaskReply(
+                        assignment=rpc.Assignment.JOB_DONE,
+                        worker_id=worker_id,
+                    )
+                order = list(self._running)
+                start = self._rr
+                self._rr += 1
+            for i in range(len(order)):
+                rec = self._jobs.get(order[(start + i) % len(order)])
+                if rec is None or rec.state is not JobState.RUNNING:
+                    continue
+                reply = rec.scheduler.assign_task(
+                    rpc.AssignTaskArgs(worker_id=worker_id), timeout=0.0
+                )
+                if reply.assignment in (rpc.Assignment.MAP,
+                                        rpc.Assignment.REDUCE):
+                    reply.job_id = rec.job_id
+                    reply.application = rec.config.application
+                    self._worker_seen(
+                        worker_id, job=rec.job_id,
+                        task=f"{reply.assignment}:{reply.task_id}",
+                    )
+                    return reply
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                self._worker_seen(worker_id)
+                return rpc.AssignTaskReply(
+                    assignment="retry", task_id=-2, worker_id=worker_id
+                )
+            with self._cond:
+                if not self._stopped:
+                    self._cond.wait(min(remaining, _ASSIGN_SWEEP_S))
+
+    def _route_spans(self, args) -> None:
+        """Service-level span persistence: dedup the batch by (worker,
+        seq) BEFORE splitting — one drained batch may carry records from
+        several jobs' attempts (the buffer flushes on whatever RPC goes
+        next) — then write each record group to ITS job's event log.
+        Consumes args.spans so the per-job scheduler cannot double-write
+        the batch into the RPC's own job log."""
+        recs = getattr(args, "spans", None)
+        if not recs:
+            return
+        args.spans = []
+        seq = getattr(args, "spans_seq", -1)
+        wid = getattr(args, "worker_id", -1)
+        if seq >= 0 and wid >= 0:
+            with self._span_seq_lock:
+                seen = self._span_seqs.setdefault(wid, set())
+                if seq in seen:
+                    return
+                seen.add(seq)
+                # seqs are monotonic per worker buffer: a transport retry
+                # replays a RECENT seq, never one thousands back — prune
+                # to a recency window so a long-lived worker's dedup set
+                # stays bounded
+                if len(seen) > 2 * _SPAN_SEQ_WINDOW:
+                    floor = max(seen) - _SPAN_SEQ_WINDOW
+                    self._span_seqs[wid] = {s for s in seen if s >= floor}
+        for jid, group in spans_mod.split_by_job(
+            recs, default=getattr(args, "job_id", "")
+        ).items():
+            rec = self._jobs.get(jid)
+            if rec is None or rec.event_log is None:
+                continue  # job unknown/terminal or spans off: drop
+            try:
+                rec.event_log.write_many(group)
+            except Exception:  # noqa: BLE001 — telemetry must not fail RPCs
+                log.exception("event log write failed for job %s", jid)
+
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        self._route_spans(args)
+        self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
+        rec = self._jobs.get(args.job_id)
+        if rec is None or rec.scheduler is None:
+            return rpc.TaskFinishedReply(ok=False)  # job gone: absorbed
+        return rec.scheduler.map_finished(args)
+
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        self._route_spans(args)
+        self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
+        rec = self._jobs.get(args.job_id)
+        if rec is None or rec.scheduler is None:
+            return rpc.TaskFinishedReply(ok=False)
+        return rec.scheduler.reduce_finished(args)
+
+    def reduce_next_file(self, args: rpc.ReduceNextFileArgs,
+                         timeout: float = 30.0) -> rpc.ReduceNextFileReply:
+        rec = self._jobs.get(args.job_id)
+        if rec is None or rec.scheduler is None or (
+            rec.state is not JobState.RUNNING
+        ):
+            # job cancelled/gone mid-reduce: end the stream so the worker
+            # wraps up instead of long-polling a dead job forever
+            return rpc.ReduceNextFileReply(done=True)
+        return rec.scheduler.reduce_next_file(args, timeout=timeout)
+
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
+        self._route_spans(args)
+        self._worker_seen(args.worker_id, metrics=args.metrics)
+        rec = self._jobs.get(args.job_id)
+        if rec is not None and rec.scheduler is not None:
+            rec.scheduler.heartbeat(
+                args.task_type, args.task_id, grace_s=args.grace_s, args=args
+            )
+
+    # ----------------------------------------------------------------- status
+    def job_status(self, job_id: str) -> dict:
+        rec = self.record(job_id)
+        out: dict = {
+            "job_id": rec.job_id,
+            "state": rec.state,
+            "submitted_at": rec.submitted_at,
+            "started_at": rec.started_at,
+            "finished_at": rec.finished_at,
+        }
+        if rec.error:
+            out["error"] = rec.error
+        if rec.scheduler is not None:
+            s = rec.scheduler
+            out["map"] = {
+                "total": len(s.map_tasks),
+                "completed": sum(
+                    t.state is TaskState.COMPLETED for t in s.map_tasks
+                ),
+            }
+            out["reduce"] = {
+                "total": len(s.reduce_tasks),
+                "completed": sum(
+                    t.state is TaskState.COMPLETED for t in s.reduce_tasks
+                ),
+            }
+            out["metrics"] = rec.metrics.snapshot()
+        if rec.state is JobState.DONE:
+            out["outputs"] = rec.outputs
+        return out
+
+    def job_result(self, job_id: str) -> dict:
+        """Committed outputs + final metrics of a DONE job; raises
+        RuntimeError for non-terminal jobs (HTTP surface answers 409)."""
+        rec = self.record(job_id)
+        if rec.state is not JobState.DONE:
+            raise RuntimeError(
+                f"job {job_id} has no result: state={rec.state}"
+            )
+        return {
+            "job_id": rec.job_id,
+            "state": rec.state,
+            "outputs": rec.outputs,
+            "metrics": rec.metrics.snapshot(),
+        }
+
+    def status(self) -> dict:
+        """Service-level view: queue depth, running jobs, per-job progress,
+        the service worker table (with piggybacked engine metrics — the
+        compile_cache_* counters land here via the heartbeat piggyback),
+        and this process's own compiled-model-cache counters (authoritative
+        for in-process workers; HTTP workers report theirs per row)."""
+        from distributed_grep_tpu.ops.engine import model_cache_counters
+
+        now = time.monotonic()
+        with self._lock:
+            jobs = {
+                jid: {"state": rec.state}
+                for jid, rec in self._jobs.items()
+            }
+            queued = len(self._queue)
+            running = list(self._running)
+            workers = {}
+            for wid, info in sorted(self.workers.items()):
+                row: dict = {
+                    "last_heartbeat_age_s": round(now - info["seen"], 3),
+                    "job": info.get("job"),
+                    "task": info.get("task"),
+                }
+                if info.get("metrics") is not None:
+                    row["metrics"] = info["metrics"]
+                workers[str(wid)] = row
+        for jid in jobs:
+            rec = self._jobs.get(jid)  # pruning may race this unlocked read
+            if rec is not None and rec.scheduler is not None:
+                jobs[jid]["map_completed"] = sum(
+                    t.state is TaskState.COMPLETED
+                    for t in rec.scheduler.map_tasks
+                )
+                jobs[jid]["map_total"] = len(rec.scheduler.map_tasks)
+        return {
+            "service": True,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "max_jobs": self.max_jobs,
+            "queue_depth_cap": self.queue_depth,
+            "queued": queued,
+            "running": running,
+            "jobs": jobs,
+            "workers": workers,
+            "compile_cache": model_cache_counters(),
+        }
+
+    # ------------------------------------------------------------- lifecycle
+    def start_local_workers(
+        self,
+        n: int,
+        fault_hooks_per_worker: list[dict] | None = None,
+    ) -> list[threading.Thread]:
+        """Attach N in-process worker loops (the single-host serving shape;
+        remote hosts attach via ``dgrep worker --addr``).  One shared
+        Metrics instance, like run_job — the piggyback aggregates across
+        local workers."""
+        from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
+
+        metrics = Metrics()
+
+        def worker_main(idx: int) -> None:
+            hooks = (fault_hooks_per_worker or [{}] * n)[idx]
+            loop = WorkerLoop(
+                ServiceLocalTransport(self, rpc_timeout_s=self.rpc_timeout_s),
+                app=None,  # resolved per assignment (reply.application)
+                metrics=metrics,
+                fault_hooks=hooks,
+                spans_enabled=self.spans,
+            )
+            try:
+                loop.run()
+            except WorkerKilled:
+                log.info("service worker %d killed by fault injection", idx)
+            except Exception:
+                log.exception("service worker %d crashed", idx)
+
+        threads = [
+            threading.Thread(target=worker_main, args=(i,),
+                             name=f"svc-worker-{i}", daemon=True)
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        self._local_workers = getattr(self, "_local_workers", [])
+        self._local_workers.extend(threads)
+        return threads
+
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Shut the service down: stop every non-terminal job's scheduler,
+        dismiss long-polling workers (JOB_DONE), join local workers."""
+        with self._cond:
+            self._stopped = True
+            for jid in list(self._queue):
+                rec = self._jobs[jid]
+                rec.state = JobState.CANCELLED
+                rec.finished_at = time.time()
+            self._queue.clear()
+            for jid in list(self._running):
+                rec = self._jobs[jid]
+                rec.state = JobState.CANCELLED
+                rec.finished_at = time.time()
+                self._close_job_locked(rec)
+            self._cond.notify_all()
+        for t in getattr(self, "_local_workers", []):
+            t.join(timeout=join_timeout_s)
+
+
+# ---------------------------------------------------------------- transports
+class ServiceLocalTransport:
+    """In-process worker transport against a GrepService: direct control
+    plane calls + per-job shared-filesystem data plane (the LocalTransport
+    shape with a job-scoped work dir that follows bind_job)."""
+
+    is_local = True
+
+    def __init__(self, service: GrepService, rpc_timeout_s: float = 30.0):
+        self.service = service
+        self.rpc_timeout_s = rpc_timeout_s
+        self._job = ""
+        self._wd: WorkDir | None = None
+
+    def bind_job(self, job_id: str) -> None:
+        if job_id == self._job and self._wd is not None:
+            return
+        rec = self.service.record(job_id)
+        if rec.workdir is None:
+            raise RuntimeError(f"job {job_id} has no work dir (not started)")
+        self._job = job_id
+        self._wd = rec.workdir
+
+    # control plane
+    def assign_task(self, args: rpc.AssignTaskArgs) -> rpc.AssignTaskReply:
+        return self.service.assign_task(args, timeout=self.rpc_timeout_s)
+
+    def map_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return self.service.map_finished(args)
+
+    def reduce_finished(self, args: rpc.TaskFinishedArgs) -> rpc.TaskFinishedReply:
+        return self.service.reduce_finished(args)
+
+    def reduce_next_file(self, args: rpc.ReduceNextFileArgs) -> rpc.ReduceNextFileReply:
+        return self.service.reduce_next_file(args, timeout=self.rpc_timeout_s)
+
+    def heartbeat(self, args: rpc.HeartbeatArgs) -> float:
+        self.service.heartbeat(args)
+        return 0.0  # same process, same clock (see LocalTransport)
+
+    # data plane (job-scoped)
+    def read_input(self, filename: str) -> bytes:
+        return resolve_input_path(filename, self._wd).read_bytes()
+
+    def read_input_path(self, filename: str):
+        return resolve_input_path(filename, self._wd), False
+
+    def write_intermediate(self, name: str, data: bytes) -> None:
+        self._wd.store.put(self._wd.root / "intermediate" / name, data)
+
+    def read_intermediate(self, name: str) -> bytes:
+        return self._wd.store.get(self._wd.root / "intermediate" / name)
+
+    def write_output(self, name: str, data: bytes) -> None:
+        self._wd.store.put(self._wd.root / "out" / name, data)
+
+    def write_output_from_file(self, name: str, path: str) -> None:
+        self._wd.store.put_from_file(self._wd.root / "out" / name, path)
+
+    def publish_task_commit(self, kind: str, task_id: int, attempt: str,
+                            payload: dict) -> None:
+        self._wd.store.commit_task(
+            self._wd.commits_dir(), kind, task_id, attempt, payload
+        )
+
+
+# --------------------------------------------------------------- HTTP server
+class ServiceServer:
+    """HTTP surface for a GrepService: the job API (POST /jobs, GET
+    /jobs/<id>[/result], POST /jobs/<id>/cancel, GET /status) plus the
+    worker planes (POST /rpc/<verb>, job-scoped GET/PUT /data/<job>/...,
+    GET /config worker bootstrap)."""
+
+    def __init__(self, service: GrepService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self._httpd = ThreadingHTTPServer((host, port), _make_service_handler(self))
+        self._httpd.daemon_threads = True
+        self.host = host
+        self._serve_thread: threading.Thread | None = None
+        # built once: handle_rpc derives the long-poll window from it per
+        # request, and /config serves it as the worker bootstrap
+        self._bootstrap = self.bootstrap_config()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-service", daemon=True
+        )
+        self._serve_thread.start()
+        log.info(
+            "service serving on %s:%d (max %d concurrent jobs, queue %d)",
+            self.host, self.port, self.service.max_jobs,
+            self.service.queue_depth,
+        )
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # worker bootstrap: run_http_worker fetches /config once at attach; the
+    # real application + options arrive per assignment, so this only names
+    # a default app and the transport/span knobs.
+    def bootstrap_config(self) -> JobConfig:
+        return JobConfig(
+            input_files=[],
+            application="distributed_grep_tpu.apps.grep",
+            work_dir=str(self.service.work_root),
+            spans=self.service.spans,
+            rpc_timeout_s=self.service.rpc_timeout_s,
+        )
+
+    def handle_rpc(self, verb: str, payload: dict) -> dict:
+        from dataclasses import asdict
+
+        window = long_poll_window_s(self._bootstrap)
+        if verb == rpc.Verb.ASSIGN_TASK:
+            reply = self.service.assign_task(
+                rpc.AssignTaskArgs(**payload), timeout=window
+            )
+        elif verb == rpc.Verb.MAP_FINISHED:
+            reply = self.service.map_finished(rpc.TaskFinishedArgs(**payload))
+        elif verb == rpc.Verb.REDUCE_FINISHED:
+            reply = self.service.reduce_finished(rpc.TaskFinishedArgs(**payload))
+        elif verb == rpc.Verb.REDUCE_NEXT_FILE:
+            reply = self.service.reduce_next_file(
+                rpc.ReduceNextFileArgs(**payload), timeout=window
+            )
+        elif verb == rpc.Verb.HEARTBEAT:
+            self.service.heartbeat(rpc.HeartbeatArgs(**payload))
+            reply = rpc.HeartbeatReply()
+        else:
+            raise KeyError(f"unknown RPC verb: {verb}")
+        return asdict(reply)
+
+
+def _safe_segment(name: str) -> str:
+    name = urllib.parse.unquote(name)
+    if "/" in name or name.startswith("."):
+        raise ValueError(f"invalid path segment: {name!r}")
+    return name
+
+
+def _make_service_handler(server: ServiceServer):
+    service = server.service
+
+    class Handler(DataPlaneHandler):
+        def do_POST(self):
+            try:
+                if self.path.startswith("/rpc/"):
+                    verb = self.path[len("/rpc/") :]
+                    payload = json.loads(self._read_body() or b"{}")
+                    self._send_json(server.handle_rpc(verb, payload))
+                elif self.path == "/jobs":
+                    try:
+                        cfg = JobConfig.from_json(
+                            (self._read_body() or b"{}").decode("utf-8",
+                                                                "strict")
+                        )
+                        job_id = service.submit(cfg)
+                    except AdmissionError as e:
+                        self._send_json({"error": str(e)}, 429)
+                        return
+                    except (TypeError, ValueError) as e:
+                        self._send_json({"error": f"bad job config: {e}"}, 400)
+                        return
+                    self._send_json({"job_id": job_id}, 202)
+                elif self.path.startswith("/jobs/") and self.path.endswith("/cancel"):
+                    job_id = _safe_segment(
+                        self.path[len("/jobs/") : -len("/cancel")]
+                    )
+                    try:
+                        state = service.cancel(job_id)
+                    except KeyError:
+                        self._send_json({"error": f"unknown job: {job_id}"}, 404)
+                        return
+                    self._send_json({"ok": True, "state": state})
+                else:
+                    self._drain_body()
+                    self._send_json({"error": "not found"}, 404)
+            except BrokenPipeError:
+                pass  # client gave up on a long-poll; service state is safe
+            except Exception as e:  # noqa: BLE001 — report, don't kill the server
+                log.exception("service rpc error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        def do_GET(self):
+            self._streaming_body = False  # per request (keep-alive reuses us)
+            try:
+                if self.path == "/config":
+                    self._send_json(json.loads(server._bootstrap.to_json()))
+                elif self.path == "/status":
+                    self._send_json(service.status())
+                elif self.path.startswith("/jobs/"):
+                    rest = self.path[len("/jobs/") :]
+                    if rest.endswith("/result"):
+                        job_id = _safe_segment(rest[: -len("/result")])
+                        try:
+                            self._send_json(service.job_result(job_id))
+                        except KeyError:
+                            self._send_json(
+                                {"error": f"unknown job: {job_id}"}, 404)
+                        except RuntimeError as e:
+                            self._send_json({"error": str(e)}, 409)
+                    else:
+                        job_id = _safe_segment(rest)
+                        try:
+                            self._send_json(service.job_status(job_id))
+                        except KeyError:
+                            self._send_json(
+                                {"error": f"unknown job: {job_id}"}, 404)
+                elif self.path.startswith("/data/"):
+                    job_id, kind, name = self._data_parts()
+                    rec = service.record(job_id)
+                    if kind == "input":
+                        if name not in rec.input_allowlist:
+                            self._send_json(
+                                {"error": f"not an input split: {name}"}, 403)
+                            return
+                        p = resolve_input_path(name, rec.workdir)
+                        if not p.exists():
+                            self._send_json(
+                                {"error": f"no such input: {name}"}, 404)
+                            return
+                        self._send_file(p)
+                    elif kind == "intermediate":
+                        p = rec.workdir.store.resolve(
+                            rec.workdir.root / "intermediate" / name
+                        )
+                        if p is None:
+                            self._send_json(
+                                {"error": f"no such file: {name}"}, 404)
+                            return
+                        self._send_file(p)
+                    else:
+                        self._send_json({"error": "not found"}, 404)
+                else:
+                    self._send_json({"error": "not found"}, 404)
+            except BrokenPipeError:
+                self.close_connection = True
+            except KeyError as e:
+                self._send_json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001
+                self.close_connection = True
+                log.exception("service get error on %s", self.path)
+                if getattr(self, "_streaming_body", False):
+                    return  # headers out: never splice JSON into a body
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        def do_PUT(self):
+            try:
+                if not self.path.startswith("/data/"):
+                    self._drain_body()
+                    self._send_json({"error": "not found"}, 404)
+                    return
+                job_id, kind, name = self._data_parts()
+                rec = service.record(job_id)
+                wd = rec.workdir
+                if kind == "intermediate":
+                    self._receive_file(wd.store, wd.root / "intermediate" / name)
+                    self._send_json({"ok": True})
+                elif kind == "out":
+                    self._receive_file(wd.store, wd.root / "out" / name)
+                    self._send_json({"ok": True})
+                elif kind == "commit":
+                    self._put_commit(wd.store, wd.commits_dir(), name)
+                else:
+                    self._drain_body()
+                    self._send_json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._drain_body()
+                self._send_json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001
+                self.close_connection = True
+                log.exception("service put error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        def _data_parts(self) -> tuple[str, str, str]:
+            """('/data/<job>/<kind>/<name>') -> (job, kind, name).  Job and
+            kind are traversal-checked segments; input names may be full
+            filesystem paths (they arrive %2F-quoted as one segment and are
+            gated by the job's input allowlist, exactly like the one-shot
+            coordinator's /data/input/ route), every other kind keeps the
+            slash-free _safe_segment rule."""
+            rest = self.path[len("/data/") :]
+            parts = rest.split("/", 2)
+            if len(parts) != 3:
+                raise ValueError(f"bad data path: {self.path!r}")
+            job_id = _safe_segment(parts[0])
+            kind = _safe_segment(parts[1])
+            if kind == "input":
+                name = urllib.parse.unquote(parts[2])
+            else:
+                name = _safe_segment(parts[2])
+            return job_id, kind, name
+
+    return Handler

@@ -39,11 +39,27 @@ class WorkerKilled(Exception):
     """Raised by fault-injection hooks to simulate a worker crash."""
 
 
+def _engine_cache_counters() -> dict | None:
+    """This process's cross-job compiled-model-cache counters
+    (compile_cache_hits/misses/evictions), or None when the engine module
+    was never imported or the cache never touched — piggybacked with the
+    Metrics snapshot so the coordinator /status workers view shows cache
+    effectiveness per worker.  sys.modules-gated: a wordcount worker must
+    not import the whole ops stack just to report nothing."""
+    import sys as _sys
+
+    eng = _sys.modules.get("distributed_grep_tpu.ops.engine")
+    if eng is None:
+        return None
+    counters = eng.model_cache_counters()
+    return counters or None
+
+
 class WorkerLoop:
     def __init__(
         self,
         transport: Transport,
-        app: LoadedApplication,
+        app: LoadedApplication | None = None,
         metrics: Optional[Metrics] = None,
         fault_hooks: Optional[dict[str, Callable[[], None]]] = None,
         reduce_memory_bytes: int = 128 << 20,
@@ -52,7 +68,19 @@ class WorkerLoop:
         job_id: str = "",
     ):
         self.transport = transport
+        # ``app`` may be None for workers attached to the service daemon
+        # (runtime/service.py): there every assignment names its own
+        # application module (AssignTaskReply.application) and the loop
+        # resolves it per task (_bind_assignment) — one fresh module
+        # instance per (loop, spec), cached, so two loops never share
+        # app-module state and a loop reuses its instance across jobs.
         self.app = app
+        self._job_apps: dict[str, LoadedApplication] = {}
+        # The SERVICE job id of the current assignment, echoed on every
+        # task RPC so the daemon can dispatch to the right scheduler.
+        # Stays "" on single-job coordinators: the rpc fields elide and
+        # the wire payload is byte-identical to the pre-service protocol.
+        self._rpc_job_id = ""
         self.metrics = metrics or Metrics()
         self.fault_hooks = fault_hooks or {}
         self.reduce_memory_bytes = reduce_memory_bytes
@@ -95,6 +123,7 @@ class WorkerLoop:
             return
         args = rpc.HeartbeatArgs(
             task_type=task_type, task_id=task_id,
+            job_id=self._rpc_job_id,
             worker_id=self.worker_id, grace_s=grace_s,
         )
         if self.spans is not None:
@@ -105,6 +134,9 @@ class WorkerLoop:
             # per-worker clock-offset estimate.
             args.spans_seq, args.spans = self.spans.drain_batch()
             args.metrics = self.metrics.piggyback()
+            cc = _engine_cache_counters()
+            if cc:
+                args.metrics.update(cc)
             args.sent_at = time.time()
             args.rtt_s = self._hb_rtt
         try:
@@ -181,6 +213,8 @@ class WorkerLoop:
             # idle wait for work — reported as an arg on the task span
             self._assign_wait_s = time.monotonic() - t_wait
             self.worker_id = reply.worker_id
+            if reply.assignment in (rpc.Assignment.MAP, rpc.Assignment.REDUCE):
+                self._bind_assignment(reply)
             if self.spans is not None:
                 # buffer-synthesized records (drop reports) render on this
                 # worker's row now that the coordinator named it
@@ -195,6 +229,32 @@ class WorkerLoop:
             elif reply.assignment == rpc.Assignment.REDUCE:
                 self._run_reduce(reply)
             # anything else ("retry"): long-poll window expired — loop again
+
+    def _bind_assignment(self, reply: rpc.AssignTaskReply) -> None:
+        """Adopt a (possibly multiplexed) assignment's job identity: span
+        tags + data-plane scope follow the job, and the application module
+        resolves from the assignment when the daemon names one (service
+        workers serve many jobs through ONE attach).  Single-job replies
+        carry neither field and this is a no-op."""
+        if reply.job_id:
+            self._rpc_job_id = reply.job_id
+            self.job_id = reply.job_id
+            bind = getattr(self.transport, "bind_job", None)
+            if bind is not None:
+                bind(reply.job_id)
+        if reply.application:
+            app = self._job_apps.get(reply.application)
+            if app is None:
+                from distributed_grep_tpu.apps.loader import load_application
+
+                app = load_application(reply.application)
+                self._job_apps[reply.application] = app
+            self.app = app
+        elif self.app is None:
+            raise RuntimeError(
+                "worker has no application: the assignment names none and "
+                "no default app was given at construction"
+            )
 
     def _publish_commit(self, kind: str, task_id: int, attempt: str,
                         payload: dict) -> None:
@@ -233,6 +293,9 @@ class WorkerLoop:
                 limit=self.spans.cap + 1
             )
             args.metrics = self.metrics.piggyback()
+            cc = _engine_cache_counters()
+            if cc:
+                args.metrics.update(cc)
         return args
 
     # ------------------------------------------------------------------- map
@@ -251,7 +314,8 @@ class WorkerLoop:
             self._fault("before_map_finished")
             self.transport.map_finished(self._finished_args(
                 rpc.TaskFinishedArgs(
-                    task_id=a.task_id, worker_id=self.worker_id,
+                    task_id=a.task_id, job_id=self._rpc_job_id,
+                    worker_id=self.worker_id,
                     produced_parts=produced,
                 )
             ))
@@ -418,7 +482,10 @@ class WorkerLoop:
                 assign_wait_s=round(self._assign_wait_s, 6),
             )
             self.transport.reduce_finished(self._finished_args(
-                rpc.TaskFinishedArgs(task_id=a.task_id, worker_id=self.worker_id)
+                rpc.TaskFinishedArgs(
+                    task_id=a.task_id, job_id=self._rpc_job_id,
+                    worker_id=self.worker_id,
+                )
             ))
         self.metrics.inc("reduce_tasks")
         self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
@@ -473,7 +540,8 @@ class WorkerLoop:
             while True:
                 r = self.transport.reduce_next_file(
                     rpc.ReduceNextFileArgs(
-                        task_id=a.task_id, files_processed=files_processed
+                        task_id=a.task_id, files_processed=files_processed,
+                        job_id=self._rpc_job_id,
                     )
                 )
                 if r.done:

@@ -218,6 +218,19 @@ def scan_record(mode: str, n_bytes: int, seconds: float,
            "ts": now - seconds, "dur": seconds, "args": args})
 
 
+def split_by_job(recs: list[dict], default: str = "") -> dict[str, list[dict]]:
+    """Group span/event records by their 'job' tag, preserving order —
+    the service daemon's per-job event routing (runtime/service.py): one
+    drained worker batch may carry records from several jobs' attempts
+    (the buffer flushes on whatever RPC goes next), and each group must
+    land in ITS job's events.jsonl.  Records without a job tag fall to
+    ``default`` (the RPC's own job)."""
+    out: dict[str, list[dict]] = {}
+    for r in recs:
+        out.setdefault(r.get("job") or default, []).append(r)
+    return out
+
+
 # ------------------------------------------------------------- coordinator
 class EventLog:
     """Append-only events.jsonl writer — the coordinator's persisted job

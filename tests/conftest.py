@@ -99,6 +99,21 @@ def _fresh_device_probe_state():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _fresh_model_cache():
+    """The cross-job compiled-model cache (ops/engine.cached_engine) is
+    process-global by design — in production a service process WANTS
+    engines shared across jobs.  Across tests that sharing would leak
+    mutated engine state (forced _accel_cached, demotion flags, retuned
+    FDR plans) from one test's engine into another's, so each test starts
+    and ends with an empty cache."""
+    from distributed_grep_tpu.ops import engine as _eng
+
+    _eng.model_cache_clear()
+    yield
+    _eng.model_cache_clear()
+
+
 def expand_records(records):
     """Flatten map output to per-record KeyValues: the built-in grep apps
     emit columnar LineBatch objects (round 5, runtime/columnar.py); tests

@@ -1,0 +1,579 @@
+"""Grep-as-a-service suite: the persistent multi-tenant coordinator
+(runtime/service.py) and the cross-job compiled-model cache
+(ops/engine.cached_engine).
+
+Covers ISSUE 6's acceptance bars end to end:
+
+* warm resubmit of an identical pattern registers compile_cache_hits and
+  SKIPS engine reconstruction (GrepEngine.__init__ spy);
+* two jobs submitted concurrently over SHARED workers produce outputs
+  byte-identical to the same jobs run serially via run_job;
+* a worker killed mid-job-A while job-B runs re-executes only A's attempt
+  (B finishes with zero retries) — the faults-matrix pattern, multi-tenant;
+* cancel leaves the other job's result intact;
+* admission control (queue depth / running cap) rejects loudly;
+* the one-shot serve_coordinator / cmd_coordinator stdout contract is
+  unperturbed by the service layer (back-compat pin; bench.py's own
+  one-JSON-line contract is pinned by tests/test_bench_contract.py).
+
+Standalone: ``python -m pytest tests/test_service.py -q``.  CPU-only; the
+grep engines run their native/host paths (backend "cpu").
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.ops import engine as engine_mod
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.runtime.service import (
+    AdmissionError,
+    GrepService,
+    JobState,
+    ServiceServer,
+)
+from distributed_grep_tpu.utils.config import JobConfig
+
+pytestmark = pytest.mark.service
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(monkeypatch):
+    """Each test starts with an empty compiled-model cache and zeroed
+    counters, and never self-calibrates (deterministic, device-free)."""
+    monkeypatch.setenv("DGREP_NO_CALIBRATE", "1")
+    engine_mod.model_cache_clear()
+    yield
+    engine_mod.model_cache_clear()
+
+
+@pytest.fixture
+def service(tmp_path):
+    svc = GrepService(
+        work_root=tmp_path / "svc",
+        task_timeout_s=5.0,
+        sweep_interval_s=0.1,
+    )
+    yield svc
+    svc.stop()
+
+
+def grep_config(corpus, pattern="hello", **kw) -> JobConfig:
+    defaults = dict(
+        input_files=[str(p) for p in corpus.values()],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": pattern, "backend": "cpu"},
+        n_reduce=3,
+    )
+    defaults.update(kw)
+    return JobConfig(**defaults)
+
+
+def outputs_by_name(paths) -> dict[str, bytes]:
+    return {Path(p).name: Path(p).read_bytes() for p in paths}
+
+
+# --------------------------------------------------------- model cache unit
+
+def test_cached_engine_hit_returns_same_object():
+    e1, v1 = engine_mod.cached_engine("needle", ignore_case=False, backend="cpu")
+    e2, v2 = engine_mod.cached_engine("needle", ignore_case=False, backend="cpu")
+    e3, v3 = engine_mod.cached_engine("other", ignore_case=False, backend="cpu")
+    assert (v1, v2, v3) == ("miss", "hit", "miss")
+    assert e1 is e2 and e1 is not e3
+    c = engine_mod.model_cache_counters()
+    assert c["compile_cache_hits"] == 1 and c["compile_cache_misses"] == 2
+
+
+def test_cached_engine_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("DGREP_MODEL_CACHE", "0")
+    e1, v1 = engine_mod.cached_engine("needle", backend="cpu")
+    e2, v2 = engine_mod.cached_engine("needle", backend="cpu")
+    assert v1 == v2 == "off" and e1 is not e2
+    assert engine_mod.model_cache_counters() == {}  # untouched
+
+
+def test_cached_engine_lru_eviction(monkeypatch):
+    monkeypatch.setenv("DGREP_MODEL_CACHE", "2")
+    engine_mod.cached_engine("p1", backend="cpu")
+    engine_mod.cached_engine("p2", backend="cpu")
+    engine_mod.cached_engine("p3", backend="cpu")  # evicts p1 (LRU)
+    c = engine_mod.model_cache_counters()
+    assert c["compile_cache_evictions"] == 1
+    _, v = engine_mod.cached_engine("p3", backend="cpu")
+    assert v == "hit"
+    _, v = engine_mod.cached_engine("p1", backend="cpu")
+    assert v == "miss"  # was evicted
+
+
+def test_cached_engine_unhashable_args_bypass():
+    class Opaque:  # an options object with no stable identity key
+        __hash__ = None
+
+    e, v = engine_mod.cached_engine("needle", backend="cpu",
+                                    device_min_bytes=1 << 20)
+    assert v == "miss"
+    e2, v2 = engine_mod.cached_engine("needle", backend="cpu",
+                                      devices=[Opaque()])
+    assert v2 == "off" and e2 is not e
+
+
+def test_cached_engine_mesh_and_device_list_bypass():
+    """REAL meshes must bypass explicitly: jax.sharding.Mesh hashes by
+    VALUE, so the unhashability guard alone would cache-share one
+    tenant's mesh engine (mutated _accel_cached/demotion state and all)
+    with the next — the off verdict must come from the mesh key itself.
+    Explicit device LISTS likewise; symbolic devices='all' (the grep_tpu
+    default) stays cacheable."""
+    from distributed_grep_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((2,), ("data",))
+    e, v = engine_mod.cached_engine("needle", backend="device", mesh=mesh,
+                                    interpret=True)
+    assert v == "off"
+    e2, v2 = engine_mod.cached_engine("needle", backend="device", mesh=mesh,
+                                      interpret=True)
+    assert v2 == "off" and e2 is not e
+    assert engine_mod.model_cache_counters() == {}  # never touched
+    import jax
+
+    _, v3 = engine_mod.cached_engine("needle", backend="cpu",
+                                     devices=jax.local_devices()[:1])
+    assert v3 == "off"
+    _, v4 = engine_mod.cached_engine("needle", backend="cpu", devices="all")
+    assert v4 == "miss"  # the symbolic form is a stable key
+
+
+def test_invalidate_cached_engine_counts_eviction():
+    e, _ = engine_mod.cached_engine("needle", backend="cpu")
+    engine_mod.invalidate_cached_engine(e)
+    c = engine_mod.model_cache_counters()
+    assert c["compile_cache_evictions"] == 1
+    _, v = engine_mod.cached_engine("needle", backend="cpu")
+    assert v == "miss"  # invalidation forced a rebuild
+
+
+def test_cache_counters_stamped_into_engine_stats():
+    e, _ = engine_mod.cached_engine("needle", backend="cpu")
+    engine_mod.cached_engine("needle", backend="cpu")  # a hit
+    e.scan(b"a needle in a haystack\n")
+    assert e.stats["compile_cache_hits"] == 1
+    assert e.stats["compile_cache_misses"] == 1
+
+
+# ------------------------------------------------------- service end to end
+
+def test_service_single_job_matches_run_job(tmp_path, corpus, service):
+    service.start_local_workers(2)
+    jid = service.submit(grep_config(corpus))
+    assert service.wait_job(jid, timeout=60), service.job_status(jid)
+    res = service.job_result(jid)
+    assert res["state"] == JobState.DONE
+
+    oracle = run_job(
+        grep_config(corpus, work_dir=str(tmp_path / "serial")), n_workers=2
+    )
+    assert outputs_by_name(res["outputs"]) == outputs_by_name(
+        oracle.output_files
+    )
+
+
+def test_warm_resubmit_hits_cache_and_skips_rebuild(tmp_path, corpus,
+                                                    service, monkeypatch):
+    """ISSUE 6 acceptance: the SECOND submit of an identical pattern (after
+    an intervening different pattern, so the app-level same-config
+    short-circuit cannot answer) registers >= 1 compile_cache_hits and
+    constructs NO new engine."""
+    constructions = []
+    orig_init = engine_mod.GrepEngine.__init__
+
+    def spying_init(self, *a, **kw):
+        constructions.append(a)
+        return orig_init(self, *a, **kw)
+
+    monkeypatch.setattr(engine_mod.GrepEngine, "__init__", spying_init)
+    service.start_local_workers(1)  # ONE worker: no sibling warms the key
+    j1 = service.submit(grep_config(corpus, pattern="hello"))
+    assert service.wait_job(j1, timeout=60)
+    j2 = service.submit(grep_config(corpus, pattern="fox"))
+    assert service.wait_job(j2, timeout=60)
+    built_before = len(constructions)
+    hits_before = engine_mod.model_cache_counters().get(
+        "compile_cache_hits", 0
+    )
+    # warm resubmit of the first pattern
+    j3 = service.submit(grep_config(corpus, pattern="hello"))
+    assert service.wait_job(j3, timeout=60)
+    assert service.job_result(j3)["state"] == JobState.DONE
+    assert len(constructions) == built_before  # model rebuild skipped
+    hits = engine_mod.model_cache_counters()["compile_cache_hits"]
+    assert hits >= hits_before + 1
+    # identical outputs cold vs warm
+    assert outputs_by_name(service.job_result(j1)["outputs"]) == \
+        outputs_by_name(service.job_result(j3)["outputs"])
+
+
+def test_concurrent_jobs_byte_identical_to_serial(tmp_path, corpus, service):
+    """ISSUE 6 acceptance: two jobs submitted concurrently to one daemon
+    over SHARED workers produce outputs byte-identical to the same jobs
+    run serially via run_job."""
+    service.start_local_workers(2)
+    cfg_a = grep_config(corpus, pattern="hello")
+    cfg_b = grep_config(corpus, pattern="fox", n_reduce=2)
+    ja = service.submit(cfg_a)
+    jb = service.submit(cfg_b)
+    assert service.wait_job(ja, timeout=60), service.job_status(ja)
+    assert service.wait_job(jb, timeout=60), service.job_status(jb)
+    got_a = outputs_by_name(service.job_result(ja)["outputs"])
+    got_b = outputs_by_name(service.job_result(jb)["outputs"])
+
+    want_a = outputs_by_name(run_job(
+        grep_config(corpus, pattern="hello",
+                    work_dir=str(tmp_path / "sa")), n_workers=2
+    ).output_files)
+    want_b = outputs_by_name(run_job(
+        grep_config(corpus, pattern="fox", n_reduce=2,
+                    work_dir=str(tmp_path / "sb")), n_workers=2
+    ).output_files)
+    assert got_a == want_a
+    assert got_b == want_b
+
+
+def test_worker_kill_mid_job_a_reexecutes_only_a(tmp_path, corpus):
+    """ISSUE 6 acceptance (faults-style, multi-tenant): SIGKILL-shaped
+    worker death mid-job-A while job-B is running re-executes only A's
+    attempt — B completes with zero retries and both outputs stay exact."""
+    from distributed_grep_tpu.runtime.worker import WorkerKilled
+
+    svc = GrepService(
+        work_root=tmp_path / "svc",
+        task_timeout_s=2.0,
+        sweep_interval_s=0.1,
+    )
+    try:
+        # the FIRST worker (whichever one) to read a map split of job A
+        # (job ids are deterministic: job-1 = first submit) dies there —
+        # keyed on the current THREAD's loop so the hook sees the job of
+        # the worker actually running it
+        from distributed_grep_tpu.runtime import worker as worker_mod
+
+        loops_by_thread: dict[str, object] = {}
+        kill_lock = threading.Lock()
+        killed = {"n": 0}
+
+        def die_on_job_a_map():
+            loop = loops_by_thread.get(threading.current_thread().name)
+            if loop is None or loop._rpc_job_id != "job-1":
+                return
+            with kill_lock:
+                if killed["n"]:
+                    return
+                killed["n"] += 1
+            raise WorkerKilled()
+
+        orig_run = worker_mod.WorkerLoop.run
+
+        def capturing_run(self):
+            loops_by_thread[threading.current_thread().name] = self
+            return orig_run(self)
+
+        worker_mod.WorkerLoop.run, saved = capturing_run, orig_run
+        try:
+            svc.start_local_workers(
+                2, fault_hooks_per_worker=[
+                    {"after_map_read": die_on_job_a_map},
+                    {"after_map_read": die_on_job_a_map},
+                ]
+            )
+        finally:
+            worker_mod.WorkerLoop.run = saved
+        ja = svc.submit(grep_config(corpus, pattern="hello"))
+        jb = svc.submit(grep_config(corpus, pattern="fox"))
+        assert ja == "job-1"
+        assert svc.wait_job(ja, timeout=60), svc.job_status(ja)
+        assert svc.wait_job(jb, timeout=60), svc.job_status(jb)
+        assert killed["n"] == 1  # the fault actually fired
+
+        rec_a, rec_b = svc.record(ja), svc.record(jb)
+        assert rec_a.metrics.counters.get("map_retries", 0) >= 1
+        assert rec_b.metrics.counters.get("map_retries", 0) == 0
+        assert rec_b.metrics.counters.get("reduce_retries", 0) == 0
+
+        # both jobs' outputs byte-identical to serial runs
+        for jid, pat, sub in ((ja, "hello", "sa"), (jb, "fox", "sb")):
+            want = outputs_by_name(run_job(
+                grep_config(corpus, pattern=pat,
+                            work_dir=str(tmp_path / sub)), n_workers=2
+            ).output_files)
+            assert outputs_by_name(svc.job_result(jid)["outputs"]) == want
+    finally:
+        svc.stop()
+
+
+def test_cancel_leaves_other_job_intact(tmp_path, corpus, service):
+    # cancel job A before any worker exists (deterministically un-started
+    # work), then attach workers: B must complete exactly, A stays
+    # cancelled with no result.
+    ja = service.submit(grep_config(corpus, pattern="hello"))
+    jb = service.submit(grep_config(corpus, pattern="fox"))
+    assert service.cancel(ja) == JobState.CANCELLED
+    service.start_local_workers(2)
+    assert service.wait_job(jb, timeout=60), service.job_status(jb)
+    assert service.job_status(ja)["state"] == JobState.CANCELLED
+    with pytest.raises(RuntimeError):
+        service.job_result(ja)
+    want = outputs_by_name(run_job(
+        grep_config(corpus, pattern="fox",
+                    work_dir=str(tmp_path / "sb")), n_workers=2
+    ).output_files)
+    assert outputs_by_name(service.job_result(jb)["outputs"]) == want
+
+
+def test_admission_control_rejects_beyond_queue(tmp_path, corpus):
+    svc = GrepService(work_root=tmp_path / "svc", max_jobs=1, queue_depth=1)
+    try:
+        # no workers attached: jobs stay running/queued
+        svc.submit(grep_config(corpus))          # running slot
+        svc.submit(grep_config(corpus))          # queued slot
+        with pytest.raises(AdmissionError):
+            svc.submit(grep_config(corpus))      # over the queue cap
+    finally:
+        svc.stop()
+
+
+def test_submit_rejects_unreadable_inputs(tmp_path, corpus, service):
+    cfg = grep_config(corpus)
+    cfg.input_files = [str(tmp_path / "no-such-file.txt")]
+    with pytest.raises(ValueError):
+        service.submit(cfg)
+
+
+def test_env_knob_accessors(monkeypatch):
+    from distributed_grep_tpu.runtime.service import (
+        env_service_max_jobs,
+        env_service_queue,
+    )
+
+    monkeypatch.setenv("DGREP_SERVICE_MAX_JOBS", "7")
+    monkeypatch.setenv("DGREP_SERVICE_QUEUE", "3")
+    assert env_service_max_jobs() == 7
+    assert env_service_queue() == 3
+    monkeypatch.setenv("DGREP_SERVICE_MAX_JOBS", "bogus")
+    assert env_service_max_jobs(5) == 5  # malformed keeps the default
+    monkeypatch.setenv("DGREP_MODEL_CACHE", "bogus")
+    assert engine_mod.env_model_cache_entries(9) == 9
+
+
+# ------------------------------------------------------------- HTTP surface
+
+def test_http_api_submit_status_result_and_telemetry(tmp_path, corpus):
+    """The full HTTP surface: POST /jobs -> GET /jobs/<id> -> result;
+    service /status exposes queue/jobs/workers with piggybacked
+    compile_cache_* counters; per-job events.jsonl carries the
+    cache:hit|miss instants and trace-export renders them."""
+    svc = GrepService(
+        work_root=tmp_path / "svc", spans=True,
+        task_timeout_s=5.0, sweep_interval_s=0.1,
+    )
+    server = ServiceServer(svc)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(f"{base}{path}", data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        svc.start_local_workers(1)
+        cfg = grep_config(corpus, spans=True)
+        jid = call("POST", "/jobs", cfg.to_json().encode())["job_id"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = call("GET", f"/jobs/{jid}")
+            if st["state"] in (JobState.DONE, JobState.FAILED):
+                break
+            time.sleep(0.1)
+        assert st["state"] == JobState.DONE, st
+        assert st["map"]["completed"] == st["map"]["total"] == len(corpus)
+        res = call("GET", f"/jobs/{jid}/result")
+        assert res["outputs"]
+        # warm resubmit over HTTP: different pattern in between, then the
+        # original again -> >= 1 cache hit visible in /status
+        j2 = call("POST", "/jobs",
+                  grep_config(corpus, pattern="fox").to_json().encode())
+        j3 = call("POST", "/jobs", cfg.to_json().encode())
+        for j in (j2["job_id"], j3["job_id"]):
+            assert svc.wait_job(j, timeout=60)
+        status = call("GET", "/status")
+        assert status["service"] is True
+        assert status["compile_cache"]["compile_cache_hits"] >= 1
+        rows = list(status["workers"].values())
+        assert rows and any(
+            "compile_cache_hits" in (r.get("metrics") or {}) for r in rows
+        )
+        # cache instants on the span pipeline, through trace-export
+        ev_path = tmp_path / "svc" / jid / "events.jsonl"
+        assert ev_path.exists()
+        names = {
+            json.loads(line).get("name")
+            for line in ev_path.read_text().splitlines() if line.strip()
+        }
+        assert "cache:miss" in names or "cache:hit" in names
+        from distributed_grep_tpu.utils.spans import (
+            EventLog,
+            export_chrome_trace,
+        )
+
+        doc = export_chrome_trace(EventLog.read(ev_path))
+        assert any(
+            e.get("name", "").startswith("cache:")
+            for e in doc["traceEvents"]
+        )
+        # unknown job and not-done result answer 404/409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            call("GET", "/jobs/job-999")
+        assert ei.value.code == 404
+    finally:
+        svc.stop()
+        server.shutdown()
+
+
+def test_http_admission_answers_429(tmp_path, corpus):
+    svc = GrepService(work_root=tmp_path / "svc", max_jobs=1, queue_depth=0)
+    server = ServiceServer(svc)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        body = grep_config(corpus).to_json().encode()
+
+        def post():
+            req = urllib.request.Request(f"{base}/jobs", data=body,
+                                         method="POST")
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        post()  # fills the single running slot (no workers attached)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post()
+        assert ei.value.code == 429
+        # malformed config answers 400
+        req = urllib.request.Request(f"{base}/jobs", data=b'{"n_reduce": 0}',
+                                     method="POST")
+        req.add_header("Content-Type", "application/json")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        svc.stop()
+        server.shutdown()
+
+
+def test_http_worker_attach_serves_service_jobs(tmp_path, corpus):
+    """A stock `dgrep worker`-shaped attach (run_http_worker) detects the
+    service daemon, scopes its data plane per job, and completes jobs."""
+    from distributed_grep_tpu.runtime.http_transport import run_http_worker
+
+    svc = GrepService(
+        work_root=tmp_path / "svc", task_timeout_s=5.0, sweep_interval_s=0.1
+    )
+    server = ServiceServer(svc)
+    server.start()
+    try:
+        t = threading.Thread(
+            target=lambda: run_http_worker(
+                addr=f"127.0.0.1:{server.port}", n_parallel=1
+            ),
+            daemon=True,
+        )
+        t.start()
+        jid = svc.submit(grep_config(corpus))
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+        want = outputs_by_name(run_job(
+            grep_config(corpus, work_dir=str(tmp_path / "serial")),
+            n_workers=2,
+        ).output_files)
+        assert outputs_by_name(svc.job_result(jid)["outputs"]) == want
+    finally:
+        svc.stop()
+        server.shutdown()
+        t.join(timeout=15)
+
+
+# ------------------------------------------------------- back-compat pins
+
+def test_one_shot_serve_coordinator_contract_unperturbed(tmp_path, corpus):
+    """The single-job coordinator entry point still returns the status
+    dict with committed "outputs" — the service layer must not perturb
+    the one-shot path (run alongside an HTTP worker thread)."""
+    import socket
+
+    from distributed_grep_tpu.apps.loader import load_application
+    from distributed_grep_tpu.runtime.http_coordinator import serve_coordinator
+    from distributed_grep_tpu.runtime.http_transport import HttpTransport
+    from distributed_grep_tpu.runtime.worker import WorkerLoop
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        app_options={"pattern": "hello"},
+        n_reduce=3,
+        work_dir=str(tmp_path / "job"),
+        coordinator_port=port,
+    )
+    app = load_application("distributed_grep_tpu.apps.grep", pattern="hello")
+    result: dict = {}
+
+    def serve():
+        result.update(serve_coordinator(cfg))
+
+    ct = threading.Thread(target=serve)
+    ct.start()
+    time.sleep(0.3)
+    wt = threading.Thread(
+        target=lambda: WorkerLoop(
+            HttpTransport(f"127.0.0.1:{port}"), app
+        ).run()
+    )
+    wt.start()
+    ct.join(timeout=60)
+    wt.join(timeout=15)
+    assert not ct.is_alive()
+    assert len(result["outputs"]) == 3
+    assert result["done"] is True
+
+
+def test_cmd_coordinator_stdout_one_json_line(tmp_path, corpus, capsys,
+                                              monkeypatch):
+    """cmd_coordinator prints EXACTLY one JSON line naming the outputs
+    (scripts and the multi-process tests parse it)."""
+    from distributed_grep_tpu import __main__ as cli
+    from distributed_grep_tpu.runtime import http_coordinator as hc
+
+    cfg_path = tmp_path / "job.json"
+    cfg_path.write_text(JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        work_dir=str(tmp_path / "job"),
+    ).to_json())
+    monkeypatch.setattr(
+        hc, "serve_coordinator",
+        lambda config, resume=False: {"outputs": ["a", "b"], "done": True},
+    )
+    rc = cli.main(["coordinator", "--config", str(cfg_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    assert json.loads(lines[0]) == {"outputs": ["a", "b"]}
