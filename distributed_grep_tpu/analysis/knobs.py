@@ -101,6 +101,13 @@ KNOBS: dict[str, Knob] = {
         "Queued-submission cap (admission control) of the service daemon; "
         "submits beyond it answer 429 (accessor: env_service_queue).",
     ),
+    "DGREP_CORPUS_BYTES": Knob(
+        "ops/layout.py", "backend-sized (0 on CPU, 1 GiB on accelerators)",
+        "Device-resident corpus cache byte budget (ops/layout.CorpusCache; "
+        "0 disables): packed/padded HBM segments stay resident per content "
+        "key so a repeat query over unchanged inputs skips the read/pack/"
+        "upload path (accessor: ops/layout.env_corpus_bytes).",
+    ),
     "DGREP_MODEL_CACHE": Knob(
         "ops/engine.py", "32",
         "Entry cap of the cross-job compiled-model cache (0 disables; "

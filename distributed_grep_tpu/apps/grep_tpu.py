@@ -180,13 +180,21 @@ def map_fn(filename: str, contents: bytes) -> list[KeyValue]:
     return _records_for(filename, contents, result)
 
 
+map_batch_paths = True  # items may be (filename, local PATH) pairs:
+# scan_batch reads cold members itself and serves warm ones from the
+# device corpus cache (round 7) — the worker hands paths over on local
+# data planes so a repeat query never re-reads unchanged files
+
+
 def map_batch_fn(items) -> list[KeyValue]:
     """Batched map (round 6): many small splits in ONE call — the engine
     packs them into shared device dispatches (GrepEngine.scan_batch /
     ops/layout.BatchPacker), so a multi-file map split pays one kernel
     pass per DGREP_BATCH_BYTES window instead of one host scan per file.
-    ``items`` is a list of (filename, contents) pairs; the records are
-    identical to per-file map_fn calls (the packed scan is exact at file
+    ``items`` is a list of (filename, contents) pairs — contents may be a
+    local PATH on local data planes (``map_batch_paths``; scan_batch
+    reads or cache-serves those itself) — and the records are identical
+    to per-file map_fn calls (the packed scan is exact at file
     granularity — every blob is newline-terminated in the packed layout,
     and the engine's confirm/stitch pass owns stripe/segment edges)."""
     if _engine is None:

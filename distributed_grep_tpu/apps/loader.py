@@ -42,6 +42,11 @@ class LoadedApplication:
     # may amortize work across them (grep_tpu packs them into shared
     # device dispatches).  Apps without one get map_fn called per member.
     map_batch_fn: Callable[[list], list[KeyValue]] | None = None
+    # declared by apps whose map_batch_fn also accepts (filename, PATH)
+    # pairs: on a local data plane the worker hands over resolved paths
+    # instead of reading members, so the engine's device corpus cache
+    # (round 7) can serve a warm window with zero file reads
+    map_batch_paths: bool = False
     # optional streaming reduce: receives a value ITERATOR — hot keys never
     # materialize their value list (runtime/extsort.py); must agree with
     # reduce_fn on every input
@@ -128,6 +133,8 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
         module=module,
         map_path_fn=map_path_fn if callable(map_path_fn) else None,
         map_batch_fn=map_batch_fn if callable(map_batch_fn) else None,
+        map_batch_paths=bool(getattr(module, "map_batch_paths", False))
+        and callable(map_batch_fn),
         reduce_stream_fn=reduce_stream_fn if callable(reduce_stream_fn) else None,
     )
     if options:

@@ -429,6 +429,12 @@ class GrepEngine:
         # batching that puts the many-small-files regime back on the
         # kernels.  None = DGREP_BATCH_BYTES or 32 MB; 0 disables packing
         # (scan_batch then degrades to per-item scans).
+        corpus_bytes: int | None = None,  # device corpus cache budget
+        # (ops/layout.CorpusCache): scans with a content key keep their
+        # packed/padded segments device-resident so a repeat query over
+        # unchanged inputs skips the read/pack/upload path entirely.
+        # None = DGREP_CORPUS_BYTES, else off (0) on CPU backends and
+        # DEFAULT_CORPUS_BYTES_ACCEL on real accelerators; 0 disables.
     ):
         if (pattern is None) == (patterns is None):
             raise ValueError("exactly one of pattern / patterns is required")
@@ -482,6 +488,12 @@ class GrepEngine:
             from distributed_grep_tpu.ops.layout import env_batch_bytes
 
             self.batch_bytes = env_batch_bytes()
+        # None = resolve lazily at scan time (_corpus_budget): the env
+        # knob wins, else the backend decides — and probing the backend
+        # here would import jax on CPU-only constructions
+        self.corpus_bytes = (
+            int(corpus_bytes) if corpus_bytes is not None else None
+        )
         self.ignore_case = ignore_case
 
         self.shift_and: ShiftAndModel | None = None
@@ -1014,12 +1026,19 @@ class GrepEngine:
             pallas_scan.available() or self._interpret
         ) and not self._pallas_broken
 
-    def scan(self, data: bytes, progress=None) -> ScanResult:
+    def scan(self, data: bytes, progress=None, corpus_key=None) -> ScanResult:
         """Scan one in-memory document.  ``progress`` (optional callable,
         called as ``progress()`` at work milestones and
         ``progress(grace_s=N)`` ahead of a possible silent compile) is how
         a runtime failure detector keeps a tight liveness window over long
-        scans (runtime/worker.py wires it to the heartbeat RPC)."""
+        scans (runtime/worker.py wires it to the heartbeat RPC).
+
+        ``corpus_key`` (ops/layout.CorpusKey, derived from a FRESH stat of
+        the input's backing file(s)) opts this scan into the device corpus
+        cache: the packed/padded segments stay HBM-resident under the key
+        and a repeat scan of the same content skips the pack + upload
+        path.  The caller asserts ``data`` IS the bytes the key stats
+        describe — scan_file/scan_batch derive key and bytes together."""
         self._nl_local.stash = None
         # Span-pipeline telemetry (utils/spans.py): each scan becomes one
         # structured per-scan record — mode, bytes, duration, and the
@@ -1027,7 +1046,7 @@ class GrepEngine:
         # flags) that previously died with the process.  active() is one
         # thread-local read when the pipeline is off.
         t0 = _time_mod.perf_counter() if spans_mod.active() else None
-        res = self._scan_impl(data, progress)
+        res = self._scan_impl(data, progress, corpus_key=corpus_key)
         # Nullable-at-'$' patterns (accept_eol at the line-start state,
         # e.g. '^$', '^ *$', 'x?$'): the empty match is valid at every
         # line's EOL — including EMPTY lines, which contain no byte for
@@ -1060,6 +1079,13 @@ class GrepEngine:
             # the cache has ever been touched, so cache-free processes
             # keep their exact stats shape
             self.stats.update(cc)
+        from distributed_grep_tpu.ops.layout import corpus_cache_counters
+
+        ccorp = corpus_cache_counters()
+        if ccorp:
+            # same contract for the device corpus cache (hits/misses/
+            # evictions + the bytes_resident gauge): nonzero-only
+            self.stats.update(ccorp)
         if t0 is not None:
             # after the EOL fix-up: the record's match count must equal the
             # ScanResult the caller actually receives
@@ -1070,7 +1096,7 @@ class GrepEngine:
             )
         return res
 
-    def _scan_impl(self, data: bytes, progress=None) -> ScanResult:
+    def _scan_impl(self, data: bytes, progress=None, corpus_key=None) -> ScanResult:
         if self.mode == "re":
             return self._host_scan(self._scan_re, data, progress)
         if self._approx_all_lines or (
@@ -1165,7 +1191,7 @@ class GrepEngine:
             res = self._host_scan(self._host_scanner(), data, progress)
             self.stats["small_host_scan"] = True  # AFTER: scanners reset stats
             return res
-        return self._scan_device(data, progress=progress)
+        return self._scan_device(data, progress=progress, corpus_key=corpus_key)
 
     def _small_for_device(self, n_bytes: int) -> bool:
         """True when a PLAIN scan() of this size should reroute to the
@@ -1173,7 +1199,17 @@ class GrepEngine:
         scan_batch's pack-vs-solo split uses the size threshold alone:
         packing amortizes dispatch overhead on every backend (interpret
         engines and XLA-on-CPU included), so it is not gated on
-        _accel_backend the way the solo-host reroute is."""
+        _accel_backend the way the solo-host reroute is.  Probes the
+        backend (resolving _accel_cached) — callers run AFTER the
+        responsiveness wall; pre-wall callers use _small_route_cached."""
+        return self._accel_backend() and self._small_route_cached(n_bytes)
+
+    def _small_route_cached(self, n_bytes: int) -> bool:
+        """_small_for_device's verdict WITHOUT the backend probe: reads
+        the cached _accel_backend answer only, so it is safe BEFORE the
+        responsiveness wall (an unresolved flag reads False — for the
+        corpus-cache opt-in that only costs one uncached scan, never a
+        wrong answer or a hang)."""
         return (
             n_bytes < self.device_min_bytes
             and not self._interpret  # CI interpret engines exist to
@@ -1184,7 +1220,7 @@ class GrepEngine:
             and self.mode != "approx"  # the host approx oracle is a ~MB/s
             # Python recurrence; the device wins at any size
             and self._host_scanner() is not None
-            and self._accel_backend()
+            and bool(self._accel_cached)
         )
 
     def _device_responsive(self) -> bool:
@@ -1263,6 +1299,64 @@ class GrepEngine:
                 cached = False
             self._accel_cached = cached
         return cached
+
+    def _corpus_budget(self) -> int:
+        """Effective device-corpus-cache byte budget for this engine's
+        scans (0 = caching off).  Resolution order: the explicit
+        ``corpus_bytes=`` construction arg, the DGREP_CORPUS_BYTES env
+        knob (ONE parse, ops/layout.env_corpus_bytes), then the backend
+        default — OFF on CPU (CI and plain host runs keep their exact
+        pre-cache behavior), DEFAULT_CORPUS_BYTES_ACCEL on real
+        accelerators (the service regime the cache exists for).  Mesh
+        engines and explicit devices= LISTS always answer 0: resident
+        segments are committed to specific devices, so sharing them
+        across engines pinned to different sets would defeat the
+        caller's pinning — the same bypass verdict as the model cache
+        (the symbolic devices="all", the grep_tpu default, stays
+        cacheable: every engine resolves it to the same local set and
+        the round-robin device assignment is deterministic)."""
+        if self.mesh is not None:
+            return 0
+        if self.devices is not None and not isinstance(self.devices, str):
+            return 0
+        if self.corpus_bytes is not None:
+            return max(0, self.corpus_bytes)
+        from distributed_grep_tpu.ops.layout import (
+            DEFAULT_CORPUS_BYTES_ACCEL,
+            env_corpus_bytes,
+        )
+
+        env = env_corpus_bytes()
+        if env is not None:
+            return env
+        return DEFAULT_CORPUS_BYTES_ACCEL if self._accel_backend() else 0
+
+    def _corpus_opt_in(self) -> bool:
+        """Cheap, jax-FREE opt-in check for the corpus-cache paths that
+        run at scan_file/scan_batch ENTRY — i.e. before the
+        responsiveness wall and on engines (mode "re"/"native") that
+        never touch jax at all.  The explicit arg and the env knob
+        answer directly; the backend-default leg answers True only when
+        a previous scan ALREADY probed the backend as an accelerator
+        (_accel_cached) — it never probes itself, so a black-holed
+        tunnel cannot hang the entry path (the round-4 wall invariant)
+        and host-only engines keep their zero-jax contract.  Cost: the
+        first scan of an accelerator process runs uncached (the cache
+        is empty then anyway); the second threads keys and populates.
+        _corpus_budget() stays the authoritative resolution — called
+        from ops/device_scan, past the wall."""
+        if self.mesh is not None or (
+            self.devices is not None and not isinstance(self.devices, str)
+        ):
+            return False
+        if self.corpus_bytes is not None:
+            return self.corpus_bytes > 0
+        from distributed_grep_tpu.ops.layout import env_corpus_bytes
+
+        env = env_corpus_bytes()
+        if env is not None:
+            return env > 0
+        return bool(self._accel_cached)
 
     # A host-routed scan of a large in-memory split proceeds in
     # newline-aligned pieces with a progress stamp between pieces — the
@@ -1369,6 +1463,76 @@ class GrepEngine:
         lines_before = 0
         carry = b""
 
+        def scan_piece(buf: bytes, key=None) -> None:
+            """One newline-bounded piece through scan(): match collection,
+            per-line / columnar emit, file-global line accounting — shared
+            by the streamed loop below and the corpus-cache warm path."""
+            nonlocal n_matches, total, end_offsets, lines_before
+            res = self.scan(buf, progress=progress, corpus_key=key)
+            total += len(buf)
+            n_matches += res.n_matches
+            end_offsets += self.stats.get("end_offsets", 0)
+            nl_idx = None
+            if res.matched_lines.size:
+                if emit is not None:
+                    nl_idx = lines_mod.newline_index(buf)
+                    for ln in res.matched_lines.tolist():
+                        s, e = lines_mod.line_span(nl_idx, ln, len(buf))
+                        emit(lines_before + ln, buf[s:e])
+                elif emit_chunk is not None:
+                    nl_idx = lines_mod.newline_index(buf)
+                    emit_chunk(lines_before, buf, res.matched_lines, nl_idx)
+                matched.extend((res.matched_lines + lines_before).tolist())
+            if nl_idx is not None:
+                # chunks are newline-terminated except possibly the final
+                # one: reuse the index instead of re-counting
+                lines_before += len(nl_idx) + (0 if buf.endswith(b"\n") else 1)
+            else:
+                lines_before += lines_mod.count_lines(buf)
+            if progress is not None:
+                progress()  # one work milestone per streamed chunk
+
+        # Device corpus cache (round 7, ops/layout.CorpusCache): a
+        # single-chunk file whose host bytes AND packed device segments
+        # are already resident serves this scan with zero file reads and
+        # zero uploads; a cold single-chunk scan threads its content key
+        # so the NEXT query over unchanged bytes is warm.  Multi-chunk
+        # files stream cold: their chunk cuts are content-dependent, and
+        # the service regime this cache targets (log/code search) is many
+        # files under the 64 MB chunk target, not one giant file.
+        corpus_k = None
+        if self._corpus_opt_in():
+            from distributed_grep_tpu.ops.layout import (
+                corpus_cache,
+                file_content_key,
+            )
+
+            k = file_content_key(path)
+            # _small_route_cached: on a real accelerator a sub-
+            # device_min_bytes solo file host-routes and can never
+            # populate — skip the key/stat/lock work outright rather
+            # than pay a guaranteed-miss lookup per query (reads the
+            # CACHED backend flag only; safe pre-wall)
+            if (
+                k is not None and 0 < k.n_bytes <= chunk_target
+                and not self._small_route_cached(k.n_bytes)
+            ):
+                corpus_k = k
+                ent = corpus_cache().lookup(k)
+                if ent is not None and len(ent.data) == k.n_bytes:
+                    # warm: the revalidated entry's host bytes stand in
+                    # for the disk read (stat drift would have evicted
+                    # it) — the file is never opened.  Counted at the
+                    # cache (host-routed engines never reach the
+                    # resident_segments verdict in scan_device)
+                    corpus_cache().count_host_hit()
+                    scan_piece(ent.data, k)
+                    self.stats["end_offsets"] = end_offsets
+                    self.stats["read_wait_seconds"] = 0.0
+                    return ScanResult(
+                        np.asarray(matched, dtype=np.int64), n_matches, total
+                    )
+
         class _Ready:
             """Future-like wrapper for data already in hand (the first,
             synchronous read, and the EOF sentinel)."""
@@ -1395,6 +1559,7 @@ class GrepEngine:
             pending = self._reader_pool().submit(f.read, chunk_target)
             return pending
 
+        key = None  # set by the whole-file unsplit branch only
         try:
             f = open(path, "rb")
             t0 = _time.perf_counter()
@@ -1413,40 +1578,39 @@ class GrepEngine:
                         else _Ready(b"")
                     )
                     buf = carry + block
-                    cut = buf.rfind(b"\n")
-                    if cut < 0:
-                        carry = buf  # line longer than the chunk: keep growing
-                        continue
-                    carry, buf = buf[cut + 1 :], buf[: cut + 1]
-                    final = False
+                    if (
+                        corpus_k is not None and total == 0
+                        and len(buf) == corpus_k.n_bytes
+                        and file_content_key(path) == corpus_k
+                    ):
+                        # The WHOLE single-chunk file is in hand and a
+                        # fresh re-stat agrees: scan it UNSPLIT (the
+                        # warm-serve path above proves whole-file-as-
+                        # one-piece is exact).  The newline cut below
+                        # would otherwise orphan an un-terminated tail
+                        # into carry and leave the corpus key
+                        # unthreaded on BOTH pieces — a no-trailing-
+                        # newline file (common in code search) would
+                        # never populate the cache.
+                        carry, final = b"", True
+                        key = corpus_k  # the re-stat above just
+                        # confirmed buf IS the keyed bytes
+                    else:
+                        cut = buf.rfind(b"\n")
+                        if cut < 0:
+                            carry = buf  # line longer than the chunk:
+                            continue     # keep growing
+                        carry, buf = buf[cut + 1 :], buf[: cut + 1]
+                        final = False
                 else:
                     buf, carry, final = carry, b"", True
                 if buf:
-                    res = self.scan(buf, progress=progress)
-                    total += len(buf)
-                    n_matches += res.n_matches
-                    end_offsets += self.stats.get("end_offsets", 0)
-                    nl_idx = None
-                    if res.matched_lines.size:
-                        if emit is not None:
-                            nl_idx = lines_mod.newline_index(buf)
-                            for ln in res.matched_lines.tolist():
-                                s, e = lines_mod.line_span(nl_idx, ln, len(buf))
-                                emit(lines_before + ln, buf[s:e])
-                        elif emit_chunk is not None:
-                            nl_idx = lines_mod.newline_index(buf)
-                            emit_chunk(
-                                lines_before, buf, res.matched_lines, nl_idx
-                            )
-                        matched.extend((res.matched_lines + lines_before).tolist())
-                    if nl_idx is not None:
-                        # chunks are newline-terminated except possibly the
-                        # final one: reuse the index instead of re-counting
-                        lines_before += len(nl_idx) + (0 if buf.endswith(b"\n") else 1)
-                    else:
-                        lines_before += lines_mod.count_lines(buf)
-                    if progress is not None:
-                        progress()  # one work milestone per streamed chunk
+                    # key is corpus_k ONLY when the unsplit branch above
+                    # confirmed (fresh re-stat) that buf is the whole
+                    # keyed file in one piece — every other piece,
+                    # including a live-append tail that outgrew the
+                    # stat, scans uncached
+                    scan_piece(buf, key)
                     if (stop_after_match and n_matches) or (
                         stop is not None and stop()
                     ):
@@ -1501,12 +1665,35 @@ class GrepEngine:
         ``batch_fill_ratio`` (mean packed-buffer fill vs batch_bytes) —
         and each packed flush emits a ``scan:batch`` span on the span
         pipeline (utils/spans.py), so trace-export shows packed
-        dispatches on the worker rows."""
-        from distributed_grep_tpu.ops.layout import BatchPacker, packed_size
+        dispatches on the worker rows.
+
+        Path items participate in the device corpus cache (round 7) when
+        a byte budget is in force: solo files and packed windows thread
+        content keys through scan(), and a repeat call over unchanged
+        files serves host bytes AND device segments from the cache —
+        zero reads, zero uploads.  A warm packed window is recognized
+        BEFORE any member is read (the cache's window index maps a
+        window's first member file to its stored member list; fresh
+        stats of every member must match), so the whole window re-scans
+        without touching the filesystem."""
+        from distributed_grep_tpu.ops.layout import (
+            BatchPacker,
+            batch_content_key,
+            corpus_cache,
+            file_content_key,
+            packed_size,
+        )
 
         cap = max(0, int(self.batch_bytes))
         packer = BatchPacker(cap) if cap > 0 else None
+        use_corpus = self._corpus_opt_in()  # jax-free (pre-wall entry)
+        cache = corpus_cache() if use_corpus else None
+        pk_keys: list = []  # member content keys, parallel to the packer
         out: list = []
+        read_wait = 0.0  # member-open stall; stamped like scan_file's so
+        # path items (worker map_batch_paths handover — the read happens
+        # HERE, inside map:compute, same shape as the map_path branch)
+        # keep disk wait visible in engine stats / the span piggyback
         bstats = {
             "batched_files": 0, "batch_dispatches": 0,
             "solo_dispatches": 0, "fill_sum": 0.0,
@@ -1517,22 +1704,21 @@ class GrepEngine:
                 emit(name, data, res)
             out.append((name, res))
 
-        def flush() -> None:
-            if packer is None:
-                return
-            batch = packer.pack()
-            if batch is None:
-                return
-            if len(batch) == 1:
-                # nothing amortized: scan the original blob (no synthesized
-                # terminator in bytes_scanned, no demux) and count it solo
-                bstats["solo_dispatches"] += 1
-                handle(batch.names[0], batch.blobs[0],
-                       self.scan(batch.blobs[0], progress=progress))
-                return
+        def scan_packed(batch, names, win_key) -> None:
+            """One packed window through scan() + demux + per-file emit —
+            shared by the cold flush and the warm-window path (which
+            reuses the CACHED PackedBatch: demux tables and member blobs
+            come from the entry, not a re-read + re-pack)."""
             t0 = _time_mod.perf_counter()
             t0_wall = _time_mod.time()
-            res = self.scan(batch.data, progress=progress)
+            res = self.scan(batch.data, progress=progress,
+                            corpus_key=win_key)
+            if cache is not None and win_key is not None:
+                # record the demux tables + member blobs behind the
+                # entry this scan just published (no-op if it was not
+                # admitted) — what makes the next call's warm window
+                # possible without re-reading members
+                cache.attach_batch(win_key, batch)
             per_file = batch.demux(res.matched_lines)
             bstats["batched_files"] += len(batch)
             bstats["batch_dispatches"] += 1
@@ -1545,25 +1731,114 @@ class GrepEngine:
                     bytes=len(batch.data), matches=res.n_matches,
                     fill_ratio=round(len(batch.data) / cap, 6),
                 )
-            for name, blob, lines in zip(batch.names, batch.blobs, per_file):
+            # member_blobs(): as-stored on a fresh pack, transient
+            # slices of batch.data on a cache-slimmed warm window
+            for name, blob, lines in zip(names, batch.member_blobs(),
+                                         per_file):
                 handle(name, blob, ScanResult(
                     lines.astype(np.int64), int(lines.size), len(blob)
                 ))
 
-        for name, data in items:
-            if not isinstance(data, (bytes, bytearray, memoryview)):
-                with open(_os.fspath(data), "rb") as f:
-                    data = f.read()
+        def flush() -> None:
+            nonlocal pk_keys
+            if packer is None:
+                return
+            keys, pk_keys = pk_keys, []
+            batch = packer.pack()
+            if batch is None:
+                return
+            if len(batch) == 1:
+                # nothing amortized: scan the original blob (no synthesized
+                # terminator in bytes_scanned, no demux) and count it solo
+                bstats["solo_dispatches"] += 1
+                handle(batch.names[0], batch.blobs[0],
+                       self.scan(batch.blobs[0], progress=progress,
+                                 corpus_key=keys[0] if keys else None))
+                return
+            win_key = batch_content_key(keys) if use_corpus else None
+            scan_packed(batch, batch.names, win_key)
+
+        def match_window(i, stored) -> list | None:
+            """Fresh member keys when ``items[i:...]`` are path items for
+            exactly the stored window's member files, in order — else
+            None (the cold path then handles item i normally)."""
+            ids = stored.identity[1]
+            if i + len(ids) > len(items):
+                return None
+            keys = []
+            for (_nm, d), ident in zip(items[i:i + len(ids)], ids):
+                if isinstance(d, (bytes, bytearray, memoryview)):
+                    return None
+                k = file_content_key(d)
+                if k is None or k.identity != ident:
+                    return None
+                keys.append(k)
+            return keys
+
+        items = list(items)  # the warm-window probe needs lookahead
+        i = 0
+        while i < len(items):
+            name, data = items[i]
+            is_blob = isinstance(data, (bytes, bytearray, memoryview))
+            fk = None
+            if use_corpus and not is_blob:
+                fk = file_content_key(data)
+                if fk is not None and packer is not None:
+                    stored = cache.window_for(fk)
+                    keys = (
+                        match_window(i, stored)
+                        if stored is not None else None
+                    )
+                    if keys is not None:
+                        wk = batch_content_key(keys)
+                        ent = cache.lookup(wk)
+                        if (
+                            ent is not None and ent.batch is not None
+                            # the ENGINE's cap governs warm content too:
+                            # a window packed under a larger budget is
+                            # not re-served once batch_bytes shrinks
+                            # (per-dispatch memory bound; the cold path
+                            # re-packs at the new granularity and the
+                            # oversized entry ages out via LRU)
+                            and len(ent.batch.data) <= cap
+                        ):
+                            flush()  # order-preserving, like a solo input
+                            cache.count_host_hit()
+                            scan_packed(
+                                ent.batch,
+                                [nm for nm, _ in items[i:i + len(keys)]],
+                                wk,
+                            )
+                            i += len(keys)
+                            continue
+            i += 1
+            if not is_blob:
+                ent = cache.lookup(fk) if fk is not None else None
+                if ent is not None and len(ent.data) == fk.n_bytes:
+                    data = ent.data  # warm host bytes: no disk read
+                    cache.count_host_hit()
+                else:
+                    t_r = _time_mod.perf_counter()
+                    with open(_os.fspath(data), "rb") as f:
+                        data = f.read()
+                    read_wait += _time_mod.perf_counter() - t_r
+                    if fk is not None and (
+                        len(data) != fk.n_bytes
+                        or file_content_key(items[i - 1][1]) != fk
+                    ):
+                        fk = None  # changed between stat and read: uncached
             data = bytes(data)
             small = len(data) < self.device_min_bytes
             if packer is None or not small or packed_size(data) > cap:
                 flush()  # order-preserving: pending smalls go first
                 bstats["solo_dispatches"] += 1
-                handle(name, data, self.scan(data, progress=progress))
+                handle(name, data,
+                       self.scan(data, progress=progress, corpus_key=fk))
                 continue
             if not packer.fits(data):
                 flush()
             packer.add(name, data)
+            pk_keys.append(fk)
         flush()
         # AFTER the last scan (each scan resets the thread's stats dict):
         # the batch counters describe the whole scan_batch call.
@@ -1578,6 +1853,7 @@ class GrepEngine:
             round(bstats["fill_sum"] / bstats["batch_dispatches"], 6)
             if bstats["batch_dispatches"] else 0.0
         )
+        st["read_wait_seconds"] = read_wait
         return out
 
     # ---------------------------------------------------------- host engines
@@ -1722,12 +1998,14 @@ class GrepEngine:
         return self._fdr_ep_dev_tables
 
     # --------------------------------------------------------- device engine
-    def _scan_device(self, data: bytes, progress=None) -> ScanResult:
+    def _scan_device(self, data: bytes, progress=None,
+                     corpus_key=None) -> ScanResult:
         """Per-segment device dispatch (ops/device_scan.py — split out
         round 5; the orchestration is the engine's, moved)."""
         from distributed_grep_tpu.ops.device_scan import scan_device
 
-        return scan_device(self, data, progress=progress)
+        return scan_device(self, data, progress=progress,
+                           corpus_key=corpus_key)
 
 def make_engine(
     pattern: str | None = None, patterns: list[str] | None = None, **kw

@@ -645,10 +645,12 @@ class GrepService:
     def status(self) -> dict:
         """Service-level view: queue depth, running jobs, per-job progress,
         the service worker table (with piggybacked engine metrics — the
-        compile_cache_* counters land here via the heartbeat piggyback),
-        and this process's own compiled-model-cache counters (authoritative
-        for in-process workers; HTTP workers report theirs per row)."""
+        compile_cache_* / corpus_cache_* counters land here via the
+        heartbeat piggyback), and this process's own compiled-model and
+        device-corpus cache counters (authoritative for in-process
+        workers; HTTP workers report theirs per row)."""
         from distributed_grep_tpu.ops.engine import model_cache_counters
+        from distributed_grep_tpu.ops.layout import corpus_cache_counters
 
         now = time.monotonic()
         with self._lock:
@@ -686,6 +688,7 @@ class GrepService:
             "jobs": jobs,
             "workers": workers,
             "compile_cache": model_cache_counters(),
+            "corpus_cache": corpus_cache_counters(),
         }
 
     # ------------------------------------------------------------- lifecycle

@@ -40,18 +40,23 @@ class WorkerKilled(Exception):
 
 
 def _engine_cache_counters() -> dict | None:
-    """This process's cross-job compiled-model-cache counters
-    (compile_cache_hits/misses/evictions), or None when the engine module
-    was never imported or the cache never touched — piggybacked with the
-    Metrics snapshot so the coordinator /status workers view shows cache
-    effectiveness per worker.  sys.modules-gated: a wordcount worker must
-    not import the whole ops stack just to report nothing."""
+    """This process's cross-job engine-cache counters — compiled-model
+    (compile_cache_hits/misses/evictions) AND device-corpus
+    (corpus_cache_hits/misses/evictions/bytes_resident) — or None when
+    the owning modules were never imported or neither cache was touched;
+    piggybacked with the Metrics snapshot so the coordinator /status
+    workers view shows cache effectiveness per worker.  sys.modules-
+    gated: a wordcount worker must not import the whole ops stack just
+    to report nothing."""
     import sys as _sys
 
+    counters: dict = {}
     eng = _sys.modules.get("distributed_grep_tpu.ops.engine")
-    if eng is None:
-        return None
-    counters = eng.model_cache_counters()
+    if eng is not None:
+        counters.update(eng.model_cache_counters())
+    lay = _sys.modules.get("distributed_grep_tpu.ops.layout")
+    if lay is not None:
+        counters.update(lay.corpus_cache_counters())
     return counters or None
 
 
@@ -373,22 +378,61 @@ class WorkerLoop:
                 # packs them into shared device dispatches); others get
                 # map_fn per member — still one task, one commit, one
                 # journal entry instead of len(members) of each.
+                batch_fn = self.app.map_batch_fn
+                # Local data plane + a batch fn that accepts paths
+                # (map_batch_paths, grep_tpu): hand over resolved member
+                # paths instead of reading them here — the engine's
+                # device corpus cache (round 7) then serves a warm
+                # window with ZERO file reads, and cold members cost the
+                # same whole-read scan_batch would have done anyway.
+                batch_paths = (
+                    batch_fn is not None
+                    and getattr(self.app, "map_batch_paths", False)
+                    and getattr(self.transport, "is_local", False)
+                    and hasattr(self.transport, "read_input_path")
+                )
                 with download_guard(), \
                         trace.annotate(f"map_read:{a.task_id}"), \
                         spans_mod.span("map:read", cat="map",
                                        file=a.filename,
                                        files=len(a.filenames)):
-                    blobs = [
-                        (name, self.transport.read_input(name))
-                        for name in a.filenames
-                    ]
+                    if batch_paths:
+                        import os as _os
+
+                        blobs = []
+                        n_bytes = 0
+                        for name in a.filenames:
+                            p, is_temp = self.transport.read_input_path(
+                                name
+                            )
+                            if is_temp:
+                                # Honor the (path, is_temp) contract
+                                # like the map_path branch: a spooled
+                                # copy is read-and-unlinked, never
+                                # handed over as a path — its transient
+                                # realpath must not become a corpus
+                                # content key (scan_batch accepts
+                                # mixed bytes/path items, so one
+                                # spooled member demotes only itself).
+                                with open(p, "rb") as _fh:
+                                    data_b = _fh.read()
+                                _os.unlink(p)
+                                blobs.append((name, data_b))
+                                n_bytes += len(data_b)
+                            else:
+                                blobs.append((name, str(p)))
+                                n_bytes += _os.path.getsize(p)
+                    else:
+                        blobs = [
+                            (name, self.transport.read_input(name))
+                            for name in a.filenames
+                        ]
+                        n_bytes = sum(len(b) for _, b in blobs)
                 self._fault("after_map_read")
-                n_bytes = sum(len(b) for _, b in blobs)
                 with self.metrics.timer("map_compute"), \
                         trace.annotate(f"map_compute:{a.task_id}"), \
                         spans_mod.span("map:compute", cat="map"), \
                         compute_guard():
-                    batch_fn = self.app.map_batch_fn
                     if batch_fn is not None:
                         records = batch_fn(blobs)
                     else:

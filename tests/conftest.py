@@ -114,6 +114,20 @@ def _fresh_model_cache():
     _eng.model_cache_clear()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_corpus_cache():
+    """The device corpus cache (ops/layout.CorpusCache) is process-global
+    by design — the service process WANTS shards shared across jobs.
+    Across tests that sharing would serve one test's resident device
+    arrays (and host bytes) to another scanning a same-named tmp file,
+    so each test starts and ends with an empty cache."""
+    from distributed_grep_tpu.ops import layout as _lay
+
+    _lay.corpus_cache_clear()
+    yield
+    _lay.corpus_cache_clear()
+
+
 def expand_records(records):
     """Flatten map output to per-record KeyValues: the built-in grep apps
     emit columnar LineBatch objects (round 5, runtime/columnar.py); tests

@@ -46,9 +46,9 @@ def _counting(monkeypatch):
     calls: list[int] = []
     orig = device_scan.scan_device
 
-    def counted(eng, data, progress=None):
+    def counted(eng, data, progress=None, **kw):
         calls.append(len(data))
-        return orig(eng, data, progress=progress)
+        return orig(eng, data, progress=progress, **kw)
 
     monkeypatch.setattr(device_scan, "scan_device", counted)
     return calls
