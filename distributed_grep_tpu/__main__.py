@@ -1098,6 +1098,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         max_jobs=args.max_jobs,
         queue_depth=args.queue,
         spans=args.spans,
+        resume=False if args.no_resume else None,
     )
     server = ServiceServer(service, host=args.host, port=args.port)
     server.start()
@@ -1126,7 +1127,8 @@ def cmd_submit(args: argparse.Namespace) -> int:
     for completion, print exactly ONE JSON line (job_id/state/outputs)."""
     import time as _time
     import urllib.error
-    import urllib.request
+
+    from distributed_grep_tpu.runtime.http_transport import client_call
 
     if args.config:
         cfg = JobConfig.load(args.config)
@@ -1147,23 +1149,28 @@ def cmd_submit(args: argparse.Namespace) -> int:
         print("error: need --config, or PATTERN and FILE arguments",
               file=sys.stderr)
         return 2
-    base = args.addr if args.addr.startswith("http") else f"http://{args.addr}"
-
     def call(method: str, path: str, body: bytes | None = None) -> dict:
-        req = urllib.request.Request(f"{base}{path}", data=body, method=method)
-        if body is not None:
-            req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req, timeout=args.timeout) as r:
-            return json.loads(r.read())
+        # the transport's bounded-jittered-retry helper: a transient
+        # connection reset mid-poll retries instead of killing the client
+        # before the daemon-death JSON fallback below can fire
+        return client_call(args.addr, method, path, body=body,
+                           timeout=args.timeout)
 
     try:
-        # to_json() is ensure_ascii json.dumps output: strict is exact
-        reply = call("POST", "/jobs", cfg.to_json().encode("utf-8", "strict"))
+        # to_json() is ensure_ascii json.dumps output: strict is exact.
+        # SINGLE-SHOT on purpose: submission is not idempotent — a reply
+        # lost after the daemon registered the job would re-POST a
+        # duplicate job (the polls below retry; they're reads).
+        reply = client_call(
+            args.addr, "POST", "/jobs",
+            cfg.to_json().encode("utf-8", "strict"),
+            timeout=args.timeout, retry=False,
+        )
     except urllib.error.HTTPError as e:
         detail = e.read()[:500].decode("utf-8", "replace")
         print(f"error: submit rejected ({e.code}): {detail}", file=sys.stderr)
         return 2
-    except OSError as e:
+    except OSError as e:  # incl. CoordinatorGone: the retry schedule ran dry
         print(f"error: cannot reach service at {args.addr}: {e}",
               file=sys.stderr)
         return 2
@@ -1225,13 +1232,15 @@ def cmd_status(args: argparse.Namespace) -> int:
     """Operator surface for a running coordinator: pretty-print its
     GET /status JSON (task states per phase + metrics counters)."""
     import urllib.error
-    import urllib.request
+
+    from distributed_grep_tpu.runtime.http_transport import client_call
 
     url = f"http://{args.addr}/status"
     try:
-        with urllib.request.urlopen(url, timeout=args.timeout) as r:
-            body = r.read()
-        status = json.loads(body)
+        # the transport's bounded-retry helper (net-retry rule): transient
+        # resets retry instead of failing the operator's one-shot query
+        status = client_call(args.addr, "GET", "/status",
+                             timeout=args.timeout)
     except urllib.error.HTTPError as e:  # reached, but not a coordinator
         print(f"error: {url} answered {e.code} {e.reason}", file=sys.stderr)
         return 2
@@ -1429,6 +1438,11 @@ def main(argv: list[str] | None = None) -> int:
                         "(DGREP_SERVICE_QUEUE overrides)")
     p.add_argument("--spans", action="store_true",
                    help="span pipeline for every job (per-job events.jsonl)")
+    p.add_argument("--no-resume", action="store_true",
+                   help="do not replay the work root's jobs.jsonl registry "
+                        "(default: a restarted daemon re-admits queued jobs "
+                        "and resumes running ones; DGREP_SERVICE_RESUME=0 "
+                        "is the env equivalent)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

@@ -101,6 +101,32 @@ KNOBS: dict[str, Knob] = {
         "Queued-submission cap (admission control) of the service daemon; "
         "submits beyond it answer 429 (accessor: env_service_queue).",
     ),
+    "DGREP_SERVICE_RESUME": Knob(
+        "runtime/service.py", "1",
+        "Crash-recovery resume of the service daemon: a restart replays "
+        "the work root's jobs.jsonl registry (re-admit queued, resume "
+        "running jobs from their journals); 0/false disables "
+        "(accessor: env_service_resume).",
+    ),
+    "DGREP_WORKER_QUARANTINE_S": Knob(
+        "runtime/scheduler.py", "30",
+        "Base quarantine window for flaky workers: after 3 consecutive "
+        "attributed task timeouts a worker receives no assignments for "
+        "base * 2^(episode-1) seconds (capped at 8x; accessor: "
+        "runtime/scheduler.env_worker_quarantine_s).",
+    ),
+    "DGREP_RPC_RETRIES": Knob(
+        "runtime/http_transport.py", "6",
+        "Transient-error retries per client HTTP call (worker RPCs, data "
+        "plane, CLI client_call); 0 disables (accessor: env_rpc_retries).",
+    ),
+    "DGREP_RPC_BACKOFF_S": Knob(
+        "runtime/http_transport.py", "0.5",
+        "Base backoff between transient-error retries: exponential, "
+        "capped at 5 s per sleep, +/-50% jitter so a daemon restart's "
+        "synchronized failures do not retry in lockstep (accessor: "
+        "env_rpc_backoff_s).",
+    ),
     "DGREP_CORPUS_BYTES": Knob(
         "ops/layout.py", "backend-sized (0 on CPU, 1 GiB on accelerators)",
         "Device-resident corpus cache byte budget (ops/layout.CorpusCache; "

@@ -680,6 +680,50 @@ def rule_logging(project: Project) -> Iterator[Violation]:
                     )
 
 
+# ------------------------------------------------------------------- rule R8
+
+_NET_SCOPE = ("runtime/",)
+_NET_FILES = ("__main__.py",)
+_NET_EXEMPT = "runtime/http_transport.py"
+# Raw client-side connection constructors: urlopen plus the http.client /
+# socket primitives it wraps.  Server-side classes (ThreadingHTTPServer)
+# are not listed — serving has no retry story to bypass.
+_RAW_NET_CALLS = {"urlopen", "create_connection", "HTTPConnection",
+                  "HTTPSConnection"}
+
+
+def rule_net_retry(project: Project) -> Iterator[Violation]:
+    """R8: no raw ``urlopen``/client-socket calls on control-plane paths
+    (runtime/, the CLI) outside runtime/http_transport.py — every client
+    HTTP call routes through the transport's bounded-jittered-retry
+    helpers (``HttpTransport._request`` / ``client_call``).  A raw call
+    dies on the first transient connection reset, exactly the failure the
+    retry layer exists to absorb (a daemon restart resets EVERY attached
+    client at once), and silently forks the retry policy the
+    DGREP_RPC_RETRIES/DGREP_RPC_BACKOFF_S knobs are supposed to govern."""
+    for rel in project.files():
+        if not (rel.startswith(_NET_SCOPE) or rel in _NET_FILES):
+            continue
+        if rel == _NET_EXEMPT:
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_name(node.func)
+            if name in _RAW_NET_CALLS:
+                yield Violation(
+                    "net-retry", rel, node.lineno,
+                    f"raw {name}() on a control-plane path: client HTTP "
+                    f"calls must route through the retry-wrapped transport "
+                    f"helpers (http_transport._request / client_call) — a "
+                    f"bare call dies on the first transient reset and "
+                    f"bypasses the DGREP_RPC_RETRIES policy",
+                )
+
+
 # ------------------------------------------------------------------ registry
 
 RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
@@ -690,6 +734,7 @@ RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
     "rpc-elide": rule_rpc_elide,
     "mosaic-ceilings": rule_mosaic_ceilings,
     "logging": rule_logging,
+    "net-retry": rule_net_retry,
 }
 
 RULE_DOCS: dict[str, str] = {

@@ -1,0 +1,153 @@
+"""FaultTransport — deterministic network-fault injection around any
+Transport, the control/data-plane sibling of ``runtime/store.FaultStore``.
+
+``FaultStore`` proved the STORAGE commit protocol against crashes at every
+interruptible instruction; production traffic, however, traverses the HTTP
+control plane (four RPC verbs) and the ``/data/`` plane, where the network
+itself misbehaves: requests vanish before reaching the daemon, replies
+vanish after the daemon acted (the duplicate-commit generator), packets
+stall, and retried requests arrive twice.  This wrapper injects exactly
+those four behaviors at the transport boundary, deterministically, so the
+chaos matrix (tests/test_chaos.py) can assert the system-level guarantees
+the retry/idempotency design promises: byte-identical outputs and
+exactly-once task registration under any interleaving.
+
+Design mirrors FaultStore: ``hooks`` maps FaultPoint -> callable(ctx) with
+ctx = the wrapped method's name (``"map_finished"``, ``"read_input"``,
+...).  A hook returns truthy to inject at its point, falsy to let the call
+through untouched — so one hook can target one verb, fire once, or fire on
+a seeded-random schedule.  Injection semantics per point:
+
+* DROP_REQUEST — the call is NOT made; ConnectionResetError raises (the
+  request died on the wire before the peer saw it).
+* DROP_REPLY — the call IS made and its reply DISCARDED;
+  ConnectionResetError raises (the peer acted, the client cannot know —
+  whoever retries produces a duplicate delivery, which the idempotent
+  commit layer must absorb).
+* DELAY — the hook's truthy return is a float: sleep that many seconds,
+  then proceed (congestion/straggler links; exercises the failure
+  detector against slow-but-alive traffic).
+* DUPLICATE — the call is made TWICE, the first reply discarded (a retry
+  racing its own original: both deliveries arrive, the second answer
+  wins client-side).
+
+Injected errors surface to the CALLER exactly like a real broken
+connection surfaces from a transport whose retry schedule is exhausted:
+a worker loop built on this wrapper dies like a worker whose network
+died, and the scheduler's timeout/re-execution + quarantine machinery —
+not the wrapper — is what the chaos tests then hold to account.
+``heartbeat`` is wrapped like everything else; the worker's advisory
+contract (never raises) already absorbs its failures.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("fault_transport")
+
+
+class FaultPoint:
+    """Injection points for FaultTransport — each models one way the
+    network can betray an RPC or data-plane call."""
+
+    DROP_REQUEST = "drop_request"
+    DROP_REPLY = "drop_reply"
+    DELAY = "delay"
+    DUPLICATE = "duplicate"
+
+    ALL = (DROP_REQUEST, DROP_REPLY, DELAY, DUPLICATE)
+
+
+# Every Transport-protocol method FaultTransport wraps: the four control
+# verbs + heartbeat, and the data plane (optional methods are wrapped only
+# when the base transport has them — hasattr probes must keep answering
+# the truth for the worker's feature detection).
+_WRAPPED = (
+    "assign_task", "map_finished", "reduce_finished", "reduce_next_file",
+    "heartbeat",
+    "read_input", "read_input_path", "write_intermediate",
+    "read_intermediate", "write_output", "write_output_from_file",
+    "publish_task_commit",
+)
+
+
+class FaultTransport:
+    """Deterministic network-fault injection around any Transport."""
+
+    def __init__(self, base, hooks: dict[str, Callable]):
+        self.base = base
+        self.hooks = dict(hooks)
+        unknown = set(self.hooks) - set(FaultPoint.ALL)
+        if unknown:
+            raise ValueError(f"unknown fault points: {sorted(unknown)}")
+        for name in _WRAPPED:
+            if hasattr(base, name):
+                setattr(self, name, self._wrap(name))
+
+    def __getattr__(self, name: str):
+        # everything un-wrapped (is_local, bind_job, fetch_config,
+        # retry_count, ...) delegates — feature probes see the base's truth
+        return getattr(self.base, name)
+
+    def _wrap(self, name: str) -> Callable:
+        fn = getattr(self.base, name)
+
+        def call(*args, **kwargs):
+            delay_hook = self.hooks.get(FaultPoint.DELAY)
+            if delay_hook:
+                delay = delay_hook(name)
+                if delay:
+                    time.sleep(float(delay))
+            drop_req = self.hooks.get(FaultPoint.DROP_REQUEST)
+            if drop_req and drop_req(name):
+                log.debug("fault: dropping request %s", name)
+                raise ConnectionResetError(
+                    f"injected fault: {name} request dropped"
+                )
+            dup = self.hooks.get(FaultPoint.DUPLICATE)
+            if dup and dup(name):
+                log.debug("fault: duplicating %s", name)
+                fn(*args, **kwargs)  # first delivery's reply discarded
+            out = fn(*args, **kwargs)
+            drop_reply = self.hooks.get(FaultPoint.DROP_REPLY)
+            if drop_reply and drop_reply(name):
+                log.debug("fault: dropping reply of %s", name)
+                raise ConnectionResetError(
+                    f"injected fault: {name} reply dropped"
+                )
+            return out
+
+        call.__name__ = name
+        return call
+
+
+def seeded_schedule(seed: int, rates: dict[str, float],
+                    only: tuple[str, ...] = ()) -> dict[str, Callable]:
+    """A reproducible chaos plan: hooks firing with the given per-point
+    probability from one seeded RNG stream.  ``rates`` maps FaultPoint ->
+    probability (DELAY's draws scale a 0-50 ms sleep); ``only`` restricts
+    injection to the named methods (empty = all).  One RNG is shared
+    across points and calls, so a (seed, rates) pair names ONE exact
+    fault interleaving per call sequence."""
+    import random
+
+    rng = random.Random(seed)
+
+    def mk(point: str, rate: float) -> Callable:
+        def hook(ctx: str):
+            if only and ctx not in only:
+                return 0
+            draw = rng.random()
+            if draw >= rate:
+                return 0
+            if point == FaultPoint.DELAY:
+                return 0.05 * draw / max(rate, 1e-9)
+            return 1
+
+        return hook
+
+    return {point: mk(point, rate) for point, rate in rates.items()}

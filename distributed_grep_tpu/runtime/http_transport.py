@@ -12,9 +12,9 @@ completion is the normal shutdown signal, as in the reference).
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
-import socket
 import time
 import urllib.error
 import urllib.parse
@@ -27,12 +27,119 @@ from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("http_transport")
 
-RETRY_BUDGET_S = 15.0
-RETRY_DELAY_S = 0.5
+# Bounded jittered retry policy for transient transport errors — shared by
+# every client-side HTTP path (worker RPCs, data-plane GET/PUT, and the
+# CLI's client_call).  DGREP_RPC_RETRIES transient failures are retried
+# with exponential backoff (base DGREP_RPC_BACKOFF_S, doubling, capped at
+# _RETRY_SLEEP_CAP_S) and +/-50% jitter: a daemon restart makes EVERY
+# attached worker's in-flight RPC fail at the same instant, and unjittered
+# synchronized retries would hammer the recovering daemon in lockstep.
+# Retrying is SAFE by construction: task effects commit via idempotent
+# per-task commit records (runtime/store.py), completions absorb
+# duplicates (scheduler), and span batches dedup on (worker, seq) — a
+# replayed request can change nothing a first delivery didn't.
+DEFAULT_RPC_RETRIES = 6
+DEFAULT_RPC_BACKOFF_S = 0.5
+_RETRY_SLEEP_CAP_S = 5.0
 
 
-class CoordinatorGone(Exception):
-    """The coordinator stopped answering — treat as job over (worker exits)."""
+def env_rpc_retries(default: int = DEFAULT_RPC_RETRIES) -> int:
+    """Transient-error retry count — the ONE parser of DGREP_RPC_RETRIES
+    (0 disables retries: first failure raises; malformed or negative
+    keeps the default)."""
+    raw = os.environ.get("DGREP_RPC_RETRIES")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def env_rpc_backoff_s(default: float = DEFAULT_RPC_BACKOFF_S) -> float:
+    """Base retry backoff in seconds — the ONE parser of
+    DGREP_RPC_BACKOFF_S (malformed or <= 0 keeps the default)."""
+    raw = os.environ.get("DGREP_RPC_BACKOFF_S")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def retry_delays():
+    """The per-call schedule of jittered backoff sleeps (a fresh iterator
+    per request): env_rpc_retries() entries, exponential from
+    env_rpc_backoff_s(), capped, each scaled by a 0.5-1.5 jitter draw."""
+    import random
+
+    base = env_rpc_backoff_s()
+    for i in range(env_rpc_retries()):
+        yield min(_RETRY_SLEEP_CAP_S, base * (2 ** i)) * random.uniform(0.5, 1.5)
+
+
+# Exceptions that mean "the peer may be gone / the connection broke" —
+# retried under the policy above.  OSError covers URLError, timeouts and
+# ConnectionError; HTTPException covers IncompleteRead/BadStatusLine
+# (peer died mid-body / mid-status).  HTTPError is deliberately handled
+# BEFORE this tuple at every site: the server answered, so liveness is
+# fine and a retry would just repeat the rejection.
+TRANSIENT_ERRORS = (OSError, http.client.HTTPException)
+
+
+class CoordinatorGone(OSError):
+    """The coordinator stopped answering (the retry schedule ran dry) —
+    treat as job over (worker exits).  An OSError subclass: callers
+    handling generic connectivity failure (the CLI clients) catch it
+    without naming the transport layer."""
+
+
+def _open_with_retries(build_request, timeout: float, desc: str,
+                       on_retry=None, deadline: float | None = None,
+                       delays=None) -> bytes:
+    """The ONE transient-retry loop every JSON-over-HTTP client call
+    shares (worker `_request` and the CLI's `client_call` — the net-retry
+    analyze rule exists so no third copy grows): urlopen the freshly
+    built request, retry TRANSIENT_ERRORS on the jittered schedule,
+    raise CoordinatorGone when it runs dry.  HTTPError passes through
+    untouched (the server ANSWERED — disposition is the caller's).
+    ``on_retry`` (optional) is called once per retry — the transport
+    counts them for the rpc_retries telemetry.
+
+    ``deadline`` (monotonic) bounds the WHOLE call, retries included:
+    CLI clients pass their --timeout as a wall-clock promise, and
+    against a black-holed host each attempt would otherwise consume the
+    full socket timeout — x(retries+1), plus backoff, a one-shot
+    `dgrep status --timeout 5` blocking for ~50 s.  Worker transports
+    pass None: their budget IS the retry schedule.  ``delays`` overrides
+    the schedule (client_call's single-shot mode passes an EMPTY one —
+    one loop, one transient classification, no second copy to drift)."""
+    if delays is None:
+        delays = retry_delays()
+    while True:
+        attempt_timeout = timeout
+        if deadline is not None:
+            attempt_timeout = max(0.5, min(timeout,
+                                           deadline - time.monotonic()))
+        try:
+            with urllib.request.urlopen(build_request(),
+                                        timeout=attempt_timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError:
+            raise
+        except TRANSIENT_ERRORS as e:
+            delay = next(delays, None)
+            if delay is None or (
+                deadline is not None
+                and time.monotonic() + delay >= deadline
+            ):
+                raise CoordinatorGone(f"{desc}: {e}") from e
+            if on_retry is not None:
+                on_retry()
+            time.sleep(delay)
 
 
 class HttpTransport:
@@ -46,33 +153,44 @@ class HttpTransport:
             addr = f"http://{addr}"
         self.base = addr.rstrip("/")
         self.rpc_timeout_s = rpc_timeout_s
+        # Transient retries performed so far, process-lifetime (telemetry:
+        # the worker piggybacks it as ``rpc_retries`` so /status shows
+        # which workers are fighting their network).  Plain int increments
+        # under the GIL — a counter, not a synchronization primitive.
+        self.retry_count = 0
 
     # ------------------------------------------------------------- plumbing
-    def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
-        import http.client
+    def _count_retry(self) -> None:
+        self.retry_count += 1
 
+    def _sleep_or_give_up(self, delays, desc: str, err: Exception) -> None:
+        """One step of the bounded-jittered retry policy: sleep the next
+        backoff, or raise CoordinatorGone when the schedule is exhausted.
+        (The streaming data-plane paths keep their own loops — spool
+        resume / reopen-per-attempt semantics — and step through here.)"""
+        delay = next(delays, None)
+        if delay is None:
+            raise CoordinatorGone(f"{desc}: {err}") from err
+        self._count_retry()
+        time.sleep(delay)
+
+    def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
         url = f"{self.base}{path}"
-        deadline: float | None = None  # anchored at the FIRST failure
-        while True:
+
+        def build():
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
                 req.add_header("Content-Type", "application/json")
-            try:
-                with urllib.request.urlopen(req, timeout=self.rpc_timeout_s) as resp:
-                    return resp.read()
-            except urllib.error.HTTPError as e:
-                # Server answered: 4xx/5xx are not liveness failures.
-                raise RuntimeError(f"{method} {path} -> {e.code}: {e.read()[:200]!r}") from e
-            except (urllib.error.URLError, socket.timeout, ConnectionError,
-                    http.client.HTTPException, OSError) as e:
-                # HTTPException covers IncompleteRead: the coordinator died
-                # mid-body — a liveness failure like any connection error
-                now = time.monotonic()
-                if deadline is None:
-                    deadline = now + RETRY_BUDGET_S
-                if now >= deadline:
-                    raise CoordinatorGone(f"{method} {path}: {e}") from e
-                time.sleep(RETRY_DELAY_S)
+            return req
+
+        try:
+            return _open_with_retries(build, self.rpc_timeout_s,
+                                      f"{method} {path}", self._count_retry)
+        except urllib.error.HTTPError as e:
+            # Server answered: 4xx/5xx are not liveness failures.
+            raise RuntimeError(
+                f"{method} {path} -> {e.code}: {e.read()[:200]!r}"
+            ) from e
 
     def _rpc(self, verb: str, payload: dict) -> dict:
         data = self._request("POST", f"/rpc/{verb}", json.dumps(payload).encode("utf-8"))
@@ -151,13 +269,12 @@ class HttpTransport:
         disk-backed path on hosts where /tmp is RAM-backed tmpfs, or the
         spool itself would consume the RAM the streaming path protects."""
         import errno
-        import http.client
         import shutil
         import tempfile
 
         spool_dir = os.environ.get("DGREP_SPOOL_DIR") or None
         url = f"{self.base}{self._data_path('input', filename)}"
-        deadline: float | None = None
+        delays = retry_delays()
         tmp = tempfile.NamedTemporaryFile(
             prefix="dgrep-in-", dir=spool_dir, delete=False
         )
@@ -180,20 +297,14 @@ class HttpTransport:
                     return Path(tmp.name), True
                 except urllib.error.HTTPError as e:
                     raise RuntimeError(f"GET {url} -> {e.code}") from e
-                except (urllib.error.URLError, socket.timeout, ConnectionError,
-                        http.client.HTTPException, OSError) as e:
+                except TRANSIENT_ERRORS as e:
                     # Local disk problems are NOT liveness failures — retrying
                     # the download cannot fix a full spool disk; surface them.
                     if isinstance(e, OSError) and e.errno in (
                         errno.ENOSPC, errno.EDQUOT, errno.EROFS,
                     ):
                         raise
-                    now = time.monotonic()
-                    if deadline is None:
-                        deadline = now + RETRY_BUDGET_S
-                    if now >= deadline:
-                        raise CoordinatorGone(f"GET {url}: {e}") from e
-                    time.sleep(RETRY_DELAY_S)
+                    self._sleep_or_give_up(delays, f"GET {url}", e)
         except BaseException:
             tmp.close()
             os.unlink(tmp.name)
@@ -225,11 +336,9 @@ class HttpTransport:
         reduce output larger than worker RAM commits without ever being
         held whole.  Same liveness/retry policy as _request; each retry
         reopens the file from the start."""
-        import http.client
-
         url = f"{self.base}{self._data_path('out', name)}"
         size = os.path.getsize(path)
-        deadline: float | None = None
+        delays = retry_delays()
         while True:
             try:
                 with open(path, "rb") as f:
@@ -241,14 +350,8 @@ class HttpTransport:
                 raise RuntimeError(
                     f"PUT {url} -> {e.code}: {e.read()[:200]!r}"
                 ) from e
-            except (urllib.error.URLError, socket.timeout, ConnectionError,
-                    http.client.HTTPException, OSError) as e:
-                now = time.monotonic()
-                if deadline is None:
-                    deadline = now + RETRY_BUDGET_S
-                if now >= deadline:
-                    raise CoordinatorGone(f"PUT {url}: {e}") from e
-                time.sleep(RETRY_DELAY_S)
+            except TRANSIENT_ERRORS as e:
+                self._sleep_or_give_up(delays, f"PUT {url}", e)
 
     # ------------------------------------------------------------ bootstrap
     def fetch_config(self) -> JobConfig:
@@ -256,6 +359,49 @@ class HttpTransport:
 
     def fetch_status(self) -> dict:
         return json.loads(self._request("GET", "/status"))
+
+
+def client_call(addr: str, method: str, path: str,
+                body: bytes | None = None, timeout: float = 30.0,
+                retry: bool = True) -> dict:
+    """One JSON-over-HTTP client call with the transport's bounded
+    jittered retry policy — the helper the CLI's control-plane clients
+    (``dgrep submit`` polls, ``dgrep status``) route through instead of
+    raw urlopen (analyze rule ``net-retry``).  A transient connection
+    reset mid-poll retries instead of killing the client; exhausting the
+    schedule raises CoordinatorGone (the caller's daemon-death fallback
+    fires); an HTTP error status re-raises immediately as HTTPError (the
+    server ANSWERED — submit's 429/400 handling needs the code).
+
+    ``retry=False`` makes the call SINGLE-SHOT (first transient failure
+    raises CoordinatorGone): for NON-idempotent requests — job submission
+    above all, where a reply lost after the daemon durably registered the
+    job would mint a duplicate job on the re-POST.  Only retry what a
+    duplicate delivery cannot change."""
+    base = addr if addr.startswith("http") else f"http://{addr}"
+    url = f"{base.rstrip('/')}{path}"
+
+    def build():
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        return req
+
+    if retry:
+        # timeout is the caller's overall wall-clock promise — pass it as
+        # the retry loop's deadline too, not just the per-attempt socket
+        # timeout (see _open_with_retries)
+        return json.loads(
+            _open_with_retries(build, timeout, f"{method} {url}",
+                               deadline=time.monotonic() + timeout)
+        )
+    # single-shot: the SAME loop with an empty schedule (first transient
+    # failure raises CoordinatorGone) — never a second transient-error
+    # classification to drift from the retried path
+    return json.loads(
+        _open_with_retries(build, timeout, f"{method} {url}",
+                           delays=iter(()))
+    )
 
 
 class ServiceHttpTransport(HttpTransport):

@@ -73,6 +73,21 @@ class AssignTaskReply:
     # derives its mid-task heartbeat cadence from it (~window/3), so the
     # two knobs can never drift apart across config changes.
     task_timeout_s: float = 10.0
+    # Client backoff hint on "retry" replies (worker quarantine,
+    # runtime/scheduler.WorkerHealth): "expect no work for this many
+    # seconds" — the worker sleeps (bounded) instead of re-entering the
+    # long-poll immediately.  0 on ordinary retries (elided from the
+    # wire — old peers interop).
+    retry_after_s: float = 0.0
+    # Scheduler-incarnation fence (round 10): a fresh random tag per
+    # Scheduler construction, echoed by the reducer's shuffle fetches —
+    # a reduce attempt that outlives a coordinator/daemon restart holds
+    # a files_processed cursor over the OLD task_files arrival order,
+    # and serving it from the rebuilt list would feed it duplicate or
+    # missing shuffle files (its commit could then WIN resolution with
+    # wrong bytes).  Mismatched epochs abort the attempt instead.
+    # "" on the wire for old peers (elided).
+    epoch: str = ""
 
 
 @dataclass
@@ -108,12 +123,27 @@ class ReduceNextFileArgs:
     task_id: int
     files_processed: int  # rpc.go:35 FilesProcessed — resume-safe cursor
     job_id: str = ""  # service multiplexing (see TaskFinishedArgs)
+    # The assignment's scheduler epoch (AssignTaskReply.epoch): the
+    # cursor above is resume-safe only WITHIN one scheduler incarnation
+    # (task_files arrival order is rebuilt on restart) — a stale epoch
+    # answers abort, never a file.  "" = pre-epoch peer (served as
+    # before; single-incarnation deployments lose nothing).
+    epoch: str = ""
+    # Who is fetching (quarantine attribution): only the CURRENT
+    # assignee's fetches mark the task as demonstrably held — a same-life
+    # straggler's fetch must not set the `stamped` evidence that would
+    # charge the REASSIGNED worker for a timeout it never caused.
+    worker_id: int = -1
 
 
 @dataclass
 class ReduceNextFileReply:
     next_file: str = ""
     done: bool = False
+    # The attempt must be ABANDONED (no commit, no finished RPC): its
+    # shuffle cursor belongs to a previous scheduler incarnation.
+    # Elided when False — old peers interop.
+    abort: bool = False
 
 
 @dataclass
@@ -163,7 +193,8 @@ _TYPES = {
 # fails when the pipeline is actually switched on.
 _ELIDE_DEFAULTS: dict[str, Any] = {
     "spans": [], "spans_seq": -1, "metrics": None,
-    "sent_at": 0.0, "rtt_s": -1.0, "filenames": [],
+    "sent_at": 0.0, "rtt_s": -1.0, "filenames": [], "retry_after_s": 0.0,
+    "epoch": "", "abort": False, "worker_id": -1,
     # service multiplexing riders (runtime/service.py): absent from the
     # wire on single-job coordinators, so pre-service peers interop
     "job_id": "", "application": "",

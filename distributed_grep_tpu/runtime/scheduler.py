@@ -29,6 +29,7 @@ condition variable and notifies on every state change.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -42,6 +43,166 @@ from distributed_grep_tpu.utils.metrics import Metrics
 from distributed_grep_tpu.utils.spans import ClockSync, EventLog
 
 log = get_logger("scheduler")
+
+# Consecutive attributed failures (task timeouts while holding the task)
+# before a worker is quarantined.  One timeout is routine (a long GC pause,
+# one slow disk); three in a row with no intervening success is a worker
+# that keeps accepting work and keeps going dark — exactly the flaky-host
+# pattern that otherwise captures a share of every job's tasks forever.
+QUARANTINE_AFTER_FAILURES = 3
+DEFAULT_QUARANTINE_S = 30.0
+# Exponential backoff cap: repeated quarantine episodes double the window
+# up to this many base windows (a worker flapping all day re-probations
+# every ~8 windows instead of hourly-compounding to never).
+_QUARANTINE_MAX_FACTOR = 8
+
+
+def env_worker_quarantine_s(default: float = DEFAULT_QUARANTINE_S) -> float:
+    """Base quarantine window for flaky workers — the ONE parser of
+    DGREP_WORKER_QUARANTINE_S (malformed or <= 0 keeps the default,
+    matching env_batch_bytes' shrug-off policy).  0 is deliberately not
+    an off switch: quarantine is gated on attributed failures, and a
+    deployment that wants it off sets the threshold unreachable by
+    keeping workers healthy, not by a zero window that would re-admit a
+    dark worker instantly."""
+    raw = os.environ.get("DGREP_WORKER_QUARANTINE_S")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+class WorkerHealth:
+    """Per-worker consecutive-failure tracker with exponential-backoff
+    quarantine — shared by every scheduler of a service daemon (one flaky
+    worker must not be re-probationed per job) and owned privately by
+    one-shot coordinators.  Thread-safe; all methods are O(1).
+
+    A *failure* is an attributed task timeout: the sweeper re-enqueued a
+    task while this worker held it.  A *success* is any committed task.
+    After QUARANTINE_AFTER_FAILURES consecutive failures the worker is
+    quarantined for base * 2^(episode-1) seconds (capped); while
+    quarantined it receives no assignments — its polls park in the
+    long-poll wait and return a retry with a retry_after_s hint.  Expiry
+    is re-probation, not absolution: the failure streak resets to one
+    step below the threshold, so one more timeout re-quarantines (with a
+    doubled window) while one success clears the record."""
+
+    # Bounded state over an unbounded worker-id stream (a service daemon
+    # allocates a FRESH id per reconnect, and crashed workers leave their
+    # records behind): past this many tracked workers the least-recently
+    # touched non-quarantined records are dropped.
+    MAX_TRACKED = 4096
+
+    def __init__(self, base_s: float | None = None):
+        self.base_s = (
+            env_worker_quarantine_s() if base_s is None else float(base_s)
+        )
+        self._lock = threading.Lock()
+        self._fails: dict[int, int] = {}  # consecutive attributed failures
+        self._episodes: dict[int, int] = {}  # quarantine episodes so far
+        self._until: dict[int, float] = {}  # monotonic expiry per worker
+        self._touched: dict[int, float] = {}  # recency (prune order)
+        self._polls: dict[int, float] = {}  # last assign poll per worker
+        self.quarantined_total = 0  # counter: episodes ever entered
+
+    def _prune_locked(self, now: float) -> None:
+        if len(self._touched) <= self.MAX_TRACKED:
+            return
+        evictable = sorted(
+            (wid for wid in self._touched
+             if self._until.get(wid, 0.0) <= now),
+            key=lambda wid: self._touched[wid],
+        )
+        for wid in evictable[: len(self._touched) - self.MAX_TRACKED]:
+            for d in (self._fails, self._episodes, self._until,
+                      self._touched, self._polls):
+                d.pop(wid, None)
+
+    def saw(self, worker_id: int) -> None:
+        """Record an assign poll.  A worker loop is single-threaded: a
+        poll AFTER an assignment proves it is NOT running that task — the
+        evidence `record_failure` callers use to distinguish a lost
+        assignment reply from a worker gone dark."""
+        if worker_id < 0:
+            return
+        with self._lock:
+            now = time.monotonic()
+            self._polls[worker_id] = now
+            self._touched[worker_id] = now
+            self._prune_locked(now)
+
+    def polled_since(self, worker_id: int, t: float) -> bool:
+        """True when the worker has asked for work after monotonic ``t``."""
+        with self._lock:
+            return self._polls.get(worker_id, float("-inf")) > t
+
+    def record_success(self, worker_id: int) -> None:
+        if worker_id < 0:
+            return
+        with self._lock:
+            # drop the WHOLE record, _polls included: _prune_locked only
+            # walks _touched, so an entry left in any sibling dict here
+            # would leak for the daemon's lifetime
+            self._fails.pop(worker_id, None)
+            self._episodes.pop(worker_id, None)
+            self._until.pop(worker_id, None)
+            self._touched.pop(worker_id, None)
+            self._polls.pop(worker_id, None)
+
+    def record_failure(self, worker_id: int) -> float:
+        """Register an attributed failure; returns the quarantine window
+        just entered (seconds), or 0.0 when the worker stays on
+        probation."""
+        if worker_id < 0:
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._touched[worker_id] = now
+            self._prune_locked(now)
+            if self._until.get(worker_id, 0.0) > now:
+                return 0.0  # already quarantined: don't re-extend per sweep
+            n = self._fails.get(worker_id, 0) + 1
+            self._fails[worker_id] = n
+            if n < QUARANTINE_AFTER_FAILURES:
+                return 0.0
+            ep = self._episodes.get(worker_id, 0) + 1
+            self._episodes[worker_id] = ep
+            window = self.base_s * min(2 ** (ep - 1), _QUARANTINE_MAX_FACTOR)
+            self._until[worker_id] = now + window
+            # re-probation: one step below the threshold, so the next
+            # failure after expiry re-quarantines immediately
+            self._fails[worker_id] = QUARANTINE_AFTER_FAILURES - 1
+            self.quarantined_total += 1
+            return window
+
+    def quarantine_remaining(self, worker_id: int) -> float:
+        """Seconds of quarantine left for this worker (0.0 = assignable)."""
+        with self._lock:
+            until = self._until.get(worker_id)
+            if until is None:
+                return 0.0
+            rem = until - time.monotonic()
+            if rem <= 0:
+                del self._until[worker_id]  # expired: re-probation
+                return 0.0
+            return rem
+
+    def snapshot(self) -> dict:
+        """Status view: active quarantines + the episode counter."""
+        now = time.monotonic()
+        with self._lock:
+            active = {
+                str(wid): round(until - now, 3)
+                for wid, until in self._until.items() if until > now
+            }
+            return {
+                "quarantined_total": self.quarantined_total,
+                "active": active,
+            }
 
 
 def _split_label(members: tuple[str, ...]) -> str:
@@ -73,6 +234,7 @@ class Scheduler:
         commit_resolver: Optional[Any] = None,
         event_log: Optional[EventLog] = None,
         on_change: Optional[Any] = None,
+        worker_health: Optional[WorkerHealth] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -101,6 +263,14 @@ class Scheduler:
         # completion (unlocks the reduce queue) or a timeout re-enqueue.
         # None (single-job coordinators) costs nothing.
         self.on_change = on_change
+        # Flaky-worker quarantine (WorkerHealth above): the sweeper
+        # attributes each timeout to the worker that held the task;
+        # enough consecutive failures park that worker's assign polls
+        # until an exponential-backoff window expires.  A service daemon
+        # passes ONE shared instance to every job's scheduler (a flaky
+        # worker is flaky for every tenant); one-shot coordinators get
+        # their own.
+        self.worker_health = worker_health or WorkerHealth()
         self._pending_events: list[dict] = []  # staged under the lock,
         # written by _flush_events after release
         self._span_seqs: dict[int, set[int]] = {}  # worker -> persisted
@@ -137,6 +307,16 @@ class Scheduler:
         self._reduce_queue: deque[int] = deque(range(n_reduce))
 
         self._next_worker_id = 0  # safeInt.get_and_increment (helper_types.go:45-79)
+        # Incarnation epoch (rpc.AssignTaskReply.epoch): task_files
+        # arrival order — and with it every reducer's files_processed
+        # cursor — is only meaningful within ONE scheduler instance; a
+        # restarted coordinator/daemon rebuilds the lists in replay
+        # order, so shuffle fetches carrying another incarnation's epoch
+        # are aborted (reduce_next_file), never served a file their
+        # cursor would misindex.
+        import uuid as _uuid
+
+        self.epoch = _uuid.uuid4().hex[:12]
         self._stopped = False
         # Incremental completion counters: COMPLETED is terminal (the
         # sweeper only re-enqueues IN_PROGRESS tasks), so counting at the
@@ -340,6 +520,9 @@ class Scheduler:
                     row["metrics"] = info["metrics"]
                 if info.get("clock_offset_s") is not None:
                     row["clock_offset_s"] = round(info["clock_offset_s"], 6)
+                q = self.worker_health.quarantine_remaining(wid)
+                if q > 0:
+                    row["quarantined_s"] = round(q, 3)
                 out[str(wid)] = row
             return out
 
@@ -385,11 +568,32 @@ class Scheduler:
             if worker_id < 0:
                 worker_id = self._next_worker_id
                 self._next_worker_id += 1
+            # BEFORE any assignment stamp: a poll-then-assign in one call
+            # must read as polled-before-held (lost-reply attribution)
+            self.worker_health.saw(worker_id)
             while True:
                 if self._stopped or self._done_locked():
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.JOB_DONE, worker_id=worker_id
                     )
+                # Quarantined workers get no work: park in the long-poll
+                # (waiting, not spinning — a tight retry loop against the
+                # control plane is itself a failure mode) and answer a
+                # retry with a client backoff hint at the window edge.
+                quarantine_s = self.worker_health.quarantine_remaining(
+                    worker_id
+                )
+                if quarantine_s > 0:
+                    remaining = deadline.remaining()
+                    if remaining <= 0:
+                        return rpc.AssignTaskReply(
+                            assignment="retry", task_id=-2,
+                            worker_id=worker_id,
+                            retry_after_s=round(quarantine_s, 3),
+                        )
+                    self._cond.wait(timeout=min(remaining, quarantine_s,
+                                                self.sweep_interval_s))
+                    continue
                 while self._map_queue and (
                     self.map_tasks[self._map_queue[0]].state is not TaskState.UNASSIGNED
                 ):
@@ -406,6 +610,8 @@ class Scheduler:
                     task.state = TaskState.IN_PROGRESS
                     task.heartbeat()
                     task.attempts += 1
+                    task.worker = worker_id
+                    task.stamped = False  # no worker-side evidence yet
                     self.metrics.inc("map_assigned")
                     self._worker_seen(worker_id, task=f"map:{tid}")
                     self._event("assign_map", task=tid, worker=worker_id,
@@ -420,6 +626,7 @@ class Scheduler:
                         worker_id=worker_id,
                         app_options=self.app_options,
                         task_timeout_s=self.task_timeout_s,
+                        epoch=self.epoch,
                     )
                 while self._reduce_queue and (
                     self.reduce_tasks[self._reduce_queue[0]].state is not TaskState.UNASSIGNED
@@ -431,6 +638,8 @@ class Scheduler:
                     task.state = TaskState.IN_PROGRESS
                     task.heartbeat()
                     task.attempts += 1
+                    task.worker = worker_id
+                    task.stamped = False  # see the map branch above
                     self.metrics.inc("reduce_assigned")
                     self._worker_seen(worker_id, task=f"reduce:{tid}")
                     self._event("assign_reduce", task=tid, worker=worker_id,
@@ -443,6 +652,7 @@ class Scheduler:
                         worker_id=worker_id,
                         app_options=self.app_options,
                         task_timeout_s=self.task_timeout_s,
+                        epoch=self.epoch,
                     )
                 remaining = deadline.remaining()
                 if remaining <= 0:
@@ -479,6 +689,9 @@ class Scheduler:
                              record) -> rpc.TaskFinishedReply:
         with self._cond:
             self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
+            # any completed task — duplicates included — is a live,
+            # functional worker: clear its failure streak
+            self.worker_health.record_success(args.worker_id)
             task = self.map_tasks[args.task_id]
             if task.state is TaskState.COMPLETED:
                 return rpc.TaskFinishedReply(ok=True)  # duplicate absorbed (:131-134)
@@ -531,6 +744,7 @@ class Scheduler:
                                 record) -> rpc.TaskFinishedReply:
         with self._cond:
             self._worker_seen(args.worker_id, task=None, metrics=args.metrics)
+            self.worker_health.record_success(args.worker_id)
             task = self.reduce_tasks[args.task_id]
             if task.state is not TaskState.COMPLETED:
                 task.state = TaskState.COMPLETED
@@ -558,10 +772,29 @@ class Scheduler:
         reducer's next intermediate file exists, or the map phase is done and
         the cursor is exhausted (done=True).  Doubles as a heartbeat (:162)."""
         deadline = _Deadline(timeout)
+        if args.epoch and args.epoch != self.epoch:
+            # a reduce attempt from a PREVIOUS scheduler incarnation (it
+            # outlived a daemon restart through its transport retries):
+            # its files_processed cursor indexes the OLD task_files
+            # arrival order — serving it from the rebuilt list would feed
+            # it duplicate/missing shuffle files and its commit could WIN
+            # attempt resolution with wrong bytes.  Abort the attempt;
+            # the re-issued one owns this incarnation.
+            log.warning(
+                "aborting reduce attempt for task %d: stale scheduler "
+                "epoch %s (current %s)", args.task_id, args.epoch,
+                self.epoch,
+            )
+            return rpc.ReduceNextFileReply(abort=True)
         with self._cond:
             task = self.reduce_tasks[args.task_id]
             while True:
                 task.heartbeat()
+                if args.worker_id < 0 or args.worker_id == task.worker:
+                    # the CURRENT assignee demonstrably holds it; a
+                    # same-life straggler's fetch must not plant the
+                    # evidence that would charge the reassigned worker
+                    task.stamped = True
                 if args.files_processed < len(task.task_files):
                     return rpc.ReduceNextFileReply(
                         next_file=task.task_files[args.files_processed], done=False
@@ -614,6 +847,12 @@ class Scheduler:
                                     type=task_type, worker=args.worker_id,
                                     grace_s=g)
                     task.heartbeat(grace_s=g)
+                    if args is None or args.worker_id < 0 \
+                            or args.worker_id == task.worker:
+                        # stamped only by the CURRENT assignee (see
+                        # reduce_next_file) — a straggler's pump must not
+                        # charge the reassigned worker
+                        task.stamped = True
                     self.metrics.inc("heartbeats")
         self._flush_events()
 
@@ -623,6 +862,7 @@ class Scheduler:
 
         while True:
             requeued = False
+            failed_workers: list[int] = []
             with self._cond:
                 if self._stopped or self._done_locked():
                     return
@@ -634,12 +874,24 @@ class Scheduler:
                         >= max(self.task_timeout_s, task.grace_s)
                     ):
                         log.warning("map task %d timed out; re-enqueueing", task.task_id)
+                        if task.stamped or not self.worker_health.polled_since(
+                            task.worker, task.timestamp
+                        ):
+                            # charge only with evidence the worker HELD the
+                            # task (a stamp) or is gone (no poll since the
+                            # assignment) — an unstamped timeout from a
+                            # worker that kept polling is a LOST REPLY, the
+                            # network's fault, not the worker's
+                            failed_workers.append(task.worker)
                         task.state = TaskState.UNASSIGNED
                         self._map_queue.append(task.task_id)
                         requeued = True
                         self.metrics.inc("map_retries")
+                        self.metrics.inc("tasks_requeued")
                         self._event("task_timeout", type="map",
-                                    task=task.task_id, attempt=task.attempts)
+                                    task=task.task_id, attempt=task.attempts,
+                                    worker=task.worker)
+                        task.worker = -1
                         self._cond.notify_all()
                 for task in self.reduce_tasks:
                     if (
@@ -648,13 +900,36 @@ class Scheduler:
                         >= max(self.task_timeout_s, task.grace_s)
                     ):
                         log.warning("reduce task %d timed out; re-enqueueing", task.task_id)
+                        if task.stamped or not self.worker_health.polled_since(
+                            task.worker, task.timestamp
+                        ):
+                            failed_workers.append(task.worker)
                         task.state = TaskState.UNASSIGNED
                         self._reduce_queue.append(task.task_id)
                         requeued = True
                         self.metrics.inc("reduce_retries")
+                        self.metrics.inc("tasks_requeued")
                         self._event("task_timeout", type="reduce",
-                                    task=task.task_id, attempt=task.attempts)
+                                    task=task.task_id, attempt=task.attempts,
+                                    worker=task.worker)
+                        task.worker = -1
                         self._cond.notify_all()
+                # Attribute each charged timeout to the worker that held
+                # the task (WorkerHealth is a leaf lock — safe under the
+                # scheduler lock, and the quarantine verdict must land
+                # before the re-enqueued task is handed back to the same
+                # dark worker on the very next poll).
+                for wid in failed_workers:
+                    window = self.worker_health.record_failure(wid)
+                    if window > 0:
+                        log.warning(
+                            "worker %d quarantined for %.1fs after %d "
+                            "consecutive task timeouts", wid, window,
+                            QUARANTINE_AFTER_FAILURES,
+                        )
+                        self.metrics.inc("workers_quarantined")
+                        self._event("quarantine", worker=wid,
+                                    window_s=round(window, 3))
             self._flush_events()
             if requeued:
                 self._notify_change()  # re-enqueued work is assignable again

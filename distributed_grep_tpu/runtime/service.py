@@ -54,7 +54,11 @@ from distributed_grep_tpu.runtime.http_coordinator import (
     long_poll_window_s,
 )
 from distributed_grep_tpu.runtime.journal import TaskJournal
-from distributed_grep_tpu.runtime.scheduler import Scheduler, _Deadline
+from distributed_grep_tpu.runtime.scheduler import (
+    Scheduler,
+    WorkerHealth,
+    _Deadline,
+)
 from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils import spans as spans_mod
@@ -113,6 +117,153 @@ def env_service_queue(default: int = DEFAULT_QUEUE_DEPTH) -> int:
         return default
 
 
+def env_service_resume(default: bool = True) -> bool:
+    """Crash-recovery resume switch — the ONE parser of
+    DGREP_SERVICE_RESUME.  On (the default), a restarted daemon replays
+    the work root's jobs.jsonl registry: terminal jobs reload as history,
+    queued jobs re-admit, running jobs resume from their per-job journals
+    and commit records.  "0"/"false"/"no" disables re-admission/resume —
+    a restart starts serving fresh (the registry still replays for the
+    job-id counter, so old work dirs are never clobbered)."""
+    raw = os.environ.get("DGREP_SERVICE_RESUME")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
+class ServiceRegistry:
+    """Append-only ``jobs.jsonl`` under the service work root — the
+    daemon's durable job table.  One JSON line per event (submit with the
+    full JobConfig, then state transitions), fsync'd per append and
+    torn-tail-truncated on reopen via the TaskJournal mechanics (the same
+    durability discipline the per-job task journal rides).  A restarted
+    daemon replays it to rebuild everything the old process held only in
+    memory; per-job progress stays where it always was — the job's own
+    journal + commit records."""
+
+    FILENAME = "jobs.jsonl"
+
+    def __init__(self, work_root: Path):
+        self.path = Path(work_root) / self.FILENAME
+        self._journal = TaskJournal(self.path)
+        self._lock = threading.Lock()  # appends come from RPC threads,
+        # watcher threads, and submit — TaskJournal itself is not locked
+
+    def record_submit(self, job_id: str, config: JobConfig) -> None:
+        with self._lock:
+            self._journal.record({
+                "kind": "job_submit", "job_id": job_id,
+                "config": json.loads(config.to_json()), "t": time.time(),
+            })
+
+    def record_state(self, job_id: str, state: str, error: str = "",
+                     outputs: list[str] | None = None) -> None:
+        entry: dict = {"kind": "job_state", "job_id": job_id,
+                       "state": state, "t": time.time()}
+        if error:
+            entry["error"] = error
+        if outputs is not None:
+            entry["outputs"] = outputs
+        with self._lock:
+            self._journal.record(entry)
+
+    def close(self) -> None:
+        with self._lock:
+            self._journal.close()
+
+    @staticmethod
+    def replay(work_root: Path) -> tuple[dict[str, dict], int]:
+        """(jobs, id_floor): job_id -> {"config": dict | None, "state":
+        str, "error": str, "outputs": [...], "t": float} in submit order
+        (dict preserves insertion), plus the first job NUMBER a new
+        incarnation may mint (max of explicit ``id_floor`` records and
+        every registered numeric id, +1) — compaction drops old terminal
+        jobs, so the floor record is what keeps their work dirs from
+        ever being re-minted.  State records for unknown job ids are
+        dropped."""
+        path = Path(work_root) / ServiceRegistry.FILENAME
+        jobs: dict[str, dict] = {}
+        floor = 1
+        for e in TaskJournal.replay(path):
+            if e.get("kind") == "id_floor":
+                try:
+                    floor = max(floor, int(e.get("next", 1)))
+                except (TypeError, ValueError):
+                    pass
+                continue
+            jid = e.get("job_id")
+            if not isinstance(jid, str):
+                continue
+            tail = jid.rpartition("-")[2]
+            if tail.isdigit():
+                floor = max(floor, int(tail) + 1)
+            if e.get("kind") == "job_submit":
+                jobs[jid] = {
+                    "config": e.get("config"), "state": JobState.QUEUED,
+                    "error": "", "outputs": [], "t": e.get("t", 0.0),
+                }
+            elif e.get("kind") == "job_state" and jid in jobs:
+                rec = jobs[jid]
+                rec["state"] = e.get("state", rec["state"])
+                rec["error"] = e.get("error", "")
+                if e.get("outputs") is not None:
+                    rec["outputs"] = e["outputs"]
+                rec["t"] = e.get("t", rec["t"])
+        return jobs, floor
+
+    @staticmethod
+    def trim(jobs: dict[str, dict],
+             keep_terminal: int = _MAX_TERMINAL_RECORDS) -> dict[str, dict]:
+        """Bound a replayed job map the way the live table is bounded:
+        every non-terminal job, plus the newest ``keep_terminal``
+        terminal records — a restart must not reload (or re-persist) a
+        lifetime of history the running daemon would have pruned."""
+        terminal = [jid for jid, info in jobs.items()
+                    if info["state"] in _TERMINAL]
+        excess = len(terminal) - keep_terminal
+        if excess <= 0:
+            return dict(jobs)
+        terminal.sort(key=lambda jid: jobs[jid].get("t", 0.0))
+        dropped = set(terminal[:excess])
+        return {jid: info for jid, info in jobs.items()
+                if jid not in dropped}
+
+    @staticmethod
+    def compact(work_root: Path, jobs: dict[str, dict],
+                id_floor: int) -> None:
+        """Rewrite jobs.jsonl from a (trimmed) replayed map — an
+        append-only log over an unbounded job stream otherwise grows, and
+        every restart would re-read the whole history.  Runs at startup
+        BEFORE the append handle opens; atomic (tmp + fsync + rename);
+        the id_floor record preserves the id space of every job the trim
+        dropped."""
+        path = Path(work_root) / ServiceRegistry.FILENAME
+        if not path.exists():
+            return
+        tmp = path.with_name(path.name + ".compact")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"kind": "id_floor", "next": id_floor},
+                               sort_keys=True) + "\n")
+            for jid, info in jobs.items():
+                if not isinstance(info.get("config"), dict):
+                    continue
+                f.write(json.dumps(
+                    {"kind": "job_submit", "job_id": jid,
+                     "config": info["config"], "t": info["t"]},
+                    sort_keys=True) + "\n")
+                if info["state"] != JobState.QUEUED:
+                    entry: dict = {"kind": "job_state", "job_id": jid,
+                                   "state": info["state"], "t": info["t"]}
+                    if info.get("error"):
+                        entry["error"] = info["error"]
+                    if info.get("outputs"):
+                        entry["outputs"] = info["outputs"]
+                    f.write(json.dumps(entry, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+
 class AdmissionError(RuntimeError):
     """Submission rejected by admission control (queue full / shutdown)."""
 
@@ -126,6 +277,13 @@ class JobState:
 
 
 _TERMINAL = (JobState.DONE, JobState.CANCELLED, JobState.FAILED)
+
+# Canonical constants by value: registry replay loads states as FRESH json
+# strings, while the runtime compares with ``is`` against the JobState
+# literals — resumed records must carry the canonical objects.
+_CANON_STATE = {
+    s: s for s in (JobState.QUEUED, JobState.RUNNING, *_TERMINAL)
+}
 
 
 @dataclass
@@ -168,6 +326,7 @@ class GrepService:
         task_timeout_s: float | None = None,
         sweep_interval_s: float | None = None,
         rpc_timeout_s: float = 60.0,
+        resume: bool | None = None,
     ):
         self.work_root = Path(work_root)
         self.work_root.mkdir(parents=True, exist_ok=True)
@@ -214,6 +373,200 @@ class GrepService:
         self._span_seqs: dict[int, set[int]] = {}
         self._span_seq_lock = threading.Lock()
 
+        # ONE flaky-worker quarantine tracker shared by every job's
+        # scheduler (runtime/scheduler.WorkerHealth): the service owns
+        # worker identity, so a worker going dark under job A must stop
+        # receiving job B's tasks too.
+        self._health = WorkerHealth()
+
+        # Durable job registry (jobs.jsonl) + staged transition records:
+        # appends are fsync'd, so they happen OUTSIDE the service lock —
+        # state changes decided under the lock stage here and flush after
+        # release (`_flush_registry`).  Crash-ordering argument: a job is
+        # registered BEFORE its id is returned to the client (submit), and
+        # a missing later transition only makes a restart redo work whose
+        # journals/commit records then short-circuit it — never lose or
+        # duplicate a result.
+        replayed, id_floor = ServiceRegistry.replay(self.work_root)
+        # bound + compact BEFORE the append handle opens: the registry is
+        # append-only over an unbounded job stream, so each restart
+        # rewrites it down to the live jobs + the newest terminal history
+        # (the id_floor record keeps dropped jobs' ids retired forever)
+        replayed = ServiceRegistry.trim(replayed)
+        ServiceRegistry.compact(self.work_root, replayed, id_floor)
+        self._registry = ServiceRegistry(self.work_root)
+        self._registry_pending: list[tuple] = []
+        # Orders FLUSH BATCHES end to end (swap + append as one unit):
+        # staging is ordered under the service lock, but two concurrent
+        # flushers writing their swapped batches unlocked could land
+        # "cancelled" before the older "running" — and replay trusts the
+        # LAST state.  Outer to self._lock; nothing takes them reversed.
+        self._registry_flush_lock = threading.Lock()
+        # the id counter continues past every id ever registered no
+        # matter what: even a resume-disabled restart must never mint an
+        # id whose work dir an earlier incarnation owns
+        self._ids = itertools.count(id_floor)
+        if env_service_resume() if resume is None else resume:
+            self._resume_replayed(replayed)
+
+    # ---------------------------------------------------------------- resume
+    def _resume_replayed(self, replayed: dict[str, dict]) -> None:
+        """Rebuild the job table from the registry at construction time
+        (single-threaded: the HTTP surface and workers attach later, so
+        no lock discipline applies yet).  Terminal jobs reload as history
+        rows; jobs that never started re-admit to the queue; jobs that
+        were RUNNING resume from their per-job journal + commit records —
+        completed tasks replay as done, in-flight attempts at crash time
+        simply re-run (their eventual duplicate commits resolve to one
+        winner, the PR-1 invariant)."""
+        for jid, info in replayed.items():
+            cfg_dict = info.get("config")
+            if not isinstance(cfg_dict, dict):
+                continue
+            try:
+                cfg = JobConfig(**cfg_dict)
+            except (TypeError, ValueError) as e:
+                log.warning("registry job %s has an unloadable config "
+                            "(%s); dropping", jid, e)
+                continue
+            state = _CANON_STATE.get(info["state"])
+            if state is None:
+                log.warning("registry job %s has unknown state %r; "
+                            "dropping", jid, info["state"])
+                continue
+            rec = JobRecord(job_id=jid, config=cfg, state=state,
+                            submitted_at=info.get("t", 0.0))
+            if state in _TERMINAL:
+                rec.finished_at = info.get("t", 0.0)
+                rec.error = info.get("error", "")
+                rec.outputs = list(info.get("outputs") or [])
+                self._jobs[jid] = rec
+                continue
+            # queued or running: the work must be (re)scheduled.  Re-run
+            # submit's readability validation FIRST — an input deleted
+            # during the outage would otherwise re-enqueue its map task
+            # forever (plan_map_splits itself shrugs stat failures off,
+            # so no exception guard can catch this) and pin a running
+            # slot until the next restart.
+            missing = [f for f in cfg.input_files
+                       if not os.access(f, os.R_OK)]
+            if missing:
+                rec.state = JobState.FAILED
+                rec.error = f"inputs unreadable at resume: {missing}"
+                rec.finished_at = time.time()
+                self._jobs[jid] = rec
+                self._registry_pending.append(
+                    (jid, JobState.FAILED, rec.error, None)
+                )
+                continue
+            # both re-plan splits (the plan is deterministic for
+            # unchanged inputs; changed inputs fail replay's member-list
+            # guard and re-run — correct either way)
+            from distributed_grep_tpu.runtime.job import plan_map_splits
+
+            rec.map_splits = plan_map_splits(
+                list(cfg.input_files), cfg.effective_batch_bytes()
+            )
+            self._jobs[jid] = rec
+            if state == JobState.RUNNING:
+                self._resume_running_job(rec)
+            else:
+                rec.state = JobState.QUEUED
+                self._queue.append(jid)
+        # start queued jobs into free slots now so a restarted daemon is
+        # serving the backlog before the first worker even attaches
+        with self._cond:
+            self._maybe_start_locked()
+        self._flush_registry()
+        if self._jobs:
+            log.info(
+                "service resume: %d jobs from registry (%d running, %d "
+                "queued)", len(self._jobs), len(self._running),
+                len(self._queue),
+            )
+
+    def _resume_running_job(self, rec: JobRecord) -> None:
+        """Re-open a job that was RUNNING when the daemon died: same work
+        dir (NOT cleared), journal replayed so completed tasks stay done,
+        commit records re-resolved as the unit of truth, event log
+        appended (one job, one log across daemon restarts)."""
+        cfg = rec.config
+        store = make_store(cfg.store)
+        rec.workdir = WorkDir(cfg.work_dir, store=store)
+        resume_entries = None
+        if cfg.journal:
+            resume_entries = TaskJournal.replay(rec.workdir.journal_path())
+            rec.journal = TaskJournal(rec.workdir.journal_path())
+        spans_on = spans_mod.enabled(cfg.spans) or self.spans
+        rec.event_log = (
+            spans_mod.EventLog(
+                rec.workdir.root / spans_mod.EventLog.FILENAME, fresh=False
+            )
+            if spans_on else None
+        )
+        rec.input_allowlist = frozenset(cfg.input_files)
+        rec.metrics = Metrics()
+        rec.scheduler = Scheduler(
+            files=rec.map_splits,
+            n_reduce=cfg.n_reduce,
+            task_timeout_s=cfg.task_timeout_s,
+            sweep_interval_s=cfg.sweep_interval_s,
+            app_options=cfg.effective_app_options(),
+            journal=rec.journal,
+            resume_entries=resume_entries,
+            metrics=rec.metrics,
+            commit_resolver=rec.workdir.resolve_task_commit,
+            event_log=rec.event_log,
+            on_change=self._wake,
+            worker_health=self._health,
+        )
+        rec.state = JobState.RUNNING
+        rec.started_at = time.time()
+        self._running.append(rec.job_id)
+        if rec.event_log is not None:
+            rec.event_log.write({
+                "t": "instant", "name": "resume", "cat": "service",
+                "ts": time.time(), "job": rec.job_id,
+                "args": {"replayed_entries": len(resume_entries or [])},
+            })
+        threading.Thread(
+            target=self._watch_job, args=(rec,), daemon=True,
+            name=f"svc-watch-{rec.job_id}",
+        ).start()
+        log.info("job %s resumed (%d journal entries replayed)",
+                 rec.job_id, len(resume_entries or []))
+
+    # -------------------------------------------------------- registry I/O
+    def _stage_state(self, rec: JobRecord,
+                     outputs: list[str] | None = None) -> None:
+        """Stage a state-transition record under the service lock; written
+        by `_flush_registry` after release (appends fsync — never inside
+        a `_locked` method)."""
+        self._registry_pending.append(
+            (rec.job_id, rec.state, rec.error, outputs)
+        )
+
+    def _flush_registry(self) -> None:
+        """Write staged registry records outside the service lock.  The
+        flush lock makes swap + append one ordered unit — without it a
+        preempted flusher could append its older batch AFTER a newer one
+        and replay would trust the stale last state.  Never raises: a
+        full disk must degrade crash-recovery, not take the control
+        plane down."""
+        with self._registry_flush_lock:
+            with self._lock:
+                if not self._registry_pending:
+                    return
+                pending, self._registry_pending = self._registry_pending, []
+            for job_id, state, error, outputs in pending:
+                try:
+                    self._registry.record_state(
+                        job_id, state, error=error, outputs=outputs
+                    )
+                except Exception:  # noqa: BLE001
+                    log.exception("registry append failed for job %s",
+                                  job_id)
+
     # ---------------------------------------------------------------- submit
     def submit(self, config: JobConfig) -> str:
         """Admit a job: validate, queue, start if a slot is free.  Raises
@@ -255,10 +608,42 @@ class GrepService:
             )
             rec = JobRecord(job_id=job_id, config=cfg,
                             submitted_at=time.time(), map_splits=splits)
-            self._jobs[job_id] = rec
-            self._queue.append(job_id)
-            self._maybe_start_locked()
+        # Durability BEFORE visibility: the registry append (fsync)
+        # happens outside the lock and before the id is handed to the
+        # client — from this line on a daemon crash re-admits the job at
+        # restart instead of silently forgetting an acknowledged submit.
+        try:
+            self._registry.record_submit(job_id, cfg)
+        except (OSError, ValueError) as e:
+            # closed registry (stop() won the race) or a dead disk: a job
+            # we cannot durably register is a job we must not accept
+            raise AdmissionError(f"cannot register job: {e}") from e
+        rejected: AdmissionError | None = None
+        with self._cond:
+            # admission re-check AT ENQUEUE: the fsync window above is
+            # unlocked, so N concurrent submits could all have passed the
+            # earlier check against the same queue depth — without this,
+            # the overload regime the 429 cap exists for overshoots it.
+            try:
+                self._check_admission_locked_or_raise(locked=True)
+            except AdmissionError as e:
+                # already durably registered: record the rejection so a
+                # restart does not re-admit a job the client saw 429'd
+                rejected = e
+                rec.state = JobState.CANCELLED
+                rec.error = "rejected by admission control at enqueue"
+                rec.finished_at = time.time()
+                self._jobs[job_id] = rec
+                self._stage_state(rec)
+                self._prune_terminal_locked()
+            else:
+                self._jobs[job_id] = rec
+                self._queue.append(job_id)
+                self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_registry()
+        if rejected is not None:
+            raise rejected
         return job_id
 
     def _check_admission_locked_or_raise(self, locked: bool = False) -> None:
@@ -281,11 +666,13 @@ class GrepService:
             rec = self._jobs[self._queue.pop(0)]
             try:
                 self._start_job_locked(rec)
+                self._stage_state(rec)  # "running" — flushed post-lock
             except Exception as e:  # noqa: BLE001 — bad job, healthy service
                 log.exception("job %s failed to start", rec.job_id)
                 rec.state = JobState.FAILED
                 rec.error = str(e)
                 rec.finished_at = time.time()
+                self._stage_state(rec)
                 # terminal without a close: bound the table on this path
                 # too (a read-only work_root fails EVERY start)
                 self._prune_terminal_locked()
@@ -318,6 +705,7 @@ class GrepService:
             commit_resolver=rec.workdir.resolve_task_commit,
             event_log=rec.event_log,
             on_change=self._wake,
+            worker_health=self._health,
         )
         rec.state = JobState.RUNNING
         rec.started_at = time.time()
@@ -357,9 +745,11 @@ class GrepService:
             rec.state = JobState.DONE
             rec.finished_at = time.time()
             rec.outputs = outputs
+            self._stage_state(rec, outputs=outputs)
             self._close_job_locked(rec)
             self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_registry()
         log.info(
             "job %s done in %.3fs (%d outputs)", rec.job_id,
             rec.finished_at - (rec.started_at or rec.finished_at),
@@ -403,15 +793,18 @@ class GrepService:
                 self._queue.remove(job_id)
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                self._stage_state(rec)
                 # terminal without a close: bound the table here too (a
                 # submit-then-cancel client loop never reaches _close)
                 self._prune_terminal_locked()
             elif rec.state is JobState.RUNNING:
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                self._stage_state(rec)
                 self._close_job_locked(rec)
                 self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_registry()
         log.info("job %s cancelled", job_id)
         return rec.state
 
@@ -469,10 +862,23 @@ class GrepService:
         deadline = _Deadline(timeout)
         with self._lock:
             worker_id = args.worker_id
-            if worker_id < 0:
+            if worker_id < 0 or worker_id not in self.workers:
+                # fresh attach — or a reconnect across a daemon restart:
+                # the new incarnation's table does not know the echoed id,
+                # and honoring it could collide with this incarnation's
+                # own allocations, so the worker gets a FRESH
+                # service-allocated id (it adopts reply.worker_id).  The
+                # row registers at allocation: identity exists from here,
+                # not from the first completed RPC.  The skip-loop covers
+                # rows a stale worker's task RPC re-created post-restart.
+                while self._next_worker_id in self.workers:
+                    self._next_worker_id += 1
                 worker_id = self._next_worker_id
                 self._next_worker_id += 1
-                # a fresh attach is the natural moment to drop rows (and
+                self.workers[worker_id] = {
+                    "job": None, "task": None, "seen": time.monotonic(),
+                }
+                # an attach is the natural moment to drop rows (and
                 # dedup sets) of workers long gone — attached-but-idle
                 # workers refresh their row every long-poll retry, so
                 # only the truly departed age past the expiry
@@ -487,13 +893,37 @@ class GrepService:
                     with self._span_seq_lock:
                         for wid in stale:
                             self._span_seqs.pop(wid, None)
+        # a poll is evidence the worker is alive and NOT running a task
+        # (single-threaded loops) — the lost-reply discriminator the
+        # sweeper's quarantine attribution reads (WorkerHealth.saw)
+        self._health.saw(worker_id)
         while True:
+            # Quarantined workers park here: no scheduler sweep, no
+            # assignment — wait out the window (or the long-poll), then
+            # answer retry with the re-probation hint so the worker backs
+            # off client-side too (WorkerLoop sleeps on retry_after_s).
+            quarantine_s = self._health.quarantine_remaining(worker_id)
+            if quarantine_s > 0:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    self._worker_seen(worker_id)
+                    return rpc.AssignTaskReply(
+                        assignment="retry", task_id=-2, worker_id=worker_id,
+                        retry_after_s=round(quarantine_s, 3),
+                    )
+                with self._cond:
+                    if not self._stopped:
+                        self._cond.wait(
+                            min(remaining, quarantine_s, _ASSIGN_SWEEP_S)
+                        )
             with self._lock:
                 if self._stopped:
                     return rpc.AssignTaskReply(
                         assignment=rpc.Assignment.JOB_DONE,
                         worker_id=worker_id,
                     )
+                if quarantine_s > 0:
+                    continue  # re-check the quarantine clock first
                 order = list(self._running)
                 start = self._rr
                 self._rr += 1
@@ -648,11 +1078,25 @@ class GrepService:
         compile_cache_* / corpus_cache_* counters land here via the
         heartbeat piggyback), and this process's own compiled-model and
         device-corpus cache counters (authoritative for in-process
-        workers; HTTP workers report theirs per row)."""
-        from distributed_grep_tpu.ops.engine import model_cache_counters
-        from distributed_grep_tpu.ops.layout import corpus_cache_counters
+        workers; HTTP workers report theirs per row).  The cache modules
+        are sys.modules-gated like the worker piggyback
+        (worker._engine_cache_counters): a daemon whose workers are all
+        REMOTE never builds an engine, and its first /status must not
+        import the whole ops stack (jax included) just to report two
+        empty dicts."""
+        import sys as _sys
+
+        eng = _sys.modules.get("distributed_grep_tpu.ops.engine")
+        lay = _sys.modules.get("distributed_grep_tpu.ops.layout")
+        model_cache_counters = (
+            eng.model_cache_counters if eng is not None else dict
+        )
+        corpus_cache_counters = (
+            lay.corpus_cache_counters if lay is not None else dict
+        )
 
         now = time.monotonic()
+        quarantine = self._health.snapshot()
         with self._lock:
             jobs = {
                 jid: {"state": rec.state}
@@ -660,6 +1104,10 @@ class GrepService:
             }
             queued = len(self._queue)
             running = list(self._running)
+            tasks_requeued = sum(
+                rec.metrics.counters.get("tasks_requeued", 0)
+                for rec in self._jobs.values()
+            )
             workers = {}
             for wid, info in sorted(self.workers.items()):
                 row: dict = {
@@ -669,6 +1117,8 @@ class GrepService:
                 }
                 if info.get("metrics") is not None:
                     row["metrics"] = info["metrics"]
+                if str(wid) in quarantine["active"]:
+                    row["quarantined_s"] = quarantine["active"][str(wid)]
                 workers[str(wid)] = row
         for jid in jobs:
             rec = self._jobs.get(jid)  # pruning may race this unlocked read
@@ -687,6 +1137,12 @@ class GrepService:
             "running": running,
             "jobs": jobs,
             "workers": workers,
+            # robustness counters (round 10): requeued-task total across
+            # the retained jobs, plus the quarantine tracker's view
+            # (episodes ever entered + currently parked workers)
+            "tasks_requeued": tasks_requeued,
+            "workers_quarantined": quarantine["quarantined_total"],
+            "quarantine": quarantine["active"],
             "compile_cache": model_cache_counters(),
             "corpus_cache": corpus_cache_counters(),
         }
@@ -741,15 +1197,19 @@ class GrepService:
                 rec = self._jobs[jid]
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                self._stage_state(rec)
             self._queue.clear()
             for jid in list(self._running):
                 rec = self._jobs[jid]
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                self._stage_state(rec)
                 self._close_job_locked(rec)
             self._cond.notify_all()
+        self._flush_registry()
         for t in getattr(self, "_local_workers", []):
             t.join(timeout=join_timeout_s)
+        self._registry.close()
 
 
 # ---------------------------------------------------------------- transports

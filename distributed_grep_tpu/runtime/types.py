@@ -45,6 +45,16 @@ class MapTask:
     # Member files of a batched multi-file split (cross-file device
     # batching, runtime/job.plan_map_splits); () for ordinary tasks.
     files: tuple[str, ...] = ()
+    # Worker holding the current attempt (-1 = none): lets the timeout
+    # sweeper attribute the failure to the worker that went silent, the
+    # input of the quarantine tracker (scheduler.WorkerHealth).
+    worker: int = -1
+    # True once the WORKER stamped this attempt (mid-task heartbeat /
+    # shuffle fetch) — proof it actually received the assignment.  An
+    # unstamped timeout might be a LOST ASSIGNMENT REPLY, not a dark
+    # worker; the sweeper then charges the worker only if it also never
+    # polled again (scheduler._sweep_loop).
+    stamped: bool = False
 
     def heartbeat(self, grace_s: float = 0.0) -> None:
         self.timestamp = time.monotonic()
@@ -58,6 +68,8 @@ class ReduceTask:
     timestamp: float = 0.0
     attempts: int = 0
     grace_s: float = 0.0  # see MapTask.grace_s
+    worker: int = -1  # see MapTask.worker (quarantine attribution)
+    stamped: bool = False  # see MapTask.stamped
     # Intermediate files registered as map tasks commit; reducers stream these
     # in arrival order (the pipelined shuffle, coordinator.go:159-174).
     task_files: list[str] = field(default_factory=list)

@@ -39,6 +39,12 @@ class WorkerKilled(Exception):
     """Raised by fault-injection hooks to simulate a worker crash."""
 
 
+class TaskAborted(Exception):
+    """The coordinator fenced this attempt off (stale scheduler epoch —
+    the attempt outlived a coordinator/daemon restart): abandon it with
+    NO commit and NO finished RPC, then go back to polling for work."""
+
+
 def _engine_cache_counters() -> dict | None:
     """This process's cross-job engine-cache counters — compiled-model
     (compile_cache_hits/misses/evictions) AND device-corpus
@@ -109,6 +115,18 @@ class WorkerLoop:
         if hook:
             hook()
 
+    def _attach_rpc_retries(self, args) -> None:
+        """Piggyback the transport's transient-retry count — UNGATED by
+        the span pipeline (an operator debugging a flaky fleet looks for
+        rpc_retries in /status precisely when spans are off) but
+        nonzero-only, so the zero-retry default keeps the wire payload
+        byte-identical to the pre-span protocol."""
+        retries = getattr(self.transport, "retry_count", 0)
+        if retries:
+            if args.metrics is None:
+                args.metrics = {}
+            args.metrics["rpc_retries"] = retries
+
     # --------------------------------------------------------------- liveness
     def _hb_interval(self, window_s: float) -> float:
         """Heartbeat cadence derived from the coordinator's declared
@@ -144,6 +162,7 @@ class WorkerLoop:
                 args.metrics.update(cc)
             args.sent_at = time.time()
             args.rtt_s = self._hb_rtt
+        self._attach_rpc_retries(args)
         try:
             rtt = hb(args)
             # Transports that measure return the successful POST's round
@@ -233,6 +252,12 @@ class WorkerLoop:
                 self._run_map(reply)
             elif reply.assignment == rpc.Assignment.REDUCE:
                 self._run_reduce(reply)
+            elif reply.retry_after_s > 0:
+                # quarantined (scheduler.WorkerHealth): the coordinator
+                # hinted how long until re-probation — sleep a bounded
+                # slice of it instead of re-entering the long-poll hot
+                # (capped so a shrunk window server-side is noticed)
+                time.sleep(min(reply.retry_after_s, 5.0))
             # anything else ("retry"): long-poll window expired — loop again
 
     def _bind_assignment(self, reply: rpc.AssignTaskReply) -> None:
@@ -301,6 +326,7 @@ class WorkerLoop:
             cc = _engine_cache_counters()
             if cc:
                 args.metrics.update(cc)
+        self._attach_rpc_retries(args)
         return args
 
     # ------------------------------------------------------------------- map
@@ -520,7 +546,17 @@ class WorkerLoop:
         t0_wall = time.time()
         attempt = new_attempt_id()
         with self._task_ctx("reduce", a.task_id, attempt):
-            self._reduce_attempt(a, attempt)
+            try:
+                self._reduce_attempt(a, attempt)
+            except TaskAborted:
+                # fenced off by a newer scheduler incarnation: this
+                # attempt's shuffle cursor is meaningless there — walk
+                # away (the re-issued attempt owns the commit) and poll
+                # for fresh work
+                log.warning("reduce task %d attempt abandoned: stale "
+                            "scheduler epoch", a.task_id)
+                self.metrics.inc("reduce_aborted")
+                return
             spans_mod.complete(
                 "reduce:task", t0_wall, time.time() - t0_wall, cat="reduce",
                 assign_wait_s=round(self._assign_wait_s, 6),
@@ -585,9 +621,12 @@ class WorkerLoop:
                 r = self.transport.reduce_next_file(
                     rpc.ReduceNextFileArgs(
                         task_id=a.task_id, files_processed=files_processed,
-                        job_id=self._rpc_job_id,
+                        job_id=self._rpc_job_id, epoch=a.epoch,
+                        worker_id=self.worker_id,
                     )
                 )
+                if getattr(r, "abort", False):
+                    raise TaskAborted(a.task_id)
                 if r.done:
                     break
                 if not r.next_file:

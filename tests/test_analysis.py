@@ -300,6 +300,47 @@ def test_logging_scope_and_get_logger_exemptions(tmp_path):
     assert not _hits(tmp_path, "logging")
 
 
+# -------------------------------------------------------------- R8 net-retry
+
+def test_net_retry_fires_on_raw_urlopen_and_socket(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "import socket\n"
+        "import urllib.request\n"
+        "def f(url, host):\n"
+        "    with urllib.request.urlopen(url, timeout=5) as r:\n"
+        "        body = r.read()\n"
+        "    c = socket.create_connection((host, 80))\n"
+        "    return body, c\n")
+    _mk(tmp_path, "__main__.py",
+        "import urllib.request\n"
+        "def poll(url):\n"
+        "    return urllib.request.urlopen(url).read()\n")
+    got = _hits(tmp_path, "net-retry")
+    assert [(v.path, v.line) for v in got] == [
+        ("__main__.py", 3), ("runtime/x.py", 4), ("runtime/x.py", 6),
+    ]
+    assert all("retry-wrapped transport helpers" in v.message for v in got)
+
+
+def test_net_retry_silent_on_transport_module_and_out_of_scope(tmp_path):
+    # the retry helpers themselves live on raw urlopen — exempt
+    _mk(tmp_path, "runtime/http_transport.py",
+        "import urllib.request\n"
+        "def _request(url):\n"
+        "    return urllib.request.urlopen(url).read()\n")
+    # benchmarks/apps are out of scope (no control-plane retry contract)
+    _mk(tmp_path, "apps/y.py",
+        "import urllib.request\n"
+        "def fetch(url):\n"
+        "    return urllib.request.urlopen(url).read()\n")
+    # server-side sockets in runtime/ are not client calls
+    _mk(tmp_path, "runtime/server.py",
+        "from http.server import ThreadingHTTPServer\n"
+        "def serve(handler):\n"
+        "    return ThreadingHTTPServer(('127.0.0.1', 0), handler)\n")
+    assert not _hits(tmp_path, "net-retry")
+
+
 # --------------------------------------------- suppression + CLI plumbing
 
 def test_pragma_suppresses_named_rule_only(tmp_path):

@@ -1,0 +1,680 @@
+"""Chaos tier (round 10): the service daemon under SIGKILL and a hostile
+network.
+
+The PR-1 crash matrix (tests/test_store_faults.py) proved the STORAGE
+commit protocol; this tier proves the control plane above it:
+
+* ``FaultTransport`` (runtime/fault_transport.py) unit semantics — drops,
+  delays, duplicates at the transport boundary;
+* duplicate-delivery idempotency end-to-end (every finished RPC + commit
+  publication delivered twice -> byte-identical outputs, no duplicate
+  journal entries);
+* worker quarantine: consecutive attributed timeouts park a worker
+  (exponential backoff), re-probation re-admits it, events + counters
+  surface the episode;
+* the bounded-jittered client retry (``client_call``) surviving RST-ing
+  sockets, including the full ``dgrep submit`` poll loop through a flaky
+  TCP proxy (the satellite fix: a transient reset used to kill the
+  client before its daemon-death JSON fallback could fire);
+* the acceptance matrix: daemon SIGKILL mid-stream (2 concurrent jobs +
+  1 queued) x {map, reduce phase} x {posix, nonatomic store} x injected
+  network faults -> the restarted daemon completes every job
+  byte-identical to a fault-free run with zero duplicate journal
+  commits.
+
+Standalone:  python -m pytest tests/test_chaos.py -q  (marker ``chaos``)
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import service_proc
+from distributed_grep_tpu.runtime.fault_transport import (
+    FaultPoint,
+    FaultTransport,
+    seeded_schedule,
+)
+from distributed_grep_tpu.runtime.http_transport import (
+    ServiceHttpTransport,
+    client_call,
+)
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.scheduler import (
+    QUARANTINE_AFTER_FAILURES,
+    Scheduler,
+    WorkerHealth,
+)
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
+from distributed_grep_tpu.runtime.worker import WorkerLoop
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.io import WorkDir
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------- FaultTransport unit
+
+class _FakeTransport:
+    def __init__(self):
+        self.calls: list[str] = []
+
+    def map_finished(self, args):
+        self.calls.append("map_finished")
+        return rpc.TaskFinishedReply(ok=True)
+
+    def read_input(self, name):
+        self.calls.append(f"read:{name}")
+        return b"data"
+
+
+def test_fault_transport_duplicate_and_passthrough():
+    base = _FakeTransport()
+    ft = FaultTransport(base, {
+        FaultPoint.DUPLICATE: lambda ctx: ctx == "map_finished",
+    })
+    reply = ft.map_finished(rpc.TaskFinishedArgs(task_id=0))
+    assert reply.ok
+    assert base.calls == ["map_finished", "map_finished"]  # two deliveries
+    assert ft.read_input("f") == b"data"  # un-faulted call passes through
+
+
+def test_fault_transport_drop_request_never_reaches_base():
+    base = _FakeTransport()
+    ft = FaultTransport(base, {
+        FaultPoint.DROP_REQUEST: lambda ctx: ctx == "map_finished",
+    })
+    with pytest.raises(ConnectionResetError):
+        ft.map_finished(rpc.TaskFinishedArgs(task_id=0))
+    assert base.calls == []  # the peer never saw it
+
+
+def test_fault_transport_drop_reply_applies_server_side():
+    base = _FakeTransport()
+    ft = FaultTransport(base, {
+        FaultPoint.DROP_REPLY: lambda ctx: True,
+    })
+    with pytest.raises(ConnectionResetError):
+        ft.map_finished(rpc.TaskFinishedArgs(task_id=0))
+    assert base.calls == ["map_finished"]  # the peer DID act
+
+
+def test_fault_transport_delay_and_feature_probes():
+    base = _FakeTransport()
+    slept = time.monotonic()
+    ft = FaultTransport(base, {
+        FaultPoint.DELAY: lambda ctx: 0.05 if ctx == "read_input" else 0,
+    })
+    assert ft.read_input("f") == b"data"
+    assert time.monotonic() - slept >= 0.05
+    # hasattr probes answer the base's truth (worker feature detection)
+    assert not hasattr(ft, "read_input_path")
+    assert not hasattr(ft, "publish_task_commit")
+    with pytest.raises(ValueError):
+        FaultTransport(base, {"bogus_point": lambda ctx: 1})
+
+
+# --------------------------------------- duplicate deliveries, end to end
+
+def grep_config(corpus, pattern="hello", **kw) -> JobConfig:
+    defaults = dict(
+        input_files=[str(p) for p in corpus.values()],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": pattern, "backend": "cpu"},
+        n_reduce=3,
+    )
+    defaults.update(kw)
+    return JobConfig(**defaults)
+
+
+def outputs_by_name(paths) -> dict[str, bytes]:
+    """name -> bytes, normalized over nonatomic part decoration (the
+    resolved winner path is <name>.part.<attempt> there)."""
+    out = {}
+    for p in paths:
+        name = Path(p).name.split(".part.")[0]
+        out[name] = Path(p).read_bytes()
+    return out
+
+
+def test_duplicate_deliveries_keep_outputs_exact(tmp_path, corpus):
+    """EVERY completion RPC and commit publication delivered twice: the
+    idempotent commit layer absorbs all of it — outputs byte-identical
+    to a clean run, journal carries each task once."""
+    from distributed_grep_tpu.runtime.service import ServiceLocalTransport
+
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    try:
+        jid = svc.submit(grep_config(corpus))
+        dup = {"n": 0}
+
+        def dup_hook(ctx: str):
+            if ctx in ("map_finished", "reduce_finished",
+                       "publish_task_commit", "write_intermediate",
+                       "write_output"):
+                dup["n"] += 1
+                return 1
+            return 0
+
+        loop = WorkerLoop(
+            FaultTransport(ServiceLocalTransport(svc, rpc_timeout_s=5.0),
+                           {FaultPoint.DUPLICATE: dup_hook}),
+            app=None,
+        )
+        t = threading.Thread(target=loop.run, daemon=True)
+        t.start()
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+        assert dup["n"] > 0  # faults actually fired
+        got = outputs_by_name(svc.job_result(jid)["outputs"])
+        want = outputs_by_name(run_job(
+            grep_config(corpus, work_dir=str(tmp_path / "serial")),
+            n_workers=2,
+        ).output_files)
+        assert got == want
+        # journal: each task committed exactly once despite double delivery
+        entries = TaskJournal.replay(
+            WorkDir(str(tmp_path / "svc" / jid)).journal_path()
+        )
+        seen = [(e["kind"], e["task_id"]) for e in entries]
+        assert len(seen) == len(set(seen))
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------------------- quarantine
+
+def test_worker_quarantine_and_reprobation(tmp_path, monkeypatch):
+    """Deterministic quarantine lifecycle at the scheduler: a worker that
+    keeps timing out is parked after QUARANTINE_AFTER_FAILURES, its polls
+    answer retry + retry_after_s, another worker gets the work, and
+    expiry re-probations the flake.  Events + counters cover it."""
+    monkeypatch.setenv("DGREP_WORKER_QUARANTINE_S", "0.6")
+    from distributed_grep_tpu.utils.spans import EventLog
+
+    ev_path = tmp_path / "events.jsonl"
+    event_log = EventLog(ev_path, fresh=True)
+    files = [str(tmp_path / "in.txt")]
+    Path(files[0]).write_text("hello\n")
+    sched = Scheduler(files=files, n_reduce=1, task_timeout_s=0.15,
+                      sweep_interval_s=0.05, event_log=event_log)
+    try:
+        flaky = -1
+        for i in range(QUARANTINE_AFTER_FAILURES):
+            reply = sched.assign_task(
+                rpc.AssignTaskArgs(worker_id=flaky), timeout=2.0
+            )
+            assert reply.assignment == rpc.Assignment.MAP, (i, reply)
+            flaky = reply.worker_id
+            # never complete: the sweeper attributes the timeout to us
+            deadline = time.monotonic() + 5
+            while sched.map_tasks[0].state.value != "unassigned":
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        # quarantined now: our poll gets retry + a re-probation hint
+        reply = sched.assign_task(
+            rpc.AssignTaskArgs(worker_id=flaky), timeout=0.1
+        )
+        assert reply.assignment == "retry"
+        assert reply.retry_after_s > 0
+        assert sched.worker_health.quarantine_remaining(flaky) > 0
+        assert sched.metrics.counters["workers_quarantined"] == 1
+        assert sched.metrics.counters["tasks_requeued"] >= 3
+        # another worker gets the task immediately
+        reply2 = sched.assign_task(rpc.AssignTaskArgs(worker_id=-1),
+                                   timeout=2.0)
+        assert reply2.assignment == rpc.Assignment.MAP
+        assert reply2.worker_id != flaky
+        sched.map_finished(rpc.TaskFinishedArgs(
+            task_id=0, worker_id=reply2.worker_id, produced_parts=[0]
+        ))
+        # /status rows surface the parked worker
+        assert "quarantined_s" in sched.worker_status()[str(flaky)]
+        # re-probation: after expiry the flake is assignable again
+        time.sleep(0.7)
+        assert sched.worker_health.quarantine_remaining(flaky) == 0.0
+        reply3 = sched.assign_task(
+            rpc.AssignTaskArgs(worker_id=flaky), timeout=2.0
+        )
+        assert reply3.assignment == rpc.Assignment.REDUCE
+    finally:
+        sched.stop()
+        event_log.close()
+    names = [json.loads(ln).get("name")
+             for ln in ev_path.read_text().splitlines() if ln.strip()]
+    assert "quarantine" in names
+    # and trace-export renders the instant
+    from distributed_grep_tpu.utils.spans import EventLog as EL
+    from distributed_grep_tpu.utils.spans import export_chrome_trace
+
+    doc = export_chrome_trace(EL.read(ev_path))
+    assert any(e.get("name") == "quarantine" for e in doc["traceEvents"])
+
+
+def test_quarantine_backoff_doubles_and_success_clears():
+    h = WorkerHealth(base_s=10.0)
+    for _ in range(QUARANTINE_AFTER_FAILURES - 1):
+        assert h.record_failure(7) == 0.0
+    assert h.record_failure(7) == 10.0  # episode 1
+    h._until.clear()  # expire by hand (no wall-clock wait)
+    assert h.record_failure(7) == 20.0  # re-probation failure: episode 2
+    h._until.clear()
+    h.record_success(7)  # a committed task clears the whole record
+    for _ in range(QUARANTINE_AFTER_FAILURES - 1):
+        assert h.record_failure(7) == 0.0
+    assert h.record_failure(7) == 10.0  # back to episode 1's window
+
+
+def test_service_status_surfaces_quarantine(tmp_path, corpus):
+    """Service-level: a worker going dark under one tenant is parked for
+    EVERY tenant (shared WorkerHealth), visible in GET /status."""
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=0.15,
+                      sweep_interval_s=0.05)
+    try:
+        jid = svc.submit(grep_config(corpus))
+        flaky = -1
+        for _ in range(QUARANTINE_AFTER_FAILURES):
+            reply = svc.assign_task(rpc.AssignTaskArgs(worker_id=flaky),
+                                    timeout=5.0)
+            assert reply.assignment == rpc.Assignment.MAP
+            flaky = reply.worker_id
+            rec = svc.record(jid)
+            deadline = time.monotonic() + 5
+            while rec.scheduler.map_tasks[reply.task_id].state.value \
+                    != "unassigned":
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        reply = svc.assign_task(rpc.AssignTaskArgs(worker_id=flaky),
+                                timeout=0.1)
+        assert reply.assignment == "retry" and reply.retry_after_s > 0
+        status = svc.status()
+        assert status["workers_quarantined"] >= 1
+        assert str(flaky) in status["quarantine"]
+        assert status["workers"][str(flaky)].get("quarantined_s", 0) > 0
+        assert status["tasks_requeued"] >= QUARANTINE_AFTER_FAILURES
+        # healthy workers finish the job while the flake is parked
+        svc.start_local_workers(1)
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+    finally:
+        svc.stop()
+
+
+def test_zombie_reducer_fenced_by_scheduler_epoch(tmp_path):
+    """A reduce attempt that outlives a daemon restart (its transport
+    retries reconnect to the NEW incarnation) carries a files_processed
+    cursor over the OLD task_files arrival order — the rebuilt scheduler
+    must ABORT it, never serve its misindexed cursor (it could commit
+    wrong bytes and win attempt resolution)."""
+    f = tmp_path / "in.txt"
+    f.write_text("hello\n")
+    sched = Scheduler(files=[str(f)], n_reduce=1, task_timeout_s=5.0,
+                      sweep_interval_s=0.5)
+    try:
+        # a fetch tagged with another incarnation's epoch: aborted
+        r = sched.reduce_next_file(
+            rpc.ReduceNextFileArgs(task_id=0, files_processed=1,
+                                   epoch="deadbeefcafe"),
+            timeout=0.1,
+        )
+        assert r.abort and not r.done and not r.next_file
+        # the current incarnation's epoch (and the legacy empty one) serve
+        for ep in (sched.epoch, ""):
+            r = sched.reduce_next_file(
+                rpc.ReduceNextFileArgs(task_id=0, files_processed=0,
+                                       epoch=ep),
+                timeout=0.1,
+            )
+            assert not r.abort
+        # assignments carry the epoch the worker must echo
+        reply = sched.assign_task(rpc.AssignTaskArgs(worker_id=-1),
+                                  timeout=1.0)
+        assert reply.assignment == rpc.Assignment.MAP
+        assert reply.epoch == sched.epoch
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------------- flaky-socket client path
+
+class FlakyProxy:
+    """TCP proxy that RST-closes every ``drop_every``-th accepted
+    connection (starting with the FIRST) and forwards the rest to the
+    upstream port — the transient-reset network a client retry policy
+    must survive."""
+
+    def __init__(self, upstream_port: int, drop_every: int = 3,
+                 offset: int = 0):
+        self.upstream_port = upstream_port
+        self.drop_every = drop_every
+        self.dropped = 0
+        self._n = offset  # offset=1: the FIRST connection passes
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._t = threading.Thread(target=self._accept_loop, daemon=True)
+        self._t.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            i = self._n
+            self._n += 1
+            if i % self.drop_every == 0:
+                # SO_LINGER(1, 0): close() sends RST, the hard reset.
+                # Count BEFORE closing — the client observes the reset
+                # the instant close() runs, and a test asserting on
+                # `dropped` right after its exception would race a
+                # post-close increment.
+                self.dropped += 1
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                )
+                conn.close()
+                continue
+            threading.Thread(target=self._pump, args=(conn,),
+                             daemon=True).start()
+
+    def _pump(self, client):
+        try:
+            up = socket.create_connection(("127.0.0.1", self.upstream_port))
+        except OSError:
+            client.close()
+            return
+
+        def shuttle(src, dst):
+            try:
+                while True:
+                    block = src.recv(1 << 16)
+                    if not block:
+                        break
+                    dst.sendall(block)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=shuttle, args=(up, client), daemon=True)
+        t.start()
+        shuttle(client, up)
+        t.join(timeout=10)
+        client.close()
+        up.close()
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+def test_client_call_survives_connection_resets(tmp_path, corpus,
+                                                monkeypatch):
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    svc = GrepService(work_root=tmp_path / "svc")
+    server = ServiceServer(svc)
+    server.start()
+    proxy = FlakyProxy(server.port, drop_every=2)  # every OTHER conn RSTs
+    try:
+        # every second call eats a reset first and retries through it
+        for _ in range(4):
+            status = client_call(f"127.0.0.1:{proxy.port}", "GET", "/status")
+            assert status["service"] is True
+        assert proxy.dropped >= 2
+    finally:
+        proxy.close()
+        svc.stop()
+        server.shutdown()
+
+
+def test_client_call_single_shot_never_replays(tmp_path, monkeypatch):
+    """retry=False (the submit POST): exactly ONE attempt — a retried
+    non-idempotent POST would mint a duplicate job after a lost reply."""
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    svc = GrepService(work_root=tmp_path / "svc")
+    server = ServiceServer(svc)
+    server.start()
+    proxy = FlakyProxy(server.port, drop_every=1)  # EVERY connection RSTs
+    try:
+        with pytest.raises(OSError):
+            client_call(f"127.0.0.1:{proxy.port}", "POST", "/jobs", b"{}",
+                        retry=False)
+        assert proxy.dropped == 1  # one attempt, zero replays
+    finally:
+        proxy.close()
+        svc.stop()
+        server.shutdown()
+
+
+def test_cmd_submit_poll_survives_flaky_socket(tmp_path, corpus,
+                                               monkeypatch, capsys):
+    """The satellite fix end-to-end: `dgrep submit --wait` through a proxy
+    that RSTs every third connection completes the job and prints exactly
+    ONE JSON line — the old raw-urlopen poll died on the first reset."""
+    from distributed_grep_tpu import __main__ as cli
+
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    server = ServiceServer(svc)
+    server.start()
+    svc.start_local_workers(2)
+    # offset=1: the submit POST itself (first connection) passes — it is
+    # deliberately SINGLE-SHOT (a retried non-idempotent POST would mint
+    # a duplicate job); every later POLL eats resets and retries through
+    proxy = FlakyProxy(server.port, drop_every=3, offset=1)
+    try:
+        rc = cli.main([
+            "submit", "--addr", f"127.0.0.1:{proxy.port}",
+            "hello", *[str(p) for p in corpus.values()],
+            "--timeout", "60",
+        ])
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert rc == 0, out
+        assert len(lines) == 1
+        doc = json.loads(lines[0])
+        assert doc["state"] == "done" and doc["outputs"]
+        assert proxy.dropped >= 1  # the flake actually bit
+    finally:
+        proxy.close()
+        svc.stop()
+        server.shutdown()
+
+
+# ------------------------------------------------------ the chaos matrix
+
+def _chaos_hooks(seed: int) -> dict:
+    """The matrix's network profile: seeded drops on every call family,
+    duplicates on the idempotent completion/commit calls, small delays
+    on the data plane."""
+    rng = random.Random(seed)
+
+    def drop_request(ctx):
+        return rng.random() < 0.04
+
+    def drop_reply(ctx):
+        return rng.random() < 0.04
+
+    def duplicate(ctx):
+        return ctx in ("map_finished", "reduce_finished",
+                       "publish_task_commit", "heartbeat") \
+            and rng.random() < 0.15
+
+    def delay(ctx):
+        if ctx in ("read_input", "read_intermediate", "write_intermediate"):
+            return 0.03 * rng.random()
+        return 0
+
+    return {
+        FaultPoint.DROP_REQUEST: drop_request,
+        FaultPoint.DROP_REPLY: drop_reply,
+        FaultPoint.DUPLICATE: duplicate,
+        FaultPoint.DELAY: delay,
+    }
+
+
+@pytest.fixture(scope="module")
+def matrix_corpus(tmp_path_factory) -> dict[str, Path]:
+    """One corpus shared by every matrix case (module-scoped on purpose:
+    output bytes embed input paths, so the fault-free oracle runs are
+    computed once per (pattern, store) and reused across the phase
+    parametrization)."""
+    root = tmp_path_factory.mktemp("chaos-corpus")
+    files = {}
+    for i in range(6):
+        p = root / f"in{i}.txt"
+        lines = []
+        for j in range(400):
+            lines.append(
+                f"line {j} of file {i}"
+                + (" hello" if j % 3 == 0 else "")
+                + (" fox" if j % 5 == 0 else "")
+            )
+        p.write_text("\n".join(lines) + "\n")
+        files[p.name] = p
+    return files
+
+
+_ORACLE_CACHE: dict[tuple[str, str], dict[str, bytes]] = {}
+
+
+@pytest.mark.parametrize("phase,store", [
+    ("map", "posix"),
+    ("map", "nonatomic"),
+    ("reduce", "posix"),
+    ("reduce", "nonatomic"),
+])
+def test_chaos_matrix_daemon_sigkill(tmp_path, monkeypatch, phase, store,
+                                     matrix_corpus):
+    """Acceptance: daemon SIGKILL mid-stream (2 running + 1 queued) x
+    {map, reduce} x {posix, nonatomic} x injected network faults — the
+    restarted daemon completes every job byte-identical to a fault-free
+    run, with zero duplicate journal commits."""
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "10")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.2")
+    corpus = matrix_corpus
+    work_root = tmp_path / "svc-root"
+    work_root.mkdir()
+    daemon = service_proc.ServiceProc(
+        work_root, workers=0,
+        env={
+            "DGREP_SERVICE_MAX_JOBS": "2",  # 3 submits = 2 running + 1 queued
+            "DGREP_WORKER_QUARANTINE_S": "1",
+        },
+    ).start()
+
+    stop = threading.Event()
+
+    def worker_main(seed: int) -> None:
+        # crashed workers are REPLACED: an injected reset kills the loop
+        # like a real network death kills a worker; the next incarnation
+        # attaches fresh (new service-allocated id) — which is also what
+        # drives quarantine pressure on the ids that died holding tasks
+        rng = random.Random(seed)
+        while not stop.is_set():
+            transport = FaultTransport(
+                ServiceHttpTransport(f"127.0.0.1:{daemon.port}",
+                                     rpc_timeout_s=15.0),
+                _chaos_hooks(rng.randrange(1 << 30)),
+            )
+            loop = WorkerLoop(transport, app=None)
+            try:
+                loop.run()
+                return  # JOB_DONE: service shut down
+            except Exception:  # noqa: BLE001 — worker died; replace it
+                time.sleep(0.2)
+
+    threads = [threading.Thread(target=worker_main, args=(seed,),
+                                daemon=True) for seed in (11, 23, 47)]
+    for t in threads:
+        t.start()
+
+    def cfg_for(pattern: str, sub: str) -> JobConfig:
+        return grep_config(
+            corpus, pattern=pattern, n_reduce=2, store=store,
+            task_timeout_s=2.0, sweep_interval_s=0.2,
+            work_dir=str(tmp_path / sub),  # service overrides its copy
+        )
+
+    patterns = ["hello", "fox", "line"]
+    try:
+        jids = [daemon.submit(cfg_for(p, f"sub{i}"))
+                for i, p in enumerate(patterns)]
+
+        # wait for the kill phase mid-stream, then SIGKILL
+        deadline = time.monotonic() + 90
+        while True:
+            assert time.monotonic() < deadline, daemon.tail_log()
+            try:
+                st = daemon.job_status(jids[0])
+            except OSError:
+                time.sleep(0.05)
+                continue
+            m = st.get("map", {})
+            if phase == "map":
+                if m.get("completed", 0) >= 1:
+                    break  # mid map phase (or later — mid-stream either way)
+            else:
+                if m and m.get("completed") == m.get("total"):
+                    break  # map phase over: reduces in flight
+            if st.get("state") == "done":
+                break  # too fast to catch — restart still exercises resume
+            time.sleep(0.03)
+        daemon.sigkill()
+        time.sleep(0.5)  # a real crash-restart gap; workers retry through it
+        daemon.start()
+
+        results = {}
+        for jid in jids:
+            st = daemon.wait_job(jid, timeout=150)
+            assert st["state"] == "done", (jid, st, daemon.tail_log())
+            results[jid] = daemon.job_result(jid)["outputs"]
+    finally:
+        stop.set()
+        # fail the workers' remaining transport calls FAST: the retry
+        # schedule is re-read from the env per call, so zeroing it here
+        # turns each crashed loop's next call into an immediate exit
+        # instead of ~20 s of backoff against a dead daemon (monkeypatch
+        # restores the var at teardown)
+        monkeypatch.setenv("DGREP_RPC_RETRIES", "0")
+        daemon.terminate()
+        for t in threads:
+            t.join(timeout=10)
+
+    # byte-identical to fault-free serial runs (oracle outputs cached per
+    # (pattern, store) — the phase parametrization reuses them)
+    for jid, pattern, i in zip(jids, patterns, range(3)):
+        key = (pattern, store)
+        if key not in _ORACLE_CACHE:
+            _ORACLE_CACHE[key] = outputs_by_name(run_job(
+                grep_config(corpus, pattern=pattern, n_reduce=2, store=store,
+                            work_dir=str(tmp_path / f"oracle{i}")),
+                n_workers=2,
+            ).output_files)
+        assert outputs_by_name(results[jid]) == _ORACLE_CACHE[key], \
+            (jid, pattern)
+
+    # zero duplicate journal commits per job, across both daemon lives
+    for jid in jids:
+        entries = TaskJournal.replay(
+            WorkDir(str(work_root / jid)).journal_path()
+        )
+        seen = [(e["kind"], e["task_id"]) for e in entries]
+        assert len(seen) == len(set(seen)), (jid, seen)

@@ -143,10 +143,8 @@ def test_http_config_bootstrap(tmp_path, corpus):
 
 
 def test_coordinator_gone_raises_after_budget(monkeypatch):
-    from distributed_grep_tpu.runtime import http_transport as ht
-
-    monkeypatch.setattr(ht, "RETRY_BUDGET_S", 0.5)
-    monkeypatch.setattr(ht, "RETRY_DELAY_S", 0.05)
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "2")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
     # Nothing listens on this port.
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -154,6 +152,7 @@ def test_coordinator_gone_raises_after_budget(monkeypatch):
     t = HttpTransport(f"127.0.0.1:{dead_port}")
     with pytest.raises(CoordinatorGone):
         t.fetch_status()
+    assert t.retry_count == 2  # every scheduled retry was spent
 
 
 @pytest.mark.slow

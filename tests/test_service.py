@@ -510,6 +510,193 @@ def test_http_worker_attach_serves_service_jobs(tmp_path, corpus):
         t.join(timeout=15)
 
 
+# --------------------------------------------- crash recovery (round 10)
+
+def test_service_restart_preserves_history_and_id_counter(tmp_path, corpus):
+    """A restarted daemon reloads terminal jobs from jobs.jsonl (results
+    still answerable) and continues the job-id counter — old work dirs
+    are never clobbered by a new incarnation's ids."""
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    svc.start_local_workers(2)
+    j1 = svc.submit(grep_config(corpus))
+    assert svc.wait_job(j1, timeout=60), svc.job_status(j1)
+    outputs = outputs_by_name(svc.job_result(j1)["outputs"])
+    svc.stop()
+
+    svc2 = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                       sweep_interval_s=0.1)
+    try:
+        assert svc2.job_status(j1)["state"] == JobState.DONE
+        assert outputs_by_name(svc2.job_result(j1)["outputs"]) == outputs
+        j2 = svc2.submit(grep_config(corpus, pattern="fox"))
+        assert j2 == "job-2"  # counter resumed past the registry's max
+        svc2.start_local_workers(1)
+        assert svc2.wait_job(j2, timeout=60), svc2.job_status(j2)
+    finally:
+        svc2.stop()
+
+
+def test_service_restart_resumes_mid_job_from_journal(tmp_path, corpus):
+    """Daemon death mid-job: a new service over the same work root
+    resumes the RUNNING job from its journal — completed maps replay as
+    done (not re-assigned), the rest run, outputs stay exact.  The first
+    service is ABANDONED, not stopped: a SIGKILL runs no teardown, so
+    neither does this test."""
+    from distributed_grep_tpu.runtime.worker import WorkerKilled
+
+    svc_a = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                        sweep_interval_s=0.1)
+    killed = {"n": 0}
+
+    def die_on_third_read():
+        killed["n"] += 1
+        if killed["n"] >= 3:
+            raise WorkerKilled()
+
+    svc_a.start_local_workers(
+        1, fault_hooks_per_worker=[{"after_map_read": die_on_third_read}]
+    )
+    j1 = svc_a.submit(grep_config(corpus))  # 3 files -> 3 map tasks
+    rec_a = svc_a.record(j1)
+    deadline = time.monotonic() + 30
+    while rec_a.metrics.counters.get("map_completed", 0) < 2 \
+            or killed["n"] < 3:
+        assert time.monotonic() < deadline, rec_a.metrics.counters
+        time.sleep(0.05)
+    # svc_a is now abandoned mid-job (its only worker is dead)
+
+    svc_b = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                        sweep_interval_s=0.1)
+    try:
+        assert svc_b.job_status(j1)["state"] == JobState.RUNNING
+        svc_b.start_local_workers(2)
+        assert svc_b.wait_job(j1, timeout=60), svc_b.job_status(j1)
+        # journal replay skipped the two committed maps
+        assert svc_b.record(j1).metrics.counters.get("map_assigned", 0) <= 1
+        want = outputs_by_name(run_job(
+            grep_config(corpus, work_dir=str(tmp_path / "serial")),
+            n_workers=2,
+        ).output_files)
+        assert outputs_by_name(svc_b.job_result(j1)["outputs"]) == want
+    finally:
+        svc_b.stop()
+
+
+def test_service_restart_readmits_queued_jobs(tmp_path, corpus):
+    """Queued (never-started) jobs survive a daemon death: the submit
+    record alone re-admits them at restart."""
+    svc_a = GrepService(work_root=tmp_path / "svc", max_jobs=1,
+                        task_timeout_s=5.0, sweep_interval_s=0.1)
+    j1 = svc_a.submit(grep_config(corpus))              # running slot
+    j2 = svc_a.submit(grep_config(corpus, pattern="fox"))  # queued
+    assert svc_a.job_status(j2)["state"] == JobState.QUEUED
+    # abandon svc_a (no workers ever attached; nothing ran)
+
+    svc_b = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                        sweep_interval_s=0.1)
+    try:
+        assert svc_b.job_status(j1)["state"] == JobState.RUNNING
+        # default max_jobs has free slots: the re-admitted job starts
+        assert svc_b.job_status(j2)["state"] in (JobState.RUNNING,
+                                                 JobState.QUEUED)
+        svc_b.start_local_workers(2)
+        for jid, pat, sub in ((j1, "hello", "sa"), (j2, "fox", "sb")):
+            assert svc_b.wait_job(jid, timeout=60), svc_b.job_status(jid)
+            want = outputs_by_name(run_job(
+                grep_config(corpus, pattern=pat,
+                            work_dir=str(tmp_path / sub)), n_workers=2
+            ).output_files)
+            assert outputs_by_name(svc_b.job_result(jid)["outputs"]) == want
+    finally:
+        svc_b.stop()
+
+
+def test_service_resume_disabled_still_advances_ids(tmp_path, corpus):
+    svc_a = GrepService(work_root=tmp_path / "svc")
+    j1 = svc_a.submit(grep_config(corpus))
+    svc_b = GrepService(work_root=tmp_path / "svc", resume=False)
+    try:
+        with pytest.raises(KeyError):
+            svc_b.record(j1)  # not re-admitted
+        j2 = svc_b.submit(grep_config(corpus, pattern="fox"))
+        assert j2 == "job-2"  # but the id space is never reused
+    finally:
+        svc_b.stop()
+
+
+def test_registry_compaction_bounds_history_and_retires_ids(tmp_path,
+                                                            corpus):
+    """The registry is append-only over an unbounded job stream: startup
+    trims the reload to the newest terminal records, rewrites the file
+    compacted, and the id_floor record keeps every dropped job's id
+    retired — old work dirs are never re-minted."""
+    from distributed_grep_tpu.runtime.service import (
+        _MAX_TERMINAL_RECORDS,
+        ServiceRegistry,
+    )
+
+    root = tmp_path / "svc"
+    root.mkdir()
+    reg = ServiceRegistry(root)
+    cfg = grep_config(corpus)
+    n_hist = _MAX_TERMINAL_RECORDS + 40
+    for i in range(1, n_hist + 1):
+        jid = f"job-{i}"
+        reg.record_submit(jid, cfg)
+        reg.record_state(jid, JobState.DONE, outputs=[])
+    reg.close()
+    size_before = (root / ServiceRegistry.FILENAME).stat().st_size
+
+    svc = GrepService(work_root=root)
+    try:
+        # in-memory reload bounded like the live table
+        terminal = [r for r in svc._jobs.values()
+                    if r.state == JobState.DONE]
+        assert len(terminal) == _MAX_TERMINAL_RECORDS
+        # the file itself was compacted
+        assert (root / ServiceRegistry.FILENAME).stat().st_size \
+            < size_before
+        # dropped ids stay retired: the next mint continues past ALL of
+        # the history, including the trimmed-away jobs
+        jid = svc.submit(grep_config(corpus))
+        assert jid == f"job-{n_hist + 1}"
+        jobs, floor = ServiceRegistry.replay(root)
+        assert floor >= n_hist + 2
+        assert "job-1" not in jobs  # trimmed out of the file
+    finally:
+        svc.stop()
+
+
+def test_resume_fails_job_whose_inputs_vanished(tmp_path, corpus):
+    """An input deleted during the outage must FAIL the resumed job, not
+    re-enqueue its map forever (plan_map_splits shrugs stat failures off,
+    so resume re-runs submit's readability validation)."""
+    svc_a = GrepService(work_root=tmp_path / "svc")
+    j1 = svc_a.submit(grep_config(corpus))
+    Path(svc_a.record(j1).config.input_files[0]).unlink()
+    # abandon svc_a (daemon crash); restart over the same root
+    svc_b = GrepService(work_root=tmp_path / "svc")
+    try:
+        st = svc_b.job_status(j1)
+        assert st["state"] == JobState.FAILED
+        assert "unreadable" in st["error"]
+    finally:
+        svc_b.stop()
+
+
+def test_resume_env_knob_accessor(monkeypatch):
+    from distributed_grep_tpu.runtime.service import env_service_resume
+
+    assert env_service_resume() is True
+    monkeypatch.setenv("DGREP_SERVICE_RESUME", "0")
+    assert env_service_resume() is False
+    monkeypatch.setenv("DGREP_SERVICE_RESUME", "false")
+    assert env_service_resume() is False
+    monkeypatch.setenv("DGREP_SERVICE_RESUME", "1")
+    assert env_service_resume() is True
+
+
 # ------------------------------------------------------- back-compat pins
 
 def test_one_shot_serve_coordinator_contract_unperturbed(tmp_path, corpus):

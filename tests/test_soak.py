@@ -313,6 +313,104 @@ def test_mini_soak_rolling_window(tmp_path):
     assert disk_peak["bytes"] <= (window + 1) * split_bytes
 
 
+@pytest.mark.soak_mini
+def test_mini_soak_daemon_kill_and_restart(tmp_path):
+    """Round-10 mini-soak leg: a REAL ``dgrep serve`` daemon (subprocess,
+    its own in-process workers) is SIGKILLed mid-window and restarted
+    over the same work root; the registry + per-job journal resume
+    completes the job with counts exact against a GNU grep oracle taken
+    at generation time.  Budget: < 60 s like the rolling-window leg."""
+    import subprocess
+    from pathlib import Path
+
+    import service_proc
+
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    t_all = time.perf_counter()
+    split_bytes = 1_000_000
+    n_splits = 12
+    rng = np.random.default_rng(23)
+    files = []
+    oracle: dict[str, int] = {}
+    block = rng.integers(32, 127, size=split_bytes, dtype=np.uint8)
+    block[rng.integers(0, block.size, size=block.size // 80)] = 0x0A
+    template = block.tobytes()
+    for i in range(n_splits):
+        p = tmp_path / f"svc{i:02d}.bin"
+        data = bytearray(template)
+        for pos in rng.integers(0, split_bytes - 64,
+                                size=int(rng.integers(3, 30))):
+            data[pos: pos + len(NEEDLE)] = NEEDLE
+        p.write_bytes(bytes(data))
+        out = subprocess.run(
+            ["grep", "-c", "-a", NEEDLE.decode()], stdin=open(p, "rb"),
+            capture_output=True, text=True,
+        )
+        oracle[str(p)] = int(out.stdout.strip() or 0)
+        files.append(str(p))
+
+    # a grep_tpu wrapper whose maps take a beat: the kill window is then
+    # deterministic to catch mid-stream (same trick as the rolling app)
+    app_py = tmp_path / "slow_grep_app.py"
+    app_py.write_text(
+        "import time\n"
+        "from distributed_grep_tpu.apps import grep_tpu as base\n"
+        "configure = base.configure\n"
+        "reduce_fn = base.reduce_fn\n"
+        "reduce_is_identity = True\n"
+        "set_progress = base.set_progress\n"
+        "map_fn = base.map_fn\n"
+        "def map_path_fn(filename, path):\n"
+        "    time.sleep(0.12)\n"
+        "    return base.map_path_fn(filename, path)\n"
+    )
+    cfg = JobConfig(
+        input_files=files,
+        application=str(app_py),
+        app_options={"pattern": NEEDLE.decode(), "backend": "cpu"},
+        n_reduce=4,
+        task_timeout_s=30.0,
+        sweep_interval_s=0.2,
+    )
+    work_root = tmp_path / "svc-root"
+    work_root.mkdir()
+    daemon = service_proc.ServiceProc(work_root, workers=1).start()
+    try:
+        jid = daemon.submit(cfg)
+        # catch the job mid-window: some maps committed, not all
+        deadline = time.monotonic() + 45
+        while True:
+            assert time.monotonic() < deadline, daemon.tail_log()
+            st = daemon.job_status(jid)
+            done_maps = st.get("map", {}).get("completed", 0)
+            if 2 <= done_maps < n_splits:
+                break
+            assert st.get("state") != "done", "job finished before the kill"
+            time.sleep(0.02)
+        daemon.sigkill()
+        daemon.start()
+        st = daemon.wait_job(jid, timeout=60)
+        assert st["state"] == "done", (st, daemon.tail_log())
+        outputs = daemon.job_result(jid)["outputs"]
+    finally:
+        daemon.terminate()
+
+    # exact per-split counts vs the generation-time GNU grep oracle: count
+    # each split's grep keys ("<path> (line number #N)") in the outputs
+    blob = b"".join(Path(p).read_bytes() for p in outputs)
+    counts = {
+        f: blob.count(f"{f} (line number #".encode())
+        for f in files
+    }
+    assert counts == oracle
+    wall = time.perf_counter() - t_all
+    print(f"\nmini-soak daemon-kill: {n_splits} splits, "
+          f"{sum(oracle.values())} lines exact across a SIGKILL+restart, "
+          f"{wall:.0f}s")
+    assert wall < 60, f"daemon-kill mini-soak over budget: {wall:.0f}s"
+
+
 # --------------------------------------------------------------- rolling 100G
 ROLL = os.environ.get("DGREP_SOAK_ROLLING", "")
 _mr = re.fullmatch(r"(\d+)G", ROLL)
