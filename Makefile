@@ -1,0 +1,18 @@
+# Top-level convenience targets.  `make lint` is the whole static tier:
+# the AST invariant checker (exit 1 on any violation — the repo's own
+# baseline is EMPTY by policy) chained with the native tier's
+# best-effort cppcheck/clang-tidy pass (no-op when neither is
+# installed).  CI and editors wanting annotations: `python -m
+# distributed_grep_tpu analyze --sarif`.
+
+.PHONY: lint native test
+
+lint:
+	python -m distributed_grep_tpu analyze
+	$(MAKE) -C native lint
+
+native:
+	$(MAKE) -C native
+
+test:
+	python -m pytest tests/ -x -q

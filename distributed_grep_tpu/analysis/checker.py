@@ -99,6 +99,45 @@ def run_analysis(
     return out
 
 
+def to_sarif(violations: list[Violation]) -> dict:
+    """Render violations as a SARIF 2.1.0 log (one run, one driver).
+    Deterministic: rules sorted by id, results keep run_analysis's
+    (path, line, rule) sort, and the serialization is sort_keys=True —
+    the same repo state always yields byte-identical output (golden test
+    in tests/test_analysis.py)."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "distributed-grep-analyze",
+                "informationUri":
+                    "https://example.invalid/distributed_grep_tpu",
+                "rules": [
+                    {"id": name,
+                     "shortDescription": {"text": RULE_DOCS[name]}}
+                    for name in sorted(RULES)
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": v.rule,
+                    "level": "error",
+                    "message": {"text": v.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": v.path},
+                            "region": {"startLine": v.line},
+                        },
+                    }],
+                }
+                for v in violations
+            ],
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="distributed_grep_tpu analyze",
@@ -117,6 +156,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="write current violations as a baseline and exit 0")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--sarif", action="store_true", dest="as_sarif",
+                   help="SARIF 2.1.0 output (CI annotations / editors); "
+                        "results keep the stable (path, line, rule) sort")
     p.add_argument("--list-rules", action="store_true",
                    help="list rules with the invariant each encodes")
     p.add_argument("--knobs", action="store_true",
@@ -155,7 +197,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{len(violations)} violation(s) -> {args.write_baseline}")
         return 0
 
-    if args.as_json:
+    if args.as_sarif:
+        print(json.dumps(to_sarif(violations), indent=2, sort_keys=True))
+    elif args.as_json:
         print(json.dumps({
             "violations": [
                 {"rule": v.rule, "path": v.path, "line": v.line,

@@ -141,6 +141,14 @@ KNOBS: dict[str, Knob] = {
         "returns the same engine, skipping model compile and the "
         "per-shape compile-grace path.",
     ),
+    "DGREP_LOCKDEP": Knob(
+        "utils/lockdep.py", "unset",
+        "1 switches the runtime lock-discipline harness on: locks built "
+        "via lockdep.make_lock are instrumented (per-thread acquisition "
+        "stacks, lock-order inversion + blocking-syscall-while-held "
+        "detection; accessor: utils/lockdep.env_lockdep).  The "
+        "service/chaos/soak_mini test fixture activates it per test.",
+    ),
     "DGREP_NATIVE_LIB": Knob(
         "utils/native.py", "unset",
         "Absolute path of the libdgrep build to load instead of "

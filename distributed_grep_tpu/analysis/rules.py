@@ -36,6 +36,9 @@ class Project:
         self.root = Path(root)
         self._cache: dict[str, ast.Module | None] = {}
         self._files: list[str] | None = None
+        # scratch space rules share within one run (e.g. the R9/R10 lock
+        # declarations, derived once per file instead of once per rule)
+        self.cache: dict = {}
 
     def files(self) -> list[str]:
         if self._files is None:
@@ -724,6 +727,557 @@ def rule_net_retry(project: Project) -> Iterator[Violation]:
                 )
 
 
+# --------------------------------------------- shared lock model (R9/R10)
+# The concurrency rules resolve lock expressions through their
+# construction sites, so the static layer reads the SAME declarations the
+# dynamic harness (utils/lockdep.py) instruments:
+#
+#   X = lockdep.make_lock("name", io_ok=True)     -> node "name", io_ok
+#   self._lock = threading.Lock()                 -> node "<rel>:<attr>"
+#   self._cond = threading.Condition(self._lock)  -> alias of self._lock
+#
+# ``io_ok=True`` is the blessed escape for locks whose PURPOSE is
+# serializing blocking work (registry/journal/start flush, the model-cache
+# compile lock, the device-probe wait) — R9 skips their critical sections;
+# R10 still graphs them.
+
+_CONC_SCOPE = ("runtime/", "ops/")
+_LOCKISH_SUFFIXES = ("lock", "cond", "mutex")
+
+
+def _lock_ctor_info(value: ast.expr) -> tuple[str | None, bool] | None:
+    """(make_lock name or None, io_ok) when ``value`` constructs a lock;
+    None when it does not."""
+    if not isinstance(value, ast.Call):
+        return None
+    fname = _last_name(value.func)
+    if fname in ("make_lock", "make_rlock"):
+        name = None
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            name = value.args[0].value
+        io_ok = any(
+            k.arg == "io_ok" and isinstance(k.value, ast.Constant)
+            and bool(k.value.value)
+            for k in value.keywords
+        )
+        return name, io_ok
+    if fname in ("Lock", "RLock"):
+        return None, False
+    return None
+
+
+class _LockDecls:
+    """Lock bindings of one module: (class-or-None, binding name) ->
+    (node id, io_ok).  Condition(self._lock) aliases the wrapped lock.
+    One recursive pass over the tree (the enclosing class travels down
+    with the recursion — no per-class re-walk)."""
+
+    def __init__(self, tree: ast.Module, rel: str):
+        self.rel = rel
+        self.map: dict[tuple[str | None, str], tuple[str, bool]] = {}
+        self._collect(tree, None)
+
+    def _bind(self, cls: str | None, name: str, value: ast.expr) -> None:
+        info = _lock_ctor_info(value)
+        if info is not None:
+            node_id, io_ok = info
+            self.map[(cls, name)] = (node_id or f"{self.rel}:{name}", io_ok)
+            return
+        # Condition over a declared lock: alias the lock's node
+        if isinstance(value, ast.Call) \
+                and _last_name(value.func) == "Condition" and value.args:
+            tgt = value.args[0]
+            key = None
+            if isinstance(tgt, ast.Attribute) \
+                    and _last_name(tgt.value) == "self":
+                key = (cls, tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                key = (cls, tgt.id) if (cls, tgt.id) in self.map \
+                    else (None, tgt.id)
+            if key in self.map:
+                self.map[(cls, name)] = self.map[key]
+
+    def _collect(self, scope: ast.AST, cls: str | None) -> None:
+        for node in ast.iter_child_nodes(scope):
+            if isinstance(node, ast.ClassDef):
+                self._collect(node, node.name)
+                continue
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if cls is not None and isinstance(tgt, ast.Attribute) \
+                            and _last_name(tgt.value) == "self":
+                        self._bind(cls, tgt.attr, node.value)
+                    elif isinstance(tgt, ast.Name):
+                        self._bind(cls, tgt.id, node.value)
+            self._collect(node, cls)
+
+    def resolve(self, expr: ast.expr,
+                cls: str | None) -> tuple[str, bool] | None:
+        """(node id, io_ok) for a with-item lock expression, or None when
+        the expression is not lock-like.  Undeclared names that END in
+        lock/cond still count (io_ok False) — an unregistered lock must
+        not silently escape the rules."""
+        if isinstance(expr, ast.Attribute) and _last_name(expr.value) == "self":
+            hit = self.map.get((cls, expr.attr))
+            if hit is not None:
+                return hit
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            hit = self.map.get((cls, expr.id)) or self.map.get((None, expr.id))
+            if hit is not None:
+                return hit
+            name = expr.id
+        else:
+            return None
+        stripped = name.lstrip("_").lower()
+        if stripped.endswith(_LOCKISH_SUFFIXES):
+            return f"{self.rel}:{name}", False
+        return None
+
+
+def _decls_for(project: Project, rel: str, tree: ast.Module) -> _LockDecls:
+    """Per-run memo of a file's lock declarations (R9 and R10 both need
+    them; deriving once per file keeps the repo-wide analyze fast)."""
+    key = ("lock-decls", rel)
+    decls = project.cache.get(key)
+    if decls is None:
+        decls = project.cache[key] = _LockDecls(tree, rel)
+    return decls
+
+
+def _functions_with_class(tree: ast.Module
+                          ) -> Iterator[tuple[str | None, ast.AST]]:
+    """(enclosing class name or None, function node) for every function,
+    carrying the nearest enclosing class through nested defs (closures in
+    a method still see that method's ``self``)."""
+
+    def rec(node: ast.AST, cls: str | None) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from rec(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from rec(child, cls)
+            else:
+                yield from rec(child, cls)
+
+    yield from rec(tree, None)
+
+
+# ------------------------------------------------------------------- rule R9
+
+# Blocking primitives by bare callable name (Name or trailing Attribute).
+_BLOCKING_CALLS = {
+    "open", "urlopen", "create_connection",
+    # engine/journal/log construction: model compile, file open+fsync
+    "GrepEngine", "TaskJournal", "EventLog", "WorkDir", "Popen",
+}
+# Attribute calls gated on the receiver (os.replace yes, str.replace no).
+_BLOCKING_RECV_ATTRS = {
+    "os": {"fsync", "replace", "rename", "unlink", "remove"},
+    "time": {"sleep"},
+    "subprocess": {"run", "call", "check_call", "check_output"},
+    "jax": {"device_put", "block_until_ready", "devices", "local_devices"},
+    "shutil": {"rmtree", "copyfile", "copy", "move"},
+}
+# Any method call on these receivers is filesystem/flush work: the
+# journal/registry fsync per record, event logs flush per batch, stores
+# and work dirs touch the work-dir filesystem.
+_IO_RECEIVERS = {"journal", "event_log", "registry", "store", "workdir"}
+
+
+def _blocking_call(node: ast.Call) -> str | None:
+    """A short label when ``node`` is a blocking call, else None."""
+    fn = node.func
+    name = _last_name(fn)
+    if name in _BLOCKING_CALLS:
+        return f"{name}()"
+    if isinstance(fn, ast.Attribute):
+        recv = _last_name(fn.value).lstrip("_")
+        # normalized receiver module aliases (time as _time / _time_mod)
+        recv_mod = recv[:-len("_mod")] if recv.endswith("_mod") else recv
+        for mod, attrs in _BLOCKING_RECV_ATTRS.items():
+            if fn.attr in attrs and (recv == mod or recv_mod == mod):
+                return f"{mod}.{fn.attr}()"
+        if recv in _IO_RECEIVERS:
+            return f"{recv}.{fn.attr}() [I/O object]"
+    return None
+
+
+def rule_locked_blocking(project: Project) -> Iterator[Violation]:
+    """R9: no blocking work inside a lock's critical section on the
+    control plane (runtime/, ops/) — no file opens/fsyncs, no sockets, no
+    sleeps, no engine construction, no jax device calls, and no calls on
+    the journal/event-log/registry/store/work-dir I/O objects, either
+    lexically under ``with <lock>:`` or anywhere in a ``*_locked``-
+    convention method (called with the lock already held).  The blessed
+    escapes are the staged-flush pattern (stage under the lock, write
+    after release) and locks DECLARED ``io_ok=True`` via lockdep.make_lock
+    — locks whose purpose is serializing that I/O (registry/journal/start
+    flush, the model-cache compile lock, the device-probe wait)."""
+    for rel in project.files():
+        if not rel.startswith(_CONC_SCOPE):
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        decls = _decls_for(project, rel, tree)
+        for cls, fn in _functions_with_class(tree):
+            base_held: list[tuple[str, bool]] = []
+            if fn.name.endswith("_locked") or "_locked_" in fn.name:
+                base_held.append((f"<{fn.name}: _locked convention>", False))
+
+            def check(node: ast.Call, held) -> Iterator[Violation]:
+                label = _blocking_call(node)
+                if label is None:
+                    return
+                hot = [n for n, io_ok in held if not io_ok]
+                if hot:
+                    yield Violation(
+                        "locked-blocking", rel, node.lineno,
+                        f"blocking {label} inside the critical section of "
+                        f"{hot[-1]} — stage the work under the lock and "
+                        f"flush after release (or declare the lock "
+                        f"io_ok=True if serializing this I/O is its "
+                        f"purpose)",
+                    )
+
+            def scan(node: ast.AST, held) -> Iterator[Violation]:
+                # nested defs/classes are their own scope: defining one
+                # under a lock runs nothing (the outer loop visits it)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    cur = list(held)
+                    for item in node.items:  # left-to-right acquisition
+                        for c in ast.walk(item.context_expr):
+                            if isinstance(c, ast.Call):
+                                yield from check(c, cur)
+                        r = decls.resolve(item.context_expr, cls)
+                        if r is not None:
+                            cur.append(r)
+                    for child in node.body:
+                        yield from scan(child, cur)
+                    return
+                if isinstance(node, ast.Call):
+                    yield from check(node, held)
+                for child in ast.iter_child_nodes(node):
+                    yield from scan(child, held)
+
+            for stmt in fn.body:
+                yield from scan(stmt, base_held)
+
+
+# ------------------------------------------------------------------ rule R10
+
+def _module_of_import(node: ast.ImportFrom | ast.Import) -> dict[str, str]:
+    """alias -> dotted module/name path for import statements."""
+    out = {}
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            out[(a.asname or a.name.split(".")[0])] = a.name
+    else:
+        mod = node.module or ""
+        for a in node.names:
+            out[a.asname or a.name] = f"{mod}.{a.name}"
+    return out
+
+
+class _CallGraph:
+    """Project-wide lock-acquisition summaries: which locks each function
+    acquires (directly or transitively) and which locks are HELD at each
+    call site — the inputs to R10's cycle search.
+
+    Receiver typing is deliberately shallow but declaration-driven:
+    ``self`` resolves to the enclosing class; attribute/variable receivers
+    resolve through dataclass field annotations and ``self.x = Class()``
+    assignments anywhere in the project; bare names resolve to same-module
+    functions, ``from x import y`` targets, and project classes (their
+    __init__).  Unresolvable calls contribute no edges — under-
+    approximation here is covered by the dynamic lockdep harness."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.fns: dict[tuple, ast.AST] = {}  # (rel, cls, name) -> node
+        self.decls: dict[str, _LockDecls] = {}
+        self.classes: dict[str, list[str]] = {}  # class name -> [rel]
+        self.attr_types: dict[str, set[str]] = {}  # attr -> class names
+        self.imports: dict[str, dict[str, str]] = {}  # rel -> alias map
+        self.mod_to_rel: dict[str, str] = {}
+        for rel in project.files():
+            tree = project.tree(rel)
+            if tree is None:
+                continue
+            self.decls[rel] = _decls_for(project, rel, tree)
+            mod = rel[:-3].replace("/", ".")
+            self.mod_to_rel[mod] = rel
+            self.mod_to_rel[f"distributed_grep_tpu.{mod}"] = rel
+            imp: dict[str, str] = {}
+            # one walk per file: imports, classes, attr types together
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    imp.update(_module_of_import(node))
+                elif isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, []).append(rel)
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    for n in ast.walk(node.annotation):
+                        if isinstance(n, ast.Name) and n.id[:1].isupper():
+                            self.attr_types.setdefault(
+                                node.target.id, set()).add(n.id)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and _last_name(tgt.value) == "self":
+                            for c in ast.walk(node.value):
+                                if isinstance(c, ast.Call):
+                                    nm = _last_name(c.func)
+                                    if nm[:1].isupper():
+                                        self.attr_types.setdefault(
+                                            tgt.attr, set()).add(nm)
+            self.imports[rel] = imp
+            for cls, fn in _functions_with_class(tree):
+                self.fns[(rel, cls, fn.name)] = fn
+
+    # ---------------------------------------------------------- resolution
+    def _method_keys(self, class_name: str, meth: str) -> list[tuple]:
+        out = []
+        for rel in self.classes.get(class_name, ()):
+            key = (rel, class_name, meth)
+            if key in self.fns:
+                out.append(key)
+        return out
+
+    def resolve_call(self, call: ast.Call, rel: str,
+                     cls: str | None) -> list[tuple]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            if (rel, cls, name) in self.fns:
+                return [(rel, cls, name)]
+            if (rel, None, name) in self.fns:
+                return [(rel, None, name)]
+            if name in self.attr_types:  # local var named like a field
+                return [k for c in self.attr_types[name]
+                        for k in self._method_keys(c, "__init__")] or []
+            if name in self.classes:
+                return self._method_keys(name, "__init__")
+            target = self.imports.get(rel, {}).get(name)
+            if target:
+                mod, _, leaf = target.rpartition(".")
+                trel = self.mod_to_rel.get(mod)
+                if trel and (trel, None, leaf) in self.fns:
+                    return [(trel, None, leaf)]
+                if trel and leaf in self.classes:
+                    return self._method_keys(leaf, "__init__")
+            return []
+        if isinstance(fn, ast.Attribute):
+            recv = _last_name(fn.value)
+            meth = fn.attr
+            if recv == "self" and cls is not None:
+                keys = self._method_keys(cls, meth)
+                return [k for k in keys if k[0] == rel] or keys
+            out: list[tuple] = []
+            for c in self.attr_types.get(recv, ()):
+                out.extend(self._method_keys(c, meth))
+            if not out and recv in self.classes:  # ClassName.static()
+                out.extend(self._method_keys(recv, meth))
+            if not out:
+                target = self.imports.get(rel, {}).get(recv)
+                if target:
+                    trel = self.mod_to_rel.get(target)
+                    if trel:
+                        if (trel, None, meth) in self.fns:
+                            out.append((trel, None, meth))
+                        elif meth in self.classes:
+                            out.extend(self._method_keys(meth, "__init__"))
+            return out
+        return []
+
+
+def rule_lock_order(project: Project) -> Iterator[Violation]:
+    """R10: the static lock-acquisition graph must be acyclic.  Nodes are
+    declared locks (lockdep.make_lock names; raw Locks key by module:var);
+    edges run held -> acquired, from nested ``with`` scopes and from calls
+    made inside a critical section to functions that (transitively)
+    acquire other locks — cross-module edges included, resolved through
+    dataclass annotations and ``self.x = Class()`` sites (the service ->
+    scheduler ``stop()`` edge, the flush locks' outer-to-inner contract).
+    Any cycle is a potential deadlock and is reported once with the
+    participating locks.  Same-lock call-path self-edges are skipped (the
+    ``locked=True`` conditional-acquire helpers would false-positive);
+    a LEXICAL ``with A: with A:`` still reports — that one is a certain
+    deadlock on a non-reentrant Lock."""
+    graph = _CallGraph(project)
+    # direct acquires per function
+    direct: dict[tuple, set[str]] = {}
+    calls: dict[tuple, list] = {}
+    edges: dict[tuple[str, str], tuple[str, int]] = {}  # (a,b) -> site
+
+    for (rel, cls, name), fn in graph.fns.items():
+        decls = graph.decls[rel]
+        acq: set[str] = set()
+        fncalls: list = []
+
+        def walk(node: ast.AST, held: tuple) -> None:
+            # nested defs/classes are their own scope: defining one under
+            # a lock runs nothing (they get their own summary)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                cur = held
+                for item in node.items:  # left-to-right acquisition
+                    for c in ast.walk(item.context_expr):
+                        if isinstance(c, ast.Call):
+                            fncalls.append((cur, c, c.lineno))
+                    r = decls.resolve(item.context_expr, cls)
+                    if r is not None:
+                        g = r[0]
+                        acq.add(g)
+                        for h in cur:
+                            edges.setdefault((h, g), (rel, node.lineno))
+                        cur = cur + (g,)
+                for child in node.body:
+                    walk(child, cur)
+                return
+            if isinstance(node, ast.Call):
+                fncalls.append((held, node, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+        direct[(rel, cls, name)] = acq
+        calls[(rel, cls, name)] = fncalls
+
+    # transitive acquires: fixpoint over the (shallow) call graph
+    trans: dict[tuple, set[str]] = {k: set(v) for k, v in direct.items()}
+    resolved: dict[tuple, list[list[tuple]]] = {}
+    for key, fncalls in calls.items():
+        rel, cls, _ = key
+        resolved[key] = [graph.resolve_call(c, rel, cls)
+                         for _, c, _ in fncalls]
+    changed = True
+    while changed:
+        changed = False
+        for key, callee_lists in resolved.items():
+            cur = trans[key]
+            before = len(cur)
+            for callees in callee_lists:
+                for ck in callees:
+                    cur |= trans.get(ck, set())
+            if len(cur) != before:
+                changed = True
+
+    # call edges: held locks -> everything the callee may acquire
+    for key, fncalls in calls.items():
+        for (held, call, line), callees in zip(fncalls, resolved[key]):
+            if not held:
+                continue
+            for ck in callees:
+                for lk in trans.get(ck, ()):
+                    for h in held:
+                        if h != lk:  # call-path self-edges: see docstring
+                            edges.setdefault((h, lk), (key[0], line))
+
+    # cycle detection over the edge graph (iterative DFS per node)
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    reported: set[frozenset] = set()
+    for (a, b), (rel, line) in sorted(edges.items(),
+                                      key=lambda kv: (kv[1][0], kv[1][1])):
+        if a == b:
+            yield Violation(
+                "lock-order", rel, line,
+                f"lock {a!r} re-acquired while already held — certain "
+                f"deadlock on a non-reentrant Lock",
+            )
+            continue
+        # path b ->* a closes a cycle through edge (a, b); keep the path
+        # so one N-lock cycle dedups to ONE report (keying on just the
+        # closing edge would report a 3-cycle three times, once per edge)
+        stack, seen = [(b, (b,))], {b}
+        found: tuple | None = None
+        while stack and found is None:
+            n, path = stack.pop()
+            for m in adj.get(n, ()):
+                if m == a:
+                    found = path
+                    break
+                if m not in seen:
+                    seen.add(m)
+                    stack.append((m, path + (m,)))
+        if found is not None:
+            cyc = frozenset(found) | {a}
+            if cyc in reported:
+                continue
+            reported.add(cyc)
+            chain = " -> ".join(found + (a,))
+            yield Violation(
+                "lock-order", rel, line,
+                f"lock-order cycle: {a!r} -> {b!r} here, but a path "
+                f"{chain} exists elsewhere — two threads taking the two "
+                f"routes deadlock",
+            )
+
+
+# ------------------------------------------------------------------ rule R11
+
+def _touches_pallas(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias, target in _module_of_import(node).items():
+                if "pallas" in target or "pallas" in alias:
+                    return True
+        elif isinstance(node, ast.Call) \
+                and _last_name(node.func) == "pallas_call":
+            return True
+    return False
+
+
+def rule_shard_map_rep(project: Project) -> Iterator[Violation]:
+    """R11: every ``shard_map`` in a pallas-touching module must pass
+    ``check_rep=False`` — pallas_call's out_shape carries no varying-mesh-
+    axes annotation, so shard_map's replication checker cannot see through
+    it and rejects the (correct) kernel at trace time; correctness is
+    pinned by the bit-identical vs-single-device tests instead
+    (test_parallel.py).  Module granularity is the deliberate
+    over-approximation: the kernel body usually arrives through a
+    parameter the AST cannot trace, and check_rep=False on a non-pallas
+    body in such a module costs only the checker's (unusable) coverage."""
+    for rel in project.files():
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        pallas = None  # lazy: most files have no shard_map at all
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _last_name(node.func) == "shard_map"):
+                continue
+            if pallas is None:
+                pallas = _touches_pallas(tree)
+            if not pallas:
+                continue
+            explicit_false = any(
+                k.arg == "check_rep" and isinstance(k.value, ast.Constant)
+                and k.value.value is False
+                for k in node.keywords
+            )
+            if not explicit_false:
+                yield Violation(
+                    "shard-map-rep", rel, node.lineno,
+                    "shard_map in a pallas-touching module without "
+                    "check_rep=False: the replication checker cannot see "
+                    "through pallas_call out_shapes and rejects the "
+                    "kernel at trace time (CLAUDE.md round-4 invariant, "
+                    "pinned by test_parallel.py)",
+                )
+
+
 # ------------------------------------------------------------------ registry
 
 RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
@@ -735,6 +1289,9 @@ RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
     "mosaic-ceilings": rule_mosaic_ceilings,
     "logging": rule_logging,
     "net-retry": rule_net_retry,
+    "locked-blocking": rule_locked_blocking,
+    "lock-order": rule_lock_order,
+    "shard-map-rep": rule_shard_map_rep,
 }
 
 RULE_DOCS: dict[str, str] = {

@@ -487,9 +487,9 @@ def scan_device(eng, data: bytes, progress=None, corpus_key=None) -> ScanResult:
     # (device_lines, stats, the mid-scan defeat guards) mutates under
     # one lock; the heavy legs — ConfirmSet probes, per-line matchers,
     # the native dense rescan — run outside it.
-    import threading
+    from distributed_grep_tpu.utils import lockdep as _lockdep_mod
 
-    state_lock = threading.Lock()
+    state_lock = _lockdep_mod.make_lock("device-scan-state")
     confirm_active = [0]  # live confirm legs; peak recorded in stats
 
     def _confirm_enter() -> None:

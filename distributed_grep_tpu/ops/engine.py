@@ -105,7 +105,11 @@ DEVICE_STALL_S = float(_os.environ.get("DGREP_DEVICE_STALL_S", "300"))
 DEVICE_RETRY_S = float(_os.environ.get("DGREP_DEVICE_RETRY_S", "600"))
 import threading as _threading_mod
 
-_device_probe_lock = _threading_mod.Lock()
+from distributed_grep_tpu.utils import lockdep as _lockdep
+
+# io_ok: racers deliberately WAIT on an in-flight probe under this lock
+# rather than falling through to a hanging device call.
+_device_probe_lock = _lockdep.make_lock("device-probe", io_ok=True)
 # Process-global probe state {verdict, at}: one backend per process, so
 # one verdict serves every engine; a False verdict re-probes at most once
 # per DEVICE_RETRY_S window PROCESS-WIDE (N degraded engines share the
@@ -208,7 +212,7 @@ log = get_logger("engine")
 # next pool creation, so a process that churns worker threads does not
 # accumulate idle daemon readers.
 _reader_pools: dict = {}
-_reader_pools_lock = _threading_mod.Lock()
+_reader_pools_lock = _lockdep.make_lock("reader-pools")
 
 
 def _thread_reader_pool():
@@ -261,13 +265,16 @@ def env_model_cache_entries(default: int = DEFAULT_MODEL_CACHE_ENTRIES) -> int:
 
 from collections import OrderedDict as _OrderedDict
 
-_model_cache_lock = _threading_mod.Lock()
+# io_ok: holding the cache lock ACROSS engine construction is the design
+# (same-pattern races collapse into one compile) — blocking under it is
+# the lock's purpose, not an accident.
+_model_cache_lock = _lockdep.make_lock("model-cache", io_ok=True)
 _model_cache: "_OrderedDict[tuple, GrepEngine]" = _OrderedDict()
 # Counters get their OWN lock: cached_engine holds _model_cache_lock across
 # a whole engine construction (seconds for big literal sets), and every
 # scan() stamps these counters into its stats — the stamp must never stall
 # behind another thread's compile.
-_model_cache_stats_lock = _threading_mod.Lock()
+_model_cache_stats_lock = _lockdep.make_lock("model-cache-stats")
 _model_cache_stats = {
     "compile_cache_hits": 0,
     "compile_cache_misses": 0,

@@ -17,11 +17,12 @@ lax.scan iterates the leading axis with unit-stride vectors.
 
 from __future__ import annotations
 
-import threading as _threading
 from collections import OrderedDict as _OrderedDict
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from distributed_grep_tpu.utils import lockdep as _lockdep
 
 NL = 0x0A
 
@@ -420,7 +421,7 @@ class CorpusCache:
     stat that feeds revalidation happens at key derivation, outside)."""
 
     def __init__(self):
-        self._lock = _threading.Lock()
+        self._lock = _lockdep.make_lock("corpus-cache")
         self._entries: "_OrderedDict[tuple, ResidentCorpus]" = _OrderedDict()
         self._bytes = 0
         # first-member file identity -> packed-window entry identity:

@@ -545,7 +545,7 @@ def run_job(
         for t in threads:
             t.join(timeout=10.0)
     if journal:
-        journal.close()
+        scheduler.close_journal()  # drains staged completions, then closes
     if event_log is not None:
         event_log.close()
 

@@ -38,6 +38,7 @@ from typing import Any, Optional
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.types import MapTask, ReduceTask, TaskState
+from distributed_grep_tpu.utils import lockdep
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 from distributed_grep_tpu.utils.spans import ClockSync, EventLog
@@ -101,7 +102,7 @@ class WorkerHealth:
         self.base_s = (
             env_worker_quarantine_s() if base_s is None else float(base_s)
         )
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("worker-health")
         self._fails: dict[int, int] = {}  # consecutive attributed failures
         self._episodes: dict[int, int] = {}  # quarantine episodes so far
         self._until: dict[int, float] = {}  # monotonic expiry per worker
@@ -273,9 +274,20 @@ class Scheduler:
         self.worker_health = worker_health or WorkerHealth()
         self._pending_events: list[dict] = []  # staged under the lock,
         # written by _flush_events after release
+        # Journal completions are staged the same way (checked:
+        # locked-blocking): TaskJournal fsyncs per record, and an fsync
+        # inside the scheduler lock would stall every RPC handler behind
+        # the disk on each commit.  The flush lock serializes write
+        # batches end to end (the service registry-flush pattern);
+        # durability-before-reply holds because map_finished /
+        # reduce_finished flush in their `finally`, before the RPC reply
+        # leaves the process.
+        self._pending_journal: list[tuple] = []
+        self._journal_flush_lock = lockdep.make_lock("journal-flush",
+                                                     io_ok=True)
         self._span_seqs: dict[int, set[int]] = {}  # worker -> persisted
         # batch seqs (retry dedup, _persist_spans)
-        self._span_seq_lock = threading.Lock()
+        self._span_seq_lock = lockdep.make_lock("span-seq")
         self._clock = ClockSync()
         # Per-worker liveness + shipped-metrics table (workers join
         # implicitly, so rows appear at first assignment/heartbeat):
@@ -284,7 +296,7 @@ class Scheduler:
         #               "clock_offset_s": ..., "rtt_s": ...}
         self.workers: dict[int, dict] = {}
 
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("scheduler")
         self._cond = threading.Condition(self._lock)
 
         # Task tables (MapData/ReduceData, helper_types.go:150-161).
@@ -442,6 +454,62 @@ class Scheduler:
                 return
             pending, self._pending_events = self._pending_events, []
         self._persist_spans(pending)
+
+    def _flush_journal(self) -> None:
+        """Write staged journal completions outside the scheduler lock —
+        TaskJournal fsyncs per record, exactly the filesystem work the
+        scheduler lock must never hold (checked: locked-blocking).  The
+        flush lock makes swap + append one ordered unit; a journal
+        closed by a racing job teardown absorbs the write (the entry
+        only re-runs an already-finished task after a restart).  Never
+        raises — a full disk degrades crash-resume, not the control
+        plane."""
+        if self.journal is None:
+            return
+        with self._journal_flush_lock:
+            self._write_staged_journal()
+
+    def close_journal(self) -> None:
+        """Flush staged completions, then close the journal — one ordered
+        unit under the flush lock, so a job teardown can never close the
+        file between a completion's staging and its write (a completion
+        stages BEFORE it notifies done, so anything a finalizer could
+        have observed is durable before the close)."""
+        if self.journal is None:
+            return
+        with self._journal_flush_lock:
+            self._write_staged_journal()
+            self.journal.close()
+
+    def _write_staged_journal(self) -> None:
+        """The write half of _flush_journal; caller holds the flush lock."""
+        with self._lock:
+            if not self._pending_journal:
+                return
+            pending, self._pending_journal = self._pending_journal, []
+        for kind, task_id, file, parts, has_record, files in pending:
+            try:
+                if kind == "map":
+                    self.journal.map_completed(
+                        task_id, file, parts, has_record=has_record,
+                        files=files,
+                    )
+                else:
+                    self.journal.reduce_completed(
+                        task_id, has_record=has_record
+                    )
+            except ValueError:
+                # closed by job teardown racing a late completion: the
+                # task is committed either way (commit records), the
+                # journal line only skipped a restart's re-run
+                log.warning(
+                    "journal append after close dropped (%s task %d)",
+                    kind, task_id,
+                )
+            except OSError:
+                log.exception(
+                    "journal append failed for %s task %d", kind, task_id
+                )
 
     def _persist_spans(self, recs: list[dict], worker_id: int = -1,
                        seq: int = -1) -> None:
@@ -682,6 +750,7 @@ class Scheduler:
         try:
             return self._map_finished_locked(args, record)
         finally:
+            self._flush_journal()  # fsync BEFORE the reply leaves
             self._flush_events()
             self._notify_change()  # map-phase completion unlocks reduces
 
@@ -708,11 +777,13 @@ class Scheduler:
             self._register_map_outputs(args.task_id, parts)
             self.metrics.inc("map_completed")
             if self.journal:
-                self.journal.map_completed(
-                    args.task_id, task.file, parts,
-                    has_record=record is not None,
-                    files=list(task.files) or None,
-                )
+                # staged under the lock (at most once per task — gated by
+                # the COMPLETED transition above), fsync'd by
+                # _flush_journal after release
+                self._pending_journal.append((
+                    "map", args.task_id, task.file, parts,
+                    record is not None, list(task.files) or None,
+                ))
             self._event("map_committed", task=args.task_id,
                         worker=args.worker_id, parts=len(parts),
                         has_record=record is not None)
@@ -738,6 +809,7 @@ class Scheduler:
         try:
             return self._reduce_finished_locked(args, record)
         finally:
+            self._flush_journal()  # fsync BEFORE the reply leaves
             self._flush_events()
 
     def _reduce_finished_locked(self, args: rpc.TaskFinishedArgs,
@@ -751,9 +823,11 @@ class Scheduler:
                 self._reduces_completed += 1
                 self.metrics.inc("reduce_completed")
                 if self.journal:
-                    self.journal.reduce_completed(
-                        args.task_id, has_record=record is not None
-                    )
+                    # staged like the map branch; see _flush_journal
+                    self._pending_journal.append((
+                        "reduce", args.task_id, None, None,
+                        record is not None, None,
+                    ))
                 self._event("reduce_committed", task=args.task_id,
                             worker=args.worker_id,
                             has_record=record is not None)

@@ -61,6 +61,7 @@ from distributed_grep_tpu.runtime.scheduler import (
 )
 from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
+from distributed_grep_tpu.utils import lockdep
 from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils.config import JobConfig
 from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
@@ -146,8 +147,11 @@ class ServiceRegistry:
     def __init__(self, work_root: Path):
         self.path = Path(work_root) / self.FILENAME
         self._journal = TaskJournal(self.path)
-        self._lock = threading.Lock()  # appends come from RPC threads,
-        # watcher threads, and submit — TaskJournal itself is not locked
+        # A dedicated I/O-serialization lock (io_ok): holding it across
+        # the fsync'ing append IS its purpose — appends come from RPC
+        # threads, watcher threads, and submit, and TaskJournal itself is
+        # not locked.
+        self._lock = lockdep.make_lock("service-registry", io_ok=True)
 
     def record_submit(self, job_id: str, config: JobConfig) -> None:
         with self._lock:
@@ -349,11 +353,24 @@ class GrepService:
         self._sweep_interval_s = sweep_interval_s
         self.rpc_timeout_s = rpc_timeout_s
 
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("service")
         self._cond = threading.Condition(self._lock)
         self._jobs: dict[str, JobRecord] = {}
         self._queue: list[str] = []  # submitted, awaiting a running slot
         self._running: list[str] = []  # assign round-robin order
+        # Job starts claimed under the lock but BUILT outside it
+        # (checked: locked-blocking): _maybe_start_locked only moves
+        # state (queue pop, RUNNING, slot), staging the record here; the
+        # filesystem half — work-dir clear, journal/event-log open,
+        # scheduler construction — runs in _flush_starts after release.
+        # The start-flush lock (io_ok: its purpose is serializing that
+        # I/O) keeps setups in staging order.
+        self._pending_starts: list[JobRecord] = []
+        self._start_flush_lock = lockdep.make_lock("start-flush", io_ok=True)
+        # Journal/event-log closes staged by _close_job_locked and run by
+        # _flush_closes after release — a file close flushes buffers,
+        # filesystem work the service lock must not hold.
+        self._pending_closes: list[tuple] = []
         self._rr = 0
         self._ids = itertools.count(1)
         self._stopped = False
@@ -371,7 +388,7 @@ class GrepService:
         # several jobs' attempts — dedup must happen before the per-job
         # split, not inside any one job's scheduler.
         self._span_seqs: dict[int, set[int]] = {}
-        self._span_seq_lock = threading.Lock()
+        self._span_seq_lock = lockdep.make_lock("span-seq")
 
         # ONE flaky-worker quarantine tracker shared by every job's
         # scheduler (runtime/scheduler.WorkerHealth): the service owns
@@ -401,7 +418,9 @@ class GrepService:
         # flushers writing their swapped batches unlocked could land
         # "cancelled" before the older "running" — and replay trusts the
         # LAST state.  Outer to self._lock; nothing takes them reversed.
-        self._registry_flush_lock = threading.Lock()
+        # io_ok: holding it across the fsync'ing appends is its purpose.
+        self._registry_flush_lock = lockdep.make_lock("registry-flush",
+                                                      io_ok=True)
         # the id counter continues past every id ever registered no
         # matter what: even a resume-disabled restart must never mint an
         # id whose work dir an earlier incarnation owns
@@ -477,6 +496,7 @@ class GrepService:
         # serving the backlog before the first worker even attaches
         with self._cond:
             self._maybe_start_locked()
+        self._flush_starts()
         self._flush_registry()
         if self._jobs:
             log.info(
@@ -641,6 +661,7 @@ class GrepService:
                 self._queue.append(job_id)
                 self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_starts()
         self._flush_registry()
         if rejected is not None:
             raise rejected
@@ -662,63 +683,130 @@ class GrepService:
             )
 
     def _maybe_start_locked(self) -> None:
+        """Claim queued jobs into free running slots.  Only STATE moves
+        here (queue pop, RUNNING, the slot, the registry record); the
+        filesystem half of a start is staged for _flush_starts — one
+        tenant's job start must not stall every other tenant's RPCs on
+        its work-dir I/O (checked: locked-blocking).  Until the flush
+        publishes rec.scheduler, readers treat the job as running-but-
+        not-yet-assignable (every consumer None-guards the scheduler)."""
         while self._queue and len(self._running) < self.max_jobs:
             rec = self._jobs[self._queue.pop(0)]
-            try:
-                self._start_job_locked(rec)
-                self._stage_state(rec)  # "running" — flushed post-lock
-            except Exception as e:  # noqa: BLE001 — bad job, healthy service
-                log.exception("job %s failed to start", rec.job_id)
-                rec.state = JobState.FAILED
-                rec.error = str(e)
-                rec.finished_at = time.time()
-                self._stage_state(rec)
-                # terminal without a close: bound the table on this path
-                # too (a read-only work_root fails EVERY start)
-                self._prune_terminal_locked()
+            rec.state = JobState.RUNNING
+            rec.started_at = time.time()
+            self._running.append(rec.job_id)
+            self._stage_state(rec)  # "running" — flushed post-lock
+            self._pending_starts.append(rec)
 
-    def _start_job_locked(self, rec: JobRecord) -> None:
+    def _build_job_runtime(self, rec: JobRecord) -> tuple:
+        """The filesystem-heavy half of a job start (no service lock
+        held): work dir (cleared — job ids are unique, but stay
+        defensive), journal + event log, metrics, scheduler.  Returns
+        the parts for the locked publish in _flush_starts."""
         cfg = rec.config
         store = make_store(cfg.store)
-        rec.workdir = WorkDir(cfg.work_dir, store=store)
-        rec.workdir.clear()  # job ids are unique, but stay defensive
-        rec.journal = (
-            TaskJournal(rec.workdir.journal_path()) if cfg.journal else None
+        workdir = WorkDir(cfg.work_dir, store=store)
+        workdir.clear()
+        journal = (
+            TaskJournal(workdir.journal_path()) if cfg.journal else None
         )
         spans_on = spans_mod.enabled(cfg.spans) or self.spans
-        rec.event_log = (
+        event_log = (
             spans_mod.EventLog(
-                rec.workdir.root / spans_mod.EventLog.FILENAME, fresh=True
+                workdir.root / spans_mod.EventLog.FILENAME, fresh=True
             )
             if spans_on else None
         )
         rec.input_allowlist = frozenset(cfg.input_files)
-        rec.metrics = Metrics()
-        rec.scheduler = Scheduler(
+        metrics = Metrics()
+        scheduler = Scheduler(
             files=rec.map_splits,
             n_reduce=cfg.n_reduce,
             task_timeout_s=cfg.task_timeout_s,
             sweep_interval_s=cfg.sweep_interval_s,
             app_options=cfg.effective_app_options(),
-            journal=rec.journal,
-            metrics=rec.metrics,
-            commit_resolver=rec.workdir.resolve_task_commit,
-            event_log=rec.event_log,
+            journal=journal,
+            metrics=metrics,
+            commit_resolver=workdir.resolve_task_commit,
+            event_log=event_log,
             on_change=self._wake,
             worker_health=self._health,
         )
-        rec.state = JobState.RUNNING
-        rec.started_at = time.time()
-        self._running.append(rec.job_id)
-        threading.Thread(
-            target=self._watch_job, args=(rec,), daemon=True,
-            name=f"svc-watch-{rec.job_id}",
-        ).start()
-        log.info(
-            "job %s started (%d map tasks, %d reduce, %d running, %d queued)",
-            rec.job_id, len(rec.scheduler.map_tasks), cfg.n_reduce,
-            len(self._running), len(self._queue),
-        )
+        return workdir, journal, event_log, metrics, scheduler
+
+    def _flush_starts(self) -> None:
+        """Run staged job starts outside the service lock.  The
+        start-flush lock (io_ok) orders setups in staging order; the
+        locked tail publishes the runtime fields in one step — or tears
+        the fresh parts down when a cancel/stop won the race mid-setup.
+        A failed setup records FAILED exactly like the old in-lock path
+        (a read-only work_root fails every start; the table stays
+        bounded)."""
+        with self._lock:
+            # fast path: nothing staged — don't serialize this caller
+            # behind another tenant's in-flight job build (entries are
+            # only handled by the flusher that observes them, so an
+            # empty list here is safe to skip)
+            if not self._pending_starts:
+                return
+        with self._start_flush_lock:
+            while True:
+                with self._cond:
+                    while self._pending_starts and (
+                        self._pending_starts[0].state is not JobState.RUNNING
+                    ):
+                        self._pending_starts.pop(0)  # cancelled pre-setup
+                    if not self._pending_starts:
+                        return
+                    rec = self._pending_starts.pop(0)
+                try:
+                    parts = self._build_job_runtime(rec)
+                except Exception as e:  # noqa: BLE001 — bad job, healthy service
+                    log.exception("job %s failed to start", rec.job_id)
+                    with self._cond:
+                        if rec.state is JobState.RUNNING:
+                            # a cancel/stop that won the race already
+                            # recorded ITS terminal state — don't
+                            # overwrite cancelled with failed
+                            rec.state = JobState.FAILED
+                            rec.error = str(e)
+                            rec.finished_at = time.time()
+                            if rec.job_id in self._running:
+                                self._running.remove(rec.job_id)
+                            self._stage_state(rec)
+                            self._prune_terminal_locked()
+                            self._maybe_start_locked()  # refill the slot
+                            self._cond.notify_all()
+                    continue
+                workdir, journal, event_log, metrics, scheduler = parts
+                published = False
+                with self._cond:
+                    if rec.state is JobState.RUNNING:
+                        rec.workdir = workdir
+                        rec.journal = journal
+                        rec.event_log = event_log
+                        rec.metrics = metrics
+                        rec.scheduler = scheduler
+                        published = True
+                        self._cond.notify_all()
+                if not published:
+                    # cancel/stop won the race mid-setup: tear down the
+                    # parts that never became visible
+                    scheduler.stop()
+                    scheduler.close_journal()
+                    if event_log is not None:
+                        event_log.close()
+                    continue
+                threading.Thread(
+                    target=self._watch_job, args=(rec,), daemon=True,
+                    name=f"svc-watch-{rec.job_id}",
+                ).start()
+                log.info(
+                    "job %s started (%d map tasks, %d reduce, %d running, "
+                    "%d queued)",
+                    rec.job_id, len(scheduler.map_tasks), rec.config.n_reduce,
+                    len(self._running), len(self._queue),
+                )
 
     def _watch_job(self, rec: JobRecord) -> None:
         """Per-running-job completion watcher: finalize when the job's
@@ -749,6 +837,8 @@ class GrepService:
             self._close_job_locked(rec)
             self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_starts()
+        self._flush_closes()
         self._flush_registry()
         log.info(
             "job %s done in %.3fs (%d outputs)", rec.job_id,
@@ -757,15 +847,42 @@ class GrepService:
         )
 
     def _close_job_locked(self, rec: JobRecord) -> None:
+        # stop() is pure state + notify (no I/O); the file closes are
+        # STAGED — flushing buffers under the service lock would stall
+        # every tenant's RPCs on the work-dir disk (checked:
+        # locked-blocking).
         if rec.scheduler is not None:
             rec.scheduler.stop()
-        if rec.journal is not None:
-            rec.journal.close()
-        if rec.event_log is not None:
-            rec.event_log.close()
+        if rec.journal is not None or rec.event_log is not None:
+            self._pending_closes.append(
+                (rec.scheduler, rec.journal, rec.event_log)
+            )
         if rec.job_id in self._running:
             self._running.remove(rec.job_id)
         self._prune_terminal_locked()
+
+    def _flush_closes(self) -> None:
+        """Close staged journals/event logs outside the service lock.
+        Journal closes route through Scheduler.close_journal — it drains
+        that job's staged completions under the journal-flush lock before
+        closing, so a finalize can never lose the last reduce_done entry
+        to the close.  A late writer racing the event-log close is
+        absorbed (EventLog drops writes on a closed file).  Never
+        raises."""
+        with self._lock:
+            if not self._pending_closes:
+                return
+            pending, self._pending_closes = self._pending_closes, []
+        for scheduler, journal, event_log in pending:
+            try:
+                if scheduler is not None and journal is not None:
+                    scheduler.close_journal()
+                elif journal is not None:
+                    journal.close()
+                if event_log is not None:
+                    event_log.close()
+            except Exception:  # noqa: BLE001 — teardown must not fail RPCs
+                log.exception("job teardown close failed")
 
     def _prune_terminal_locked(self) -> None:
         """Bound the job table over an unbounded stream: keep the newest
@@ -804,6 +921,8 @@ class GrepService:
                 self._close_job_locked(rec)
                 self._maybe_start_locked()
             self._cond.notify_all()
+        self._flush_starts()
+        self._flush_closes()
         self._flush_registry()
         log.info("job %s cancelled", job_id)
         return rec.state
@@ -929,7 +1048,9 @@ class GrepService:
                 self._rr += 1
             for i in range(len(order)):
                 rec = self._jobs.get(order[(start + i) % len(order)])
-                if rec is None or rec.state is not JobState.RUNNING:
+                if rec is None or rec.state is not JobState.RUNNING or (
+                    rec.scheduler is None  # start staged, setup in flight
+                ):
                     continue
                 reply = rec.scheduler.assign_task(
                     rpc.AssignTaskArgs(worker_id=worker_id), timeout=0.0
@@ -1206,6 +1327,8 @@ class GrepService:
                 self._stage_state(rec)
                 self._close_job_locked(rec)
             self._cond.notify_all()
+        self._flush_starts()  # drains (and tears down) cancelled pendings
+        self._flush_closes()
         self._flush_registry()
         for t in getattr(self, "_local_workers", []):
             t.join(timeout=join_timeout_s)

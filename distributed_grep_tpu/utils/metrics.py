@@ -9,11 +9,16 @@ phases, and the job dumps one dict at completion.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+from distributed_grep_tpu.utils import lockdep
+
+
+def _metrics_lock():
+    return lockdep.make_lock("metrics")
 
 
 @dataclass
@@ -22,7 +27,7 @@ class Metrics:
 
     counters: dict[str, float] = field(default_factory=lambda: defaultdict(float))
     timings: dict[str, list[float]] = field(default_factory=lambda: defaultdict(list))
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: object = field(default_factory=_metrics_lock, repr=False)
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:

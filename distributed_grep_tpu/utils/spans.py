@@ -33,6 +33,8 @@ import time
 from contextlib import contextmanager
 from pathlib import Path
 
+from distributed_grep_tpu.utils import lockdep
+
 _ENV_VAR = "DGREP_SPANS"
 
 # Bounded buffering: a match-dense job can emit one scan record per chunk;
@@ -59,7 +61,7 @@ class SpanBuffer:
     so one RPC never ships an unbounded body."""
 
     def __init__(self, cap: int = BUFFER_CAP):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("span-buffer")
         self._recs: list[dict] = []
         self.cap = cap
         self.dropped = 0
@@ -245,7 +247,8 @@ class EventLog:
         # log across coordinator restarts.
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._lock = threading.Lock()
+        # io_ok: serializing the write+flush is this lock's purpose
+        self._lock = lockdep.make_lock("event-log", io_ok=True)
         self._f = open(self.path, "w" if fresh else "a", encoding="utf-8")
 
     def write(self, rec: dict) -> None:

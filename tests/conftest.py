@@ -87,6 +87,45 @@ def corpus(tmp_path):
 
 
 @pytest.fixture(autouse=True)
+def _lockdep_audit(request):
+    """The dynamic half of the concurrency-discipline layer (round 11):
+    under the `service`, `chaos`, and `soak_mini` suites every lock built
+    through utils/lockdep.make_lock is instrumented — per-thread
+    acquisition stacks, lock-order inversion detection, blocking-syscall-
+    while-held detection — and the test FAILS if the run observed either.
+    This is the runtime cross-check of the static `locked-blocking` /
+    `lock-order` rules: the AST proves what it can see, the harness
+    watches what the threads actually did.  Other tests skip activation
+    (make_lock hands out raw Locks — zero overhead; suite-wide
+    DGREP_LOCKDEP=1 was tried and blew the tier-1 time budget).  Locks
+    the ops modules build at IMPORT time are outside this fixture's
+    reach — the env-enabled path that covers them is pinned by a
+    subprocess test in tests/test_lockdep.py."""
+    markers = {m.name for m in request.node.iter_markers()}
+    if not markers & {"service", "chaos", "soak_mini"}:
+        yield
+        return
+    from distributed_grep_tpu.utils import lockdep
+
+    lockdep.activate()
+    lockdep.reset()
+    try:
+        yield
+    finally:
+        report = lockdep.report()
+        lockdep.deactivate()
+        lockdep.reset()
+    assert not report["inversions"], (
+        "lockdep observed a lock-order inversion:\n"
+        + "\n".join(str(i) for i in report["inversions"])
+    )
+    assert not report["blocking"], (
+        "lockdep observed a blocking syscall while holding a lock:\n"
+        + "\n".join(str(b) for b in report["blocking"])
+    )
+
+
+@pytest.fixture(autouse=True)
 def _fresh_device_probe_state():
     """The engine's device-probe verdict is process-global (one backend
     per process in production); tests that exercise demotion would poison

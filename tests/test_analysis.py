@@ -47,7 +47,9 @@ def test_repo_is_clean_with_empty_baseline():
 def test_cli_analyze_subcommand_green(capsys):
     from distributed_grep_tpu.__main__ import main
 
-    assert main(["analyze"]) == 0
+    # one full-repo pass through the CLI (--json covers the plain exit-0
+    # contract too; a second bare `analyze` run would double the
+    # repo-wide analysis cost in the tier-1 suite for no extra signal)
     assert main(["analyze", "--json"]) == 0
     out = capsys.readouterr().out
     doc = json.loads(out)
@@ -339,6 +341,338 @@ def test_net_retry_silent_on_transport_module_and_out_of_scope(tmp_path):
         "def serve(handler):\n"
         "    return ThreadingHTTPServer(('127.0.0.1', 0), handler)\n")
     assert not _hits(tmp_path, "net-retry")
+
+
+# ------------------------------------------------------ R9 locked-blocking
+
+def test_locked_blocking_fires_in_with_block_and_locked_method(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "import os\n"
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, p):\n"
+        "        with self._lock:\n"
+        "            open(p)\n"  # file open under a hot lock
+        "    def _push_locked(self, f):\n"
+        "        os.fsync(f.fileno())\n"  # fsync in a _locked method
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            self.journal.map_completed(1, 'f', [])\n")  # I/O object
+    got = _hits(tmp_path, "locked-blocking")
+    assert [v.line for v in got] == [8, 10, 13]
+    msgs = "\n".join(v.message for v in got)
+    assert "open()" in msgs and "os.fsync()" in msgs
+    assert "journal.map_completed() [I/O object]" in msgs
+    assert "_locked convention" in got[1].message
+
+
+def test_locked_blocking_fires_on_sleep_engine_and_socket(tmp_path):
+    _mk(tmp_path, "ops/x.py",
+        "import time\n"
+        "import threading\n"
+        "from urllib.request import urlopen\n"
+        "_l = threading.Lock()\n"
+        "def f(url, pat):\n"
+        "    with _l:\n"
+        "        time.sleep(1)\n"
+        "        urlopen(url)\n"
+        "        eng = GrepEngine(pat)\n"
+        "    return eng\n")
+    got = _hits(tmp_path, "locked-blocking")
+    assert [v.line for v in got] == [7, 8, 9]
+
+
+def test_locked_blocking_nested_compound_reports_once(tmp_path):
+    """A blocking call under if/try INSIDE the with reports exactly once
+    (no double-walk), and a with-ITEM expression is scanned against the
+    locks already held to its left."""
+    _mk(tmp_path, "runtime/x.py",
+        "import threading\n"
+        "_l = threading.Lock()\n"
+        "def f(p, cond):\n"
+        "    with _l:\n"
+        "        if cond:\n"
+        "            try:\n"
+        "                open(p)\n"
+        "            except OSError:\n"
+        "                pass\n"
+        "def g(p):\n"
+        "    with _l, open(p) as fh:\n"  # item opened AFTER _l acquired
+        "        return fh\n")
+    got = _hits(tmp_path, "locked-blocking")
+    assert [v.line for v in got] == [7, 11]
+
+
+def test_locked_blocking_nested_def_under_lock_is_not_flagged(tmp_path):
+    """Defining a closure under a lock runs nothing — its body is its
+    own scope (flagged only under its OWN locks / _locked name)."""
+    _mk(tmp_path, "runtime/x.py",
+        "import threading\n"
+        "_l = threading.Lock()\n"
+        "def f(p):\n"
+        "    with _l:\n"
+        "        def cb():\n"
+        "            return open(p)\n"
+        "        return cb\n")
+    assert not _hits(tmp_path, "locked-blocking")
+
+
+def test_locked_blocking_io_ok_and_staged_flush_stay_silent(tmp_path):
+    """The two blessed escapes: a lock DECLARED io_ok (serializing the
+    I/O is its purpose) and the staged-flush pattern (stage under the
+    lock, write after release)."""
+    _mk(tmp_path, "runtime/ok.py",
+        "import os\n"
+        "import threading\n"
+        "from distributed_grep_tpu.utils.lockdep import make_lock\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._flush_lock = make_lock('flush', io_ok=True)\n"
+        "        self._pending = []\n"
+        "    def commit(self, entry, f):\n"
+        "        with self._lock:\n"
+        "            self._pending.append(entry)\n"  # staging: no I/O
+        "        self._flush(f)\n"
+        "    def _flush(self, f):\n"
+        "        with self._flush_lock:\n"
+        "            with self._lock:\n"
+        "                pending, self._pending = self._pending, []\n"
+        "            os.fsync(f.fileno())\n"  # under the io_ok lock only
+        "    def teardown(self, p):\n"
+        "        open(p)\n"  # no lock held: fine
+        "    def h(self, s):\n"
+        "        with self._lock:\n"
+        "            return s.replace('a', 'b')\n")  # str.replace != os.replace
+    assert not _hits(tmp_path, "locked-blocking")
+
+
+def test_locked_blocking_out_of_scope_dirs_are_exempt(tmp_path):
+    _mk(tmp_path, "utils/spans_like.py",
+        "import threading\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def write(self, f):\n"
+        "        with self._lock:\n"
+        "            open(f)\n")  # utils/ is not in the R9 scope
+    assert not _hits(tmp_path, "locked-blocking")
+
+
+# ----------------------------------------------------------- R10 lock-order
+
+def test_lock_order_fires_on_cross_function_cycle(tmp_path):
+    _mk(tmp_path, "runtime/y.py",
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "def f():\n"
+        "    with a:\n"
+        "        g()\n"  # a -> b via the call edge
+        "def g():\n"
+        "    with b:\n"
+        "        pass\n"
+        "def h():\n"
+        "    with b:\n"
+        "        with a:\n"  # b -> a lexically: cycle
+        "            pass\n")
+    got = _hits(tmp_path, "lock-order")
+    assert len(got) == 1
+    assert "lock-order cycle" in got[0].message
+
+
+def test_lock_order_three_lock_cycle_reports_once(tmp_path):
+    """One A->B->C->A cycle is ONE deadlock: dedup keys on the cycle's
+    full lock set, not the closing edge (edge-keyed dedup would report
+    a 3-cycle three times)."""
+    _mk(tmp_path, "runtime/tri.py",
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "c = threading.Lock()\n"
+        "def f():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with b:\n"
+        "        with c:\n"
+        "            pass\n"
+        "def h():\n"
+        "    with c:\n"
+        "        with a:\n"
+        "            pass\n")
+    got = _hits(tmp_path, "lock-order")
+    assert len(got) == 1 and "cycle" in got[0].message
+
+
+def test_lock_order_fires_on_lexical_self_reacquire(tmp_path):
+    _mk(tmp_path, "ops/z.py",
+        "import threading\n"
+        "l = threading.Lock()\n"
+        "def f():\n"
+        "    with l:\n"
+        "        with l:\n"
+        "            pass\n")
+    (v,) = _hits(tmp_path, "lock-order")
+    assert "re-acquired while already held" in v.message
+
+
+def test_lock_order_cross_module_edge_via_annotation(tmp_path):
+    """The service -> scheduler shape: a dataclass field annotation types
+    the receiver, the call edge crosses modules, and the REVERSE order
+    in the other module closes the cycle."""
+    _mk(tmp_path, "runtime/sched.py",
+        "import threading\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def stop(self):\n"
+        "        with self._lock:\n"
+        "            helper()\n"
+        "def helper():\n"
+        "    pass\n")
+    _mk(tmp_path, "runtime/svc.py",
+        "import threading\n"
+        "from runtime.sched import Sched\n"
+        "class Rec:\n"
+        "    scheduler: Sched | None = None\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def close(self, rec):\n"
+        "        with self._lock:\n"
+        "            rec.scheduler.stop()\n")
+    assert not _hits(tmp_path, "lock-order")  # svc -> sched alone: acyclic
+    _mk(tmp_path, "runtime/sched.py",
+        "import threading\n"
+        "from runtime.svc import Svc\n"
+        "class Sched:\n"
+        "    svc: Svc | None = None\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def stop(self):\n"
+        "        with self._lock:\n"
+        "            self.svc.close(None)\n")  # sched -> svc: cycle closes
+    got = _hits(tmp_path, "lock-order")
+    assert len(got) == 1 and "cycle" in got[0].message
+
+
+def test_lock_order_conditional_acquire_helper_is_not_a_self_cycle(tmp_path):
+    """The `locked=True` re-entry guard shape (service admission check):
+    a helper that conditionally takes the SAME lock its caller holds must
+    not read as a self-deadlock — call-path self-edges are skipped by
+    design (the lexical `with a: with a:` case still reports)."""
+    _mk(tmp_path, "runtime/adm.py",
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def check(self, locked=False):\n"
+        "        if not locked:\n"
+        "            with self._lock:\n"
+        "                return self.check(locked=True)\n"
+        "        return True\n"
+        "    def submit(self):\n"
+        "        with self._lock:\n"
+        "            self.check(locked=True)\n")
+    assert not _hits(tmp_path, "lock-order")
+
+
+def test_lock_order_make_lock_names_and_condition_alias(tmp_path):
+    """make_lock names are the graph nodes, and Condition(self._lock)
+    aliases the wrapped lock — `with self._cond:` is the same node as
+    `with self._lock:` (no phantom second lock)."""
+    _mk(tmp_path, "runtime/named.py",
+        "import threading\n"
+        "from distributed_grep_tpu.utils.lockdep import make_lock\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = make_lock('svc')\n"
+        "        self._cond = threading.Condition(self._lock)\n"
+        "    def wake(self):\n"
+        "        with self._cond:\n"
+        "            pass\n"
+        "    def wait_then(self):\n"
+        "        with self._lock:\n"
+        "            self.wake()\n")  # same node: skipped, not a cycle
+    assert not _hits(tmp_path, "lock-order")
+
+
+# -------------------------------------------------------- R11 shard-map-rep
+
+def test_shard_map_rep_fires_in_pallas_module(tmp_path):
+    _mk(tmp_path, "parallel/k.py",
+        "from jax.experimental.shard_map import shard_map\n"
+        "from distributed_grep_tpu.ops import pallas_scan\n"
+        "def go(body, mesh, spec):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec)\n")
+    (v,) = _hits(tmp_path, "shard-map-rep")
+    assert v.line == 4 and "check_rep=False" in v.message
+
+
+def test_shard_map_rep_fires_on_explicit_true(tmp_path):
+    _mk(tmp_path, "parallel/k.py",
+        "from jax.experimental.shard_map import shard_map\n"
+        "def kernel(x):\n"
+        "    return pallas_call(x)\n"  # pallas-touching via the call
+        "def go(mesh, spec):\n"
+        "    return shard_map(kernel, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec, check_rep=True)\n")
+    (v,) = _hits(tmp_path, "shard-map-rep")
+    assert v.line == 5
+
+
+def test_shard_map_rep_silent_on_compliant_and_non_pallas(tmp_path):
+    # the XLA-core sharded scan: no pallas anywhere -> check_rep may stay
+    _mk(tmp_path, "parallel/scan.py",
+        "from jax.experimental.shard_map import shard_map\n"
+        "def go(body, mesh, spec):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec)\n")
+    # the kernel module passes check_rep=False as required
+    _mk(tmp_path, "parallel/kern.py",
+        "from jax.experimental.shard_map import shard_map\n"
+        "from distributed_grep_tpu.ops import pallas_scan\n"
+        "def go(body, mesh, spec):\n"
+        "    return shard_map(body, mesh=mesh, in_specs=spec,\n"
+        "                     out_specs=spec, check_rep=False)\n")
+    assert not _hits(tmp_path, "shard-map-rep")
+
+
+# ----------------------------------------------------------- SARIF output
+
+def test_sarif_output_shape_and_stability(tmp_path, capsys):
+    _mk(tmp_path, "parallel/x.py", "def f():\n    print('x')\n")
+    assert analyze_main(["--root", str(tmp_path), "--sarif"]) == 1
+    first = capsys.readouterr().out
+    doc = json.loads(first)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "distributed-grep-analyze"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(RULES)  # every rule, stable order
+    (res,) = [r for r in run["results"] if r["ruleId"] == "logging"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "parallel/x.py"
+    assert loc["region"]["startLine"] == 2
+    # byte-stable: same tree -> identical SARIF and identical --json
+    assert analyze_main(["--root", str(tmp_path), "--sarif"]) == 1
+    assert capsys.readouterr().out == first
+    assert analyze_main(["--root", str(tmp_path), "--json"]) == 1
+    j1 = capsys.readouterr().out
+    assert analyze_main(["--root", str(tmp_path), "--json"]) == 1
+    assert capsys.readouterr().out == j1
+
+
+def test_sarif_clean_tree_is_green_with_empty_results(tmp_path, capsys):
+    _mk(tmp_path, "apps/ok.py", "x = 1\n")
+    assert analyze_main(["--root", str(tmp_path), "--sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
 
 
 # --------------------------------------------- suppression + CLI plumbing
