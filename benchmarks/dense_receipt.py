@@ -1,0 +1,262 @@
+"""Match-dense 64 MB receipt: CLI wall + host-side stage attribution.
+
+The one workload where the host record pipeline, not the kernel, is the
+wall (BASELINE.md rounds 4-6): a dense English-like corpus where ~40% of
+lines match, so the job's cost is everything BETWEEN kernel output and
+mr-out — record build, partition split, shuffle encode/decode, reduce
+format, display merge.  This is the one-command reproduction of the
+round-6 profile and the before/after receipt for the native map-record
+pipeline (round 8, ``dgrep_build_records``):
+
+    python benchmarks/dense_receipt.py              # wall + stage profile
+    python benchmarks/dense_receipt.py --check      # + native-vs-off byte identity
+    python benchmarks/dense_receipt.py --ab         # + CLI wall with the
+                                                    #   record build forced
+                                                    #   off (DGREP_NATIVE_RECORDS=0)
+
+Stage times are accumulated by wrapping the pipeline's own entry points
+(``_records_for``, ``bucketize``, ``encode_records``/``decode_records``,
+``format_lines_bytes``) around an in-process job — the same attribution
+method as the round-6 manual profile, now reproducible in one command.
+The CLI leg runs ``python -m distributed_grep_tpu grep`` as a real
+subprocess with stdout to a file (interpreter startup included — that is
+the number BASELINE quotes as "CLI wall").  Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# Runnable as `python benchmarks/...` from anywhere: the repo root joins
+# the FRONT of sys.path so the checkout being benchmarked always wins.
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+
+# CPU-pinned (CLAUDE.md environment rules): ASSIGN, never setdefault.
+# This benchmark measures the HOST record pipeline — the cpu engine path
+# never imports jax, so no plugin-factory pop is needed here.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+
+def make_corpus(path: Path, mb: int, seed: int = 6) -> None:
+    """English-shaped dense corpus: ~36-byte lines of lowercase words,
+    'the' planted so ~40% of lines match (the round-6 receipt shape:
+    733k matched of 1.78M lines at 64 MB)."""
+    n = mb << 20
+    rng = np.random.default_rng(seed)
+    data = rng.integers(97, 123, size=n, dtype=np.uint8)  # a-z
+    data[rng.integers(0, n, size=n // 6)] = 0x20
+    data[rng.integers(0, n, size=n // 36)] = 0x0A
+    pos = rng.integers(0, n - 4, size=n // 90)
+    for i, b in enumerate(b"the"):
+        data[pos + i] = b
+    data[-1] = 0x0A
+    path.write_bytes(data.tobytes())
+
+
+class StageClock:
+    """Accumulate wall time per stage by wrapping pipeline entry points.
+    Sums are plain float adds under the GIL — worker threads race only
+    benignly (same method as the round-6 manual profile)."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+
+    def wrap(self, obj, name: str, stage: str):
+        fn = getattr(obj, name)
+
+        @functools.wraps(fn)
+        def timed(*a, **k):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                self.totals[stage] = (
+                    self.totals.get(stage, 0.0) + time.perf_counter() - t0
+                )
+
+        setattr(obj, name, timed)
+        return fn
+
+
+def run_inprocess(corpus: Path, pattern: str, work: Path,
+                  clock: StageClock | None = None) -> dict:
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    cfg = JobConfig(
+        application="distributed_grep_tpu.apps.grep_tpu",
+        input_files=[str(corpus)],
+        work_dir=str(work),
+        n_reduce=10,
+        journal=False,
+        app_options={"pattern": pattern, "backend": "cpu"},
+    )
+    t0 = time.perf_counter()
+    res = run_job(cfg, n_workers=2)
+    job_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    display = b"".join(res.display_blocks_sorted())
+    display_s = time.perf_counter() - t1
+    outs = {p.name: p.read_bytes() for p in res.output_files}
+    out = {
+        "job_s": round(job_s, 3),
+        "display_s": round(display_s, 3),
+        "matched_lines": display.count(b"\n"),
+    }
+    if clock is not None:
+        out["stages"] = {k: round(v, 3) for k, v in
+                        sorted(clock.totals.items())}
+    out["_outs"] = outs
+    out["_display"] = display
+    return out
+
+
+def profiled_run(corpus: Path, pattern: str, work: Path) -> dict:
+    clock = StageClock()
+    from distributed_grep_tpu.ops import lines as ops_lines
+    from distributed_grep_tpu.ops.engine import GrepEngine
+    from distributed_grep_tpu.runtime import columnar, shuffle
+
+    clock.wrap(GrepEngine, "scan", "scan")
+    # Wrap at the DEFINITION sites: the app loader gives each job a fresh
+    # grep_tpu module instance whose `from ... import` bindings resolve at
+    # load time (inside run_job, i.e. after these wraps land) — wrapping
+    # the already-imported app module would miss the worker's copy.
+    clock.wrap(columnar, "make_batch_from_lines", "record_build")
+    clock.wrap(ops_lines, "newline_index", "newline_index")
+    clock.wrap(shuffle, "bucketize", "bucketize_split")
+    clock.wrap(shuffle, "encode_records", "shuffle_encode")
+    clock.wrap(shuffle, "decode_records", "shuffle_decode")
+    clock.wrap(columnar.IdentityCollator, "add_many", "collate_add")
+    clock.wrap(columnar.LineBatch, "format_lines_bytes", "reduce_format")
+    try:
+        return run_inprocess(corpus, pattern, work, clock)
+    finally:
+        # wrappers are process-local and this process exits after the
+        # run; nothing to restore for correctness, but be tidy anyway
+        pass
+
+
+def cli_wall(corpus: Path, pattern: str, extra_env: dict | None = None) -> float:
+    env = dict(os.environ, PYTHONPATH=str(_root), JAX_PLATFORMS="cpu",
+               **(extra_env or {}))
+    with tempfile.NamedTemporaryFile() as out:
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "distributed_grep_tpu", "grep",
+             pattern, str(corpus), "--backend", "cpu"],
+            stdout=out, stderr=subprocess.PIPE, env=env, timeout=600,
+        )
+        wall = time.perf_counter() - t0
+    if r.returncode not in (0, 1):
+        raise RuntimeError(f"CLI failed rc={r.returncode}: {r.stderr[-500:]}")
+    return wall
+
+
+def check_byte_identity(corpus: Path, pattern: str, tmp: Path) -> dict:
+    """Native record/merge loops ON vs ALL OFF (numpy fallbacks + the
+    per-record spill path via a tiny reduce cap): mr-out files and display
+    bytes must be byte-identical — the test_native_merge.py contract, run
+    at receipt scale."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils import native
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    def run(tag: str) -> tuple[dict, bytes]:
+        cfg = JobConfig(
+            application="distributed_grep_tpu.apps.grep_tpu",
+            input_files=[str(corpus)],
+            work_dir=str(tmp / f"check-{tag}"),
+            n_reduce=4,
+            journal=False,
+            reduce_memory_bytes=8 << 20,  # force collator spill runs
+            app_options={"pattern": pattern, "backend": "cpu"},
+        )
+        res = run_job(cfg, n_workers=2)
+        outs = {p.name: p.read_bytes() for p in res.output_files}
+        return outs, b"".join(res.display_blocks_sorted())
+
+    outs_on, disp_on = run("native")
+    saved = {}
+    for name in ("gather_ranges_native", "format_batch", "merge_display",
+                 "build_records", "line_spans_native", "unique_lines_native"):
+        if hasattr(native, name):
+            saved[name] = getattr(native, name)
+            setattr(native, name, lambda *a, **k: None)
+    try:
+        outs_off, disp_off = run("python")
+    finally:
+        for name, fn in saved.items():
+            setattr(native, name, fn)
+    ok = outs_on == outs_off and disp_on == disp_off
+    return {"identical": ok, "mr_out_files": len(outs_on),
+            "display_bytes": len(disp_on)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64)
+    ap.add_argument("--pattern", default="the")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--ab", action="store_true",
+                    help="also time the CLI with DGREP_NATIVE_RECORDS=0")
+    ap.add_argument("--skip-cli", action="store_true")
+    args = ap.parse_args()
+
+    result: dict = {"benchmark": "dense_receipt", "mb": args.mb,
+                    "pattern": args.pattern}
+    with tempfile.TemporaryDirectory(prefix="dgrep-dense-") as td:
+        tmp = Path(td)
+        corpus = tmp / "corpus.txt"
+        t0 = time.perf_counter()
+        make_corpus(corpus, args.mb)
+        result["gen_s"] = round(time.perf_counter() - t0, 3)
+
+        if not args.skip_cli:
+            result["cli_wall_s"] = round(
+                cli_wall(corpus, args.pattern), 3)
+            if args.ab:
+                result["cli_wall_records_off_s"] = round(
+                    cli_wall(corpus, args.pattern,
+                             {"DGREP_NATIVE_RECORDS": "0"}), 3)
+
+        prof = profiled_run(corpus, args.pattern, tmp / "job")
+        prof.pop("_outs")
+        prof.pop("_display")
+        result.update(prof)
+
+        from distributed_grep_tpu.utils import native as _native
+
+        result["native_available"] = _native.native_available()
+        result["native_records"] = bool(
+            getattr(_native, "build_records", None)
+            and _native.native_available()
+            and _native.env_native_records()
+        ) if hasattr(_native, "env_native_records") else False
+
+        if args.check:
+            result["check"] = check_byte_identity(
+                corpus, args.pattern, tmp)
+
+    print(json.dumps(result))
+    if args.check and not result["check"]["identical"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

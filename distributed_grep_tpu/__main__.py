@@ -657,9 +657,13 @@ def cmd_grep(args: argparse.Namespace) -> int:
         cfg.work_dir = tempfile.mkdtemp(prefix="dgrep-")
         # Ephemeral workdir: nobody can resume a randomly-named temp dir,
         # so the per-task fsync'd journal is pure overhead here (a
-        # 2,000-file grep -r paid 2,000 fsyncs for nothing — round 5).
-        # --work-dir jobs keep the journal: their path is re-addressable.
+        # 2,000-file grep -r paid 2,000 fsyncs for nothing — round 5),
+        # and so is the blob store's fsync-before-rename (round 8: ~0.3 s
+        # per dense 64 MB job; the atomic rename commit stays, only crash
+        # durability is waived — a power cut costs a re-run).  --work-dir
+        # jobs keep both: their path is re-addressable.
         cfg.journal = False
+        cfg.durable = False
     ctx_before = args.context if args.context is not None else args.before_context
     ctx_after = args.context if args.context is not None else args.after_context
 

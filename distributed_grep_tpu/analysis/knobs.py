@@ -149,6 +149,14 @@ KNOBS: dict[str, Knob] = {
         "detection; accessor: utils/lockdep.env_lockdep).  The "
         "service/chaos/soak_mini test fixture activates it per test.",
     ),
+    "DGREP_NATIVE_RECORDS": Knob(
+        "utils/native.py", "on",
+        "0/false disables the native map-record pipeline (round 8: "
+        "dgrep_unique_lines / dgrep_line_spans / dgrep_build_records — "
+        "kernel output to partitioned mr-out slabs in one C pass); the "
+        "numpy fallbacks then serve every call, byte-identical "
+        "(accessor: utils/native.env_native_records).  Debug kill-switch.",
+    ),
     "DGREP_NATIVE_LIB": Knob(
         "utils/native.py", "unset",
         "Absolute path of the libdgrep build to load instead of "

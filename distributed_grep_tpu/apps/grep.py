@@ -162,7 +162,7 @@ def literal_mode_lines(
     spanning exactly line start to line end."""
     import numpy as np
 
-    from distributed_grep_tpu.ops.lines import line_of_offsets, newline_index
+    from distributed_grep_tpu.ops.lines import newline_index
     from distributed_grep_tpu.utils.native import literal_scan
 
     global _WORD_BYTES
@@ -191,7 +191,10 @@ def literal_mode_lines(
         return empty
     if nl is None:
         nl = newline_index(contents)
-    return np.unique(line_of_offsets(ends, nl))
+    # ends stay ascending through the boolean mask: native linear merge
+    from distributed_grep_tpu.ops.lines import unique_match_lines
+
+    return unique_match_lines(ends, nl)
 
 
 def map_fn(filename: str, contents: bytes) -> list[KeyValue]:

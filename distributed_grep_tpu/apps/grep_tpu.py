@@ -20,8 +20,11 @@ import numpy as _np
 
 from distributed_grep_tpu.apps.base import KeyValue
 from distributed_grep_tpu.ops.engine import GrepEngine, cached_engine
-from distributed_grep_tpu.ops.lines import count_lines, line_span, newline_index
-from distributed_grep_tpu.runtime.columnar import make_batch_from_lines
+from distributed_grep_tpu.ops.lines import count_lines, newline_index
+from distributed_grep_tpu.runtime.columnar import (
+    DeferredBatch,
+    make_batch_from_lines,
+)
 from distributed_grep_tpu.utils import spans as _spans_mod
 
 # Reduce is values[0] and keys are unique per (file, line): the runtime's
@@ -212,7 +215,14 @@ def map_batch_fn(items) -> list[KeyValue]:
 def _records_for(filename: str, contents: bytes, result) -> list[KeyValue]:
     """Everything after the scan — -w/-x confirm, -v, count/presence
     collapse, columnar batch build — shared by map_fn (one scan per call)
-    and map_batch_fn (one packed scan, per-file demuxed results)."""
+    and map_batch_fn (one packed scan, per-file demuxed results).  Runs
+    under its own ``map:emit`` span so trace-export separates scan time
+    from record-build time on the worker row."""
+    with _spans_mod.span("map:emit", cat="map"):
+        return _records_for_inner(filename, contents, result)
+
+
+def _records_for_inner(filename: str, contents: bytes, result) -> list[KeyValue]:
     emit = result.matched_lines  # int64 ndarray, stays vectorized throughout
     nl = None
     if _confirm is not None and emit.size:
@@ -228,15 +238,27 @@ def _records_for(filename: str, contents: bytes, result) -> list[KeyValue]:
             )
             emit = _np.intersect1d(emit, sel)
         else:
+            # Batched -w/-x confirm (round 8): ONE vectorized span pass,
+            # then the host regex runs over zero-copy memoryview slices
+            # of the SOURCE buffer — replacing a per-line line_span()
+            # call + contents slice (~8 us/line over dense candidates).
+            # Slices, not pos/endpos: the confirm regex anchors (\A/\Z,
+            # the -w lookarounds) must see each LINE as the whole string
+            # — a memoryview slice is exactly that, with no gather.
+            from distributed_grep_tpu.runtime.columnar import line_spans
+
+            starts, ends = line_spans(emit, nl, len(contents))
             progress = _progress_fn()
-            kept = []
-            for i, ln in enumerate(emit.tolist()):
-                if _confirm.search(
-                    contents[slice(*line_span(nl, ln, len(contents)))]
-                ):
-                    kept.append(ln)
-                _stamp_every(progress, i)  # -w/-x over dense candidates
-            emit = _np.asarray(kept, dtype=_np.int64)
+            mv = memoryview(contents)
+            s_l, e_l = starts.tolist(), ends.tolist()
+
+            def confirmed():
+                for i in range(emit.size):
+                    _stamp_every(progress, i)  # -w/-x over dense candidates
+                    yield _confirm.search(mv[s_l[i] : e_l[i]]) is not None
+
+            keep = _np.fromiter(confirmed(), dtype=bool, count=emit.size)
+            emit = emit[keep]
     if _invert:
         emit = _np.setdiff1d(
             _np.arange(1, count_lines(contents) + 1, dtype=_np.int64), emit
@@ -247,11 +269,15 @@ def _records_for(filename: str, contents: bytes, result) -> list[KeyValue]:
         return []
     if nl is None:
         nl = newline_index(contents)
-    # Columnar emit (round 5): ONE LineBatch for the whole split — line
-    # spans and the output slab are built with vectorized gathers instead
-    # of a KeyValue + f-string + utf-8 decode per matched line (the
-    # ~28 us/record pipeline BASELINE.md profiled; runtime/columnar.py).
-    batch = make_batch_from_lines(
+    # Columnar emit, DEFERRED (rounds 5+8): ONE batch for the whole split,
+    # carrying (source bytes, line numbers, newline index) instead of a
+    # gathered slab — the worker's shuffle partitions it straight from the
+    # source in one native pass (dgrep_build_records), so the intermediate
+    # whole-batch gather the round-5 path paid never runs.  Anything that
+    # needs the slab (tests, per-record consumers) materializes lazily
+    # (runtime/columnar.DeferredBatch); `contents` is alive for the map
+    # task's lifetime anyway on this whole-bytes path.
+    batch = DeferredBatch(
         filename, emit, _np.frombuffer(contents, dtype=_np.uint8), nl,
         len(contents),
     )
@@ -317,10 +343,19 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
     # with vectorized span gathers (runtime/columnar.py) — the -w/-x
     # confirm still runs per candidate line (it is a host regex), but the
     # surviving lines batch the same way.
+    import os as _os
+
     batches: list = []
     progress = _progress_fn()
+    file_size = _os.path.getsize(path)
 
     def emit_chunk(lines_before: int, buf: bytes, mlines, nl_idx) -> None:
+        # one map:emit span per chunk: record build separated from scan
+        # time on the worker's trace row (same contract as _records_for)
+        with _spans_mod.span("map:emit", cat="map"):
+            _emit_chunk_inner(lines_before, buf, mlines, nl_idx)
+
+    def _emit_chunk_inner(lines_before: int, buf: bytes, mlines, nl_idx) -> None:
         arr = _np.frombuffer(buf, dtype=_np.uint8)
         if _confirm is not None and _confirm_lit is not None:
             # literal -w/-x: one vectorized boundary confirm per chunk,
@@ -332,6 +367,21 @@ def map_path_fn(filename: str, path: str) -> list[KeyValue]:
             mlines = mlines[_np.isin(mlines, sel)]
             if not mlines.size:
                 return
+        if (lines_before == 0 and len(buf) == file_size
+                and (_confirm is None or _confirm_lit is not None)):
+            # The whole file fits this one chunk (the common CLI shape:
+            # files at or under the 64 MB chunk target): the buffer's
+            # lifetime equals the whole-bytes path's, so the slab gather
+            # defers like _records_for (round 8) and the shuffle
+            # partitions straight from the source bytes in one native
+            # pass.  Multi-chunk streams keep eager batches — deferring
+            # would pin every chunk until shuffle.  The regex -w/-x leg
+            # also stays eager: its confirm reads per-line bytes anyway.
+            if mlines.size:
+                batches.append(
+                    DeferredBatch(filename, mlines, arr, nl_idx, len(buf))
+                )
+            return
         batch = make_batch_from_lines(
             filename, mlines, arr, nl_idx, len(buf),
             lineno_base=lines_before,

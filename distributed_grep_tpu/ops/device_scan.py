@@ -711,7 +711,8 @@ def scan_device(eng, data: bytes, progress=None, corpus_key=None) -> ScanResult:
             # transient slice: jobs hold (start, len), not segment copies
             seg_view = data[seg_start : seg_start + seg_len]
             seg_nl = lines_mod.newline_index(seg_view)
-            seg_lines = np.unique(lines_mod.line_of_offsets(offsets, seg_nl))
+            # offsets are np.unique output (sorted): native linear merge
+            seg_lines = lines_mod.unique_match_lines(offsets, seg_nl)
             base = int(np.searchsorted(nl, seg_start))  # lines before segment
             with state_lock:
                 device_lines.update((seg_lines + base).tolist())

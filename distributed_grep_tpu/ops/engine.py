@@ -1479,15 +1479,26 @@ class GrepEngine:
             total += len(buf)
             n_matches += res.n_matches
             end_offsets += self.stats.get("end_offsets", 0)
-            nl_idx = None
+            # scan() clears the thread's nl stash at entry and the host
+            # scan modes re-stash this buffer's index — a length-matching
+            # stash is therefore THIS scan's, never a stale collision;
+            # reuse it instead of a second full newline pass over the
+            # chunk (round 8: emit AND line accounting both need it)
+            stash = getattr(self._nl_local, "stash", None)
+            nl_idx = (
+                stash[1] if stash is not None and stash[0] == len(buf)
+                else None
+            )
             if res.matched_lines.size:
                 if emit is not None:
-                    nl_idx = lines_mod.newline_index(buf)
+                    if nl_idx is None:
+                        nl_idx = lines_mod.newline_index(buf)
                     for ln in res.matched_lines.tolist():
                         s, e = lines_mod.line_span(nl_idx, ln, len(buf))
                         emit(lines_before + ln, buf[s:e])
                 elif emit_chunk is not None:
-                    nl_idx = lines_mod.newline_index(buf)
+                    if nl_idx is None:
+                        nl_idx = lines_mod.newline_index(buf)
                     emit_chunk(lines_before, buf, res.matched_lines, nl_idx)
                 matched.extend((res.matched_lines + lines_before).tolist())
             if nl_idx is not None:
@@ -1909,8 +1920,9 @@ class GrepEngine:
             offsets = np.zeros(0, dtype=np.int64)
         nl = lines_mod.newline_index(data)
         self._nl_local.stash = (len(data), nl)  # reused by scan()'s EOL leg
-        lns = np.unique(lines_mod.line_of_offsets(offsets, nl)) if offsets.size else \
-            np.zeros(0, dtype=np.int64)
+        # offsets are sorted on every branch above (literal_scan emits in
+        # ascending order, np.unique sorts): one native linear merge
+        lns = lines_mod.unique_match_lines(offsets, nl)
         self.stats = {"end_offsets": int(offsets.size)}
         return ScanResult(lns.astype(np.int64), int(lns.size), len(data))
 

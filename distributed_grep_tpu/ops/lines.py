@@ -43,6 +43,20 @@ def line_of_offsets(offsets: np.ndarray, nl_index: np.ndarray) -> np.ndarray:
     return np.searchsorted(nl_index, offsets - 1, side="right") + 1
 
 
+def unique_match_lines(offsets: np.ndarray, nl_index: np.ndarray) -> np.ndarray:
+    """Sorted unique 1-based line numbers of SORTED match end offsets —
+    ``np.unique(line_of_offsets(...))`` as one native linear merge when
+    libdgrep is available (both arrays are sorted, so the searchsorted +
+    sort-based-unique pair is two avoidable O(n log n) passes on the
+    match-dense path; the fallback is bit-identical)."""
+    if offsets.size == 0:
+        return np.zeros(0, dtype=np.int64)
+    out = native.unique_lines_native(nl_index, offsets)
+    if out is not None:
+        return out
+    return np.unique(line_of_offsets(offsets, nl_index)).astype(np.int64)
+
+
 def line_span(nl_index: np.ndarray, line_no: int, n_bytes: int) -> tuple[int, int]:
     """[start, end) byte range of 1-based line line_no (end excludes '\\n')."""
     start = 0 if line_no == 1 else int(nl_index[line_no - 2]) + 1

@@ -137,6 +137,18 @@ class LineBatch:
         )
 
     def split_by_partition(self, n_reduce: int) -> dict[int, "LineBatch"]:
+        """Per-reduce sub-batches.  Native fast path (round 8,
+        ``dgrep_build_records``): hash + partition grouping + slab gather
+        run as ONE C pass over this batch's slab; the numpy fallback
+        (vectorized FNV + one select/gather per partition) is
+        bit-identical — partition assignment is pinned against
+        ``utils.native.partition`` either way."""
+        native = _native_split(
+            self.filename, np.frombuffer(self.slab, dtype=np.uint8),
+            self.offsets[:-1], self.offsets[1:], self.linenos, n_reduce,
+        )
+        if native is not None:
+            return native
         parts = self.partitions(n_reduce)
         return {
             int(r): self.select(parts == r) for r in np.unique(parts)
@@ -222,6 +234,118 @@ def gather_ranges(
     return arr[src].tobytes(), offsets
 
 
+def line_spans(
+    linenos: np.ndarray, nl_index: np.ndarray, n_bytes: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """[start, end) byte span per 1-based line — the vectorized form of
+    ops/lines.line_span (end excludes the '\\n').  Native single loop when
+    libdgrep is available; the numpy fallback is identical (including the
+    clip semantics on the unselected np.where branch)."""
+    from distributed_grep_tpu.utils.native import line_spans_native
+
+    ln = np.asarray(linenos, dtype=np.int64)
+    if ln.size == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z.copy()
+    sp = line_spans_native(nl_index, ln, n_bytes)
+    if sp is not None:
+        return sp
+    nl = nl_index.astype(np.int64)
+    if nl.size == 0:  # chunk with no newline: only line 1 exists
+        return (np.zeros(ln.size, dtype=np.int64),
+                np.full(ln.size, n_bytes, dtype=np.int64))
+    # np.where evaluates both branches: clip the fancy indexes so the
+    # out-of-range side (line 1 / last line) reads a harmless slot
+    starts = np.where(ln == 1, 0, nl[np.clip(ln - 2, 0, nl.size - 1)] + 1)
+    ends = np.where(
+        ln - 1 < nl.size, nl[np.clip(ln - 1, 0, nl.size - 1)], n_bytes
+    )
+    return starts.astype(np.int64), ends.astype(np.int64)
+
+
+def _native_split(
+    filename: str, data: np.ndarray, starts: np.ndarray, ends: np.ndarray,
+    stored_linenos: np.ndarray, n_reduce: int,
+) -> "dict[int, LineBatch] | None":
+    """The native one-pass record build (utils/native.build_records)
+    wrapped into per-partition LineBatches, or None when unavailable —
+    the ONE routing point both split paths (built batch, deferred batch)
+    share, so the key-prefix encoding cannot drift between them."""
+    from distributed_grep_tpu.utils.native import build_records
+
+    prefix = (filename + " (line number #").encode("utf-8", "surrogateescape")
+    parts = build_records(data, starts, ends, stored_linenos, prefix, n_reduce)
+    if parts is None:
+        return None
+    return {
+        p: LineBatch(filename=filename, linenos=ln, offsets=off, slab=slab)
+        for p, (ln, off, slab) in parts.items()
+    }
+
+
+class DeferredBatch(LineBatch):
+    """A LineBatch whose offsets/slab are built ON DEMAND from the source
+    buffer + newline index (round 8).  The built-in grep apps emit these
+    from whole-buffer scans (apps/grep_tpu._records_for and the
+    single-chunk streaming leg): the worker's shuffle then splits them by
+    partition straight from the SOURCE bytes in one native pass
+    (``dgrep_build_records``), so the intermediate whole-batch slab
+    gather never runs on the hot path.  Any other access — to_keyvalues,
+    select, the wire encoder, tests — touches ``.offsets``/``.slab``
+    and materializes the ordinary batch lazily, so every existing
+    LineBatch consumer works unchanged (``isinstance`` included).
+
+    Holds a reference to the source buffer: emit ONLY where that buffer
+    is alive for the record's lifetime anyway (a whole-bytes map split,
+    or a streamed file that fits one chunk).  The multi-chunk streaming
+    path keeps eager batches — deferring there would pin every chunk's
+    buffer until the shuffle leg, unbounding the stream's memory."""
+
+    def __init__(self, filename: str, linenos: np.ndarray, data: np.ndarray,
+                 nl_index: np.ndarray, n_bytes: int, lineno_base: int = 0):
+        ln = np.asarray(linenos, dtype=np.int64)
+        self.filename = filename
+        self.linenos = ln + lineno_base  # the STORED (key) numbers
+        self._local = ln
+        self._base = int(lineno_base)
+        self._data = data
+        self._nl = nl_index
+        self._n_bytes = int(n_bytes)
+        self._built: LineBatch | None = None
+
+    def _materialized(self) -> LineBatch:
+        if self._built is None:
+            self._built = make_batch_from_lines(
+                self.filename, self._local, self._data, self._nl,
+                self._n_bytes, lineno_base=self._base,
+            )
+        return self._built
+
+    @property
+    def offsets(self) -> np.ndarray:  # type: ignore[override]
+        return self._materialized().offsets
+
+    @property
+    def slab(self) -> bytes:  # type: ignore[override]
+        return self._materialized().slab
+
+    def split_by_partition(self, n_reduce: int) -> dict[int, LineBatch]:
+        from distributed_grep_tpu.utils.native import native_records_available
+
+        if native_records_available():
+            # availability gated FIRST: the span pass below exists only
+            # to feed the native build — on the fallback tree it would
+            # be computed, discarded, and recomputed by materialize
+            starts, ends = line_spans(self._local, self._nl, self._n_bytes)
+            native = _native_split(
+                self.filename, self._data, starts, ends, self.linenos,
+                n_reduce,
+            )
+            if native is not None:
+                return native
+        return self._materialized().split_by_partition(n_reduce)
+
+
 def make_batch_from_lines(
     filename: str,
     linenos: np.ndarray,
@@ -241,19 +365,7 @@ def make_batch_from_lines(
             filename=filename, linenos=ln,
             offsets=np.zeros(1, dtype=np.int64), slab=b"",
         )
-    nl = nl_index.astype(np.int64)
-    if nl.size == 0:  # chunk with no newline: only line 1 exists
-        starts = np.zeros(ln.size, dtype=np.int64)
-        ends = np.full(ln.size, n_bytes, dtype=np.int64)
-    else:
-        # np.where evaluates both branches: clip the fancy indexes so the
-        # out-of-range side (line 1 / last line) reads a harmless slot
-        starts = np.where(
-            ln == 1, 0, nl[np.clip(ln - 2, 0, nl.size - 1)] + 1
-        )
-        ends = np.where(
-            ln - 1 < nl.size, nl[np.clip(ln - 1, 0, nl.size - 1)], n_bytes
-        )
+    starts, ends = line_spans(ln, nl_index, n_bytes)
     slab, offsets = gather_ranges(data, starts, ends)
     return LineBatch(
         filename=filename, linenos=ln + lineno_base, offsets=offsets,

@@ -455,7 +455,10 @@ def run_job(
 ) -> JobResult:
     from distributed_grep_tpu.runtime.store import FaultStore, make_store
 
-    workdir = WorkDir(config.work_dir, store=make_store(config.store))
+    workdir = WorkDir(
+        config.work_dir,
+        store=make_store(config.store, durable=config.durable),
+    )
     if app is None:
         app = load_application(config.application, **config.effective_app_options())
 

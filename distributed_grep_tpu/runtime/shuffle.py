@@ -24,8 +24,13 @@ def bucketize(records: list, n_reduce: int) -> dict[int, list]:
 
     Records are KeyValue (per-record FNV of the key) or columnar
     LineBatch (runtime/columnar.py — the match-dense fast path; its
-    vectorized per-record FNV gives the EXACT same record->partition
-    mapping, so per-record and columnar maps shuffle identically)."""
+    per-record FNV gives the EXACT same record->partition mapping, so
+    per-record and columnar maps shuffle identically).  Batch splitting
+    is ONE native pass per batch when libdgrep is available
+    (dgrep_build_records: hash + grouping + slab gather; round 8), and a
+    DeferredBatch (the grep apps' whole-buffer emit) splits straight
+    from its SOURCE bytes — the intermediate whole-batch slab is never
+    built on this path."""
     from distributed_grep_tpu.runtime.columnar import LineBatch
 
     buckets: dict[int, list] = {}

@@ -103,7 +103,11 @@ class LocalTransport:
         self.store.put(self.workdir.root / "out" / name, data)
 
     def write_output_from_file(self, name: str, path: str) -> None:
-        self.store.put_from_file(self.workdir.root / "out" / name, path)
+        # the worker donates its spool (it only ever unlinks leftovers):
+        # a rename-capable store commits it zero-copy (round 8)
+        self.store.put_from_file(
+            self.workdir.root / "out" / name, path, consume=True
+        )
 
     def publish_task_commit(self, kind: str, task_id: int, attempt: str,
                             payload: dict) -> None:

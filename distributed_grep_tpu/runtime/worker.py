@@ -504,7 +504,10 @@ class WorkerLoop:
         # The shuffle leg (bucketize + intermediate writes) is worker-side
         # code with no app involvement, and on a match-dense map it can
         # run past the sweep window by itself (549k records measured ~8 s
-        # on this host — observed swept mid-shuffle and re-executed).  The
+        # on this host — observed swept mid-shuffle and re-executed; the
+        # round-8 native record build runs HERE too — a DeferredBatch
+        # partitions from its source bytes inside bucketize, so the
+        # map:shuffle span now carries the one-pass build).  The
         # coarse pump is the right liveness here, same tradeoff as the
         # download legs: a hang in OUR shuffle is a worker bug, not an
         # app hang the detector needs to catch.  Small outputs skip the
@@ -685,4 +688,7 @@ class WorkerLoop:
                 with open(spool, "rb") as f:
                     self.transport.write_output(f"mr-out-{a.task_id}", f.read())
         finally:
-            os.unlink(spool)
+            # the transport may have CONSUMED the spool (rename commit on
+            # local data planes, runtime/store.put_from_file consume=True)
+            if os.path.exists(spool):
+                os.unlink(spool)

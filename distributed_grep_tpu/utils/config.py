@@ -59,6 +59,12 @@ class JobConfig:
     task_timeout_s: float = 10.0  # coordinator.go:105,:114
     sweep_interval_s: float = 1.0  # coordinator.go:122
     journal: bool = True  # durable task-commit journal for coordinator resume
+    # durable=False waives the blob store's fsync-before-rename (atomic
+    # rename commit unchanged; runtime/store.make_store) — ONLY for
+    # ephemeral temp work dirs nobody can resume (the CLI sets it with
+    # journal=False; ~0.3 s of fsync per dense 64 MB job on a laptop-class
+    # disk).  Resumable and service work dirs must keep the default.
+    durable: bool = True
 
     # --- Observability (utils/spans.py) ------------------------------------
     # Span/event pipeline: workers ship per-task-attempt spans piggybacked

@@ -139,6 +139,42 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_uint8),
         ]
+    if hasattr(lib, "dgrep_build_records"):
+        lib.dgrep_unique_lines.restype = ctypes.c_int64
+        lib.dgrep_unique_lines.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dgrep_line_spans.restype = None
+        lib.dgrep_line_spans.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dgrep_build_records.restype = ctypes.c_int64
+        lib.dgrep_build_records.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
     if hasattr(lib, "dgrep_confirm_build"):
         lib.dgrep_confirm_build.restype = ctypes.c_void_p
         lib.dgrep_confirm_build.argtypes = [
@@ -195,10 +231,16 @@ def newline_index(data: bytes) -> np.ndarray:
         return np.flatnonzero(np.frombuffer(data, dtype=np.uint8) == 0x0A).astype(np.uint64)
     cap = max(1024, len(data) // 16)
     while True:
-        buf = (ctypes.c_uint64 * cap)()
-        n = lib.dgrep_newline_index(data, len(data), buf, cap)
+        # np.empty, not a ctypes array: (c_uint64 * cap)() ZEROES the
+        # buffer — measured as the wrapper's single biggest cost on a
+        # dense 64 MB input (25 ms of memset vs a 30 ms AVX2 scan)
+        buf = np.empty(cap, dtype=np.uint64)
+        n = lib.dgrep_newline_index(
+            data, len(data),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), cap,
+        )
         if n <= cap:
-            return np.ctypeslib.as_array(buf)[:n].copy()
+            return buf[:n].copy()
         cap = n
 
 
@@ -219,12 +261,18 @@ def literal_scan(haystack: bytes, needle: bytes) -> np.ndarray:
             out.append(i + len(needle))
             start = i + 1
         return np.asarray(out, dtype=np.uint64)
-    cap = 4096
+    # size the first buffer off the data (one match per ~64 bytes): a
+    # match-dense corpus must not pay a SECOND full scan just to learn
+    # the count (the old fixed 4096 cap re-ran the whole 64 MB receipt)
+    cap = max(4096, len(haystack) >> 6)
     while True:
-        buf = (ctypes.c_uint64 * cap)()
-        n = lib.dgrep_literal_scan(haystack, len(haystack), needle, len(needle), buf, cap)
+        buf = np.empty(cap, dtype=np.uint64)
+        n = lib.dgrep_literal_scan(
+            haystack, len(haystack), needle, len(needle),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), cap,
+        )
         if n <= cap:
-            return np.ctypeslib.as_array(buf)[:n].copy()
+            return buf[:n].copy()
         cap = n
 
 
@@ -459,6 +507,147 @@ def merge_display(bufs: list[bytes]) -> bytes | None:
     return out[:wrote].tobytes()
 
 
+# --- Native map-record pipeline (round 8) ----------------------------------
+#
+# One C pass from kernel output (matched line numbers + the newline index)
+# to the partitioned per-reduce LineBatch arrays — replacing the numpy
+# chain make_batch_from_lines -> partitions() -> per-partition select().
+# Routed from runtime/columnar.py, which keeps bit-identical numpy
+# fallbacks for every entry; DGREP_NATIVE_RECORDS=0 is the debug
+# kill-switch (this module is the knob's single owner, analysis/knobs.py).
+
+def env_native_records() -> bool:
+    """False when DGREP_NATIVE_RECORDS disables the native record build
+    (the numpy fallbacks then serve every call — byte-identical, slower)."""
+    return os.environ.get("DGREP_NATIVE_RECORDS", "") not in ("0", "false")
+
+
+def native_records_available() -> bool:
+    """True when the one-pass record build can answer — callers that
+    would otherwise pre-compute inputs just to feed it (DeferredBatch's
+    span pass) check this FIRST so the fallback path does no wasted
+    work."""
+    lib = _try_load()
+    return (lib is not None and hasattr(lib, "dgrep_build_records")
+            and env_native_records())
+
+
+def unique_lines_native(nl: np.ndarray, ends: np.ndarray) -> np.ndarray | None:
+    """Unique 1-based line numbers of sorted match END offsets, or None
+    when libdgrep is unavailable (caller keeps the searchsorted+unique
+    fallback, ops/lines.unique_match_lines)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_unique_lines"):
+        return None
+    if not env_native_records():
+        return None
+    nl = np.ascontiguousarray(nl, dtype=np.uint64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    out = np.empty(ends.size, dtype=np.int64)
+    n = lib.dgrep_unique_lines(
+        nl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        nl.size,
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out[:n].copy()
+
+
+def line_spans_native(
+    nl: np.ndarray, linenos: np.ndarray, n_bytes: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """[start, end) byte span per 1-based line (vectorized
+    ops/lines.line_span), or None when libdgrep is unavailable."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_line_spans"):
+        return None
+    if not env_native_records():
+        return None
+    nl = np.ascontiguousarray(nl, dtype=np.uint64)
+    linenos = np.ascontiguousarray(linenos, dtype=np.int64)
+    starts = np.empty(linenos.size, dtype=np.int64)
+    ends = np.empty(linenos.size, dtype=np.int64)
+    lib.dgrep_line_spans(
+        nl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        nl.size,
+        linenos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        linenos.size,
+        int(n_bytes),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return starts, ends
+
+
+def build_records(
+    data: np.ndarray, starts: np.ndarray, ends: np.ndarray,
+    linenos: np.ndarray, prefix: bytes, n_reduce: int,
+) -> dict[int, tuple[np.ndarray, np.ndarray, bytes]] | None:
+    """One-pass partitioned record build: line spans of ``data`` in,
+    ``{partition: (stored linenos, offsets, slab bytes)}`` out — the
+    grouped arrays of each partition's LineBatch, record order preserved
+    inside each partition, partition assignment bit-identical to
+    ``partition(f"{prefix}{lineno})")`` per record.  None when libdgrep
+    is unavailable, DGREP_NATIVE_RECORDS disables it, or the inputs are
+    not the grep shape (caller keeps the numpy split path)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_build_records"):
+        return None
+    if not env_native_records():
+        return None
+    data = np.asarray(data)
+    if data.dtype != np.uint8 or data.ndim != 1:
+        return None  # spans are ELEMENT indices; C indexes bytes
+    if not data.flags.c_contiguous:
+        data = np.ascontiguousarray(data)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    linenos = np.ascontiguousarray(linenos, dtype=np.int64)
+    n = int(linenos.size)
+    if n == 0:
+        return {}
+    total = int(np.sum(ends - starts))
+    out_linenos = np.empty(n, dtype=np.int64)
+    out_offsets = np.empty(n + 1, dtype=np.int64)
+    out_slab = np.empty(max(1, total), dtype=np.uint8)
+    counts = np.zeros(n_reduce, dtype=np.int64)
+    nbytes = np.zeros(n_reduce, dtype=np.int64)
+    wrote = lib.dgrep_build_records(
+        data.ctypes.data_as(ctypes.c_char_p),
+        data.size,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        linenos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        prefix,
+        len(prefix),
+        int(n_reduce),
+        out_linenos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out_slab.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        nbytes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if wrote < 0:
+        return None  # malformed span: let the numpy path handle it
+    out: dict[int, tuple[np.ndarray, np.ndarray, bytes]] = {}
+    r0 = 0
+    b0 = 0
+    for p in range(int(n_reduce)):
+        c = int(counts[p])
+        nb = int(nbytes[p])
+        if c:
+            out[p] = (
+                out_linenos[r0 : r0 + c].copy(),
+                out_offsets[r0 : r0 + c + 1] - b0,
+                out_slab[b0 : b0 + nb].tobytes(),
+            )
+        r0 += c
+        b0 += nb
+    return out
+
+
 # Big inputs fan the DFA scan across threads; newline-aligned chunking keeps
 # output byte-identical (every state's '\n' transition is the start state —
 # the table invariant the device stripes rely on too).
@@ -487,17 +676,17 @@ def dfa_scan_mt(
     # second full scan just to learn the count
     cap = max(4096, len(data) >> 6)
     while True:
-        buf = (ctypes.c_uint64 * cap)()
+        buf = np.empty(cap, dtype=np.uint64)
         n = lib.dgrep_dfa_scan_mt(
             data,
             len(data),
             table.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
             accept_bytes,
             start_state,
-            buf,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             cap,
             n_threads,
         )
         if n <= cap:
-            return np.ctypeslib.as_array(buf)[:n].copy()
+            return buf[:n].copy()
         cap = n

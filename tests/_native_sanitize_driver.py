@@ -158,6 +158,65 @@ def surface() -> None:
                               np.asarray([0, 2], dtype=np.int64), b"\xff\xff")
     check(bad is None, "format_batch refuses non-UTF-8 slab")
 
+    # --- unique_lines / line_spans / build_records (record pipeline) -------
+    nl_i = native.newline_index(data)
+    ends_c = np.asarray(
+        sorted(rng.sample(range(1, len(data) + 1), 4000)), dtype=np.int64
+    )
+    want_u = np.unique(
+        np.searchsorted(nl_i.astype(np.int64), ends_c - 1, side="right") + 1
+    )
+    got_u = native.unique_lines_native(nl_i, ends_c)
+    check(got_u is not None and np.array_equal(got_u, want_u), "unique_lines")
+    check(native.unique_lines_native(nl_i, np.zeros(0, np.int64)).size == 0,
+          "unique_lines empty")
+
+    n_lines = int(nl_i.size) + (0 if data.endswith(b"\n") else 1)
+    lns = np.asarray(
+        sorted(rng.sample(range(1, n_lines + 1), 3000)), dtype=np.int64
+    )
+    sp = native.line_spans_native(nl_i, lns, len(data))
+    check(sp is not None, "line_spans available")
+    st, en = sp
+    nl64 = nl_i.astype(np.int64)
+    for i in (0, 1, len(lns) // 2, len(lns) - 1):
+        ln = int(lns[i])
+        w_s = 0 if ln == 1 else int(nl64[ln - 2]) + 1
+        w_e = int(nl64[ln - 1]) if ln - 1 < nl64.size else len(data)
+        check((int(st[i]), int(en[i])) == (w_s, w_e), f"line_spans ln={ln}")
+    sp0 = native.line_spans_native(np.zeros(0, np.uint64),
+                                   np.asarray([1], np.int64), 5)
+    check(sp0 is not None and (int(sp0[0][0]), int(sp0[1][0])) == (0, 5),
+          "line_spans no-newline chunk")
+
+    arr_u8 = np.frombuffer(data, np.uint8)
+    prefix = "f\udcffile (line number #".encode("utf-8", "surrogateescape")
+    for n_reduce in (1, 7):
+        parts = native.build_records(arr_u8, st, en, lns + 10**12,
+                                     prefix, n_reduce)
+        check(parts is not None, "build_records available")
+        total = 0
+        for p, (pl, po, slab) in parts.items():
+            check(0 <= p < n_reduce, "build_records partition range")
+            check(int(po[0]) == 0 and int(po[-1]) == len(slab),
+                  "build_records offsets")
+            total += int(pl.size)
+            for j in range(min(5, int(pl.size))):
+                key = prefix + str(int(pl[j])).encode() + b")"
+                check(native.fnv32a(key) % n_reduce == p,
+                      "build_records partition == fnv32a")
+                line = slab[int(po[j]):int(po[j + 1])]
+                ln = int(pl[j] - 10**12)
+                w_s = 0 if ln == 1 else int(nl64[ln - 2]) + 1
+                w_e = int(nl64[ln - 1]) if ln - 1 < nl64.size else len(data)
+                check(line == data[w_s:w_e], "build_records slab bytes")
+        check(total == lns.size, "build_records record count")
+    check(native.build_records(
+        arr_u8, np.asarray([0], np.int64),
+        np.asarray([len(data) + 9], np.int64),
+        np.asarray([1], np.int64), prefix, 4) is None,
+        "build_records refuses out-of-bounds span")
+
     # --- merge_display (k-way, codepoint path order, tie-break) ------------
     def rec(path: bytes, n: int, text: bytes) -> bytes:
         return path + b" (line number #" + str(n).encode() + b")\t" + text
@@ -194,6 +253,16 @@ def stress() -> None:
     seq = native.dfa_scan_mt(data, table, accept, n_threads=1).tolist()
     cand = np.arange(0, len(data), 2, dtype=np.uint64)
     want_mask = cs.confirm(data, cand, n_threads=1)
+    # shared inputs for the record-pipeline stress: concurrent worker
+    # slots share one engine, so concurrent build_records over the SAME
+    # data/nl arrays is the production shape (entries are read-only)
+    nl_i = native.newline_index(data)
+    n_lines = int(nl_i.size) + (0 if data.endswith(b"\n") else 1)
+    lns = np.arange(1, n_lines + 1, 3, dtype=np.int64)
+    sp = native.line_spans_native(nl_i, lns, len(data))
+    arr_u8 = np.frombuffer(data, np.uint8)
+    prefix = b"s (line number #"
+    want_parts = native.build_records(arr_u8, sp[0], sp[1], lns, prefix, 5)
     errors: list[str] = []
 
     def pound(idx: int) -> None:
@@ -205,6 +274,17 @@ def stress() -> None:
             mask = cs.confirm(data, cand, n_threads=4)
             if not np.array_equal(mask, want_mask):
                 errors.append(f"thread {idx}: confirm diverged")
+                return
+            got_sp = native.line_spans_native(nl_i, lns, len(data))
+            parts = native.build_records(
+                arr_u8, got_sp[0], got_sp[1], lns, prefix, 5
+            )
+            if set(parts) != set(want_parts) or any(
+                parts[p][2] != want_parts[p][2]
+                or not np.array_equal(parts[p][0], want_parts[p][0])
+                for p in parts
+            ):
+                errors.append(f"thread {idx}: build_records diverged")
                 return
 
     threads = [threading.Thread(target=pound, args=(i,)) for i in range(4)]

@@ -1,0 +1,415 @@
+"""Native map-record pipeline (round 8): byte-identity pins.
+
+libdgrep's dgrep_unique_lines / dgrep_line_spans / dgrep_build_records
+collapse everything between kernel output and the partitioned mr-out
+slabs into one C pass.  Exactness story:
+
+* unique_lines: a linear merge over two sorted arrays — pinned against
+  np.unique(searchsorted) on random offsets.
+* line_spans: pinned against ops/lines.line_span per line, including the
+  no-trailing-newline and no-newline-at-all chunk shapes.
+* build_records: partition assignment must be bit-identical to
+  utils.native.partition on the formatted key (the reference ihash
+  contract runtime/columnar.partitions already pins — extended here to
+  the native entry), and the per-partition (linenos, offsets, slab)
+  triples must equal the numpy select()/gather chain exactly.
+* DeferredBatch: the lazy whole-buffer batch must materialize to the
+  eager batch and split identically through both the native and the
+  numpy paths; DGREP_NATIVE_RECORDS=0 must silence every native entry.
+
+The e2e test pins the whole route at job scale: mr-out files and display
+bytes with the native record entries on == all off, spill path included.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.ops.lines import (
+    line_of_offsets,
+    line_span,
+    newline_index,
+    unique_match_lines,
+)
+from distributed_grep_tpu.runtime import shuffle
+from distributed_grep_tpu.runtime.columnar import (
+    DeferredBatch,
+    LineBatch,
+    line_spans,
+    make_batch_from_lines,
+)
+from distributed_grep_tpu.utils import native
+from distributed_grep_tpu.utils.native import partition
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="libdgrep unavailable"
+)
+
+
+def _text(rng: random.Random, n: int, alphabet=b"abc de\nfgh") -> bytes:
+    return bytes(rng.choice(alphabet) for _ in range(n))
+
+
+def _disable_native_records(monkeypatch):
+    """Silence every native record entry (the numpy-fallback tree)."""
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.build_records",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.line_spans_native",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.unique_lines_native",
+        lambda *a, **k: None,
+    )
+
+
+# ------------------------------------------------------------ unique_lines
+
+def test_unique_lines_matches_searchsorted():
+    rng = random.Random(3)
+    data = _text(rng, 30000)
+    nl = newline_index(data)
+    for size in (1, 7, 500, 3000):
+        ends = np.array(
+            sorted(rng.sample(range(1, len(data) + 1), size)), np.int64
+        )
+        want = np.unique(line_of_offsets(ends, nl))
+        got = unique_match_lines(ends, nl)
+        assert np.array_equal(got, want)
+    assert unique_match_lines(np.zeros(0, np.int64), nl).size == 0
+
+
+def test_unique_lines_duplicate_offsets_same_line():
+    data = b"aaa\nbbb\nccc\n"
+    nl = newline_index(data)
+    ends = np.array([1, 2, 3, 3, 9, 10], np.int64)  # lines 1,1,1,1,3,3
+    assert unique_match_lines(ends, nl).tolist() == [1, 3]
+
+
+# -------------------------------------------------------------- line_spans
+
+@pytest.mark.parametrize("tail_newline", [True, False])
+def test_line_spans_matches_line_span(tail_newline):
+    rng = random.Random(5)
+    data = _text(rng, 20000)
+    data = data + b"\n" if tail_newline else data.rstrip(b"\n") + b"x"
+    nl = newline_index(data)
+    n_lines = nl.size + (0 if data.endswith(b"\n") else 1)
+    lns = np.arange(1, n_lines + 1, dtype=np.int64)
+    starts, ends = line_spans(lns, nl, len(data))
+    for i, ln in enumerate(lns.tolist()):
+        assert (starts[i], ends[i]) == line_span(nl, ln, len(data))
+
+
+def test_line_spans_no_newline_chunk():
+    s, e = line_spans(np.array([1], np.int64), np.zeros(0, np.uint64), 9)
+    assert (s[0], e[0]) == (0, 9)
+
+
+def test_line_spans_native_equals_numpy(monkeypatch):
+    rng = random.Random(11)
+    data = _text(rng, 8000)
+    nl = newline_index(data)
+    lns = np.array(sorted(rng.sample(range(1, nl.size), 200)), np.int64)
+    got = line_spans(lns, nl, len(data))
+    _disable_native_records(monkeypatch)
+    want = line_spans(lns, nl, len(data))
+    assert np.array_equal(got[0], want[0]) and np.array_equal(got[1], want[1])
+
+
+# ----------------------------------------------------------- build_records
+
+@pytest.mark.parametrize("fname", [
+    "/data/split-03.txt",
+    "weird \udcff\udc80 name",        # surrogateescaped raw bytes
+    "dir/uni-é中.txt",                 # multi-byte UTF-8
+    "",
+])
+def test_build_records_partition_bit_identical(fname):
+    """The shuffle contract, extended to the native entry: the one-pass
+    build must route every record exactly like utils.native.partition on
+    its formatted key (reference ihash semantics)."""
+    rng = random.Random(7)
+    data = _text(rng, 16000)
+    nl = newline_index(data)
+    n_lines = max(2, nl.size)
+    for base in (0, 10**13):
+        local = np.array(
+            sorted(rng.sample(range(1, n_lines), min(250, n_lines - 1))),
+            np.int64,
+        )
+        stored = local + base
+        starts, ends = line_spans(local, nl, len(data))
+        prefix = (fname + " (line number #").encode("utf-8", "surrogateescape")
+        for n_reduce in (1, 4, 97):
+            parts = native.build_records(
+                np.frombuffer(data, np.uint8), starts, ends, stored,
+                prefix, n_reduce,
+            )
+            assert parts is not None
+            seen = 0
+            for p, (lns, offs, slab) in parts.items():
+                assert offs[0] == 0 and offs[-1] == len(slab)
+                seen += lns.size
+                for ln in lns.tolist():
+                    key = f"{fname} (line number #{ln})"
+                    assert partition(key, n_reduce) == p, key
+            assert seen == stored.size
+
+
+def test_split_by_partition_native_equals_numpy(monkeypatch):
+    rng = random.Random(9)
+    data = _text(rng, 16000)
+    arr = np.frombuffer(data, np.uint8)
+    nl = newline_index(data)
+    local = np.array(sorted(rng.sample(range(1, nl.size), 300)), np.int64)
+    eager = make_batch_from_lines("f.txt", local, arr, nl, len(data))
+    deferred = DeferredBatch("f.txt", local, arr, nl, len(data))
+    got_e = eager.split_by_partition(8)
+    got_d = deferred.split_by_partition(8)
+    _disable_native_records(monkeypatch)
+    want = make_batch_from_lines(
+        "f.txt", local, arr, nl, len(data)
+    ).split_by_partition(8)
+    want_d = DeferredBatch(
+        "f.txt", local, arr, nl, len(data)
+    ).split_by_partition(8)
+    assert set(got_e) == set(want) == set(got_d) == set(want_d)
+    for p in want:
+        for got in (got_e[p], got_d[p], want_d[p]):
+            assert np.array_equal(got.linenos, want[p].linenos)
+            assert np.array_equal(got.offsets, want[p].offsets)
+            assert got.slab == want[p].slab
+
+
+def test_build_records_empty_and_malformed():
+    arr = np.frombuffer(b"abc\ndef\n", np.uint8)
+    assert native.build_records(
+        arr, np.zeros(0, np.int64), np.zeros(0, np.int64),
+        np.zeros(0, np.int64), b"f (line number #", 4,
+    ) == {}
+    # out-of-bounds span: refuse (numpy fallback would take over)
+    bad = native.build_records(
+        arr, np.array([0], np.int64), np.array([99], np.int64),
+        np.array([1], np.int64), b"f (line number #", 4,
+    )
+    assert bad is None
+
+
+def test_env_kill_switch(monkeypatch):
+    """DGREP_NATIVE_RECORDS=0 silences every native record entry — the
+    debug kill-switch registered in analysis/knobs.py."""
+    monkeypatch.setenv("DGREP_NATIVE_RECORDS", "0")
+    assert not native.env_native_records()
+    arr = np.frombuffer(b"abc\ndef\n", np.uint8)
+    assert native.build_records(
+        arr, np.array([0], np.int64), np.array([3], np.int64),
+        np.array([1], np.int64), b"f (line number #", 4,
+    ) is None
+    assert native.line_spans_native(
+        np.array([3], np.uint64), np.array([1], np.int64), 8
+    ) is None
+    assert native.unique_lines_native(
+        np.array([3], np.uint64), np.array([1], np.int64)
+    ) is None
+    monkeypatch.setenv("DGREP_NATIVE_RECORDS", "1")
+    assert native.env_native_records()
+
+
+# ---------------------------------------------------------- DeferredBatch
+
+def test_deferred_batch_materializes_to_eager():
+    rng = random.Random(13)
+    data = _text(rng, 6000)
+    arr = np.frombuffer(data, np.uint8)
+    nl = newline_index(data)
+    local = np.array(sorted(rng.sample(range(1, nl.size), 80)), np.int64)
+    eager = make_batch_from_lines("g", local, arr, nl, len(data),
+                                  lineno_base=500)
+    deferred = DeferredBatch("g", local, arr, nl, len(data), lineno_base=500)
+    assert isinstance(deferred, LineBatch)  # every consumer sees a batch
+    assert len(deferred) == len(eager)
+    assert np.array_equal(deferred.linenos, eager.linenos)
+    assert np.array_equal(deferred.offsets, eager.offsets)  # materializes
+    assert deferred.slab == eager.slab
+    assert deferred.to_keyvalues() == eager.to_keyvalues()
+    assert deferred.format_lines_bytes() == eager.format_lines_bytes()
+
+
+def test_deferred_batch_through_bucketize_matches_per_record():
+    rng = random.Random(17)
+    data = _text(rng, 6000)
+    arr = np.frombuffer(data, np.uint8)
+    nl = newline_index(data)
+    local = np.array(sorted(rng.sample(range(1, nl.size), 120)), np.int64)
+    deferred = DeferredBatch("/f.txt", local, arr, nl, len(data))
+    per_record = shuffle.bucketize(deferred.to_keyvalues(), 5)
+    columnar = shuffle.bucketize(
+        [DeferredBatch("/f.txt", local, arr, nl, len(data))], 5
+    )
+    assert set(per_record) == set(columnar)
+    for r in per_record:
+        expanded = []
+        for item in columnar[r]:
+            expanded.extend(item.to_keyvalues())
+        assert expanded == per_record[r], r
+
+
+def test_grep_tpu_emits_deferred_and_wire_roundtrips():
+    """The whole-bytes map path emits DeferredBatch records whose encoded
+    wire form equals the eager batch's (the shuffle writes them through
+    encode_records — materialization must be transparent there too)."""
+    from distributed_grep_tpu.apps import grep_tpu
+
+    grep_tpu.configure(pattern="fox", backend="cpu")
+    data = b"a fox\nno match\nfoxfox\nlast fox"
+    records = grep_tpu.map_fn("f.txt", data)
+    assert len(records) == 1 and isinstance(records[0], DeferredBatch)
+    enc = shuffle.encode_records(records)
+    back = shuffle.decode_records(enc)
+    assert len(back) == 1
+    assert back[0].to_keyvalues() == records[0].to_keyvalues()
+
+
+# ------------------------------------------------ batched -w/-x confirm
+
+def test_regex_confirm_batched_matches_cpu_app():
+    """The batched slab confirm (-w/-x over non-literal patterns) must
+    select exactly the lines the CPU app's per-line confirm selects.
+    ignore_case defeats the literal fast path, forcing the regex leg."""
+    from tests.conftest import expand_records
+
+    from distributed_grep_tpu.apps import grep as grep_cpu
+    from distributed_grep_tpu.apps import grep_tpu
+
+    data = (b"the fox\nTHE END\nbreathe\n the \nlast the" + b"\n"
+            b"xtheyx\nthe\n")
+    for mode in ({"word_regexp": True}, {"line_regexp": True}):
+        grep_cpu.configure(pattern="the", ignore_case=True, **mode)
+        grep_tpu.configure(pattern="the", ignore_case=True, backend="cpu",
+                           **mode)
+        assert grep_tpu._confirm is not None and grep_tpu._confirm_lit is None
+        want = expand_records(grep_cpu.map_fn("f", data))
+        got = expand_records(grep_tpu.map_fn("f", data))
+        assert got == want, mode
+
+
+# ------------------------------------------------------- ephemeral store
+
+def test_non_durable_store_skips_fsync_bytes_identical(tmp_path, monkeypatch):
+    """JobConfig.durable=False (the CLI's ephemeral temp workdirs) must
+    skip every blob fsync while producing byte-identical outputs; the
+    default stays fully durable."""
+    import os as _os
+
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    calls = {"n": 0}
+    real_fsync = _os.fsync
+
+    def counting(fd):
+        calls["n"] += 1
+        real_fsync(fd)
+
+    monkeypatch.setattr(_os, "fsync", counting)
+    src = tmp_path / "in.txt"
+    src.write_bytes(b"needle one\nplain\nneedle two\n" * 200)
+
+    def run(durable: bool, tag: str):
+        cfg = JobConfig(
+            application="distributed_grep_tpu.apps.grep_tpu",
+            input_files=[str(src)], work_dir=str(tmp_path / f"job-{tag}"),
+            n_reduce=3, journal=False, durable=durable,
+            app_options={"pattern": "needle", "backend": "cpu"},
+        )
+        calls["n"] = 0
+        res = run_job(cfg, n_workers=1)
+        return {p.name: p.read_bytes() for p in res.output_files}, calls["n"]
+
+    outs_d, fsyncs_d = run(True, "durable")
+    outs_e, fsyncs_e = run(False, "ephemeral")
+    assert outs_d == outs_e
+    assert fsyncs_d > 0 and fsyncs_e == 0
+
+
+def test_put_from_file_consume_renames_and_copies(tmp_path):
+    """consume=True commits by rename when allowed (src disappears) and
+    the blob bytes are identical either way; consume=False keeps src."""
+    from distributed_grep_tpu.runtime.store import PosixStore
+
+    for durable in (True, False):
+        store = PosixStore(durable=durable)
+        src = tmp_path / f"spool-{durable}"
+        src.write_bytes(b"payload-" + str(durable).encode())
+        dst = tmp_path / f"out-{durable}" / "mr-out-0"
+        store.put_from_file(dst, src, consume=True)
+        assert dst.read_bytes() == b"payload-" + str(durable).encode()
+        assert not src.exists()  # renamed, not copied
+    store = PosixStore()
+    src = tmp_path / "keep"
+    src.write_bytes(b"kept")
+    dst = tmp_path / "out-keep"
+    store.put_from_file(dst, src)
+    assert dst.read_bytes() == b"kept" and src.exists()
+
+
+# ------------------------------------------------------------------- e2e
+
+def test_job_output_native_records_vs_python_paths_with_spill(
+    tmp_path, monkeypatch
+):
+    """E2E: mr-out files AND display bytes are byte-identical with the
+    native record pipeline on vs EVERY native loop off — spill/extsort
+    path engaged via a tiny reduce cap (the acceptance contract, same
+    harness as test_native_merge.py's e2e)."""
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    rng = np.random.default_rng(33)
+    data = rng.integers(32, 127, size=4 << 20, dtype=np.uint8)
+    data[rng.integers(0, data.size, size=data.size // 50)] = 0x0A
+    needle = np.frombuffer(b"the", np.uint8)
+    for p in rng.integers(0, data.size - 8, size=40000):
+        data[p : p + 3] = needle
+    src = tmp_path / "corpus.bin"
+    src.write_bytes(data.tobytes())
+
+    def run(tag):
+        wd = tmp_path / f"job-{tag}"
+        cfg = JobConfig(
+            application="distributed_grep_tpu.apps.grep_tpu",
+            input_files=[str(src)],
+            work_dir=str(wd), n_reduce=4, journal=False,
+            reduce_memory_bytes=128 << 10,  # force spill runs
+            app_options={"pattern": "the", "backend": "cpu"},
+        )
+        res = run_job(cfg, n_workers=2)
+        outs = {p.name: p.read_bytes() for p in res.output_files}
+        disp = b"".join(res.display_blocks_sorted())
+        return outs, disp, res.metrics
+
+    outs_native, disp_native, m = run("native")
+    assert m["counters"].get("reduce_spills", 0) > 0, "spill did not engage"
+
+    _disable_native_records(monkeypatch)
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.gather_ranges_native",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.format_batch",
+        lambda *a, **k: None,
+    )
+    monkeypatch.setattr(
+        "distributed_grep_tpu.utils.native.merge_display", lambda bufs: None
+    )
+    outs_py, disp_py, _ = run("python")
+    assert outs_native == outs_py
+    assert disp_native == disp_py

@@ -314,6 +314,93 @@ def test_mini_soak_rolling_window(tmp_path):
 
 
 @pytest.mark.soak_mini
+def test_mini_soak_match_dense_native_records(tmp_path):
+    """Round-8 mini-soak leg: a MATCH-DENSE window through the native
+    map-record pipeline (DeferredBatch -> dgrep_build_records -> mr-out
+    slabs) with a mid-run crash + journal resume — the new record path
+    must stay crash/resume-exact.  Counts are pinned per split against a
+    generation-time GNU ``grep -c`` oracle; unlike the rolling-window leg
+    the corpus here is dense (~1 in 6 lines matches), so the record
+    build, partition split, and identity collation all run at real
+    volume across BOTH daemon lives.  Budget: < 60 s."""
+    import resource
+
+    from distributed_grep_tpu.runtime.job import run_job
+    from distributed_grep_tpu.runtime.worker import WorkerKilled
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    split_bytes = 3_000_000
+    n_splits = 10
+    rng = np.random.default_rng(41)
+    files = []
+    oracle: dict[str, int] = {}
+    t_all = time.perf_counter()
+    for i in range(n_splits):
+        block = rng.integers(97, 123, size=split_bytes, dtype=np.uint8)
+        block[rng.integers(0, block.size, size=block.size // 8)] = 0x20
+        block[rng.integers(0, block.size, size=block.size // 45)] = 0x0A
+        # dense plant: ~1 needle site per ~300 bytes -> ~1 in 6 lines
+        for pos in rng.integers(0, block.size - 64, size=block.size // 300):
+            block[pos : pos + len(NEEDLE)] = np.frombuffer(NEEDLE, np.uint8)
+        p = tmp_path / f"dense{i:02d}.bin"
+        p.write_bytes(block.tobytes())
+        with open(p, "rb") as fh:
+            out = subprocess.run(
+                ["grep", "-c", "-a", NEEDLE.decode()], stdin=fh,
+                capture_output=True, text=True,
+            )
+        oracle[str(p)] = int(out.stdout.strip() or 0)
+        files.append(str(p))
+    assert sum(oracle.values()) > 50_000, "corpus not dense enough to count"
+
+    cfg = JobConfig(
+        input_files=files,
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": NEEDLE.decode(), "backend": "cpu"},
+        n_reduce=4,
+        work_dir=str(tmp_path / "job"),
+        task_timeout_s=30.0,
+        sweep_interval_s=0.2,
+    )
+
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    kill_after = max(1, n_splits // 3)
+    done = {"n": 0}
+
+    def die_midway():
+        done["n"] += 1
+        if done["n"] > kill_after:
+            raise WorkerKilled()
+
+    # Phase 1 — crash mid-corpus after ~1/3 of the maps committed.
+    with pytest.raises(RuntimeError, match="all workers exited"):
+        run_job(cfg, n_workers=1,
+                fault_hooks_per_worker=[{"before_map_finished": die_midway}])
+    # Phase 2 — journal resume completes only the uncommitted remainder.
+    res = run_job(cfg, n_workers=2, resume=True)
+    assigned = res.metrics["counters"]["map_assigned"]
+    assert assigned <= n_splits - kill_after, (
+        f"resume re-ran completed work: {assigned} assigned after "
+        f"{kill_after} were journaled"
+    )
+
+    from distributed_grep_tpu.runtime.job import GREP_KEY_RE
+
+    counts = dict.fromkeys(files, 0)
+    for key, _v in res.iter_results():
+        m = GREP_KEY_RE.match(key)
+        assert m and m.group(1) in counts
+        counts[m.group(1)] += 1
+    assert counts == oracle
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    wall = time.perf_counter() - t_all
+    print(f"\nmini-soak dense: {n_splits * split_bytes / 1e6:.0f} MB, "
+          f"{sum(oracle.values())} matched lines exact across a crash+resume "
+          f"in {wall:.0f}s, RSS growth {(rss1 - rss0) / 1024:.0f} MB")
+    assert wall < 60, f"dense mini-soak over its time budget: {wall:.0f}s"
+
+
+@pytest.mark.soak_mini
 def test_mini_soak_daemon_kill_and_restart(tmp_path):
     """Round-10 mini-soak leg: a REAL ``dgrep serve`` daemon (subprocess,
     its own in-process workers) is SIGKILLed mid-window and restarted
