@@ -21,31 +21,11 @@ import sys
 from distributed_grep_tpu.utils.config import JobConfig
 
 
-def _has_backref(rx: str) -> bool:
-    """True if the regex uses any group-number-sensitive construct: a
-    numeric (\\1) or named ((?P=name)) backreference, or a conditional
-    group test ((?(1)...)).  Walks re's own parse tree rather than
-    scanning text, so octal escapes inside character classes ("[\\1]") and
-    literal '(?P=' inside classes are not false positives.  Only called
-    on patterns re.compile already accepted."""
-    try:
-        import re._parser as parser  # 3.11+
-    except ImportError:
-        import sre_parse as parser  # 3.10: same tree, pre-rename module
-
-    def walk(node) -> bool:
-        if isinstance(node, parser.SubPattern):
-            return any(walk(item) for item in node)
-        if isinstance(node, tuple):
-            op = node[0]
-            if op in (parser.GROUPREF, parser.GROUPREF_EXISTS):
-                return True
-            return any(walk(x) for x in node[1:])
-        if isinstance(node, list):
-            return any(walk(x) for x in node)
-        return False
-
-    return walk(parser.parse(rx))
+# group-number-sensitivity check (backreferences / conditional group
+# tests, which do not survive being joined into an alternation): ONE
+# definition, shared with the scan-fusion eligibility guard — re-homed
+# to runtime/fusion.py (ops-free, CLI-importable) in round 13
+from distributed_grep_tpu.runtime.fusion import has_backref as _has_backref
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -215,6 +195,123 @@ def _grep_stdin_stream(args: argparse.Namespace, patterns) -> int:
     return finish(rc)
 
 
+def _resolve_pattern_args(args: argparse.Namespace) -> tuple[int, list | None]:
+    """Resolve -e/-f/-F/-E plus the positional PATTERN slot into the
+    engine-facing query — the ONE front half shared by cmd_grep and
+    cmd_submit (service tenants must be able to submit the same
+    multi-pattern jobs the local CLI runs; ISSUE 11 satellite).  Returns
+    (0, patterns) on success — ``args.pattern`` then holds the
+    single-pattern form (possibly a joined alternation), ``patterns``
+    the literal set (grep -F / plain -f) — or (2, None) after printing
+    the GNU-shaped diagnostic.  Mutates args like GNU's option rules: a
+    positional PATTERN displaced by -e/-f parses as the first input
+    file."""
+    import re
+    from pathlib import Path
+
+    patterns: list[str] | None = None
+    if args.e_patterns:
+        # like grep: -e supplies the pattern(s); the positional slot, if
+        # used, parses as the first input file
+        if args.pattern is not None:
+            args.files.insert(0, args.pattern)
+            args.pattern = None
+        if args.patterns_file:
+            print("error: use -e or -f, not both", file=sys.stderr)
+            return 2, None
+        if args.fixed_strings:
+            # literal set -> set engines; like grep -F, an embedded newline
+            # separates alternative patterns
+            patterns = [p for e in args.e_patterns for p in e.split("\n")]
+        elif len(args.e_patterns) == 1:
+            args.pattern = args.e_patterns[0]
+        else:
+            for rx in args.e_patterns:
+                try:
+                    _validate_regex(rx)
+                except re.error as e:
+                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
+                    return 2, None
+            if any(_has_backref(rx) for rx in args.e_patterns):
+                print("error: -e patterns use backreferences, which do not "
+                      "survive being joined into one alternation",
+                      file=sys.stderr)
+                return 2, None
+            args.pattern = "(?:" + "|".join(
+                f"(?:{rx})" for rx in args.e_patterns) + ")"
+    elif args.fixed_strings and args.pattern is not None:
+        if "\n" in args.pattern:
+            patterns = args.pattern.split("\n")  # grep -F: newline = OR
+        else:
+            args.pattern = re.escape(args.pattern)
+    if args.patterns_file:
+        if args.pattern is not None:
+            # like grep: -f replaces the positional pattern, which then
+            # parses as the first input file
+            args.files.insert(0, args.pattern)
+            args.pattern = None
+        pf = Path(args.patterns_file)
+        if not pf.exists():
+            print(f"error: no such file: {args.patterns_file}", file=sys.stderr)
+            return 2, None
+        # bytes + surrogateescape: pattern files need not be UTF-8 (the apps
+        # re-encode with surrogateescape, so arbitrary bytes round-trip).
+        # Split on \n only — splitlines() would also split on \r/\v/\f/\x85
+        # and silently fragment literal patterns containing those bytes.
+        raw = pf.read_bytes().split(b"\n")
+        if raw and raw[-1] == b"":
+            raw.pop()  # a trailing newline is a terminator, not an empty pattern
+        if not raw:
+            print(f"error: empty pattern file: {args.patterns_file}", file=sys.stderr)
+            return 2, None
+        if any(not ln for ln in raw):
+            # grep -f: an empty pattern line matches every line
+            patterns = None
+            args.pattern = ""
+        elif args.extended_regexp:
+            # grep -E -f: each line is a regex; the set is their alternation,
+            # compiled by the single-pattern engines (NFA/DFA)
+            decoded = [ln.decode("utf-8", "surrogateescape") for ln in raw]
+            for rx in decoded:
+                try:
+                    _validate_regex(rx)
+                except re.error as e:
+                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
+                    return 2, None
+            if len(decoded) > 1 and any(_has_backref(rx) for rx in decoded):
+                # Joining lines into one alternation offsets group numbers
+                # by the capturing groups of earlier lines, so a line's
+                # backreference would silently point at another line's
+                # group.  re.compile can't catch the semantic change.
+                print(
+                    "error: -E -f pattern lines use backreferences, which "
+                    "do not survive being joined into one alternation; "
+                    "run such patterns individually",
+                    file=sys.stderr,
+                )
+                return 2, None
+            patterns = None
+            # non-capturing groups: wrapping with (..) would renumber any
+            # backreferences inside the lines (the device subset compiler
+            # parses (?:..) too, models/dfa.py)
+            args.pattern = "(?:" + "|".join(f"(?:{rx})" for rx in decoded) + ")"
+        else:
+            patterns = [ln.decode("utf-8", "surrogateescape") for ln in raw]
+    if args.pattern is None and patterns is None:
+        print("error: need a PATTERN or -f FILE", file=sys.stderr)
+        return 2, None
+    # validate any single-pattern path — including the -E -f alternation,
+    # whose wrapping can break group-sensitive regexes (backreferences)
+    # even when every line compiled on its own
+    if patterns is None and args.pattern is not None:
+        try:
+            _validate_regex(args.pattern)
+        except re.error as e:
+            print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
+            return 2, None
+    return 0, patterns
+
+
 def cmd_grep(args: argparse.Namespace) -> int:
     import re
     from pathlib import Path
@@ -237,106 +334,9 @@ def cmd_grep(args: argparse.Namespace) -> int:
         print("error: -w/-x are not supported with --max-errors (approximate "
               "matches have no exact boundaries)", file=sys.stderr)
         return 2
-    patterns: list[str] | None = None
-    if args.e_patterns:
-        # like grep: -e supplies the pattern(s); the positional slot, if
-        # used, parses as the first input file
-        if args.pattern is not None:
-            args.files.insert(0, args.pattern)
-            args.pattern = None
-        if args.patterns_file:
-            print("error: use -e or -f, not both", file=sys.stderr)
-            return 2
-        if args.fixed_strings:
-            # literal set -> set engines; like grep -F, an embedded newline
-            # separates alternative patterns
-            patterns = [p for e in args.e_patterns for p in e.split("\n")]
-        elif len(args.e_patterns) == 1:
-            args.pattern = args.e_patterns[0]
-        else:
-            for rx in args.e_patterns:
-                try:
-                    _validate_regex(rx)
-                except re.error as e:
-                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
-                    return 2
-            if any(_has_backref(rx) for rx in args.e_patterns):
-                print("error: -e patterns use backreferences, which do not "
-                      "survive being joined into one alternation",
-                      file=sys.stderr)
-                return 2
-            args.pattern = "(?:" + "|".join(
-                f"(?:{rx})" for rx in args.e_patterns) + ")"
-    elif args.fixed_strings and args.pattern is not None:
-        if "\n" in args.pattern:
-            patterns = args.pattern.split("\n")  # grep -F: newline = OR
-        else:
-            args.pattern = re.escape(args.pattern)
-    if args.patterns_file:
-        if args.pattern is not None:
-            # like grep: -f replaces the positional pattern, which then
-            # parses as the first input file
-            args.files.insert(0, args.pattern)
-            args.pattern = None
-        pf = Path(args.patterns_file)
-        if not pf.exists():
-            print(f"error: no such file: {args.patterns_file}", file=sys.stderr)
-            return 2
-        # bytes + surrogateescape: pattern files need not be UTF-8 (the apps
-        # re-encode with surrogateescape, so arbitrary bytes round-trip).
-        # Split on \n only — splitlines() would also split on \r/\v/\f/\x85
-        # and silently fragment literal patterns containing those bytes.
-        raw = pf.read_bytes().split(b"\n")
-        if raw and raw[-1] == b"":
-            raw.pop()  # a trailing newline is a terminator, not an empty pattern
-        if not raw:
-            print(f"error: empty pattern file: {args.patterns_file}", file=sys.stderr)
-            return 2
-        if any(not ln for ln in raw):
-            # grep -f: an empty pattern line matches every line
-            patterns = None
-            args.pattern = ""
-        elif args.extended_regexp:
-            # grep -E -f: each line is a regex; the set is their alternation,
-            # compiled by the single-pattern engines (NFA/DFA)
-            decoded = [ln.decode("utf-8", "surrogateescape") for ln in raw]
-            for rx in decoded:
-                try:
-                    _validate_regex(rx)
-                except re.error as e:
-                    print(f"error: invalid pattern {rx!r}: {e}", file=sys.stderr)
-                    return 2
-            if len(decoded) > 1 and any(_has_backref(rx) for rx in decoded):
-                # Joining lines into one alternation offsets group numbers
-                # by the capturing groups of earlier lines, so a line's
-                # backreference would silently point at another line's
-                # group.  re.compile can't catch the semantic change.
-                print(
-                    "error: -E -f pattern lines use backreferences, which "
-                    "do not survive being joined into one alternation; "
-                    "run such patterns individually",
-                    file=sys.stderr,
-                )
-                return 2
-            patterns = None
-            # non-capturing groups: wrapping with (..) would renumber any
-            # backreferences inside the lines (the device subset compiler
-            # parses (?:..) too, models/dfa.py)
-            args.pattern = "(?:" + "|".join(f"(?:{rx})" for rx in decoded) + ")"
-        else:
-            patterns = [ln.decode("utf-8", "surrogateescape") for ln in raw]
-    if args.pattern is None and patterns is None:
-        print("error: need a PATTERN or -f FILE", file=sys.stderr)
-        return 2
-    # validate any single-pattern path — including the -E -f alternation,
-    # whose wrapping can break group-sensitive regexes (backreferences)
-    # even when every line compiled on its own
-    if patterns is None and args.pattern is not None:
-        try:
-            _validate_regex(args.pattern)
-        except re.error as e:
-            print(f"error: invalid pattern {args.pattern!r}: {e}", file=sys.stderr)
-            return 2
+    rc, patterns = _resolve_pattern_args(args)
+    if rc:
+        return rc
     import os as _os
 
     if args.max_errors:
@@ -1136,21 +1136,36 @@ def cmd_submit(args: argparse.Namespace) -> int:
 
     if args.config:
         cfg = JobConfig.load(args.config)
-    elif args.pattern is not None and args.files:
+    elif args.pattern is not None or args.e_patterns or args.patterns_file:
+        if args.fixed_strings and args.extended_regexp:
+            print("error: -E and -F are conflicting matchers",
+                  file=sys.stderr)
+            return 2
+        # pattern-set parity with the local CLI (ISSUE 11 satellite): -e
+        # PAT -e PAT / -f patfile / -F newline-sets resolve through the
+        # SAME front half cmd_grep uses, so service tenants can submit
+        # the multi-pattern jobs the fusion layer serves
+        rc, patterns = _resolve_pattern_args(args)
+        if rc:
+            return rc
+        if not args.files:
+            print("error: need FILE arguments to submit", file=sys.stderr)
+            return 2
         from pathlib import Path as _Path
 
         cfg = JobConfig(
             input_files=[str(_Path(f).resolve()) for f in args.files],
             application="distributed_grep_tpu.apps.grep_tpu",
             app_options={
-                "pattern": args.pattern,
                 "backend": args.backend,
                 **({"ignore_case": True} if args.ignore_case else {}),
+                **({"patterns": patterns} if patterns
+                   else {"pattern": args.pattern}),
             },
             n_reduce=args.n_reduce or 10,
         )
     else:
-        print("error: need --config, or PATTERN and FILE arguments",
+        print("error: need --config, or PATTERN/-e/-f and FILE arguments",
               file=sys.stderr)
         return 2
     def call(method: str, path: str, body: bytes | None = None) -> dict:
@@ -1461,6 +1476,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("pattern", nargs="?", default=None)
     p.add_argument("files", nargs="*")
     p.add_argument("-i", "--ignore-case", action="store_true")
+    # pattern-set parity with the local grep CLI (same resolution front
+    # half, _resolve_pattern_args): multi-pattern submits are what the
+    # scan-fusion layer and the FDR set engines serve
+    p.add_argument("-e", "--regexp", action="append", default=None,
+                   metavar="PATTERN", dest="e_patterns",
+                   help="pattern(s); repeatable — the job runs their union")
+    p.add_argument("-f", "--patterns-file", default=None,
+                   help="newline-separated pattern file (like grep -f)")
+    p.add_argument("-F", "--fixed-strings", action="store_true",
+                   help="treat PATTERN / -e patterns as literal strings")
+    p.add_argument("-E", "--extended-regexp", action="store_true",
+                   help="with -f: treat pattern lines as regexes "
+                        "(joined alternation)")
     p.add_argument("--backend", default="cpu", choices=["cpu", "device"],
                    help="engine backend for the PATTERN/FILE form (default "
                         "cpu: host scanners, no jax import on the workers; "

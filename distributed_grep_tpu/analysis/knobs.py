@@ -157,6 +157,22 @@ KNOBS: dict[str, Knob] = {
         "numpy fallbacks then serve every call, byte-identical "
         "(accessor: utils/native.env_native_records).  Debug kill-switch.",
     ),
+    "DGREP_SERVICE_FUSE": Knob(
+        "runtime/fusion.py", "1",
+        "Cross-tenant scan fusion of the service daemon (round 13): "
+        "co-running print-mode grep jobs over content-identical splits "
+        "share ONE worker scan per split; 0/false disables planning "
+        "entirely — wire payloads, journals, and outputs then match the "
+        "pre-fusion daemon byte for byte (accessor: "
+        "runtime/fusion.env_service_fuse).",
+    ),
+    "DGREP_FUSE_MAX_QUERIES": Knob(
+        "runtime/fusion.py", "8",
+        "Queries per fused attempt cap: bounds the union automaton's "
+        "size and the blast radius of one lost worker (each re-enqueued "
+        "participant re-runs solo; accessor: "
+        "runtime/fusion.env_fuse_max_queries).",
+    ),
     "DGREP_NATIVE_LIB": Knob(
         "utils/native.py", "unset",
         "Absolute path of the libdgrep build to load instead of "

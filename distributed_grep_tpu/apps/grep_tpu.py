@@ -212,29 +212,138 @@ def map_batch_fn(items) -> list[KeyValue]:
     return records
 
 
-def _records_for(filename: str, contents: bytes, result) -> list[KeyValue]:
+class _EmitOpts:
+    """Per-query post-scan options — the module globals configure() sets,
+    reified so the fused path (map_fused_fn) can run K queries' record
+    builds side by side without reconfiguring the module."""
+
+    __slots__ = ("confirm", "confirm_lit", "confirm_mode", "invert",
+                 "count_only")
+
+    def __init__(self, confirm, confirm_lit, confirm_mode, invert,
+                 count_only):
+        self.confirm = confirm
+        self.confirm_lit = confirm_lit
+        self.confirm_mode = confirm_mode
+        self.invert = invert
+        self.count_only = count_only
+
+
+def _module_emit_opts() -> _EmitOpts:
+    return _EmitOpts(_confirm, _confirm_lit, _confirm_mode, _invert,
+                     _count_only)
+
+
+# app-level option keys configure() consumes itself; everything else in
+# app_options is an engine kwarg (the fused path rebuilds the same split)
+_APP_OPTION_KEYS = frozenset((
+    "pattern", "patterns", "ignore_case", "invert", "word_regexp",
+    "line_regexp", "count_only", "presence_only", "max_errors",
+    "backend", "devices", "mesh_shape", "mesh_axes", "pattern_axis",
+))
+
+
+def map_fused_fn(items, participants) -> list[list[KeyValue]]:
+    """Cross-tenant fused map (round 13): K co-tenant queries over ONE
+    shared split — one union scan per packed window (ops/fuse.py), then
+    each participant's own post-scan semantics (-w/-x confirm, -v,
+    record build) over its exact per-query results.  ``participants``
+    carry each tenant's app_options and member names (two tenants may
+    address the same content through different paths); returns one
+    record list per participant, each bit-identical to that
+    participant's solo map_batch_fn over the same content.  Raises
+    ops/fuse.FuseError for specs the union cannot host — the worker then
+    falls back to solo per-participant execution."""
+    from distributed_grep_tpu.apps.grep import build_confirm
+    from distributed_grep_tpu.ops import fuse as fuse_mod
+    from distributed_grep_tpu.runtime.fusion import query_spec
+
+    items = list(items)
+    specs = []
+    opt_sets = []
+    for p in participants:
+        o = dict(p.get("app_options") or {})
+        spec = query_spec(o)
+        if spec is None:
+            raise fuse_mod.FuseError(
+                f"participant {p.get('job_id')!r} query is not fusable"
+            )
+        specs.append(spec)
+        opt_sets.append(o)
+    base = opt_sets[0]
+    engine_kw = {k: v for k, v in base.items() if k not in _APP_OPTION_KEYS}
+    backend = base.get("backend", "device")
+    if backend == "device":
+        engine_kw["devices"] = base.get("devices", "all")
+    scanner = fuse_mod.FusedScanner(specs, backend=backend, **engine_kw)
+    emit_opts = []
+    names_per: list[list | None] = []
+    for p, o in zip(participants, opt_sets):
+        mode = (
+            "line" if o.get("line_regexp")
+            else "word" if o.get("word_regexp") else "search"
+        )
+        confirm = build_confirm(
+            pattern=o.get("pattern"), patterns=o.get("patterns"),
+            ignore_case=bool(o.get("ignore_case")), mode=mode,
+        )
+        # no confirm-literal fast path here: it needs the participant's
+        # solo engine; the regex confirm is bit-identical, and fused
+        # attempts see only this split's candidate lines anyway
+        emit_opts.append(_EmitOpts(confirm, None, mode,
+                                   bool(o.get("invert")),
+                                   bool(o.get("count_only"))))
+        nm = list(p.get("filenames") or [])
+        if not nm and p.get("filename"):
+            nm = [p["filename"]]
+        if len(nm) != len(items):
+            # fail SAFE, never silently key this tenant's records by the
+            # primary's paths: FuseError makes the worker fall back to
+            # per-participant solo execution (each with its own names)
+            raise fuse_mod.FuseError(
+                f"participant {p.get('job_id')!r} has {len(nm)} member "
+                f"names for a {len(items)}-item split"
+            )
+        names_per.append(nm)
+    outs: list[list[KeyValue]] = [[] for _ in participants]
+
+    def emit(i, name, data, results, nl) -> None:
+        for k, res in enumerate(results):
+            outs[k].extend(_records_for(names_per[k][i], data, res,
+                                        opts=emit_opts[k], nl=nl))
+
+    scanner.scan_batch(items, progress=_progress_fn(), emit=emit)
+    return outs
+
+
+def _records_for(filename: str, contents: bytes, result,
+                 opts: _EmitOpts | None = None, nl=None) -> list[KeyValue]:
     """Everything after the scan — -w/-x confirm, -v, count/presence
     collapse, columnar batch build — shared by map_fn (one scan per call)
     and map_batch_fn (one packed scan, per-file demuxed results).  Runs
     under its own ``map:emit`` span so trace-export separates scan time
-    from record-build time on the worker row."""
+    from record-build time on the worker row.  ``nl`` is an optional
+    precomputed newline index of ``contents`` (the fused path hands one
+    shared index to K participants' record builds)."""
     with _spans_mod.span("map:emit", cat="map"):
-        return _records_for_inner(filename, contents, result)
+        return _records_for_inner(filename, contents, result,
+                                  opts or _module_emit_opts(), nl=nl)
 
 
-def _records_for_inner(filename: str, contents: bytes, result) -> list[KeyValue]:
+def _records_for_inner(filename: str, contents: bytes, result,
+                       o: _EmitOpts, nl=None) -> list[KeyValue]:
     emit = result.matched_lines  # int64 ndarray, stays vectorized throughout
-    nl = None
-    if _confirm is not None and emit.size:
-        nl = newline_index(contents)
-        if _confirm_lit is not None:
+    if o.confirm is not None and emit.size:
+        if nl is None:
+            nl = newline_index(contents)
+        if o.confirm_lit is not None:
             # literal -w/-x: vectorized boundary confirm — the selected
             # lines are computed directly (they are a subset of the
             # engine's occurrence lines by construction)
             from distributed_grep_tpu.apps.grep import literal_mode_lines
 
             sel = literal_mode_lines(
-                contents, _confirm_lit, _confirm_mode, nl
+                contents, o.confirm_lit, o.confirm_mode, nl
             )
             emit = _np.intersect1d(emit, sel)
         else:
@@ -255,15 +364,15 @@ def _records_for_inner(filename: str, contents: bytes, result) -> list[KeyValue]
             def confirmed():
                 for i in range(emit.size):
                     _stamp_every(progress, i)  # -w/-x over dense candidates
-                    yield _confirm.search(mv[s_l[i] : e_l[i]]) is not None
+                    yield o.confirm.search(mv[s_l[i] : e_l[i]]) is not None
 
             keep = _np.fromiter(confirmed(), dtype=bool, count=emit.size)
             emit = emit[keep]
-    if _invert:
+    if o.invert:
         emit = _np.setdiff1d(
             _np.arange(1, count_lines(contents) + 1, dtype=_np.int64), emit
         )
-    if _count_only:
+    if o.count_only:
         return [KeyValue(key=filename, value=str(int(emit.size)))]
     if not emit.size:
         return []

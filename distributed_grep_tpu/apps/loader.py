@@ -51,6 +51,13 @@ class LoadedApplication:
     # materialize their value list (runtime/extsort.py); must agree with
     # reduce_fn on every input
     reduce_stream_fn: Callable[[str, Any], str] | None = None
+    # optional fused entry (cross-tenant scan fusion, runtime/fusion.py +
+    # ops/fuse.py): map_fused_fn(items, participants) scans the split
+    # ONCE for K co-tenant queries and returns one record list per
+    # participant — each bit-identical to that participant's own
+    # map_batch_fn over the same items.  ``participants`` carry each
+    # tenant's app_options and member names.
+    map_fused_fn: Callable[[list, list], list] | None = None
 
     def configure(self, **options: Any) -> None:
         hook = getattr(self.module, "configure", None)
@@ -126,6 +133,7 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
     map_path_fn = getattr(module, "map_path_fn", None)
     map_batch_fn = getattr(module, "map_batch_fn", None)
     reduce_stream_fn = getattr(module, "reduce_stream_fn", None)
+    map_fused_fn = getattr(module, "map_fused_fn", None)
     app = LoadedApplication(
         name=spec,
         map_fn=map_fn,
@@ -136,6 +144,7 @@ def load_application(spec: str, **options: Any) -> LoadedApplication:
         map_batch_paths=bool(getattr(module, "map_batch_paths", False))
         and callable(map_batch_fn),
         reduce_stream_fn=reduce_stream_fn if callable(reduce_stream_fn) else None,
+        map_fused_fn=map_fused_fn if callable(map_fused_fn) else None,
     )
     if options:
         app.configure(**options)

@@ -1,0 +1,310 @@
+"""Cross-query scan fusion: ONE device scan answers K grep queries.
+
+The service regime (runtime/service.py) sees a STREAM of jobs, and at
+"millions of users" the query mix over a hot corpus is the common case —
+K tenants grepping the same warm shards previously paid K full scans.
+This module is the engine half of the fusion layer (runtime/fusion.py is
+the planning half): a ``FusedScanner`` takes K query specs, compiles ONE
+union engine, runs ONE dispatch per chunk/packed window through the
+existing pipeline (device kernels, cross-file batching, the device
+corpus cache — all unchanged), and then restores each query's EXACT
+result with a per-query confirm over the shared candidate lines.
+
+Correctness rides the repo's core invariant: device filters may
+over-approximate, because the per-line host confirm restores exactness.
+The union engine's matched lines are a SUPERSET of every member query's
+matched lines —
+
+* alternation: a line matching query k matches the union branch k;
+* ignore-case mixes: the union compiles with ``ignore_case=True`` when
+  ANY member asks for it — a deliberate over-approximation for the
+  case-sensitive members (more candidates, never fewer);
+* empty-match members make the union match the empty string too, so the
+  engine's match-everything leg reports every line;
+
+— and the per-query confirm is an EXACT host engine (backend="cpu":
+native AC/DFA banks, memmem, or the re loop) scanned over a compact slab
+of only the candidate lines.  Slab line i is candidate line i verbatim
+(newline-terminated, so per-line semantics — '^', '$', empty lines —
+are preserved), which makes the mapping back to source line numbers pure
+arithmetic.  Each query's fused result is therefore bit-identical to a
+solo scan of that query (pinned across kernel families in
+tests/test_fuse.py).
+
+Fusion is a FAST PATH, never a correctness dependency: any spec the
+union builder cannot host (empty patterns, backreference-bearing
+regexes, approx queries) raises ``FuseError`` and the caller falls back
+to per-query solo scans.
+"""
+
+from __future__ import annotations
+
+import re as _re
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.ops import lines as lines_mod
+from distributed_grep_tpu.ops.engine import GrepEngine, ScanResult, cached_engine
+from distributed_grep_tpu.utils import lockdep
+
+
+class FuseError(ValueError):
+    """These specs cannot share one union scan — scan them solo."""
+
+
+@dataclass(frozen=True)
+class QuerySpec:
+    """One fused query: exactly one of pattern/patterns, plus its case
+    flag.  ``patterns`` members are literal strings (grep -F semantics);
+    ``pattern`` is a regex in the engine dialect."""
+
+    pattern: str | None = None
+    patterns: tuple[str, ...] | None = None
+    ignore_case: bool = False
+
+    @staticmethod
+    def normalize(spec) -> "QuerySpec":
+        """Accept a QuerySpec or a (pattern, patterns, ignore_case)
+        tuple (the shape runtime/fusion.query_spec emits)."""
+        if isinstance(spec, QuerySpec):
+            s = spec
+        else:
+            pat, pats, ic = spec
+            s = QuerySpec(
+                pattern=pat,
+                patterns=tuple(pats) if pats is not None else None,
+                ignore_case=bool(ic),
+            )
+        if (s.pattern is None) == (s.patterns is None):
+            raise FuseError("spec needs exactly one of pattern/patterns")
+        if s.pattern is not None and not s.pattern:
+            # the empty pattern matches everything; a solo scan answers it
+            # without scanning — fusing it would only grow the union
+            raise FuseError("empty pattern is not fusable")
+        if s.patterns is not None and (
+            not s.patterns or any(p == "" for p in s.patterns)
+        ):
+            raise FuseError("empty literal in pattern set is not fusable")
+        return s
+
+
+def union_engine_args(specs: list[QuerySpec]) -> dict:
+    """Construction args of the UNION engine for these specs.
+
+    All-literal-set specs merge into one pattern set (the FDR/pairset/
+    AC-bank machinery is already a multi-literal union engine — and
+    exactly what the model cache keys on); any regex member switches to
+    one alternation pattern, literals re.escape'd into branches (the
+    engine dialect parses escaped metacharacters and ``(?:``, the same
+    forms the CLI's -e/-F joins already emit).  ``ignore_case`` is the
+    OR over members — a superset for case-sensitive members, which the
+    per-query confirm undoes."""
+    ic_any = any(s.ignore_case for s in specs)
+    if all(s.patterns is not None for s in specs):
+        merged: list[str] = []
+        seen: set[str] = set()
+        for s in specs:
+            for p in s.patterns:  # type: ignore[union-attr]
+                if p not in seen:
+                    seen.add(p)
+                    merged.append(p)
+        return {"patterns": merged, "ignore_case": ic_any}
+    # Backreference guard (the documented FuseError, enforced for direct
+    # API users too — the service planner pre-filters via the same
+    # helper): joining a group-number-sensitive regex into an alternation
+    # silently repoints its groups, breaking the union-superset invariant
+    # the whole design rests on.  runtime/fusion is deliberately
+    # ops-free, so the import runs this direction.
+    from distributed_grep_tpu.runtime.fusion import has_backref
+
+    branches: list[str] = []
+    for s in specs:
+        if s.patterns is not None:
+            branches.extend(_re.escape(p) for p in s.patterns)
+        else:
+            if has_backref(s.pattern):  # type: ignore[arg-type]
+                raise FuseError(
+                    f"pattern {s.pattern!r} uses backreferences — it "
+                    f"cannot join an alternation union"
+                )
+            branches.append(s.pattern)  # type: ignore[arg-type]
+    return {
+        "pattern": "(?:" + "|".join(f"(?:{b})" for b in branches) + ")",
+        "ignore_case": ic_any,
+    }
+
+
+# ----------------------------------------------------- fusion telemetry
+# Module-level counters, the model-cache/corpus-cache contract: {} while
+# untouched (zero-activity processes never grow stats/piggyback keys),
+# merged into the worker heartbeat piggyback by
+# runtime/worker._engine_cache_counters (sys.modules-gated there).
+_fuse_stats_lock = lockdep.make_lock("fuse-stats")
+_fuse_stats = {
+    "fused_queries": 0,     # query-scans answered by shared dispatches
+    "fused_dispatches": 0,  # union scan passes that served K >= 2 queries
+    "fused_dispatches_saved": 0,  # (K-1) x passes co-queries did not pay
+    "fusion_bytes_saved": 0,  # (K-1) x bytes each fused pass scanned once
+}
+
+
+def fusion_counters() -> dict:
+    with _fuse_stats_lock:
+        if not any(_fuse_stats.values()):
+            return {}
+        return dict(_fuse_stats)
+
+
+def fusion_counters_clear() -> None:
+    with _fuse_stats_lock:
+        for k in _fuse_stats:
+            _fuse_stats[k] = 0
+
+
+def _count_fusion(n_queries: int, dispatches: int, n_bytes: int) -> None:
+    if n_queries < 2:
+        return
+    with _fuse_stats_lock:
+        _fuse_stats["fused_queries"] += n_queries
+        _fuse_stats["fused_dispatches"] += dispatches
+        _fuse_stats["fused_dispatches_saved"] += (n_queries - 1) * dispatches
+        _fuse_stats["fusion_bytes_saved"] += (n_queries - 1) * n_bytes
+
+
+class FusedScanner:
+    """K queries, one scan.  Construction compiles the union engine and
+    one exact CPU confirm engine per query, all through the cross-job
+    model cache (a warm daemon re-fusing the same tenant mix pays zero
+    compiles).  ``engine_opts`` are the SHARED engine kwargs (backend,
+    devices, interpret, batch_bytes, ...) — the planner guarantees the
+    fused jobs agree on them (runtime/fusion.fusion_key)."""
+
+    def __init__(self, specs, **engine_opts):
+        self.specs = [QuerySpec.normalize(s) for s in specs]
+        if not self.specs:
+            raise FuseError("no specs")
+        if engine_opts.get("mesh") is not None or engine_opts.get("max_errors"):
+            raise FuseError("mesh/approx engines are not fusable")
+        try:
+            args = union_engine_args(self.specs)
+            self.union, self._union_verdict = cached_engine(
+                args.get("pattern"),
+                patterns=args.get("patterns"),
+                ignore_case=args["ignore_case"],
+                **engine_opts,
+            )
+        except FuseError:
+            raise
+        except Exception as e:  # noqa: BLE001 — union outside every engine subset
+            raise FuseError(f"union engine construction failed: {e}") from e
+        # Exact per-query confirm oracles: host engines (native AC/DFA
+        # banks / memmem / re loop) — never a device dispatch, and tiny
+        # relative to the scan they replace (they see candidate lines
+        # only).  Cached: the specs are exactly solo jobs' patterns, so
+        # a tenant's own solo resubmit shares the object.
+        self.confirms: list[GrepEngine] = []
+        try:
+            for s in self.specs:
+                eng, _ = cached_engine(
+                    s.pattern,
+                    patterns=list(s.patterns) if s.patterns is not None else None,
+                    ignore_case=s.ignore_case,
+                    backend="cpu",
+                )
+                self.confirms.append(eng)
+        except Exception as e:  # noqa: BLE001
+            raise FuseError(f"confirm engine construction failed: {e}") from e
+
+    # ------------------------------------------------------------ confirm
+    def _confirm_all(self, data: bytes, union_res: ScanResult
+                     ) -> tuple[list[ScanResult], np.ndarray | None]:
+        """Each query's exact ScanResult from the union scan's candidate
+        lines, plus the newline index used (None when none was needed):
+        gather the candidates into a newline-terminated slab (slab line
+        i == candidate i) and scan it with each query's exact host
+        engine — per-line semantics are position-invariant, so the slab
+        verdicts ARE the per-line verdicts of a solo scan.  The newline
+        index is REUSED from the union engine's per-scan stash when the
+        lengths match (a host-mode union scan just indexed this exact
+        buffer) and handed back to the caller — K participants' record
+        builds must not each re-pay a full pass (measured: the newline
+        passes alone cost more than the union scan on selective
+        queries)."""
+        cl = union_res.matched_lines
+        n = len(data)
+        if cl.size == 0:
+            return [
+                ScanResult(np.zeros(0, dtype=np.int64), 0, n)
+                for _ in self.specs
+            ], None
+        from distributed_grep_tpu.runtime.columnar import (
+            gather_ranges,
+            line_spans,
+        )
+
+        arr = np.frombuffer(data, dtype=np.uint8)
+        stash = getattr(self.union._nl_local, "stash", None)
+        nl = (
+            stash[1] if stash is not None and stash[0] == n
+            else lines_mod.newline_index(data)
+        )
+        starts, ends = line_spans(cl, nl, n)
+        # include each line's '\n' (the final line may not have one —
+        # the slab scan still counts it as a line, like the source scan)
+        slab, _offsets = gather_ranges(arr, starts, np.minimum(ends + 1, n))
+        out: list[ScanResult] = []
+        for eng in self.confirms:
+            sub = eng.scan(slab)
+            ml = cl[sub.matched_lines - 1].astype(np.int64)
+            out.append(ScanResult(ml, int(ml.size), n))
+        return out, nl
+
+    # --------------------------------------------------------------- scan
+    def scan(self, data: bytes, progress=None, corpus_key=None
+             ) -> list[ScanResult]:
+        """One in-memory document, K exact results — one union scan
+        (device corpus cache included via ``corpus_key``), K slab
+        confirms."""
+        union_res = self.union.scan(data, progress=progress,
+                                    corpus_key=corpus_key)
+        results, _nl = self._confirm_all(data, union_res)
+        _count_fusion(len(self.specs), 1, len(data))
+        return results
+
+    def scan_batch(self, items, progress=None, emit=None):
+        """Many inputs through the union engine's packed batching — one
+        dispatch per DGREP_BATCH_BYTES window serves every query.  Items
+        are (name, bytes-or-path) like GrepEngine.scan_batch (path items
+        ride the corpus cache: a warm window re-scans with zero reads).
+
+        Returns ``[per-spec [(name, ScanResult)] ]`` in input order;
+        ``emit(index, name, data, results_per_spec, nl_index)`` is
+        called per input while its bytes are in memory (the fused grep
+        app builds each participant's records there; ``nl_index`` is
+        this input's newline index when the confirm pass computed one —
+        K record builds share it instead of re-indexing per
+        participant)."""
+        outs: list[list] = [[] for _ in self.specs]
+        pos = [0]
+        total_bytes = [0]
+
+        def on_item(name, data, union_res) -> None:
+            results, nl = self._confirm_all(data, union_res)
+            i = pos[0]
+            pos[0] += 1
+            total_bytes[0] += len(data)
+            for k, res in enumerate(results):
+                outs[k].append((name, res))
+            if emit is not None:
+                emit(i, name, data, results, nl)
+
+        self.union.scan_batch(items, progress=progress, emit=on_item)
+        # dispatch accounting AFTER the call (scan_batch stamps its batch
+        # counters into the union engine's thread stats at return)
+        st = self.union.stats
+        dispatches = int(st.get("batch_dispatches", 0)) + int(
+            st.get("solo_dispatches", 0)
+        )
+        _count_fusion(len(self.specs), max(1, dispatches), total_bytes[0])
+        return outs

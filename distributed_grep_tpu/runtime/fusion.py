@@ -1,0 +1,212 @@
+"""Cross-tenant scan-fusion planning — the service half of ops/fuse.py.
+
+The daemon's assign loop calls into this module to decide which
+co-running print-mode grep jobs may share ONE worker scan per map split:
+
+* ``fusion_key(config)`` — a grouping key over everything EXCEPT the
+  query (pattern/patterns/ignore_case): two jobs fuse only when their
+  application, every other app option, and their split-planning window
+  agree, so the fused attempt can run one engine configuration and each
+  participant's post-processing is its own job's exact semantics.
+* ``query_spec(options)`` — the (pattern, patterns, ignore_case) tuple
+  ops/fuse.QuerySpec accepts, or None when this query must scan solo
+  (empty patterns, backreference-bearing regexes, approx queries).
+* ``split_identity(split)`` — CONTENT identity of a map split: per-member
+  (realpath, size, mtime_ns, inode) from a fresh stat — the CorpusCache
+  validator tuple, so "same content" here is exactly what makes the
+  device corpus cache serve both tenants the same resident shards.
+
+This module is deliberately free of ops/jax imports: eligibility runs on
+the daemon's control plane at submit/assign time (a remote-worker daemon
+must stay importable without the ops stack), and all stat work runs
+OUTSIDE the service/scheduler locks (analyze: locked-blocking).
+
+Knobs (registered in analysis/knobs.py, owned here):
+
+* ``DGREP_SERVICE_FUSE`` — 0/false disables fusion planning entirely; the
+  daemon's wire payloads, journals, and outputs are then byte-identical
+  to a pre-fusion daemon (the ``fused`` reply field is elided when
+  empty).
+* ``DGREP_FUSE_MAX_QUERIES`` — cap on queries per fused attempt
+  (default 8): bounds the union automaton's size and the blast radius of
+  one lost worker (a timeout re-enqueues K tasks, each of which then
+  re-runs solo or in a fresh fusion).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_FUSE_MAX_QUERIES = 8
+
+# The one application the fused map attempt knows how to run (it must
+# expose map_fused_fn); jobs on any other app never fuse.
+FUSABLE_APPLICATION = "distributed_grep_tpu.apps.grep_tpu"
+
+# A fused attempt whole-reads its split (GrepEngine.scan_batch): splits
+# past this total size keep the streaming solo path instead of trading
+# bounded memory for a shared dispatch.
+MAX_FUSED_SPLIT_BYTES = 256 << 20
+
+# The query keys a fused group may differ on; every OTHER app option must
+# be equal across the group (fusion_key folds them in).
+_QUERY_KEYS = ("pattern", "patterns", "ignore_case")
+
+
+def env_service_fuse(default: bool = True) -> bool:
+    """Cross-tenant fusion switch — the ONE parser of DGREP_SERVICE_FUSE.
+    On by default; "0"/"false"/"no" turns planning off entirely (a true
+    no-op: assignments, wire payloads, and outputs revert to the
+    pre-fusion daemon byte for byte)."""
+    raw = os.environ.get("DGREP_SERVICE_FUSE")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
+def env_fuse_max_queries(default: int = DEFAULT_FUSE_MAX_QUERIES) -> int:
+    """Queries-per-fused-attempt cap — the ONE parser of
+    DGREP_FUSE_MAX_QUERIES (malformed keeps the default, matching
+    env_batch_bytes' shrug-off policy; values below 2 clamp to 2, the
+    smallest fusion — turning fusion OFF is DGREP_SERVICE_FUSE=0's job,
+    not this knob's)."""
+    raw = os.environ.get("DGREP_FUSE_MAX_QUERIES")
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(2, int(raw))
+    except ValueError:
+        return default
+
+
+def has_backref(rx: str) -> bool:
+    """True when the regex uses any group-number-sensitive construct
+    (numeric/named backreference, conditional group test) — joining such
+    a pattern into an alternation silently repoints its groups.  Walks
+    re's parse tree (the __main__._has_backref logic, re-homed here so
+    the service can ask without importing the CLI); parse failures count
+    as True (not fusable — the union builder could not host it anyway)."""
+    try:
+        import re._parser as parser  # 3.11+
+    except ImportError:
+        import sre_parse as parser  # 3.10
+
+    def walk(node) -> bool:
+        if isinstance(node, parser.SubPattern):
+            return any(walk(item) for item in node)
+        if isinstance(node, tuple):
+            op = node[0]
+            if op in (parser.GROUPREF, parser.GROUPREF_EXISTS):
+                return True
+            return any(walk(x) for x in node[1:])
+        if isinstance(node, list):
+            return any(walk(x) for x in node)
+        return False
+
+    try:
+        return walk(parser.parse(rx))
+    except Exception:  # noqa: BLE001 — unparseable: treat as unfusable
+        return True
+
+
+def query_spec(options: dict) -> tuple | None:
+    """(pattern, patterns, ignore_case) when this job's query can join a
+    fused union (ops/fuse.QuerySpec.normalize accepts the tuple), else
+    None — the solo paths then serve it unchanged."""
+    if options.get("max_errors"):
+        return None  # approx queries have no union form
+    pats = options.get("patterns")
+    ic = bool(options.get("ignore_case"))
+    if pats:
+        norm = tuple(
+            p.decode("utf-8", "surrogateescape") if isinstance(p, bytes)
+            else str(p)
+            for p in pats
+        )
+        if any(p == "" for p in norm):
+            return None
+        return (None, norm, ic)
+    pat = options.get("pattern")
+    if isinstance(pat, bytes):
+        pat = pat.decode("utf-8", "surrogateescape")
+    if not pat:
+        return None  # empty pattern matches everything — solo is free
+    if has_backref(pat):
+        return None
+    return (pat, None, ic)
+
+
+def fusion_key(config) -> tuple | None:
+    """Grouping key for a JobConfig's fused-eligibility, or None when the
+    job can never fuse.  Jobs fuse only within one key: same application
+    (grep_tpu — the app that implements map_fused_fn), same app options
+    apart from the query itself, same split-planning window (so the two
+    jobs' map splits over identical inputs align), and a query the union
+    builder can host.  Print-mode only: count/presence queries ride
+    stop-early streaming paths the fused batch scan does not reproduce."""
+    if getattr(config, "application", None) != FUSABLE_APPLICATION:
+        return None
+    opts = config.effective_app_options()
+    if opts.get("count_only") or opts.get("presence_only"):
+        return None
+    if opts.get("mesh_shape"):
+        return None  # mesh engines bypass every cross-job cache — and fusion
+    if query_spec(opts) is None:
+        return None
+    rest = {k: v for k, v in opts.items() if k not in _QUERY_KEYS}
+    try:
+        frozen = tuple(sorted((k, _freeze(v)) for k, v in rest.items()))
+    except TypeError:
+        return None  # unhashable exotic option: stay solo
+    return (config.application, frozen, int(config.effective_batch_bytes()))
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def split_identity(split) -> tuple | None:
+    """Content identity of one map split (a path, or a list of member
+    paths): per-member (realpath, size, mtime_ns, inode) — the
+    CorpusCache validator tuple.  None when any member cannot be statted
+    or the split is too large to whole-read in a fused attempt.  Stat
+    work: call OUTSIDE the service/scheduler locks only."""
+    members = split if isinstance(split, (list, tuple)) else [split]
+    out = []
+    total = 0
+    for m in members:
+        try:
+            real = os.path.realpath(os.fspath(m))
+            st = os.stat(real)
+        except OSError:
+            return None
+        total += int(st.st_size)
+        out.append((real, int(st.st_size), int(st.st_mtime_ns),
+                    int(st.st_ino)))
+    if total > MAX_FUSED_SPLIT_BYTES:
+        return None
+    return tuple(out)
+
+
+def plan_identities(map_splits: list) -> tuple[list, dict]:
+    """(identities, index) for a job's planned map splits: identities[i]
+    is split_identity(map_splits[i]) (None = unfusable split) and index
+    maps identity -> task id (task ids are split indices by
+    construction — runtime/scheduler seeds MapTask(i, files[i])).
+    Runs at submit/resume time, outside every lock."""
+    identities = [split_identity(s) for s in map_splits]
+    index = {}
+    for tid, ident in enumerate(identities):
+        if ident is not None and ident not in index:
+            index[ident] = tid
+    return identities, index
+
+
+def split_n_bytes(identity) -> int:
+    """Total content bytes of a split identity (the planner's
+    fusion_bytes_saved accounting — sizes were captured in the stat)."""
+    return sum(v[1] for v in identity) if identity else 0

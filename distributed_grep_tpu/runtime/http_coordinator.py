@@ -28,7 +28,6 @@ import json
 import threading
 import time
 import urllib.parse
-from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_grep_tpu.runtime import rpc
@@ -160,7 +159,9 @@ class CoordinatorServer:
             reply = rpc.HeartbeatReply()
         else:
             raise KeyError(f"unknown RPC verb: {verb}")
-        return asdict(reply)
+        # historical asdict shape, NEW reply fields elided at defaults
+        # (rpc.reply_to_dict) — payloads stay byte-identical pre-fusion
+        return rpc.reply_to_dict(reply)
 
     def status(self) -> dict:
         s = self.scheduler

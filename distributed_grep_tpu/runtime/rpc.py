@@ -88,6 +88,15 @@ class AssignTaskReply:
     # wrong bytes).  Mismatched epochs abort the attempt instead.
     # "" on the wire for old peers (elided).
     epoch: str = ""
+    # Cross-tenant scan fusion (round 13, runtime/fusion.py): co-tenant
+    # map tasks riding THIS assignment — one worker scan serves every
+    # participant, each committed through its own job's data plane and
+    # scheduler.  Entries are dicts shaped like a map assignment
+    # ({job_id, task_id, filename, filenames, n_reduce, app_options,
+    # task_timeout_s, epoch}).  Empty (and elided from the wire, see
+    # reply_to_dict) everywhere fusion is off or ineligible — payloads
+    # then stay byte-identical to the pre-fusion protocol.
+    fused: list = field(default_factory=list)
 
 
 @dataclass
@@ -194,11 +203,27 @@ _TYPES = {
 _ELIDE_DEFAULTS: dict[str, Any] = {
     "spans": [], "spans_seq": -1, "metrics": None,
     "sent_at": 0.0, "rtt_s": -1.0, "filenames": [], "retry_after_s": 0.0,
-    "epoch": "", "abort": False, "worker_id": -1,
+    "epoch": "", "abort": False, "worker_id": -1, "fused": [],
     # service multiplexing riders (runtime/service.py): absent from the
     # wire on single-job coordinators, so pre-service peers interop
     "job_id": "", "application": "",
 }
+
+# Reply serialization keeps the historical asdict shape (default-valued
+# fields INCLUDED — changing that would alter every existing payload);
+# only NEW reply fields elide at their defaults, so a fusion-disabled
+# daemon's replies are byte-identical to the pre-fusion protocol and old
+# workers (cls(**payload) constructors) only break when fusion is
+# actually handing them fused work.
+_REPLY_ELIDE = ("fused",)
+
+
+def reply_to_dict(msg: Any) -> dict:
+    d = dataclasses.asdict(msg)
+    for k in _REPLY_ELIDE:
+        if not d.get(k, True):
+            del d[k]
+    return d
 
 
 def to_dict(msg: Any) -> dict:

@@ -680,6 +680,7 @@ class Scheduler:
                     task.attempts += 1
                     task.worker = worker_id
                     task.stamped = False  # no worker-side evidence yet
+                    task.fused_claim = False  # normal assign: chargeable
                     self.metrics.inc("map_assigned")
                     self._worker_seen(worker_id, task=f"map:{tid}")
                     self._event("assign_map", task=tid, worker=worker_id,
@@ -730,6 +731,58 @@ class Scheduler:
                         worker_id=worker_id,
                     )
                 self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
+
+    def claim_map_task(self, task_id: int, worker_id: int) -> dict | None:
+        """Claim one SPECIFIC idle map task for a fused attempt (the
+        service's cross-tenant scan fusion, runtime/fusion.py): the
+        co-tenant's task joins another job's assignment, so this is the
+        assign_task map branch minus the queue pop — the stale queue
+        entry is skipped by the assign loop's UNASSIGNED check, exactly
+        like a timeout re-enqueue's leftovers.  First attempts only: a
+        task that already timed out once re-runs solo (fusion is a fast
+        path; a fused-attempt-specific failure must not loop).  Returns
+        the assignment fields for the fused reply entry, or None (not
+        idle / retried / stopped — the planner then simply skips this
+        tenant).  State moves only under the lock; events flush after
+        release (checked: locked-blocking)."""
+        try:
+            with self._cond:
+                if self._stopped or not 0 <= task_id < len(self.map_tasks):
+                    return None
+                task = self.map_tasks[task_id]
+                if task.state is not TaskState.UNASSIGNED or task.attempts:
+                    return None
+                task.state = TaskState.IN_PROGRESS
+                task.heartbeat()
+                task.attempts += 1
+                task.worker = worker_id
+                task.stamped = False  # no worker-side evidence yet
+                # Quarantine attribution: a fused EXTRA's timeout is never
+                # charged (see the sweeper) — K participant schedulers
+                # each sharing one WorkerHealth would otherwise count one
+                # lost fused attempt as K consecutive failures and
+                # insta-quarantine the worker; the PRIMARY assignment's
+                # timeout carries the one charge for the shared event.
+                task.fused_claim = True
+                self.metrics.inc("map_assigned")
+                self.metrics.inc("fused_assigned")
+                self._worker_seen(worker_id, task=f"map:{task_id}")
+                self._event("assign_map", task=task_id, worker=worker_id,
+                            attempt=task.attempts, file=task.file,
+                            fused=True)
+                log.debug("fuse-claim map task %d (%s) -> worker %d",
+                          task_id, task.file, worker_id)
+                return {
+                    "task_id": task_id,
+                    "filename": task.file,
+                    "filenames": list(task.files),
+                    "n_reduce": self.n_reduce,
+                    "app_options": self.app_options,
+                    "task_timeout_s": self.task_timeout_s,
+                    "epoch": self.epoch,
+                }
+        finally:
+            self._flush_events()
 
     # ------------------------------------------------------------- completion
     def _notify_change(self) -> None:
@@ -948,14 +1001,22 @@ class Scheduler:
                         >= max(self.task_timeout_s, task.grace_s)
                     ):
                         log.warning("map task %d timed out; re-enqueueing", task.task_id)
-                        if task.stamped or not self.worker_health.polled_since(
-                            task.worker, task.timestamp
-                        ):
+                        if (
+                            task.stamped or not self.worker_health.polled_since(
+                                task.worker, task.timestamp
+                            )
+                        ) and not getattr(task, "fused_claim", False):
                             # charge only with evidence the worker HELD the
                             # task (a stamp) or is gone (no poll since the
                             # assignment) — an unstamped timeout from a
                             # worker that kept polling is a LOST REPLY, the
-                            # network's fault, not the worker's
+                            # network's fault, not the worker's.  Fused
+                            # EXTRAS (claim_map_task) are never charged:
+                            # K participant schedulers share ONE
+                            # WorkerHealth, so one lost fused attempt
+                            # would otherwise count as K consecutive
+                            # failures and insta-quarantine; the PRIMARY
+                            # assignment's timeout carries the one charge.
                             failed_workers.append(task.worker)
                         task.state = TaskState.UNASSIGNED
                         self._map_queue.append(task.task_id)
@@ -992,8 +1053,12 @@ class Scheduler:
                 # the task (WorkerHealth is a leaf lock — safe under the
                 # scheduler lock, and the quarantine verdict must land
                 # before the re-enqueued task is handed back to the same
-                # dark worker on the very next poll).
-                for wid in failed_workers:
+                # dark worker on the very next poll).  DEDUPED per sweep:
+                # one worker going dark is ONE event however many tasks
+                # it held — a FUSED attempt (round 13) parks K tasks on
+                # one worker, and counting its single death K times
+                # would quarantine on the first lost attempt.
+                for wid in sorted(set(failed_workers)):
                     window = self.worker_health.record_failure(wid)
                     if window > 0:
                         log.warning(

@@ -48,6 +48,7 @@ from dataclasses import replace as _dc_replace
 from http.server import ThreadingHTTPServer
 from pathlib import Path
 
+from distributed_grep_tpu.runtime import fusion as fusion_mod
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.http_coordinator import (
     DataPlaneHandler,
@@ -315,6 +316,15 @@ class JobRecord:
     # many-small-files submit must not stall every other tenant's
     # heartbeats while the kernel walks its tree.
     map_splits: list = field(default_factory=list)
+    # Cross-tenant scan fusion (round 13, runtime/fusion.py): this job's
+    # eligibility key, per-split content identities (the CorpusCache
+    # realpath+stat validator tuples), and identity -> map-task index —
+    # all computed alongside map_splits at submit/resume time, OUTSIDE
+    # the service lock (stat work; checked: locked-blocking).  Empty
+    # when fusion is off or the job can never fuse.
+    fusion_key: tuple | None = None
+    split_identities: list = field(default_factory=list)
+    fuse_index: dict = field(default_factory=dict)
 
 
 class GrepService:
@@ -395,6 +405,15 @@ class GrepService:
         # worker identity, so a worker going dark under job A must stop
         # receiving job B's tasks too.
         self._health = WorkerHealth()
+
+        # Cross-tenant fusion planning counters (GET /status "fusion"):
+        # fused_jobs = participant tasks served by shared attempts,
+        # fused_dispatches = fused attempts handed out, fusion_bytes_saved
+        # = split bytes co-tenants did NOT re-scan.  Leaf lock.
+        self._fusion_lock = lockdep.make_lock("fusion-stats")
+        self._fusion_stats = {
+            "fused_jobs": 0, "fused_dispatches": 0, "fusion_bytes_saved": 0,
+        }
 
         # Durable job registry (jobs.jsonl) + staged transition records:
         # appends are fsync'd, so they happen OUTSIDE the service lock —
@@ -486,6 +505,8 @@ class GrepService:
             rec.map_splits = plan_map_splits(
                 list(cfg.input_files), cfg.effective_batch_bytes()
             )
+            (rec.fusion_key, rec.split_identities,
+             rec.fuse_index) = self._fusion_plan(cfg, rec.map_splits)
             self._jobs[jid] = rec
             if state == JobState.RUNNING:
                 self._resume_running_job(rec)
@@ -610,6 +631,7 @@ class GrepService:
         splits = plan_map_splits(
             list(config.input_files), config.effective_batch_bytes()
         )
+        fuse_key, identities, fuse_index = self._fusion_plan(config, splits)
         with self._cond:
             self._check_admission_locked_or_raise(locked=True)
             job_id = f"job-{next(self._ids)}"
@@ -627,7 +649,10 @@ class GrepService:
                    if self._sweep_interval_s is not None else {}),
             )
             rec = JobRecord(job_id=job_id, config=cfg,
-                            submitted_at=time.time(), map_splits=splits)
+                            submitted_at=time.time(), map_splits=splits,
+                            fusion_key=fuse_key,
+                            split_identities=identities,
+                            fuse_index=fuse_index)
         # Durability BEFORE visibility: the registry append (fsync)
         # happens outside the lock and before the id is handed to the
         # client — from this line on a daemon crash re-admits the job at
@@ -1059,6 +1084,13 @@ class GrepService:
                                         rpc.Assignment.REDUCE):
                     reply.job_id = rec.job_id
                     reply.application = rec.config.application
+                    if reply.assignment == rpc.Assignment.MAP:
+                        # cross-tenant scan fusion: co-running jobs with
+                        # an idle map task over the SAME content join
+                        # this assignment (runs outside the service
+                        # lock — claim + event-log writes are I/O-adjacent)
+                        self._plan_fused_assignment(rec, reply, worker_id,
+                                                    order)
                     self._worker_seen(
                         worker_id, job=rec.job_id,
                         task=f"{reply.assignment}:{reply.task_id}",
@@ -1073,6 +1105,114 @@ class GrepService:
             with self._cond:
                 if not self._stopped:
                     self._cond.wait(min(remaining, _ASSIGN_SWEEP_S))
+
+    @staticmethod
+    def _fusion_plan(config: JobConfig, splits: list) -> tuple:
+        """(fusion_key, split_identities, fuse_index) for a job —
+        eligibility plus per-split content identity (runtime/fusion.py).
+        Stat work: callers run it OUTSIDE the service lock, alongside
+        plan_map_splits.  All-empty when fusion is disabled (the
+        disabled daemon does not even pay the stats) or the job can
+        never fuse."""
+        if not fusion_mod.env_service_fuse():
+            return None, [], {}
+        key = fusion_mod.fusion_key(config)
+        if key is None:
+            return None, [], {}
+        identities, index = fusion_mod.plan_identities(splits)
+        return key, identities, index
+
+    def _plan_fused_assignment(self, rec: JobRecord,
+                               reply: rpc.AssignTaskReply, worker_id: int,
+                               order: list[str]) -> None:
+        """Attach co-tenant map tasks to a MAP assignment: every OTHER
+        running job with the same fusion key and an idle first-attempt
+        map task over the same split content claims its task onto this
+        reply (Scheduler.claim_map_task), so ONE worker scan serves all
+        of them.  Runs with NO service lock held — unlocked job-table
+        reads follow the assign loop's existing precedent, claims take
+        only the target scheduler's own lock, and event-log writes are
+        plain file appends.  A fused attempt that later dies simply
+        times out per job and re-runs solo (claim gates on attempts==0).
+        No-op when fusion is off — the reply (and its wire form) is then
+        byte-identical to the pre-fusion protocol."""
+        if rec.fusion_key is None or not fusion_mod.env_service_fuse():
+            return
+        idents = rec.split_identities
+        tid = reply.task_id
+        ident = idents[tid] if 0 <= tid < len(idents) else None
+        if ident is None:
+            return
+        # FRESH revalidation, the corpus cache's contract (stale bytes
+        # are never served): identities were captured at submit, and a
+        # path can stop resolving to the same content before the scan —
+        # an atomic deploy flip retargets a symlink, an append moves
+        # mtime.  A drifted primary fuses nothing; a drifted co-tenant
+        # is skipped (its task runs solo over ITS OWN current paths).
+        # Stat work — this method runs with no service lock held.
+        if fusion_mod.split_identity(rec.map_splits[tid]) != ident:
+            return
+        cap = fusion_mod.env_fuse_max_queries()
+        planned: list[dict] = []
+        for jid2 in order:
+            if len(planned) + 1 >= cap:
+                break
+            if jid2 == rec.job_id:
+                continue
+            rec2 = self._jobs.get(jid2)
+            if (rec2 is None or rec2.state is not JobState.RUNNING
+                    or rec2.scheduler is None
+                    or rec2.fusion_key != rec.fusion_key):
+                continue
+            tid2 = rec2.fuse_index.get(ident)
+            if tid2 is None:
+                continue
+            # the co-tenant's OWN paths must still resolve to this
+            # content too (they may reach it through a different route)
+            if fusion_mod.split_identity(rec2.map_splits[tid2]) != ident:
+                continue
+            info = rec2.scheduler.claim_map_task(tid2, worker_id)
+            if info is None:
+                continue
+            planned.append({"job_id": rec2.job_id, **info})
+        if not planned:
+            return
+        reply.fused = planned
+        n_bytes = fusion_mod.split_n_bytes(ident)
+        with self._fusion_lock:
+            self._fusion_stats["fused_jobs"] += 1 + len(planned)
+            self._fusion_stats["fused_dispatches"] += 1
+            self._fusion_stats["fusion_bytes_saved"] += (
+                len(planned) * n_bytes
+            )
+        # fuse:plan instant in EACH participant's events.jsonl — every
+        # fused tenant's trace shows the shared attempt (split_by_job
+        # routes worker-side fuse:split records the same way)
+        parts = [(rec.job_id, tid)] + [
+            (p["job_id"], p["task_id"]) for p in planned
+        ]
+        now = time.time()
+        for jid_p, tid_p in parts:
+            r = self._jobs.get(jid_p)
+            if r is None or r.event_log is None:
+                continue
+            try:
+                r.event_log.write({
+                    "t": "instant", "name": "fuse:plan", "cat": "fuse",
+                    "ts": now, "job": jid_p,
+                    "args": {
+                        "task": tid_p, "queries": len(parts),
+                        "worker": worker_id, "bytes": n_bytes,
+                        "participants": [j for j, _ in parts],
+                    },
+                })
+            except Exception:  # noqa: BLE001 — telemetry must not fail assigns
+                log.exception("fuse:plan event write failed for %s", jid_p)
+        log.info(
+            "fused map assignment: %d queries share task %s:%d (worker %d,"
+            " %d bytes scanned once)", len(parts), rec.job_id, tid,
+            worker_id, n_bytes,
+        )
 
     def _route_spans(self, args) -> None:
         """Service-level span persistence: dedup the batch by (worker,
@@ -1218,6 +1358,14 @@ class GrepService:
 
         now = time.monotonic()
         quarantine = self._health.snapshot()
+        with self._fusion_lock:
+            # nonzero-only, like the cache counter dicts: a fusion-free
+            # (or fusion-disabled) daemon's /status keeps its exact
+            # pre-fusion shape
+            fusion_stats = (
+                dict(self._fusion_stats)
+                if any(self._fusion_stats.values()) else {}
+            )
         with self._lock:
             jobs = {
                 jid: {"state": rec.state}
@@ -1266,6 +1414,11 @@ class GrepService:
             "quarantine": quarantine["active"],
             "compile_cache": model_cache_counters(),
             "corpus_cache": corpus_cache_counters(),
+            # cross-tenant scan fusion (round 13): planning-side counters
+            # (fused_jobs / fused_dispatches / fusion_bytes_saved);
+            # engine-side counters ride the per-worker heartbeat
+            # piggyback rows (runtime/worker._engine_cache_counters)
+            **({"fusion": fusion_stats} if fusion_stats else {}),
         }
 
     # ------------------------------------------------------------- lifecycle
@@ -1451,8 +1604,6 @@ class ServiceServer:
         )
 
     def handle_rpc(self, verb: str, payload: dict) -> dict:
-        from dataclasses import asdict
-
         window = long_poll_window_s(self._bootstrap)
         if verb == rpc.Verb.ASSIGN_TASK:
             reply = self.service.assign_task(
@@ -1471,7 +1622,9 @@ class ServiceServer:
             reply = rpc.HeartbeatReply()
         else:
             raise KeyError(f"unknown RPC verb: {verb}")
-        return asdict(reply)
+        # historical asdict shape, NEW reply fields elided at defaults
+        # (rpc.reply_to_dict) — fusion-off payloads stay byte-identical
+        return rpc.reply_to_dict(reply)
 
 
 def _safe_segment(name: str) -> str:

@@ -55,6 +55,12 @@ class MapTask:
     # worker; the sweeper then charges the worker only if it also never
     # polled again (scheduler._sweep_loop).
     stamped: bool = False
+    # True while the current attempt was claimed as a fused EXTRA
+    # (Scheduler.claim_map_task, cross-tenant scan fusion): its timeout
+    # is never charged to WorkerHealth — K participant schedulers share
+    # one health tracker, and a single lost fused attempt must count as
+    # ONE dark-worker event (the primary assignment's charge), not K.
+    fused_claim: bool = False
 
     def heartbeat(self, grace_s: float = 0.0) -> None:
         self.timestamp = time.monotonic()

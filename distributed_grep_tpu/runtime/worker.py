@@ -47,13 +47,14 @@ class TaskAborted(Exception):
 
 def _engine_cache_counters() -> dict | None:
     """This process's cross-job engine-cache counters — compiled-model
-    (compile_cache_hits/misses/evictions) AND device-corpus
-    (corpus_cache_hits/misses/evictions/bytes_resident) — or None when
-    the owning modules were never imported or neither cache was touched;
-    piggybacked with the Metrics snapshot so the coordinator /status
-    workers view shows cache effectiveness per worker.  sys.modules-
-    gated: a wordcount worker must not import the whole ops stack just
-    to report nothing."""
+    (compile_cache_hits/misses/evictions), device-corpus
+    (corpus_cache_hits/misses/evictions/bytes_resident), AND scan-fusion
+    (fused_queries/fused_dispatches/fusion_bytes_saved, ops/fuse.py) —
+    or None when the owning modules were never imported or none was
+    touched; piggybacked with the Metrics snapshot so the coordinator
+    /status workers view shows cache/fusion effectiveness per worker.
+    sys.modules-gated: a wordcount worker must not import the whole ops
+    stack just to report nothing."""
     import sys as _sys
 
     counters: dict = {}
@@ -63,6 +64,9 @@ def _engine_cache_counters() -> dict | None:
     lay = _sys.modules.get("distributed_grep_tpu.ops.layout")
     if lay is not None:
         counters.update(lay.corpus_cache_counters())
+    fuse = _sys.modules.get("distributed_grep_tpu.ops.fuse")
+    if fuse is not None:
+        counters.update(fuse.fusion_counters())
     return counters or None
 
 
@@ -135,18 +139,21 @@ class WorkerLoop:
         return min(5.0, max(0.05, float(window_s) / 3.0))
 
     def _heartbeat(self, task_type: str, task_id: int,
-                   grace_s: float = 0.0) -> None:
+                   grace_s: float = 0.0, job_id: str | None = None) -> None:
         """Advisory mid-task stamp (UpdateTimestamp, coordinator.go:176-182
         — exposed by the reference but never called mid-map; here it is
         what lets the sweeper run a tight window over long maps, VERDICT
-        r3 item 3).  Never raises: liveness is best-effort, the task's own
-        RPCs surface real transport failure."""
+        r3 item 3).  ``job_id`` overrides the current assignment's job
+        for FUSED attempts (one scan holds K jobs' tasks; every
+        participant's scheduler must see stamps).  Never raises: liveness
+        is best-effort, the task's own RPCs surface real transport
+        failure."""
         hb = getattr(self.transport, "heartbeat", None)
         if hb is None:
             return
         args = rpc.HeartbeatArgs(
             task_type=task_type, task_id=task_id,
-            job_id=self._rpc_job_id,
+            job_id=self._rpc_job_id if job_id is None else job_id,
             worker_id=self.worker_id, grace_s=grace_s,
         )
         if self.spans is not None:
@@ -329,8 +336,47 @@ class WorkerLoop:
         self._attach_rpc_retries(args)
         return args
 
+    def _read_members(self, names: list[str], want_paths: bool
+                      ) -> tuple[list, int]:
+        """Resolve split members to (name, bytes-or-local-path) items +
+        total bytes.  ``want_paths`` hands over resolved paths on local
+        data planes (the device corpus cache then serves warm windows
+        with zero reads); spooled temp copies honor the (path, is_temp)
+        contract — read and unlinked, never handed over as a path (a
+        transient realpath must not become a corpus content key).
+        Shared by the batched map branch and the fused attempt."""
+        import os as _os
+
+        items: list = []
+        n_bytes = 0
+        if (want_paths
+                and getattr(self.transport, "is_local", False)
+                and hasattr(self.transport, "read_input_path")):
+            for name in names:
+                p, is_temp = self.transport.read_input_path(name)
+                if is_temp:
+                    with open(p, "rb") as _fh:
+                        data_b = _fh.read()
+                    _os.unlink(p)
+                    items.append((name, data_b))
+                    n_bytes += len(data_b)
+                else:
+                    items.append((name, str(p)))
+                    n_bytes += _os.path.getsize(p)
+        else:
+            for name in names:
+                b = self.transport.read_input(name)
+                items.append((name, b))
+                n_bytes += len(b)
+        return items, n_bytes
+
     # ------------------------------------------------------------------- map
     def _run_map(self, a: rpc.AssignTaskReply) -> None:
+        if a.fused:
+            # cross-tenant scan fusion (runtime/fusion.py): this
+            # assignment carries co-tenant tasks — one scan, K commits
+            self._run_map_fused(a)
+            return
         from distributed_grep_tpu.runtime.store import new_attempt_id
 
         t0 = time.perf_counter()
@@ -414,46 +460,15 @@ class WorkerLoop:
                 batch_paths = (
                     batch_fn is not None
                     and getattr(self.app, "map_batch_paths", False)
-                    and getattr(self.transport, "is_local", False)
-                    and hasattr(self.transport, "read_input_path")
                 )
                 with download_guard(), \
                         trace.annotate(f"map_read:{a.task_id}"), \
                         spans_mod.span("map:read", cat="map",
                                        file=a.filename,
                                        files=len(a.filenames)):
-                    if batch_paths:
-                        import os as _os
-
-                        blobs = []
-                        n_bytes = 0
-                        for name in a.filenames:
-                            p, is_temp = self.transport.read_input_path(
-                                name
-                            )
-                            if is_temp:
-                                # Honor the (path, is_temp) contract
-                                # like the map_path branch: a spooled
-                                # copy is read-and-unlinked, never
-                                # handed over as a path — its transient
-                                # realpath must not become a corpus
-                                # content key (scan_batch accepts
-                                # mixed bytes/path items, so one
-                                # spooled member demotes only itself).
-                                with open(p, "rb") as _fh:
-                                    data_b = _fh.read()
-                                _os.unlink(p)
-                                blobs.append((name, data_b))
-                                n_bytes += len(data_b)
-                            else:
-                                blobs.append((name, str(p)))
-                                n_bytes += _os.path.getsize(p)
-                    else:
-                        blobs = [
-                            (name, self.transport.read_input(name))
-                            for name in a.filenames
-                        ]
-                        n_bytes = sum(len(b) for _, b in blobs)
+                    blobs, n_bytes = self._read_members(
+                        a.filenames, want_paths=batch_paths
+                    )
                 self._fault("after_map_read")
                 with self.metrics.timer("map_compute"), \
                         trace.annotate(f"map_compute:{a.task_id}"), \
@@ -540,6 +555,270 @@ class WorkerLoop:
                 produced.append(r)
         self._publish_commit("map", a.task_id, attempt, {"parts": produced})
         return produced
+
+    # ------------------------------------------------------------ fused map
+    def _run_map_fused(self, a: rpc.AssignTaskReply) -> None:
+        """One worker scan serving K co-tenant map tasks (cross-tenant
+        scan fusion — runtime/fusion.py planned it, ops/fuse.py runs it).
+        The primary assignment's split is read ONCE (the planner matched
+        the participants' splits by content identity); the app's
+        map_fused_fn produces each participant's records from one union
+        scan; each participant then commits through ITS OWN job's data
+        plane, commit record, and finished RPC — per-job exactly-once,
+        journals, attempt resolution, and the epoch fence are untouched.
+        Any failure in the fused leg falls back to per-participant SOLO
+        execution over the already-read items (fusion is a fast path,
+        never a correctness dependency); a participant whose commit leg
+        fails simply times out in its own scheduler and re-runs solo."""
+        from distributed_grep_tpu.runtime.store import new_attempt_id
+
+        t0_wall = time.time()
+        participants: list[dict] = [{
+            "job_id": a.job_id, "task_id": a.task_id,
+            "filename": a.filename, "filenames": list(a.filenames),
+            "n_reduce": a.n_reduce, "app_options": a.app_options,
+            "epoch": a.epoch, "task_timeout_s": a.task_timeout_s,
+        }]
+        participants += [dict(p) for p in a.fused]
+        part_ids = [(p["job_id"], p["task_id"]) for p in participants]
+
+        # Fused liveness: EVERY participant's scheduler must see stamps,
+        # or co-tenants' sweepers would re-enqueue tasks this worker is
+        # actively scanning.  The throttled callback fans one stamp out
+        # to K (job, task) pairs; grace declarations pass through.  The
+        # cadence derives from the TIGHTEST participant's declared
+        # detector window (fusion_key does not align task_timeout_s — a
+        # co-tenant with a 2 s window must not be stamped on the
+        # primary's 60 s cadence and swept mid-scan).
+        window_s = min(
+            float(p.get("task_timeout_s", a.task_timeout_s))
+            for p in participants
+        )
+        min_interval = self._hb_interval(window_s)
+        last = [0.0]
+
+        def progress(grace_s: float = 0.0) -> None:
+            now = time.monotonic()
+            if not grace_s and now - last[0] < min_interval:
+                return
+            last[0] = now
+            for jid_p, tid_p in part_ids:
+                self._heartbeat("map", tid_p, grace_s=grace_s, job_id=jid_p)
+
+        import contextlib
+        import threading
+
+        def fused_pump(force: bool = False):
+            """Coarse liveness over legs with no app progress (download,
+            shuffle/commit) — the solo path's download_guard/
+            shuffle_guard, fanned out to every participant's (job, task)
+            so no co-tenant's sweeper fires mid-leg.  Local transports
+            skip it like the solo guards do (reads/writes resolve in
+            microseconds there) unless ``force`` (match-dense local
+            shuffle legs can outrun the sweep window by themselves —
+            the solo shuffle_guard's 50k-record rule)."""
+            if not force and getattr(self.transport, "is_local", False):
+                return contextlib.nullcontext()
+
+            @contextlib.contextmanager
+            def ctx():
+                stop = threading.Event()
+                interval = min(2.0, min_interval)
+
+                def pump() -> None:
+                    while not stop.wait(interval):
+                        for jid_p, tid_p in part_ids:
+                            self._heartbeat("map", tid_p, job_id=jid_p)
+
+                t = threading.Thread(target=pump, name="fused-hb-pump",
+                                     daemon=True)
+                t.start()
+                try:
+                    yield
+                finally:
+                    stop.set()
+                    t.join(timeout=interval + 1.0)
+
+            return ctx()
+
+        names = list(a.filenames) or [a.filename]
+        want_paths = bool(getattr(self.app, "map_batch_paths", False))
+        attempt0 = new_attempt_id()
+        committed = 0
+        t0 = time.perf_counter()  # attempt start, like _run_map: the
+        # record_scan/map_task_total telemetry must include the read leg
+        # or fused gbps reads systematically higher than solo's
+        with self._task_ctx("map", a.task_id, attempt0):
+            with fused_pump(), \
+                    trace.annotate(f"map_read:{a.task_id}"), \
+                    spans_mod.span("map:read", cat="map", file=a.filename,
+                                   files=len(names)):
+                items, n_bytes = self._read_members(names, want_paths)
+            self._fault("after_map_read")
+            has_progress = self.app.set_progress(progress)
+            records_per: list | None = None
+            try:
+                if self.app.map_fused_fn is not None:
+                    with self.metrics.timer("map_compute"), \
+                            trace.annotate(f"map_compute:{a.task_id}"), \
+                            spans_mod.span("map:compute", cat="map",
+                                           fused=len(participants)):
+                        records_per = self.app.map_fused_fn(
+                            items, participants
+                        )
+            except Exception:  # noqa: BLE001 — fusion is a fast path only
+                log.exception(
+                    "fused map attempt failed (%d queries); falling back "
+                    "to solo per-participant execution", len(participants),
+                )
+                records_per = None
+            finally:
+                if has_progress:
+                    self.app.set_progress(None)
+            self.metrics.record_scan(n_bytes, time.perf_counter() - t0)
+
+            def dense_records() -> bool:
+                # the solo shuffle_guard's 50k-record rule, summed over
+                # participants: a local match-dense commit loop can
+                # outrun the sweep window with no RPC activity
+                if records_per is None:
+                    return False
+                from distributed_grep_tpu.runtime.columnar import LineBatch
+
+                n = sum(
+                    len(r) if isinstance(r, LineBatch) else 1
+                    for recs in records_per for r in recs
+                )
+                return n >= 50_000
+
+            with fused_pump(force=dense_records()):
+                for k, part in enumerate(participants):
+                    try:
+                        if records_per is not None:
+                            records = records_per[k]
+                        else:
+                            records = self._solo_participant_records(
+                                part, items, progress
+                            )
+                        self._commit_fused_participant(
+                            part, records,
+                            attempt0 if k == 0 else new_attempt_id(),
+                            n_queries=len(participants),
+                        )
+                        committed += 1
+                    except WorkerKilled:
+                        raise  # fault injection: die like a real crash
+                    except Exception:  # noqa: BLE001 — tenant re-runs solo
+                        log.exception(
+                            "fused participant %s task %d failed; its "
+                            "scheduler will re-issue it",
+                            part["job_id"], part["task_id"],
+                        )
+                    progress()  # stamp the still-pending participants
+            spans_mod.complete(
+                "map:task", t0_wall, time.time() - t0_wall, cat="map",
+                assign_wait_s=round(self._assign_wait_s, 6),
+                fused=len(participants),
+            )
+        self.metrics.inc("fused_map_attempts")
+        self.metrics.observe("map_task_total", time.perf_counter() - t0)
+        log.info(
+            "fused map attempt served %d/%d co-tenant tasks (%s:%d + %d)",
+            committed, len(participants), a.job_id, a.task_id,
+            len(a.fused),
+        )
+
+    def _solo_participant_records(self, part: dict, items: list,
+                                  progress) -> list:
+        """The fused attempt's fallback: run ONE participant's ordinary
+        map over the already-read items (its own configure + batch/plain
+        map), exactly what a solo attempt of its task would compute."""
+        self.app.configure(**part["app_options"])
+        p_items = self._participant_items(items, part)
+        has_progress = self.app.set_progress(progress)
+        try:
+            if self.app.map_batch_fn is not None:
+                return self.app.map_batch_fn(p_items)
+            out = []
+            for name, data in p_items:
+                if not isinstance(data, (bytes, bytearray, memoryview)):
+                    with open(data, "rb") as f:
+                        data = f.read()
+                out.extend(self.app.map_fn(name, bytes(data)))
+            return out
+        finally:
+            if has_progress:
+                self.app.set_progress(None)
+
+    @staticmethod
+    def _participant_items(items: list, part: dict) -> list:
+        """Re-label shared split items with THIS participant's member
+        names (two tenants may address the same content through
+        different paths — symlinks/hardlinks; record keys must carry
+        each job's own names)."""
+        p_names = list(part.get("filenames") or []) or [part.get("filename")]
+        if len(p_names) != len(items):
+            # fail safe, never key this tenant's records by the shared
+            # split's (primary) names: the raise fails THIS participant's
+            # fallback, its own scheduler re-issues the task solo
+            raise RuntimeError(
+                f"fused participant {part.get('job_id')!r} has "
+                f"{len(p_names)} member names for a {len(items)}-item split"
+            )
+        return [(p_names[i], data) for i, (_nm, data) in enumerate(items)]
+
+    def _commit_fused_participant(self, part: dict, records: list,
+                                  attempt: str, n_queries: int) -> None:
+        """One participant's commit leg: bind ITS job's data plane,
+        bucketize with ITS n_reduce, write intermediates under ITS task
+        id, publish ITS commit record, send ITS finished RPC — the exact
+        solo-map commit protocol, replayed per tenant."""
+        import contextlib
+
+        jid, tid = part["job_id"], part["task_id"]
+        self._rpc_job_id = jid
+        self.job_id = jid
+        bind = getattr(self.transport, "bind_job", None)
+        if bind is not None:
+            bind(jid)
+        if self.spans is not None:
+            # explicit job tag: split_by_job routes this record into the
+            # PARTICIPANT's events.jsonl, not the primary's
+            self.spans.add({
+                "t": "instant", "name": "fuse:split", "cat": "fuse",
+                "ts": time.time(), "job": jid, "worker": self.worker_id,
+                "args": {"task": tid, "queries": n_queries},
+            })
+        # the commit leg's spans (map:shuffle, map:commit) carry THIS
+        # participant's job/task tags — under the primary's ambient
+        # context they would all route into the primary's events.jsonl
+        # and its trace row would show K shuffle legs
+        ctx = (
+            spans_mod.task_context(
+                self.spans, job=jid, worker=self.worker_id, task=tid,
+                attempt=attempt, kind="map",
+            )
+            if self.spans is not None else contextlib.nullcontext()
+        )
+        with ctx:
+            with spans_mod.span("map:shuffle", cat="map"):
+                buckets = shuffle.bucketize(records, part["n_reduce"])
+                self._fault("before_map_commit")
+                produced: list[int] = []
+                for r, kvs in sorted(buckets.items()):
+                    self.transport.write_intermediate(
+                        f"mr-{tid}-{r}", shuffle.encode_records(kvs)
+                    )
+                    produced.append(r)
+            self._publish_commit("map", tid, attempt, {"parts": produced})
+            self._fault("before_map_finished")
+            self.transport.map_finished(self._finished_args(
+                rpc.TaskFinishedArgs(
+                    task_id=tid, job_id=jid, worker_id=self.worker_id,
+                    produced_parts=produced,
+                )
+            ))
+        self.metrics.inc("map_tasks")
 
     # ---------------------------------------------------------------- reduce
     def _run_reduce(self, a: rpc.AssignTaskReply) -> None:

@@ -154,6 +154,18 @@ def _fresh_model_cache():
 
 
 @pytest.fixture(autouse=True)
+def _fresh_fusion_counters():
+    """The scan-fusion telemetry counters (ops/fuse.py) are
+    process-global like the cache counters — zero them per test so one
+    test's fused scans never satisfy another's counter assertions."""
+    from distributed_grep_tpu.ops import fuse as _fuse
+
+    _fuse.fusion_counters_clear()
+    yield
+    _fuse.fusion_counters_clear()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_corpus_cache():
     """The device corpus cache (ops/layout.CorpusCache) is process-global
     by design — the service process WANTS shards shared across jobs.

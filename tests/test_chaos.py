@@ -55,7 +55,7 @@ from distributed_grep_tpu.runtime.scheduler import (
 )
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
-from distributed_grep_tpu.runtime.worker import WorkerLoop
+from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
 from distributed_grep_tpu.utils.config import JobConfig
 from distributed_grep_tpu.utils.io import WorkDir
 
@@ -577,6 +577,13 @@ def test_chaos_matrix_daemon_sigkill(tmp_path, monkeypatch, phase, store,
         env={
             "DGREP_SERVICE_MAX_JOBS": "2",  # 3 submits = 2 running + 1 queued
             "DGREP_WORKER_QUARANTINE_S": "1",
+            # fusion OFF: this matrix pins the PRE-fusion daemon's exact
+            # crash/restart behavior (the round-13 no-op contract); the
+            # fused-attempt death path has its own dedicated case below
+            # (test_chaos_worker_killed_mid_fused_attempt) — co-running
+            # same-corpus jobs fusing here would add fused-retry timing
+            # variance to an already load-sensitive 2 s-timeout matrix
+            "DGREP_SERVICE_FUSE": "0",
         },
     ).start()
 
@@ -672,6 +679,108 @@ def test_chaos_matrix_daemon_sigkill(tmp_path, monkeypatch, phase, store,
             (jid, pattern)
 
     # zero duplicate journal commits per job, across both daemon lives
+    for jid in jids:
+        entries = TaskJournal.replay(
+            WorkDir(str(work_root / jid)).journal_path()
+        )
+        seen = [(e["kind"], e["task_id"]) for e in entries]
+        assert len(seen) == len(set(seen)), (jid, seen)
+
+
+# ------------------------------------------- fused-attempt worker death
+
+@pytest.mark.fuse
+def test_chaos_worker_killed_mid_fused_attempt(tmp_path, monkeypatch,
+                                               corpus):
+    """ISSUE 11 chaos bar: a worker dies mid-FUSED-attempt (after the
+    shared scan, before any participant's commit — the widest blast
+    radius: K claimed tasks, zero commits).  Every participant job must
+    finish byte-identical to its fault-free oracle, each job's journal
+    holding each (kind, task) at most once; the re-enqueued tasks re-run
+    SOLO (claim_map_task gates on attempts == 0), so fusion never
+    becomes a correctness dependency."""
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "4")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    work_root = tmp_path / "svc-root"
+    work_root.mkdir()
+    daemon = service_proc.ServiceProc(
+        work_root, workers=0,
+        env={"DGREP_SERVICE_MAX_JOBS": "3"},
+    ).start()
+
+    stop = threading.Event()
+    killed = threading.Event()
+
+    def kill_once() -> None:
+        if not killed.is_set():
+            killed.set()
+            raise WorkerKilled("mid-fused-attempt")
+
+    def worker_main(assassin: bool) -> None:
+        # the killed incarnation is REPLACED by a clean one, like the
+        # matrix's crash-replace loops
+        while not stop.is_set():
+            hooks = (
+                {"before_map_commit": kill_once}
+                if assassin and not killed.is_set() else {}
+            )
+            loop = WorkerLoop(
+                ServiceHttpTransport(f"127.0.0.1:{daemon.port}",
+                                     rpc_timeout_s=10.0),
+                app=None, fault_hooks=hooks,
+            )
+            try:
+                loop.run()
+                return  # JOB_DONE: daemon shut down
+            except WorkerKilled:
+                time.sleep(0.1)
+            except Exception:  # noqa: BLE001 — worker died; replace it
+                time.sleep(0.2)
+
+    patterns = ["hello", "fox", "line"]
+    threads: list[threading.Thread] = []  # bound before any try-exit path
+    try:
+        jids = [daemon.submit(grep_config(
+            corpus, pattern=p, n_reduce=2, task_timeout_s=2.0,
+            sweep_interval_s=0.2, work_dir=str(tmp_path / f"sub{i}"),
+        )) for i, p in enumerate(patterns)]
+        # all three must be RUNNING (fusable) before any worker attaches,
+        # or the first assignment has nothing to fuse with
+        deadline = time.monotonic() + 30
+        while True:
+            assert time.monotonic() < deadline, daemon.tail_log()
+            sts = [daemon.job_status(j) for j in jids]
+            if all(s.get("state") == "running" for s in sts):
+                break
+            time.sleep(0.05)
+        threads = [threading.Thread(target=worker_main, args=(i == 0,),
+                                    daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        results = {}
+        for jid in jids:
+            st = daemon.wait_job(jid, timeout=90)
+            assert st["state"] == "done", (jid, st, daemon.tail_log())
+            results[jid] = daemon.job_result(jid)["outputs"]
+        status = daemon.status()
+        assert killed.is_set()  # the kill actually fired mid-attempt
+        assert status.get("fusion", {}).get("fused_dispatches", 0) >= 1, \
+            status  # fusion actually engaged before the death
+    finally:
+        stop.set()
+        monkeypatch.setenv("DGREP_RPC_RETRIES", "0")
+        daemon.terminate()
+        for t in threads:
+            t.join(timeout=10)
+
+    for jid, pattern, i in zip(jids, patterns, range(3)):
+        oracle = outputs_by_name(run_job(
+            grep_config(corpus, pattern=pattern, n_reduce=2,
+                        work_dir=str(tmp_path / f"oracle{i}")),
+            n_workers=2,
+        ).output_files)
+        assert outputs_by_name(results[jid]) == oracle, (jid, pattern)
+
     for jid in jids:
         entries = TaskJournal.replay(
             WorkDir(str(work_root / jid)).journal_path()

@@ -1,0 +1,417 @@
+"""Cross-tenant scan fusion (ISSUE 11): one dispatch, K exact queries.
+
+Pytest marker ``fuse``, standalone-runnable like ``perf``/``service``:
+
+    python -m pytest tests/test_fuse.py -q
+
+Pins the acceptance bars:
+* fused-vs-solo BYTE identity across kernel families (shift_and / nfa /
+  fdr / pairset / dfa-filter '$' / the \\b re-fallback leg), including
+  ignore_case mixes and candidate-free queries, for scan AND the
+  batched/window path;
+* the dispatch-count proof (``perf`` style: a scan_device spy at the
+  real boundary): K=4 co-running service jobs over one shared corpus
+  run 1 device dispatch per split, not 4, and the fusion counters agree;
+* DGREP_SERVICE_FUSE=0 is a true no-op (no fused planning, no new wire
+  keys, byte-identical outputs);
+* the solo fallback: a broken fused leg still finishes every
+  participant byte-identical (fusion is never a correctness dependency).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_grep_tpu.ops import device_scan
+from distributed_grep_tpu.ops import fuse as fuse_mod
+from distributed_grep_tpu.ops.engine import GrepEngine
+from distributed_grep_tpu.runtime import fusion as fusion_mod
+from distributed_grep_tpu.runtime import rpc
+from distributed_grep_tpu.runtime.job import run_job
+from distributed_grep_tpu.runtime.scheduler import Scheduler
+from distributed_grep_tpu.runtime.service import GrepService
+from distributed_grep_tpu.utils.config import JobConfig
+
+pytestmark = pytest.mark.fuse
+
+
+def _doc() -> bytes:
+    lines = []
+    for j in range(120):
+        lines.append(
+            f"line {j} "
+            + ("hello " if j % 3 == 0 else "")
+            + ("NEEDLE " if j % 7 == 0 else "")
+            + ("error" if j % 5 == 0 else "tail")
+        )
+    lines.append("")  # an empty line (nullable-pattern edge)
+    lines.append("last line without newline")
+    return ("\n".join(lines)).encode()
+
+
+# Specs chosen so the SOLO engines cover every kernel family:
+# shift_and (literal), nfa (alternation+repeat), fdr (many >=2-byte
+# literals), pairset (all 1-2 byte members), the '$' dfa-filter leg, the
+# \b re-fallback leg, an ignore_case member, and a candidate-free query.
+_SPECS = [
+    ("hello", None, False),                              # shift_and
+    ("(needle|err+or)", None, True),                     # nfa, ignore_case
+    (None, ("hello", "needle", "line 11", "tail"), False),   # fdr set
+    (None, ("he", "ta", "x"), False),                    # pairset set
+    ("error$", None, False),                             # '$' device filter
+    (r"\bhello\b", None, False),                         # re-fallback leg
+    ("zz-never-there", None, False),                     # candidate-free
+]
+
+
+def _solo(spec, **kw) -> GrepEngine:
+    pat, pats, ic = spec
+    return GrepEngine(pat, patterns=list(pats) if pats else None,
+                      ignore_case=ic, **kw)
+
+
+def test_fused_vs_solo_identity_across_families():
+    data = _doc()
+    # the union rides the device (interpret) kernel path; the solo
+    # oracles are the exact host engines — device-vs-host solo identity
+    # is pinned elsewhere (test_parallel/test_ops), so fused == cpu-solo
+    # pins fused == solo for every backend
+    fs = fuse_mod.FusedScanner(_SPECS, interpret=True)
+    fused = fs.scan(data)
+    for spec, fr in zip(_SPECS, fused):
+        sr = _solo(spec, backend="cpu").scan(data)
+        assert np.array_equal(sr.matched_lines, fr.matched_lines), (
+            spec, sr.matched_lines, fr.matched_lines,
+        )
+        assert fr.n_matches == fr.matched_lines.size
+        assert fr.bytes_scanned == len(data)
+    cc = fuse_mod.fusion_counters()
+    assert cc["fused_queries"] == len(_SPECS)
+    assert cc["fused_dispatches"] >= 1
+    assert cc["fusion_bytes_saved"] == (len(_SPECS) - 1) * len(data)
+
+
+def test_fused_all_sets_union_is_a_set_engine():
+    """All-literal-set tenants merge into ONE pattern-set union (the
+    FDR/AC machinery is already a multi-literal engine) — no regex
+    escape round trip involved."""
+    specs = [
+        (None, ("hello", "needle"), False),
+        (None, ("tail", "line 7"), True),
+    ]
+    args = fuse_mod.union_engine_args(
+        [fuse_mod.QuerySpec.normalize(s) for s in specs]
+    )
+    assert args.get("patterns") == ["hello", "needle", "tail", "line 7"]
+    assert args["ignore_case"] is True
+    data = _doc()
+    fused = fuse_mod.FusedScanner(specs, backend="cpu").scan(data)
+    for spec, fr in zip(specs, fused):
+        sr = _solo(spec, backend="cpu").scan(data)
+        assert np.array_equal(sr.matched_lines, fr.matched_lines), spec
+
+
+def test_fused_scan_batch_window_identity(tmp_path):
+    """The batched/window path: mixed small files (packed into shared
+    windows), an empty file, and a no-trailing-newline file — per-file
+    fused results equal per-file solo scans, bit for bit."""
+    blobs = {
+        "a.txt": b"hello world\nno match here\nNEEDLE found\n",
+        "b.txt": b"",
+        "c.txt": b"error\nhello error",  # no trailing newline
+        "d.txt": _doc(),
+    }
+    items = []
+    for name, b in blobs.items():
+        p = tmp_path / name
+        p.write_bytes(b)
+        items.append((name, str(p)))
+    fs = fuse_mod.FusedScanner(_SPECS, interpret=True, batch_bytes=1 << 20)
+    outs = fs.scan_batch(items)
+    assert len(outs) == len(_SPECS)
+    for spec, per_file in zip(_SPECS, outs):
+        solo = _solo(spec, backend="cpu")
+        assert [n for n, _ in per_file] == list(blobs)
+        for (name, fr) in per_file:
+            sr = solo.scan(blobs[name])
+            assert np.array_equal(sr.matched_lines, fr.matched_lines), (
+                spec, name,
+            )
+
+
+def test_unfusable_specs_raise_fuse_error():
+    with pytest.raises(fuse_mod.FuseError):
+        fuse_mod.QuerySpec.normalize(("", None, False))
+    with pytest.raises(fuse_mod.FuseError):
+        fuse_mod.QuerySpec.normalize((None, ("ok", ""), False))
+    # backreference-bearing regexes cannot join an alternation (their
+    # groups would repoint) — the union builder refuses them even for
+    # direct API users, not just through the service planner
+    with pytest.raises(fuse_mod.FuseError):
+        fuse_mod.FusedScanner([(r"(a)b\1", None, False),
+                               ("hello", None, False)], backend="cpu")
+    # service-side mirror: unfusable queries get no fusion key at all
+    assert fusion_mod.query_spec({"pattern": ""}) is None
+    assert fusion_mod.query_spec({"pattern": r"(a)\1"}) is None
+    assert fusion_mod.query_spec({"pattern": "x", "max_errors": 1}) is None
+    assert fusion_mod.query_spec({"pattern": "hello"}) == (
+        "hello", None, False,
+    )
+
+
+def test_claim_map_task_first_attempts_only(tmp_path):
+    sched = Scheduler(files=["f1", "f2"], n_reduce=1, task_timeout_s=30.0)
+    try:
+        info = sched.claim_map_task(1, worker_id=7)
+        assert info is not None and info["task_id"] == 1
+        assert info["epoch"] == sched.epoch
+        # already claimed -> not idle -> no double assignment
+        assert sched.claim_map_task(1, worker_id=8) is None
+        # a retried task (attempts > 0) never re-fuses: simulate timeout
+        t = sched.map_tasks[1]
+        from distributed_grep_tpu.runtime.types import TaskState
+
+        t.state = TaskState.UNASSIGNED
+        assert t.attempts == 1
+        assert sched.claim_map_task(1, worker_id=9) is None
+        assert sched.claim_map_task(99, worker_id=9) is None  # bad id
+    finally:
+        sched.stop()
+
+
+# --------------------------------------------------------------- service
+
+def _mk_corpus(tmp_path, n_files=2, n_lines=400) -> list[str]:
+    files = []
+    for i in range(n_files):
+        p = tmp_path / f"in{i}.txt"
+        p.write_text("".join(
+            f"line {j} of {i} {'hello' if j % 3 == 0 else ''}"
+            f"{' fox' if j % 5 == 0 else ''}\n" for j in range(n_lines)
+        ))
+        files.append(str(p))
+    return files
+
+
+def _cfg(files, pattern, work_dir, **app_extra) -> JobConfig:
+    return JobConfig(
+        input_files=files,
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": pattern, **app_extra},
+        n_reduce=2,
+        work_dir=work_dir,
+        task_timeout_s=30.0,
+        sweep_interval_s=0.2,
+    )
+
+
+def _wait_running(svc: GrepService, jids, timeout=10.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(svc.record(j).scheduler is not None for j in jids):
+            return
+        time.sleep(0.02)
+    raise AssertionError("jobs did not all start")
+
+
+def _outputs(paths) -> dict[str, bytes]:
+    return {Path(p).name: Path(p).read_bytes() for p in paths}
+
+
+@pytest.mark.service
+@pytest.mark.perf
+def test_service_dispatch_count_k4_one_per_split(tmp_path, monkeypatch):
+    """The acceptance dispatch proof: K=4 co-running jobs over one
+    shared corpus execute 1 device dispatch per split, counted at the
+    REAL boundary (ops/device_scan.scan_device, the one entry every
+    device-path scan funnels through) — and the fusion counters agree."""
+    calls: list[int] = []
+    orig = device_scan.scan_device
+
+    def counted(eng, data, progress=None, **kw):
+        calls.append(len(data))
+        return orig(eng, data, progress=progress, **kw)
+
+    monkeypatch.setattr(device_scan, "scan_device", counted)
+    files = _mk_corpus(tmp_path, n_files=2)
+    pats = ["hello", "fox", "line 1", "of 0"]
+    svc = GrepService(work_root=tmp_path / "svc")
+    try:
+        jids = [
+            svc.submit(_cfg(files, p, str(tmp_path / f"w{i}"),
+                            backend="device", interpret=True))
+            for i, p in enumerate(pats)
+        ]
+        _wait_running(svc, jids)
+        svc.start_local_workers(1)
+        for j in jids:
+            assert svc.wait_job(j, timeout=120), svc.job_status(j)
+        st = svc.status()
+    finally:
+        svc.stop()
+    n_splits = len(files)  # no batching configured: one task per file
+    # THE bar: 1 device dispatch per split, not K per split
+    assert len(calls) == n_splits, (len(calls), n_splits)
+    assert st["fusion"]["fused_dispatches"] == n_splits
+    assert st["fusion"]["fused_jobs"] == len(pats) * n_splits
+    cc = fuse_mod.fusion_counters()
+    assert cc["fused_dispatches"] == n_splits
+    assert cc["fused_dispatches_saved"] == (len(pats) - 1) * n_splits
+    assert cc["fused_queries"] == len(pats) * n_splits
+
+
+@pytest.mark.service
+def test_service_fused_outputs_identical_and_spans(tmp_path):
+    """Fused service outputs are byte-identical to solo oracles; the
+    fuse:plan / fuse:split instants land in EACH participant's
+    events.jsonl (spans.split_by_job routing)."""
+    import json
+
+    files = _mk_corpus(tmp_path, n_files=2, n_lines=200)
+    pats = ["hello", "fox"]
+    svc = GrepService(work_root=tmp_path / "svc", spans=True)
+    try:
+        jids = [svc.submit(_cfg(files, p, str(tmp_path / f"w{i}"),
+                                backend="cpu"))
+                for i, p in enumerate(pats)]
+        _wait_running(svc, jids)
+        svc.start_local_workers(1)
+        for j in jids:
+            assert svc.wait_job(j, timeout=60)
+        st = svc.status()
+        assert st["fusion"]["fused_dispatches"] >= 1
+        outs = {j: _outputs(svc.record(j).outputs) for j in jids}
+        for j in jids:
+            events = [
+                json.loads(ln) for ln in
+                (svc.work_root / j / "events.jsonl").read_text().splitlines()
+            ]
+            names = {e.get("name") for e in events}
+            assert "fuse:plan" in names, (j, sorted(names))
+            assert "fuse:split" in names, (j, sorted(names))
+    finally:
+        svc.stop()
+    for i, (j, p) in enumerate(zip(jids, pats)):
+        oracle = run_job(
+            _cfg(files, p, str(tmp_path / f"oracle{i}"), backend="cpu"),
+            n_workers=2,
+        )
+        assert outs[j] == _outputs(oracle.output_files), (j, p)
+
+
+@pytest.mark.service
+def test_fusion_disabled_is_a_noop(tmp_path, monkeypatch):
+    """DGREP_SERVICE_FUSE=0: no planning (no stats, no fusion_key, no
+    /status key), the fused reply field never reaches the wire, and
+    outputs match the solo oracles exactly."""
+    monkeypatch.setenv("DGREP_SERVICE_FUSE", "0")
+    # wire shape: a default reply serializes WITHOUT the new key
+    assert "fused" not in rpc.reply_to_dict(rpc.AssignTaskReply())
+    assert "fused" not in rpc.to_dict(rpc.AssignTaskReply())
+    files = _mk_corpus(tmp_path, n_files=2, n_lines=120)
+    pats = ["hello", "fox"]
+    svc = GrepService(work_root=tmp_path / "svc")
+    try:
+        jids = [svc.submit(_cfg(files, p, str(tmp_path / f"w{i}"),
+                                backend="cpu"))
+                for i, p in enumerate(pats)]
+        for j in jids:
+            assert svc.record(j).fusion_key is None
+        _wait_running(svc, jids)
+        svc.start_local_workers(1)
+        for j in jids:
+            assert svc.wait_job(j, timeout=60)
+        st = svc.status()
+        assert "fusion" not in st
+        outs = {j: _outputs(svc.record(j).outputs) for j in jids}
+    finally:
+        svc.stop()
+    assert not fuse_mod.fusion_counters()
+    for i, (j, p) in enumerate(zip(jids, pats)):
+        oracle = run_job(
+            _cfg(files, p, str(tmp_path / f"oracle-off{i}"), backend="cpu"),
+            n_workers=2,
+        )
+        assert outs[j] == _outputs(oracle.output_files), (j, p)
+
+
+@pytest.mark.service
+def test_submit_pattern_set_parity(tmp_path, capsys):
+    """ISSUE 11 satellite: `dgrep submit -F -e A -e B` (and -f/-E) plumb
+    pattern SETS into the submitted JobConfig the same way the local CLI
+    path does — the service runs the multi-pattern job and its outputs
+    match the local run_job oracle."""
+    import json
+
+    from distributed_grep_tpu import __main__ as cli
+    from distributed_grep_tpu.runtime.service import ServiceServer
+
+    files = _mk_corpus(tmp_path, n_files=2, n_lines=80)
+    svc = GrepService(work_root=tmp_path / "svc")
+    server = ServiceServer(svc)
+    server.start()
+    try:
+        svc.start_local_workers(1)
+        rc = cli.main([
+            "submit", "--addr", f"127.0.0.1:{server.port}",
+            "-F", "-e", "hello", "-e", "fox", *files,
+            "--timeout", "60",
+        ])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert rc == 0, out
+        doc = json.loads(out[-1])
+        assert doc["state"] == "done" and doc["outputs"]
+        got = _outputs(doc["outputs"])
+    finally:
+        server.shutdown()
+        svc.stop()
+    oracle = run_job(
+        JobConfig(
+            input_files=files,
+            application="distributed_grep_tpu.apps.grep_tpu",
+            app_options={"patterns": ["hello", "fox"], "backend": "cpu"},
+            n_reduce=10,
+            work_dir=str(tmp_path / "oracle"),
+        ),
+        n_workers=2,
+    )
+    assert got == _outputs(oracle.output_files)
+
+
+@pytest.mark.service
+def test_fused_leg_failure_falls_back_to_solo(tmp_path, monkeypatch):
+    """Fusion is a fast path, never a correctness dependency: with the
+    union scanner broken outright, the fused attempt's solo fallback
+    still finishes every participant byte-identical to its oracle."""
+
+    def boom(*a, **kw):
+        raise fuse_mod.FuseError("injected: union scanner down")
+
+    monkeypatch.setattr(fuse_mod, "FusedScanner", boom)
+    files = _mk_corpus(tmp_path, n_files=2, n_lines=120)
+    pats = ["hello", "fox"]
+    svc = GrepService(work_root=tmp_path / "svc")
+    try:
+        jids = [svc.submit(_cfg(files, p, str(tmp_path / f"w{i}"),
+                                backend="cpu"))
+                for i, p in enumerate(pats)]
+        _wait_running(svc, jids)
+        svc.start_local_workers(1)
+        for j in jids:
+            assert svc.wait_job(j, timeout=60)
+        # planning DID fuse (the daemon's counters moved) …
+        assert svc.status()["fusion"]["fused_dispatches"] >= 1
+        outs = {j: _outputs(svc.record(j).outputs) for j in jids}
+    finally:
+        svc.stop()
+    # … and the fallback still produced exact per-tenant outputs
+    for i, (j, p) in enumerate(zip(jids, pats)):
+        oracle = run_job(
+            _cfg(files, p, str(tmp_path / f"oracle-fb{i}"), backend="cpu"),
+            n_workers=2,
+        )
+        assert outs[j] == _outputs(oracle.output_files), (j, p)
