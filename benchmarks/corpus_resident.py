@@ -106,6 +106,11 @@ def main() -> int:
     ap.add_argument("--timing", default="e2e", choices=["e2e", "slope"],
                     help="slope: additionally slope-time the device-"
                          "resident rescan of the packed corpus")
+    ap.add_argument("--index", action="store_true",
+                    help="add a shard-index leg (all three caches warm) "
+                         "so model / corpus / index are attributable "
+                         "separately; the base legs always run with "
+                         "DGREP_INDEX=0 so their meaning is unchanged")
     ap.add_argument("--device", action="store_true",
                     help="do NOT pin JAX_PLATFORMS=cpu (live tunnel window)")
     ap.add_argument("--check", action="store_true",
@@ -161,6 +166,10 @@ def main() -> int:
             raise RuntimeError(f"job {job_id} ended {st['state']}: {st}")
         return dt, call("GET", f"/jobs/{job_id}/result")
 
+    # The base legs run with the shard index OFF: their round-7 meaning
+    # (model vs corpus attribution) is unchanged by the index tier — the
+    # --index leg below measures the third cache separately.
+    os.environ["DGREP_INDEX"] = "0"
     cold_s, cold_res = submit_and_wait()
     # model-warm leg: the compiled-model cache answers, but the corpus
     # cache is emptied — the submit pays the full data path again
@@ -172,6 +181,22 @@ def main() -> int:
         dt, warm_res = submit_and_wait()
         warm.append(dt)
     warm_s = min(warm)
+
+    index_warm_s = None
+    index_res = None
+    if args.index:
+        # shard-index leg: all THREE caches answer.  One untimed pass
+        # builds + persists the summaries; the timed reps then route —
+        # shards the query cannot match are pruned at the planner, so
+        # warm cost falls from O(corpus) toward O(matching shards).
+        os.environ.pop("DGREP_INDEX", None)
+        submit_and_wait()  # summary-building pass
+        idx = []
+        for _ in range(max(1, args.warm_reps)):
+            dt, index_res = submit_and_wait()
+            idx.append(dt)
+        index_warm_s = min(idx)
+    os.environ.pop("DGREP_INDEX", None)
     status = call("GET", "/status")
     corpus = status.get("corpus_cache", {})
 
@@ -194,6 +219,12 @@ def main() -> int:
         "corpus_cache_misses": int(corpus.get("corpus_cache_misses", 0)),
         "bytes_resident": int(corpus.get("corpus_cache_bytes_resident", 0)),
     }
+    if index_warm_s is not None:
+        out["index_warm_s"] = round(index_warm_s, 4)
+        out["index_speedup_vs_warm"] = (
+            round(warm_s / index_warm_s, 3) if index_warm_s else 0.0
+        )
+        out["index"] = status.get("index", {})
 
     if args.check:
         def by_name(res: dict) -> dict:
@@ -201,6 +232,8 @@ def main() -> int:
                     for p in res.get("outputs", [])}
 
         identical = by_name(cold_res) == by_name(warm_res)
+        if index_res is not None:
+            identical = identical and by_name(index_res) == by_name(cold_res)
         out["check"] = "ok" if identical else "MISMATCH"
 
     service.stop()

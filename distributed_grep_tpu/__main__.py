@@ -1213,6 +1213,18 @@ def cmd_submit(args: argparse.Namespace) -> int:
             out["outputs"] = call("GET", f"/jobs/{job_id}/result")["outputs"]
         elif status.get("error"):
             out["error"] = status["error"]
+        # shard-index routing, surfaced without trace-export: how many
+        # shards the planner never dispatched (and the bytes they would
+        # have scanned) — nonzero-only, so index-off daemons and
+        # unpruned jobs keep the exact pre-index line
+        counters = (status.get("metrics") or {}).get("counters") or {}
+        if counters.get("index_shards_pruned"):
+            out["index_shards_pruned"] = int(
+                counters["index_shards_pruned"]
+            )
+            out["index_bytes_skipped"] = int(
+                counters.get("index_bytes_skipped", 0)
+            )
     except OSError as e:  # urllib.error.* are OSError subclasses
         out["error"] = f"lost service at {args.addr}: {e}"
     print(json.dumps(out))

@@ -180,6 +180,21 @@ KNOBS: dict[str, Knob] = {
         "libdgrep-tsan.so); a set-but-unloadable path raises instead of "
         "silently degrading to the Python fallbacks.",
     ),
+    "DGREP_INDEX": Knob(
+        "index/summary.py", "on",
+        "Shard-index tier (trigram summaries route queries past shards "
+        "that cannot match): 0/false disables every lookup, build, and "
+        "prune — planning, wire payloads, and outputs revert to the "
+        "pre-index behavior exactly (accessor: "
+        "index/summary.env_index_enabled).",
+    ),
+    "DGREP_INDEX_SUMMARY_BYTES": Knob(
+        "index/summary.py", "16384",
+        "Per-shard trigram bloom size, rounded down to a power of two in "
+        "[1 KB, 1 MB]; larger summaries lower the bloom false-positive "
+        "rate on trigram-dense shards (accessor: "
+        "index/summary.env_summary_bytes).",
+    ),
 }
 
 

@@ -91,11 +91,26 @@ def configure(
     # only per-file TRUTHINESS is consumed, so the streaming scan may stop
     # at the first chunk containing a match (GNU grep -q/-l stop at the
     # first match); the emitted count may then be partial
+    index_dir: object = None,  # shard-index persistence root (the service
+    # sets <work_root>/index at submit): worker-built trigram summaries
+    # land there, so the daemon's split planner — and the NEXT daemon
+    # after a restart — prunes shards this worker already summarized
     **engine_opts: object,
 ) -> None:
     global _engine, _invert, _confirm, _count_only, _presence, _configured_with
     if isinstance(pattern, bytes):
         pattern = pattern.decode("utf-8", "surrogateescape")
+    if index_dir is not None or _configured_with is not None:
+        # BEFORE the same-config short-circuit: the store must follow the
+        # daemon even when the engine config is unchanged across jobs —
+        # attach when a dir arrives, DETACH when a later job has none (a
+        # worker that outlives its daemon must not keep publishing into a
+        # retired work root, and an index-off daemon's workers must stay
+        # summary-free).  First-ever configure with no dir skips the
+        # import entirely (one-shot CLI jobs never touch the tier).
+        from distributed_grep_tpu.index import summary as _index_summary
+
+        _index_summary.attach_store(index_dir if index_dir else None)
     _invert = bool(invert)
     _count_only = bool(count_only)
     _presence = bool(presence_only)
@@ -208,6 +223,11 @@ def map_batch_fn(items) -> list[KeyValue]:
         emit=lambda name, data, res: records.extend(
             _records_for(name, data, res)
         ),
+        # shard-index member pruning skips the read and emits (name, b"",
+        # empty result) — exact for print and count records (zero matches
+        # IS the proven answer) but NOT for -v, whose complement needs
+        # the file's real lines: invert keeps every read
+        index_prune=not _invert,
     )
     return records
 
@@ -240,6 +260,7 @@ _APP_OPTION_KEYS = frozenset((
     "pattern", "patterns", "ignore_case", "invert", "word_regexp",
     "line_regexp", "count_only", "presence_only", "max_errors",
     "backend", "devices", "mesh_shape", "mesh_axes", "pattern_axis",
+    "index_dir",
 ))
 
 

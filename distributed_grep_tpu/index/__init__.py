@@ -1,0 +1,36 @@
+"""Shard-index tier: trigram/bloom summaries route queries past shards
+that cannot match.
+
+The subsystem has three halves, all jax-free (the service daemon's
+control plane imports ``index.plan`` at submit time, and a remote-worker
+daemon must stay importable without the ops stack):
+
+* ``index.summary`` — the summary format: a fixed-size case-folded
+  trigram-presence bloom per shard (native ``dgrep_trigram_summary``
+  pass with a bit-identical numpy fallback), the in-memory
+  ``SummaryCache``, the module telemetry counters, and the DGREP_INDEX /
+  DGREP_INDEX_SUMMARY_BYTES knobs.
+* ``index.store`` — per-work-root persistence: one file per shard keyed
+  by the content-identity validator tuple (realpath + size/mtime_ns/
+  inode — the CorpusCache contract), atomically replaced, stat-drift
+  rejected at load.  A daemon restart reloads summaries, so "warm"
+  survives the process.
+* ``index.plan`` — the query planner: required-literal alternatives
+  derived from the regex AST / pattern set (Google Code Search's trigram
+  trick: trigram absent => literal absent => no match), plus the
+  ``SplitPruner`` the service's ``plan_map_splits`` call consults so
+  pruned splits never become map tasks.
+
+Exactness never depends on the index: a summary only ever answers
+"cannot match"; a maybe — or a missing/stale summary — always scans.
+"""
+
+from distributed_grep_tpu.index.summary import (  # noqa: F401
+    DEFAULT_SUMMARY_BYTES,
+    build_summary,
+    env_index_enabled,
+    env_summary_bytes,
+    index_counters,
+    index_counters_clear,
+    summary_cache,
+)

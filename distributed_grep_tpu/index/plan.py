@@ -1,0 +1,250 @@
+"""Query planner: required-literal alternatives + split pruning.
+
+The routing claim the whole tier rests on: if every match of a query
+must contain at least one member of a literal set (the query's
+**required-literal alternatives**), then a shard whose summary lacks
+some trigram of EVERY member cannot match — Google Code Search's trigram
+query rewrite, reduced to the presence form a per-shard bloom can
+answer.  Derivation is deliberately conservative: anything the walk
+cannot prove required yields None (index-INELIGIBLE — the query scans
+everything), never a weaker-than-true requirement.  Ineligible by
+construction: empty-match patterns (no required bytes), approx mode
+(edits can destroy any literal), and any alternative under 3 bytes (no
+trigram to check).
+
+Both sides derive from the SAME inputs — the daemon-side SplitPruner
+from the JobConfig's app options, the engine from its stashed
+constructor args — so planner and engine can never disagree on
+eligibility.  jax-free (models/dfa is numpy-only): safe on the service
+control plane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_grep_tpu.index import summary as summary_mod
+from distributed_grep_tpu.models import dfa as _dfa
+
+# Alternatives cap: a query needing more than this many required-literal
+# alternatives checks too many grams per shard to be worth the lookup
+# (and giant alternations are FDR's territory anyway).
+MAX_ALTERNATIVES = 64
+
+
+def _singleton(node) -> int | None:
+    """The one byte of a single-member Char class, or None."""
+    mask = node.mask
+    if mask == 0 or mask & (mask - 1):
+        return None
+    return mask.bit_length() - 1
+
+
+def _node_alts(node) -> list[bytes] | None:
+    """Literal alternatives such that EVERY match of ``node`` contains at
+    least one of them, or None when no usable set exists.  Weakening is
+    always sound here (a shorter run / a superset of alternatives is a
+    weaker claim that still holds); returning None just forfeits pruning."""
+    if isinstance(node, _dfa.Char):
+        b = _singleton(node)
+        return [bytes([b])] if b is not None else None
+    if isinstance(node, _dfa.Anchor):
+        return None  # zero-width: no required bytes
+    if isinstance(node, _dfa.Repeat):
+        if node.min < 1:
+            return None  # optional: nothing is required
+        sub = _node_alts(node.node)
+        if sub is not None and len(sub) == 1 and len(sub[0]) == 1:
+            # a{3,} requires "aaa": min copies of a singleton concatenate
+            return [sub[0] * min(node.min, 8)]
+        return sub  # >= 1 copy: the inner requirement holds
+    if isinstance(node, _dfa.Alt):
+        out: list[bytes] = []
+        for opt in node.options:
+            sub = _node_alts(opt)
+            if sub is None or len(out) + len(sub) > MAX_ALTERNATIVES:
+                return None  # one unconstrained branch unconstrains the Alt
+            out.extend(sub)
+        return out or None
+    if isinstance(node, _dfa.Concat):
+        # Every part is required, so we may pick the single BEST
+        # requirement; consecutive singleton chars stitch into longer
+        # literal runs (zero-width anchors and non-literal parts break a
+        # run — breaking only weakens the claim, which stays sound).
+        candidates: list[list[bytes]] = []
+        run = b""
+        for part in node.parts:
+            b = _singleton(part) if isinstance(part, _dfa.Char) else None
+            if b is not None:
+                run += bytes([b])
+                continue
+            if run:
+                candidates.append([run])
+                run = b""
+            if isinstance(part, (_dfa.Anchor,)):
+                continue
+            sub = _node_alts(part)
+            if sub is not None:
+                candidates.append(sub)
+        if run:
+            candidates.append([run])
+        best: list[bytes] | None = None
+        best_len = 0
+        for c in candidates:
+            mn = min(len(x) for x in c)
+            if mn > best_len:
+                best, best_len = c, mn
+        return best
+    return None
+
+
+class QueryRequirements:
+    """Compiled query side of the index: folded trigram codes per
+    required-literal alternative.  ``may_match(summary)`` is True unless
+    every alternative has some trigram absent — the only verdict that
+    prunes, and it is exact ("cannot match"), never a guess."""
+
+    __slots__ = ("alternatives", "literals")
+
+    def __init__(self, literals: list[bytes]):
+        self.literals = literals
+        self.alternatives = [summary_mod.trigram_codes(l) for l in literals]
+
+    def may_match(self, summary: bytes) -> bool:
+        return any(
+            summary_mod.has_all_trigrams(summary, codes)
+            for codes in self.alternatives
+        )
+
+
+def _as_bytes(p) -> bytes:
+    return (
+        p.encode("utf-8", "surrogateescape") if isinstance(p, str)
+        else bytes(p)
+    )
+
+
+def requirements_for_query(
+    pattern: str | None = None,
+    patterns: list | None = None,
+    ignore_case: bool = False,
+    max_errors: int = 0,
+) -> QueryRequirements | None:
+    """The query's required-literal alternatives, or None = ineligible
+    (scan everything).  Pattern sets are literal sets by contract (the
+    AC/FDR engines): the members ARE the alternatives.  Single patterns
+    parse through the models/dfa AST; parsing is case-SENSITIVE — the
+    summary's build-time fold makes ignore_case a query-time no-op (the
+    trigram codes fold on both sides), so ``ignore_case`` only matters
+    to the engines, not to eligibility.  Every alternative must carry at
+    least one trigram (>= 3 bytes) — a shorter member can never be
+    ruled out, which would make pruning unsound."""
+    if max_errors:
+        return None  # approx: k edits can destroy any required literal
+    if patterns is not None:
+        lits = [_as_bytes(p) for p in patterns]
+        if not lits or len(lits) > MAX_ALTERNATIVES:
+            return None
+    else:
+        if pattern is None:
+            return None
+        if isinstance(pattern, bytes):
+            pattern = pattern.decode("utf-8", "surrogateescape")
+        try:
+            ast = _dfa._Parser(pattern, ignore_case=False).parse()
+        except _dfa.RegexError:
+            return None  # outside the subset: no sound derivation
+        lits = _node_alts(ast)
+        if not lits:
+            return None
+    if any(len(l) < 3 for l in lits):
+        return None
+    req = QueryRequirements(lits)
+    if any(c.size == 0 for c in req.alternatives):
+        return None
+    return req
+
+
+# ------------------------------------------------------- split pruning
+
+class SplitPruner:
+    """The daemon-side hook runtime/job.plan_map_splits consults: a file
+    whose persisted summary rules the query out is dropped from the plan
+    — no map task, no worker open, no dispatch.  Tallies are the
+    caller's to surface (the service stamps them into per-job metrics
+    and the /status "index" view); this object never touches the module
+    counters (those are the ENGINE side's, and an in-process worker
+    would double-count).  All I/O (store loads) runs at plan time,
+    outside every service/scheduler lock."""
+
+    def __init__(self, requirements: QueryRequirements, store):
+        self.requirements = requirements
+        self.store = store
+        self.shards_pruned = 0
+        self.bytes_skipped = 0
+        self.maybe_scans = 0
+
+    def prune(self, path) -> bool:
+        key = summary_mod.file_key(path)
+        if key is None:
+            return False
+        # memory first (an in-process-worker daemon shares the global
+        # cache the workers populate), then this job's persistent store
+        s = summary_mod.summary_cache().lookup(key)
+        if s is None and self.store is not None:
+            s = self.store.load(key)
+            if s is not None:
+                summary_mod.summary_cache().put(key, s)
+        if s is None:
+            return False
+        if self.requirements.may_match(s):
+            self.maybe_scans += 1
+            return False
+        self.shards_pruned += 1
+        self.bytes_skipped += key.n_bytes
+        return True
+
+
+# App options the grep apps define whose zero-match output is NOT empty:
+# such a job must keep its map tasks even for shards that cannot match
+# (an inverted file emits every line; count/presence jobs emit a record
+# per file).  Engine-level pruning stays exact for them — only the
+# planner (which removes whole tasks) gates on these.
+_UNPRUNABLE_OPTIONS = ("invert", "count_only", "presence_only")
+
+GREP_APPLICATION = "distributed_grep_tpu.apps.grep_tpu"
+
+
+def pruner_for_job(config, index_root) -> SplitPruner | None:
+    """A SplitPruner for this JobConfig, or None when planner-level
+    pruning is not sound or not possible: index off, a non-grep_tpu app
+    (the planner cannot know a foreign app's zero-match output), an
+    option whose zero-match output is non-empty, or an ineligible query."""
+    if not summary_mod.env_index_enabled():
+        return None
+    if getattr(config, "application", None) != GREP_APPLICATION:
+        return None
+    opts = config.effective_app_options()
+    if any(opts.get(k) for k in _UNPRUNABLE_OPTIONS):
+        return None
+    try:
+        req = requirements_for_query(
+            pattern=opts.get("pattern"),
+            patterns=opts.get("patterns"),
+            ignore_case=bool(opts.get("ignore_case")),
+            max_errors=int(opts.get("max_errors") or 0),
+        )
+    except Exception:  # noqa: BLE001 — derivation must never break submit
+        req = None
+    if req is None:
+        return None
+    from distributed_grep_tpu.index.store import IndexStore
+
+    store = IndexStore(index_root)
+    if not (summary_mod.summary_cache().nonempty or store.root.is_dir()):
+        # nothing to consult anywhere (no summary ever built in-process,
+        # no persisted store yet): skip the per-file stat + guaranteed-
+        # ENOENT load work — the engine side's may_route() discipline,
+        # planner edition.  One dir stat per submit buys it.
+        return None
+    return SplitPruner(req, store)

@@ -1,0 +1,98 @@
+"""Persistent shard-summary store: one file per shard under a work root.
+
+Layout: ``<root>/<sha256(identity)[:40]>.tgs`` — a JSON header line
+(identity, validators, version) followed by the raw bloom bytes.  Writes
+are tmp + ``os.replace`` (atomic: readers see the old summary or the new
+one, never a torn file) with NO fsync — a summary is a cache artifact; a
+lost one rebuilds on the next cold scan, which is cheaper than an fsync
+per shard on the scan path.  Loads compare identity AND validators
+against the caller's FRESH stat: any size/mtime_ns/inode drift means the
+content changed — the stale file is deleted and the caller scans (the
+CorpusCache stale-never-served contract, persisted).
+
+All I/O here runs in caller context with no lock held (the SummaryCache
+lock wraps dict surgery only — locked-blocking discipline).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from pathlib import Path
+
+_VERSION = 1
+
+
+def _canon(obj):
+    """Tuples -> lists, recursively: the JSON round-trip shape, so stored
+    headers compare equal to a live key's fields."""
+    if isinstance(obj, (list, tuple)):
+        return [_canon(x) for x in obj]
+    return obj
+
+
+class IndexStore:
+    def __init__(self, root):
+        self.root = Path(root)
+        self._made = False
+
+    def _path_for(self, identity) -> Path:
+        blob = json.dumps(_canon(identity), ensure_ascii=True,
+                          separators=(",", ":"))
+        h = hashlib.sha256(blob.encode("utf-8", "surrogatepass")).hexdigest()
+        return self.root / f"{h[:40]}.tgs"
+
+    def load(self, key) -> bytes | None:
+        """The stored summary for ``key``, or None.  A record whose
+        validators disagree with the key's fresh stat is STALE: deleted
+        (best-effort) and never served."""
+        p = self._path_for(key.identity)
+        try:
+            with open(p, "rb") as f:
+                header = json.loads(f.readline())
+                blob = f.read()
+        except (OSError, ValueError):
+            return None
+        if (
+            header.get("v") != _VERSION
+            or header.get("identity") != _canon(key.identity)
+            or len(blob) != header.get("m")
+        ):
+            return None
+        if header.get("validators") != _canon(key.validators):
+            try:
+                os.unlink(p)  # stat drift: evict the stale record
+            except OSError:
+                pass
+            return None
+        return blob
+
+    def save(self, key, summary: bytes) -> None:
+        """Atomically persist ``summary`` under ``key`` (best-effort: a
+        full disk degrades warm routing, never the scan)."""
+        p = self._path_for(key.identity)
+        header = json.dumps({
+            "v": _VERSION,
+            "identity": _canon(key.identity),
+            "validators": _canon(key.validators),
+            "m": len(summary),
+        }, ensure_ascii=True, separators=(",", ":"))
+        tmp = p.with_name(
+            f".{p.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+        )
+        try:
+            if not self._made:
+                self.root.mkdir(parents=True, exist_ok=True)
+                self._made = True
+            with open(tmp, "wb") as f:
+                f.write(header.encode("utf-8", "surrogatepass"))
+                f.write(b"\n")
+                f.write(summary)
+            os.replace(tmp, p)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

@@ -1,0 +1,371 @@
+"""Trigram shard summaries: format, builders, cache, counters, knobs.
+
+One summary is a fixed-size bloom over the CASE-FOLDED trigrams of a
+shard's bytes (a file, or a packed batch window): 2 bits per trigram
+position, indexed by the low/high 32-bit halves of one 64-bit Fibonacci
+mix of the 24-bit folded trigram code.  Folding at build time makes
+``ignore_case`` an index-time no-op — a case-insensitive query folds its
+required literals to the same grams; a case-sensitive query only
+over-approximates (fold can merge grams, never drop them), so the
+"cannot match" verdict stays sound in both directions.
+
+The native pass (``dgrep_trigram_summary``, utils/native.py) and the
+numpy fallback below produce IDENTICAL bits — persisted summaries never
+depend on which side built them (pinned by tests/test_index.py).
+
+Knobs (single-owner rule, registered in analysis/knobs.py):
+
+* ``DGREP_INDEX`` — the tier's kill-switch (default ON; 0/false/no
+  disables every lookup, build, and prune — byte-for-byte the pre-index
+  behavior).
+* ``DGREP_INDEX_SUMMARY_BYTES`` — bloom size per shard (default 16 KB;
+  clamped to a power of two in [1 KB, 1 MB]).  Larger summaries lower
+  the bloom false-positive rate on trigram-dense shards; mixed sizes
+  coexist (each summary carries its own size).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from distributed_grep_tpu.utils import lockdep as _lockdep
+
+DEFAULT_SUMMARY_BYTES = 16384  # 131072 bits: ~µ-scale fp on file-sized shards
+
+# In-memory cache cap (entries): bounded RAM for a long-lived service
+# process — 4096 x 16 KB default summaries ≈ 64 MB, well under the corpus
+# cache's host-bytes footprint for the same shards.
+CACHE_MAX_ENTRIES = 4096
+
+_MIX = np.uint64(0x9E3779B97F4A7C15)  # Fibonacci multiplier (same as C)
+
+
+def env_index_enabled(default: bool = True) -> bool:
+    """The shard-index master switch — the ONE parser of DGREP_INDEX.
+    On by default (the warm service/engine paths it accelerates); "0"/
+    "false"/"no" turns the whole tier off: no lookups, no builds, no
+    pruning, no /status key — the pre-index behavior exactly."""
+    raw = os.environ.get("DGREP_INDEX")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
+def env_summary_bytes(default: int = DEFAULT_SUMMARY_BYTES) -> int:
+    """Per-shard bloom size — the ONE parser of DGREP_INDEX_SUMMARY_BYTES
+    (malformed keeps the default, matching env_batch_bytes' shrug-off
+    policy).  Rounded DOWN to a power of two in [1 KB, 1 MB]: the two-
+    probe bit indexing masks with size*8-1, so a non-power-of-two would
+    bias the hash and desynchronize the C and numpy builders."""
+    raw = os.environ.get("DGREP_INDEX_SUMMARY_BYTES")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    v = min(max(v, 1 << 10), 1 << 20)
+    return 1 << (v.bit_length() - 1)
+
+
+# --------------------------------------------------------------- trigrams
+
+# ASCII case fold (A-Z -> a-z), as a 256-entry LUT for the vectorized paths.
+_FOLD = np.arange(256, dtype=np.uint8)
+_FOLD[ord("A"):ord("Z") + 1] += 32
+
+
+def trigram_codes(literal: bytes) -> np.ndarray:
+    """The folded 24-bit trigram codes of ``literal`` (deduped, sorted) —
+    the query side of the index.  Empty for literals under 3 bytes (no
+    trigram: such a literal can never be ruled out by the summary)."""
+    if len(literal) < 3:
+        return np.zeros(0, dtype=np.uint64)
+    f = _FOLD[np.frombuffer(literal, dtype=np.uint8)].astype(np.uint64)
+    v = (f[:-2] << np.uint64(16)) | (f[1:-1] << np.uint64(8)) | f[2:]
+    return np.unique(v)
+
+
+def _bit_indices(codes: np.ndarray, n_bits: int) -> np.ndarray:
+    """The two bloom bit indices per trigram code (concatenated) — the
+    shared math of the builder fallback and the membership check."""
+    h = codes.astype(np.uint64) * _MIX
+    mask = np.uint64(n_bits - 1)
+    return np.concatenate([h & mask, (h >> np.uint64(32)) & mask])
+
+
+def build_summary(data: bytes, summary_bytes: int | None = None) -> bytes:
+    """The trigram bloom of ``data``: native one-pass when libdgrep
+    carries dgrep_trigram_summary, else the bit-identical numpy scatter
+    (chunked with a 2-byte overlap so temporaries stay bounded).  A
+    shard under 3 bytes yields the all-zero summary — correct: it cannot
+    contain any 3+-byte required literal."""
+    m = summary_bytes if summary_bytes is not None else env_summary_bytes()
+    bloom = np.zeros(m, dtype=np.uint8)
+    from distributed_grep_tpu.utils import native as native_mod
+
+    if native_mod.trigram_summary_into(data, bloom):
+        _count("index_summaries_built")
+        return bloom.tobytes()
+    n_bits = m * 8
+    step = 8 << 20
+    arr = np.frombuffer(data, dtype=np.uint8)
+    for pos in range(0, max(len(data) - 2, 0), step):
+        piece = _FOLD[arr[pos:pos + step + 2]].astype(np.uint64)
+        if piece.size < 3:
+            break
+        v = (
+            (piece[:-2] << np.uint64(16))
+            | (piece[1:-1] << np.uint64(8))
+            | piece[2:]
+        )
+        idx = np.unique(_bit_indices(v, n_bits))
+        np.bitwise_or.at(
+            bloom, (idx >> np.uint64(3)).astype(np.int64),
+            (np.uint8(1) << (idx & np.uint64(7)).astype(np.uint8)),
+        )
+    _count("index_summaries_built")
+    return bloom.tobytes()
+
+
+def has_all_trigrams(summary: bytes, codes: np.ndarray) -> bool:
+    """True unless some trigram of the literal is ABSENT from the bloom —
+    i.e. False is the proof "this literal does not occur in the shard"
+    (bit absent => trigram absent => literal absent); True is only ever
+    "maybe"."""
+    if codes.size == 0:
+        return True  # no trigram to check: can never rule the literal out
+    bloom = np.frombuffer(summary, dtype=np.uint8)
+    idx = _bit_indices(codes, bloom.size * 8)
+    bits = (
+        bloom[(idx >> np.uint64(3)).astype(np.int64)]
+        >> (idx & np.uint64(7)).astype(np.uint8)
+    ) & 1
+    return bool(bits.all())
+
+
+# ---------------------------------------------------------------- telemetry
+
+_counters_lock = _lockdep.make_lock("index-counters")
+_counters = {
+    "index_shards_pruned": 0,
+    "index_bytes_skipped": 0,
+    "index_maybe_scans": 0,
+    "index_summaries_built": 0,
+}
+# Lock-free never-touched fast path (the corpus cache's `_touched`
+# convention): engine.scan() polls index_counters() once per chunk, and
+# on processes where the index never fires that poll must not serialize
+# worker threads on a process-global mutex.  Plain attribute — CPython
+# reads/writes are atomic, and a stale False costs one scan's telemetry
+# reading {} at the exact moment of first touch.
+_touched = False
+
+
+def _count(key: str, n: int = 1) -> None:
+    global _touched
+    with _counters_lock:
+        _counters[key] += n
+        _touched = True
+
+
+def record_prune(n_bytes: int) -> None:
+    """One shard skipped by the index (engine side)."""
+    global _touched
+    with _counters_lock:
+        _counters["index_shards_pruned"] += 1
+        _counters["index_bytes_skipped"] += int(n_bytes)
+        _touched = True
+
+
+def record_maybe() -> None:
+    """A summary was consulted but could not rule the query out."""
+    _count("index_maybe_scans")
+
+
+def index_counters() -> dict:
+    """Copy of the counters, or {} when the index was never touched —
+    the nonzero-only contract every cache counter dict follows (zero-
+    activity processes never grow stats/piggyback/status keys).  The
+    never-touched answer is LOCK-FREE (see _touched above)."""
+    if not _touched:
+        return {}
+    with _counters_lock:
+        if not any(_counters.values()):
+            return {}
+        return dict(_counters)
+
+
+def index_counters_clear() -> None:
+    global _touched
+    with _counters_lock:
+        for k in _counters:
+            _counters[k] = 0
+        _touched = False
+
+
+# ------------------------------------------------------------ shard keys
+
+@dataclass(frozen=True)
+class ShardKey:
+    """Content identity of one shard — the same (identity, validators)
+    shape as ops/layout.CorpusKey (which the engine passes here
+    directly, duck-typed), redeclared so the daemon-side planner can
+    derive keys without importing the ops package."""
+
+    identity: tuple  # ("file", realpath) | ("pack", (realpath, ...))
+    validators: tuple  # ((size, mtime_ns, ino), ...), one per member
+
+    @property
+    def n_bytes(self) -> int:
+        return sum(v[0] for v in self.validators)
+
+
+def file_key(path) -> ShardKey | None:
+    """ShardKey for a filesystem path from a FRESH stat, or None when it
+    cannot be statted (the caller then neither prunes nor publishes)."""
+    try:
+        real = os.path.realpath(os.fspath(path))
+        st = os.stat(real)
+    except OSError:
+        return None
+    return ShardKey(
+        identity=("file", real),
+        validators=((int(st.st_size), int(st.st_mtime_ns), int(st.st_ino)),),
+    )
+
+
+# ------------------------------------------------------------ summary cache
+
+class SummaryCache:
+    """Process-global LRU of (identity -> (validators, summary)) — dict
+    surgery only under the lock (no I/O, no builds: the locked-blocking
+    discipline; loads/builds happen in the module helpers below, outside).
+    Validator mismatch at lookup evicts — stale summaries are never
+    consulted (the CorpusCache revalidation contract)."""
+
+    def __init__(self, max_entries: int = CACHE_MAX_ENTRIES):
+        self._lock = _lockdep.make_lock("index-cache")
+        self._max = int(max_entries)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # Lock-free "has this cache ever been populated" flag (the
+        # `_touched` convention): may_route() reads it per scan entry,
+        # and a process that can never hold a summary must not pay a
+        # stat + global-mutex lookup per file just to miss.  Plain
+        # attribute; conservatively stays True until clear().
+        self.nonempty = False
+
+    def lookup(self, key) -> bytes | None:
+        if key is None:
+            return None
+        with self._lock:
+            ent = self._entries.get(key.identity)
+            if ent is None:
+                return None
+            validators, summary = ent
+            if validators != key.validators:
+                del self._entries[key.identity]  # stat drift: stale
+                return None
+            self._entries.move_to_end(key.identity)
+            return summary
+
+    def put(self, key, summary: bytes) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._entries[key.identity] = (key.validators, summary)
+            self._entries.move_to_end(key.identity)
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+            self.nonempty = True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.nonempty = False
+
+
+_cache = SummaryCache()
+_store = None  # attached IndexStore (persistence), or None
+
+
+def summary_cache() -> SummaryCache:
+    return _cache
+
+
+def attach_store(root) -> None:
+    """Attach (or detach, root=None) the persistent summary store — the
+    service threads its ``<work_root>/index`` dir through the grep app's
+    ``index_dir`` option so worker-built summaries survive the daemon
+    AND the workers (runtime/service.py sets it at submit)."""
+    global _store
+    if root is None:
+        _store = None
+        return
+    from distributed_grep_tpu.index.store import IndexStore
+
+    cur = _store
+    if cur is None or os.fspath(cur.root) != os.fspath(root):
+        _store = IndexStore(root)
+
+
+def attached_store():
+    return _store
+
+
+def may_route() -> bool:
+    """Lock-free per-scan gate: could a summary lookup possibly answer?
+    True when the persistent store is attached or the in-memory cache
+    has ever been populated.  False means every lookup is a structural
+    miss — callers then skip the realpath+stat+lock work outright (the
+    CorpusCache `_small_route_cached` discipline: no guaranteed-miss
+    stat/lock per query)."""
+    return _store is not None or _cache.nonempty
+
+
+def lookup_summary(key) -> bytes | None:
+    """The shard's summary from memory, falling back to the attached
+    persistent store (store I/O runs here, outside the cache lock; a
+    store hit repopulates memory).  None = no summary (or stat drift —
+    both sides evict): the caller scans."""
+    if key is None:
+        return None
+    s = _cache.lookup(key)
+    if s is not None:
+        return s
+    st = _store
+    if st is None:
+        return None
+    s = st.load(key)
+    if s is not None:
+        _cache.put(key, s)
+    return s
+
+
+def publish_summary(key, data: bytes) -> bytes | None:
+    """Build ``data``'s summary and publish it under ``key`` (memory +
+    the attached store).  Callers invoke this AFTER the scan that read
+    ``data`` succeeded — the CorpusCache publish discipline — and assert
+    data IS the bytes the key's fresh stat described.  Returns the
+    summary (so the caller can also attach it to a CorpusCache entry),
+    or None when the key is unusable."""
+    if key is None:
+        return None
+    s = build_summary(data)
+    _cache.put(key, s)
+    st = _store
+    if st is not None:
+        st.save(key, s)  # atomic, best-effort; outside every lock
+    return s
+
+
+def clear() -> None:
+    """Tests: empty the in-memory cache, detach the store, zero counters."""
+    global _store
+    _cache.clear()
+    _store = None
+    index_counters_clear()

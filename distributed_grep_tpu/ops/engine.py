@@ -502,6 +502,19 @@ class GrepEngine:
             int(corpus_bytes) if corpus_bytes is not None else None
         )
         self.ignore_case = ignore_case
+        # Shard-index inputs (distributed_grep_tpu/index): the ORIGINAL
+        # query as constructed, captured BEFORE the literal-set routing
+        # below rebinds pattern/patterns — requirements derive from the
+        # query text via index.plan (the daemon-side planner derives from
+        # the same inputs, so the two sides cannot disagree on
+        # eligibility).  Resolved lazily in _index_requirements.
+        self._index_query = (
+            pattern,
+            tuple(patterns) if patterns is not None else None,
+            bool(ignore_case),
+            int(max_errors),
+        )
+        self._index_req: object = False  # False = unresolved; None = ineligible
 
         self.shift_and: ShiftAndModel | None = None
         self._sa_filtered: ShiftAndModel | None = None  # rare-class device filter
@@ -1093,6 +1106,15 @@ class GrepEngine:
             # same contract for the device corpus cache (hits/misses/
             # evictions + the bytes_resident gauge): nonzero-only
             self.stats.update(ccorp)
+        import sys as _sys
+
+        idx_mod = _sys.modules.get("distributed_grep_tpu.index.summary")
+        if idx_mod is not None:
+            # shard-index telemetry (index_shards_pruned/bytes_skipped/
+            # maybe_scans/summaries_built), nonzero-only: sys.modules-
+            # gated so index-free processes never import the tier just
+            # to report nothing
+            self.stats.update(idx_mod.index_counters())
         if t0 is not None:
             # after the EOL fix-up: the record's match count must equal the
             # ScanResult the caller actually receives
@@ -1365,6 +1387,78 @@ class GrepEngine:
             return env > 0
         return bool(self._accel_cached)
 
+    # ------------------------------------------------------- shard index
+    def _index_requirements(self):
+        """This query's required-literal trigram requirements
+        (index.plan.QueryRequirements), or None — index off
+        (DGREP_INDEX=0) or ineligible (empty-match patterns, members
+        under 3 bytes, approx mode, patterns outside the parser subset).
+        The derivation is resolved once per engine; the env switch is
+        re-read per call so the kill-switch works on cached engines.
+        jax-free (index + models/dfa are numpy-only): safe at the
+        scan_file/scan_batch entries, before the responsiveness wall."""
+        from distributed_grep_tpu.index import summary as index_summary
+
+        if not index_summary.env_index_enabled():
+            return None
+        if self._index_req is False:
+            from distributed_grep_tpu.index import plan as index_plan
+
+            pat, pats, ic, me = self._index_query
+            try:
+                self._index_req = index_plan.requirements_for_query(
+                    pattern=pat,
+                    patterns=list(pats) if pats is not None else None,
+                    ignore_case=ic, max_errors=me,
+                )
+            except Exception:  # noqa: BLE001 — derivation must never
+                # break a scan: ineligible just means "scan everything"
+                self._index_req = None
+        return self._index_req
+
+    def _index_publish_enabled(self) -> bool:
+        """Whether this scan should BUILD summaries at all: only when a
+        reuse surface exists — the persistent store is attached (the
+        service threads <work_root>/index through the app) or the corpus
+        cache is opted in (the in-process warm-query regime).  A one-shot
+        CLI job has neither: building summaries its process will never
+        consult would tax every cold local run for nothing.  Lookups and
+        prunes stay ungated — they only fire when summaries already
+        exist."""
+        from distributed_grep_tpu.index import summary as index_summary
+
+        return (
+            index_summary.attached_store() is not None
+            or self._corpus_opt_in()
+        )
+
+    def _index_pruned(self, key) -> "ScanResult":
+        """Stamp one engine-side prune (counters + span instant + this
+        thread's stats) and return the exact empty result — the summary
+        proved no line of the shard can match, so "zero matched lines"
+        is the true answer, for every caller semantics."""
+        from distributed_grep_tpu.index import summary as index_summary
+
+        index_summary.record_prune(key.n_bytes)
+        spans_mod.instant("index:prune", cat="engine", bytes=key.n_bytes)
+        self.stats = {}
+        self.stats.update(index_summary.index_counters())
+        return ScanResult(np.zeros(0, dtype=np.int64), 0, 0)
+
+    def _index_publish(self, key, data: bytes) -> None:
+        """Publish ``data``'s summary under ``key`` (memory + attached
+        store) and mirror it onto the corpus-cache entry when one is
+        resident.  Called AFTER the scan over ``data`` succeeded — the
+        CorpusCache publish discipline — from the already-resident host
+        bytes, so the build never sits on the cold read path."""
+        from distributed_grep_tpu.index import summary as index_summary
+
+        s = index_summary.publish_summary(key, data)
+        if s is not None:
+            from distributed_grep_tpu.ops.layout import corpus_cache
+
+            corpus_cache().attach_summary(key, s)
+
     # A host-routed scan of a large in-memory split proceeds in
     # newline-aligned pieces with a progress stamp between pieces — the
     # same per-chunk exactness scan_file relies on (every engine mode is
@@ -1518,14 +1612,54 @@ class GrepEngine:
         # files stream cold: their chunk cuts are content-dependent, and
         # the service regime this cache targets (log/code search) is many
         # files under the 64 MB chunk target, not one giant file.
+        from distributed_grep_tpu.ops.layout import file_content_key
+
+        # Shard index (distributed_grep_tpu/index): the query's required-
+        # literal set vs this shard's trigram summary — "cannot match"
+        # returns the exact empty result WITHOUT opening the file; a
+        # maybe (or no summary yet) scans, and a successful whole-file
+        # scan publishes the summary for the next query.  The lookup is
+        # jax-free and runs before the responsiveness wall, like the
+        # corpus opt-in.
+        idx_req = self._index_requirements()
+        idx_key = None
+        idx_pub = False  # publish after a successful whole-file scan
+        if idx_req is not None:
+            from distributed_grep_tpu.index import summary as index_summary
+
+            # lock-free routing gate: derive the key (realpath + stat)
+            # only when a lookup could answer or a publish could land —
+            # a summary-free one-shot process pays nothing per file
+            if index_summary.may_route() or self._index_publish_enabled():
+                idx_key = file_content_key(path)
+            if idx_key is not None:
+                summ = index_summary.lookup_summary(idx_key)
+                if summ is not None:
+                    if not idx_req.may_match(summ):
+                        return self._index_pruned(idx_key)
+                    index_summary.record_maybe()
+                    spans_mod.instant("index:maybe", cat="engine")
+                else:
+                    # single-chunk shards only — the corpus-cache regime:
+                    # multi-chunk cuts are content-dependent and the
+                    # target workload is many files under the chunk
+                    # target; and only when a reuse surface exists
+                    # (_index_publish_enabled — one-shot jobs skip the
+                    # build entirely)
+                    idx_pub = (
+                        0 < idx_key.n_bytes <= chunk_target
+                        and self._index_publish_enabled()
+                    )
+        idx_whole: bytes | None = None  # the whole keyed bytes, once in hand
+
         corpus_k = None
         if self._corpus_opt_in():
-            from distributed_grep_tpu.ops.layout import (
-                corpus_cache,
-                file_content_key,
-            )
+            from distributed_grep_tpu.ops.layout import corpus_cache
 
-            k = file_content_key(path)
+            # one fresh stat serves both tiers when the index already took
+            # it — key identity and validators must describe the same
+            # snapshot for the publish below to be sound
+            k = idx_key if idx_key is not None else file_content_key(path)
             # _small_route_cached: on a real accelerator a sub-
             # device_min_bytes solo file host-routes and can never
             # populate — skip the key/stat/lock work outright rather
@@ -1545,6 +1679,10 @@ class GrepEngine:
                     # resident_segments verdict in scan_device)
                     corpus_cache().count_host_hit()
                     scan_piece(ent.data, k)
+                    if idx_pub:
+                        # scan succeeded over the entry's (revalidated)
+                        # bytes: backfill the summary the index missed
+                        self._index_publish(k, ent.data)
                     self.stats["end_offsets"] = end_offsets
                     self.stats["read_wait_seconds"] = 0.0
                     return ScanResult(
@@ -1596,10 +1734,13 @@ class GrepEngine:
                         else _Ready(b"")
                     )
                     buf = carry + block
+                    whole_k = corpus_k if corpus_k is not None else (
+                        idx_key if idx_pub else None
+                    )
                     if (
-                        corpus_k is not None and total == 0
-                        and len(buf) == corpus_k.n_bytes
-                        and file_content_key(path) == corpus_k
+                        whole_k is not None and total == 0
+                        and len(buf) == whole_k.n_bytes
+                        and file_content_key(path) == whole_k
                     ):
                         # The WHOLE single-chunk file is in hand and a
                         # fresh re-stat agrees: scan it UNSPLIT (the
@@ -1609,10 +1750,16 @@ class GrepEngine:
                         # into carry and leave the corpus key
                         # unthreaded on BOTH pieces — a no-trailing-
                         # newline file (common in code search) would
-                        # never populate the cache.
+                        # never populate the cache.  The index-publish
+                        # leg takes the same branch (same exactness
+                        # argument) even when the corpus cache is off.
                         carry, final = b"", True
                         key = corpus_k  # the re-stat above just
-                        # confirmed buf IS the keyed bytes
+                        # confirmed buf IS the keyed bytes; only the
+                        # corpus key threads through scan() — the index
+                        # summary publishes after the scan succeeds
+                        if idx_pub:
+                            idx_whole = buf
                     else:
                         cut = buf.rfind(b"\n")
                         if cut < 0:
@@ -1629,6 +1776,12 @@ class GrepEngine:
                     # including a live-append tail that outgrew the
                     # stat, scans uncached
                     scan_piece(buf, key)
+                    if idx_whole is not None:
+                        # the scan over the whole keyed bytes SUCCEEDED:
+                        # publish the shard summary (from the bytes
+                        # already in hand — never an extra read)
+                        self._index_publish(idx_key, idx_whole)
+                        idx_whole = None
                     if (stop_after_match and n_matches) or (
                         stop is not None and stop()
                     ):
@@ -1654,7 +1807,8 @@ class GrepEngine:
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
 
     # ------------------------------------------------- cross-file batching
-    def scan_batch(self, items, progress=None, emit=None):
+    def scan_batch(self, items, progress=None, emit=None,
+                   index_prune: bool = False):
         """Scan many inputs, packing small ones into shared dispatches.
 
         ``items`` is an iterable of ``(name, data)`` where ``data`` is the
@@ -1706,7 +1860,28 @@ class GrepEngine:
         packer = BatchPacker(cap) if cap > 0 else None
         use_corpus = self._corpus_opt_in()  # jax-free (pre-wall entry)
         cache = corpus_cache() if use_corpus else None
+        # Shard index (distributed_grep_tpu/index): ``index_prune=True``
+        # is the CALLER's assertion that per-item emits with empty data
+        # and the (exact) empty result are equivalent to its real
+        # semantics — true for print/count consumers, FALSE for invert
+        # (the complement of nothing is nothing, not every line), so the
+        # grep app passes ``not invert``.  Pruned path items are never
+        # opened; cold-read members publish their summaries after the
+        # flush's scan succeeds, and warm packed windows prune whole
+        # (with their real cached member blobs — exact for every
+        # consumer).
+        idx_req = self._index_requirements()
+        idx_on = idx_req is not None
+        idx_pub_ok = idx_on and self._index_publish_enabled()
+        if idx_on:
+            from distributed_grep_tpu.index import summary as index_summary
+
+            # lock-free routing gate (see scan_file): without a possible
+            # lookup answer or a publish surface, skip all per-member
+            # key/stat/lock work
+            idx_on = index_summary.may_route() or idx_pub_ok
         pk_keys: list = []  # member content keys, parallel to the packer
+        pk_pub: list = []  # (key, bytes) members to index-publish, ditto
         out: list = []
         read_wait = 0.0  # member-open stall; stamped like scan_file's so
         # path items (worker map_batch_paths handover — the read happens
@@ -1737,6 +1912,14 @@ class GrepEngine:
                 # admitted) — what makes the next call's warm window
                 # possible without re-reading members
                 cache.attach_batch(win_key, batch)
+                if idx_on and index_summary.lookup_summary(win_key) is None:
+                    # window-level summary (packed-window pruning on the
+                    # warm path): built from batch.data, so boundary-
+                    # spanning trigrams only ADD bits — over-approximate,
+                    # never unsound.  Corpus-cache regimes only: without
+                    # a resident window there is no warm-window scan to
+                    # prune.
+                    self._index_publish(win_key, batch.data)
             per_file = batch.demux(res.matched_lines)
             bstats["batched_files"] += len(batch)
             bstats["batch_dispatches"] += 1
@@ -1758,10 +1941,11 @@ class GrepEngine:
                 ))
 
         def flush() -> None:
-            nonlocal pk_keys
+            nonlocal pk_keys, pk_pub
             if packer is None:
                 return
             keys, pk_keys = pk_keys, []
+            pubs, pk_pub = pk_pub, []
             batch = packer.pack()
             if batch is None:
                 return
@@ -1772,9 +1956,15 @@ class GrepEngine:
                 handle(batch.names[0], batch.blobs[0],
                        self.scan(batch.blobs[0], progress=progress,
                                  corpus_key=keys[0] if keys else None))
-                return
-            win_key = batch_content_key(keys) if use_corpus else None
-            scan_packed(batch, batch.names, win_key)
+            else:
+                win_key = batch_content_key(keys) if use_corpus else None
+                scan_packed(batch, batch.names, win_key)
+            # the members' scan succeeded: publish the summaries staged
+            # at read time (per-member keys — what the service planner
+            # prunes with)
+            for ent in pubs:
+                if ent is not None:
+                    self._index_publish(*ent)
 
         def match_window(i, stored) -> list | None:
             """Fresh member keys when ``items[i:...]`` are path items for
@@ -1799,39 +1989,107 @@ class GrepEngine:
             name, data = items[i]
             is_blob = isinstance(data, (bytes, bytearray, memoryview))
             fk = None
-            if use_corpus and not is_blob:
+            if (use_corpus or idx_on) and not is_blob:
                 fk = file_content_key(data)
-                if fk is not None and packer is not None:
-                    stored = cache.window_for(fk)
-                    keys = (
-                        match_window(i, stored)
-                        if stored is not None else None
-                    )
-                    if keys is not None:
-                        wk = batch_content_key(keys)
-                        ent = cache.lookup(wk)
-                        if (
-                            ent is not None and ent.batch is not None
-                            # the ENGINE's cap governs warm content too:
-                            # a window packed under a larger budget is
-                            # not re-served once batch_bytes shrinks
-                            # (per-dispatch memory bound; the cold path
-                            # re-packs at the new granularity and the
-                            # oversized entry ages out via LRU)
-                            and len(ent.batch.data) <= cap
-                        ):
-                            flush()  # order-preserving, like a solo input
-                            cache.count_host_hit()
-                            scan_packed(
-                                ent.batch,
-                                [nm for nm, _ in items[i:i + len(keys)]],
-                                wk,
+            if use_corpus and not is_blob and fk is not None \
+                    and packer is not None:
+                stored = cache.window_for(fk)
+                keys = (
+                    match_window(i, stored)
+                    if stored is not None else None
+                )
+                if keys is not None:
+                    wk = batch_content_key(keys)
+                    ent = cache.lookup(wk)
+                    if (
+                        ent is not None and ent.batch is not None
+                        # the ENGINE's cap governs warm content too:
+                        # a window packed under a larger budget is
+                        # not re-served once batch_bytes shrinks
+                        # (per-dispatch memory bound; the cold path
+                        # re-packs at the new granularity and the
+                        # oversized entry ages out via LRU)
+                        and len(ent.batch.data) <= cap
+                    ):
+                        wsum = None
+                        if idx_on:
+                            wsum = (
+                                ent.summary
+                                if ent.summary is not None
+                                else index_summary.lookup_summary(wk)
                             )
+                        if wsum is not None and not idx_req.may_match(wsum):
+                            # whole warm window pruned: members emit their
+                            # REAL cached blobs with the (exact) empty
+                            # result the summary proves — sound for every
+                            # consumer incl. invert, and no union scan is
+                            # dispatched
+                            flush()
+                            index_summary.record_prune(wk.n_bytes)
+                            spans_mod.instant("index:prune", cat="engine",
+                                              bytes=wk.n_bytes)
+                            names_w = [nm for nm, _ in
+                                       items[i:i + len(keys)]]
+                            for nm, blob in zip(names_w,
+                                                ent.batch.member_blobs()):
+                                handle(nm, blob, ScanResult(
+                                    np.zeros(0, dtype=np.int64), 0,
+                                    len(blob),
+                                ))
                             i += len(keys)
                             continue
+                        if wsum is not None:
+                            # consulted and could not rule the query
+                            # out: the warm-window scan is a maybe (the
+                            # counter the dense-regime telemetry reads)
+                            index_summary.record_maybe()
+                        flush()  # order-preserving, like a solo input
+                        cache.count_host_hit()
+                        scan_packed(
+                            ent.batch,
+                            [nm for nm, _ in items[i:i + len(keys)]],
+                            wk,
+                        )
+                        if idx_pub_ok:
+                            # backfill per-MEMBER summaries from the
+                            # cached blobs (the warm path never iterates
+                            # members, so a corpus-warm daemon would
+                            # otherwise starve the planner of the
+                            # per-file summaries it prunes with)
+                            for mk, blob in zip(
+                                keys, ent.batch.member_blobs()
+                            ):
+                                if index_summary.lookup_summary(mk) is None:
+                                    self._index_publish(mk, blob)
+                        i += len(keys)
+                        continue
             i += 1
+            idx_missing = False  # publish this member after its scan
+            if idx_on and fk is not None:
+                summ = index_summary.lookup_summary(fk)
+                if summ is None:
+                    idx_missing = idx_pub_ok
+                elif not idx_req.may_match(summ):
+                    if index_prune:
+                        # "cannot match", and the caller declared empty-
+                        # data emits exact: the file is never opened
+                        flush()  # order-preserving, like a solo input
+                        index_summary.record_prune(fk.n_bytes)
+                        spans_mod.instant("index:prune", cat="engine",
+                                          bytes=fk.n_bytes)
+                        handle(name, b"", ScanResult(
+                            np.zeros(0, dtype=np.int64), 0, 0
+                        ))
+                        continue
+                    # caller needs the bytes (invert): scan as usual —
+                    # still exact, the index just saves nothing here
+                else:
+                    index_summary.record_maybe()
             if not is_blob:
-                ent = cache.lookup(fk) if fk is not None else None
+                ent = (
+                    cache.lookup(fk)
+                    if cache is not None and fk is not None else None
+                )
                 if ent is not None and len(ent.data) == fk.n_bytes:
                     data = ent.data  # warm host bytes: no disk read
                     cache.count_host_hit()
@@ -1852,11 +2110,17 @@ class GrepEngine:
                 bstats["solo_dispatches"] += 1
                 handle(name, data,
                        self.scan(data, progress=progress, corpus_key=fk))
+                if idx_missing and fk is not None:
+                    # the solo scan succeeded: publish this shard's summary
+                    self._index_publish(fk, data)
                 continue
             if not packer.fits(data):
                 flush()
             packer.add(name, data)
             pk_keys.append(fk)
+            pk_pub.append(
+                (fk, data) if idx_missing and fk is not None else None
+            )
         flush()
         # AFTER the last scan (each scan resets the thread's stats dict):
         # the batch counters describe the whole scan_batch call.
@@ -1872,6 +2136,11 @@ class GrepEngine:
             if bstats["batch_dispatches"] else 0.0
         )
         st["read_wait_seconds"] = read_wait
+        if idx_on:
+            # re-stamp AFTER the last flush: members pruned after the
+            # final dispatch would otherwise miss this call's stats (the
+            # scan()-tail merge only sees counters as of its own scan)
+            st.update(index_summary.index_counters())
         return out
 
     # ---------------------------------------------------------- host engines

@@ -405,6 +405,11 @@ class ResidentCorpus:
     variants: dict = field(default_factory=dict)
     batch: PackedBatch | None = None
     device_bytes: int = 0
+    # Shard-index summary (distributed_grep_tpu/index): the trigram bloom
+    # of ``data``, attached by the engine AFTER the scan that built it
+    # succeeded — resident next to the bytes it summarizes, so a warm
+    # entry answers "can this query match here?" without any store read.
+    summary: bytes | None = None
 
 
 def _segments_nbytes(segments) -> int:
@@ -588,6 +593,18 @@ class CorpusCache:
                     # probe's membership revalidation makes a stale index
                     # row a clean miss, never a wrong answer
                     self._windows[key.identity[1][0]] = key.identity
+
+    def attach_summary(self, key: CorpusKey | None, summary: bytes) -> None:
+        """Record the shard-index trigram summary behind an entry's bytes
+        (same no-op-when-absent contract as attach_batch: a window that
+        was never admitted simply keeps its summary in the index tier's
+        own cache/store)."""
+        if key is None:
+            return
+        with self._lock:
+            ent = self._entries.get(key.identity)
+            if ent is not None and ent.key.validators == key.validators:
+                ent.summary = summary
 
     def window_for(self, member_key: CorpusKey | None) -> CorpusKey | None:
         """The STORED key of a cached packed window whose first member is

@@ -383,6 +383,7 @@ def plan_map_splits(
     input_files: list[str],
     batch_bytes: int,
     small_bytes: int | None = None,
+    pruner=None,
 ) -> list:
     """Group consecutive small input files into multi-file map splits —
     MapReduce's batch-small-inputs-into-splits move (Dean & Ghemawat §3.1)
@@ -398,9 +399,20 @@ def plan_map_splits(
     ``batch_bytes`` <= 0 disables grouping; ``small_bytes`` defaults to
     the engine's device_min_bytes default (DGREP_DEVICE_MIN_BYTES or
     1 MB) so "too small for its own dispatch" means the same thing on
-    both sides."""
+    both sides.
+
+    ``pruner`` (index.plan.SplitPruner, shard-index tier) drops files
+    whose persisted trigram summary proves the query cannot match —
+    pruned files never become (part of) a map task, so no worker ever
+    opens or dispatches them.  The caller (runtime/service) gates the
+    pruner on app semantics where a zero-match file still produces
+    output (invert/count/presence jobs plan unpruned), and its summary
+    lookups revalidate fresh stats, so a drifted file is a clean miss
+    that keeps its task."""
     import os
 
+    if pruner is not None:
+        input_files = [f for f in input_files if not pruner.prune(f)]
     if batch_bytes <= 0 or len(input_files) < 2:
         return list(input_files)
     if small_bytes is None:

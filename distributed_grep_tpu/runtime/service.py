@@ -325,6 +325,12 @@ class JobRecord:
     fusion_key: tuple | None = None
     split_identities: list = field(default_factory=list)
     fuse_index: dict = field(default_factory=dict)
+    # Shard-index planning tallies (index.plan.SplitPruner at submit/
+    # resume): kept on the record because the job's Metrics object is
+    # built later, at start flush — the builder seeds these into it so
+    # /jobs/<id> and dgrep submit's final line can surface routing.
+    index_shards_pruned: int = 0
+    index_bytes_skipped: int = 0
 
 
 class GrepService:
@@ -415,6 +421,17 @@ class GrepService:
             "fused_jobs": 0, "fused_dispatches": 0, "fusion_bytes_saved": 0,
         }
 
+        # Shard-index planning counters (GET /status "index"): shards the
+        # split planner dropped (no map task, no worker open), bytes those
+        # shards would have scanned, and summaries that answered "maybe".
+        # Planner-side only — the engine-side counters ride each worker's
+        # heartbeat piggyback rows, exactly like fusion.  Leaf lock.
+        self._index_lock = lockdep.make_lock("index-stats")
+        self._index_stats = {
+            "index_shards_pruned": 0, "index_bytes_skipped": 0,
+            "index_maybe_scans": 0,
+        }
+
         # Durable job registry (jobs.jsonl) + staged transition records:
         # appends are fsync'd, so they happen OUTSIDE the service lock —
         # state changes decided under the lock stage here and flush after
@@ -499,12 +516,23 @@ class GrepService:
                 continue
             # both re-plan splits (the plan is deterministic for
             # unchanged inputs; changed inputs fail replay's member-list
-            # guard and re-run — correct either way)
+            # guard and re-run — correct either way).  BOTH states prune
+            # against the restart-surviving summary store (the "warm
+            # survives the process" contract): for a job that was pruned
+            # at submit the store still holds the same summaries, so the
+            # re-plan REPRODUCES the submit-time split list and journal
+            # replay keeps every committed task; a plan that still
+            # drifts (summaries that appeared/evicted during the outage)
+            # only re-runs the drifted splits — pruned files produce no
+            # output either way, so every plan is output-identical.
             from distributed_grep_tpu.runtime.job import plan_map_splits
 
+            pruner = self._index_pruner(cfg)
             rec.map_splits = plan_map_splits(
-                list(cfg.input_files), cfg.effective_batch_bytes()
+                list(cfg.input_files), cfg.effective_batch_bytes(),
+                pruner=pruner,
             )
+            self._stamp_index_plan(rec, pruner)
             (rec.fusion_key, rec.split_identities,
              rec.fuse_index) = self._fusion_plan(cfg, rec.map_splits)
             self._jobs[jid] = rec
@@ -547,6 +575,12 @@ class GrepService:
         )
         rec.input_allowlist = frozenset(cfg.input_files)
         rec.metrics = Metrics()
+        if rec.index_shards_pruned:
+            # seed the resume re-plan's shard-index tallies (same
+            # contract as the start-flush parts builder): a resumed
+            # job's /jobs view and submit-client JSON keep the routing
+            rec.metrics.inc("index_shards_pruned", rec.index_shards_pruned)
+            rec.metrics.inc("index_bytes_skipped", rec.index_bytes_skipped)
         rec.scheduler = Scheduler(
             files=rec.map_splits,
             n_reduce=cfg.n_reduce,
@@ -626,10 +660,25 @@ class GrepService:
                    if not os.access(f, os.R_OK)]
         if missing:
             raise ValueError(f"unreadable input files: {missing}")
+        # Shard index (distributed_grep_tpu/index): thread the service's
+        # persistence root through the grep app BEFORE planning, so the
+        # stored config (registry), the fusion key, and the workers all
+        # see one consistent option set; with DGREP_INDEX=0 nothing is
+        # injected and the daemon is byte-for-byte pre-index.
+        idx_dir = self._index_app_dir(config)
+        if idx_dir is not None:
+            config = _dc_replace(
+                config,
+                app_options={**config.app_options, "index_dir": idx_dir},
+            )
         # splits depend only on (input_files, batch window) — stat the
-        # inputs here, outside the lock (see JobRecord.map_splits)
+        # inputs here, outside the lock (see JobRecord.map_splits); the
+        # index pruner's summary/store reads run here too (never under
+        # the service lock — locked-blocking)
+        pruner = self._index_pruner(config)
         splits = plan_map_splits(
-            list(config.input_files), config.effective_batch_bytes()
+            list(config.input_files), config.effective_batch_bytes(),
+            pruner=pruner,
         )
         fuse_key, identities, fuse_index = self._fusion_plan(config, splits)
         with self._cond:
@@ -653,6 +702,7 @@ class GrepService:
                             fusion_key=fuse_key,
                             split_identities=identities,
                             fuse_index=fuse_index)
+        self._stamp_index_plan(rec, pruner)
         # Durability BEFORE visibility: the registry append (fsync)
         # happens outside the lock and before the id is handed to the
         # client — from this line on a daemon crash re-admits the job at
@@ -744,6 +794,11 @@ class GrepService:
         )
         rec.input_allowlist = frozenset(cfg.input_files)
         metrics = Metrics()
+        if rec.index_shards_pruned:
+            # seed the planning-time shard-index tallies (stamped at
+            # submit, before this Metrics object existed)
+            metrics.inc("index_shards_pruned", rec.index_shards_pruned)
+            metrics.inc("index_bytes_skipped", rec.index_bytes_skipped)
         scheduler = Scheduler(
             files=rec.map_splits,
             n_reduce=cfg.n_reduce,
@@ -1122,6 +1177,66 @@ class GrepService:
         identities, index = fusion_mod.plan_identities(splits)
         return key, identities, index
 
+    # ----------------------------------------------------- shard index
+    def _index_app_dir(self, config: JobConfig) -> str | None:
+        """The index persistence root to thread through the grep app's
+        ``index_dir`` option, or None — index off (DGREP_INDEX=0 is a
+        true no-op: no option injected, payloads byte-identical to the
+        pre-index daemon), a non-grep application, or the submitter
+        already chose a dir."""
+        from distributed_grep_tpu.index.plan import GREP_APPLICATION
+        from distributed_grep_tpu.index.summary import env_index_enabled
+
+        if not env_index_enabled():
+            return None
+        if getattr(config, "application", None) != GREP_APPLICATION:
+            return None
+        if config.app_options.get("index_dir"):
+            return None
+        return str(self.work_root / "index")
+
+    def _index_pruner(self, config: JobConfig):
+        """A shard-index SplitPruner for this job's planning pass, or
+        None (index.plan owns the gating: index off, unprunable
+        semantics — invert/count/presence —, ineligible query).  The
+        pruner consults the SAME store the job's workers publish to —
+        the app-option ``index_dir`` when the submitter (or this
+        daemon's injection) set one, else the daemon default — so
+        planner and workers can never read/write different stores.  Its
+        summary/store reads run at plan time in the caller, outside
+        every service/scheduler lock (locked-blocking)."""
+        from distributed_grep_tpu.index import plan as index_plan
+
+        try:
+            index_dir = (
+                config.effective_app_options().get("index_dir")
+                or self.work_root / "index"
+            )
+            return index_plan.pruner_for_job(config, index_dir)
+        except Exception:  # noqa: BLE001 — a broken index must degrade
+            # to unpruned planning, never take submits down
+            log.exception("index pruner construction failed; "
+                          "planning unpruned")
+            return None
+
+    def _stamp_index_plan(self, rec: JobRecord, pruner) -> None:
+        """Fold one planning pass's prune tallies into the job's metrics
+        (the /jobs/<id> view and dgrep submit's final JSON read them)
+        and the service-level /status "index" counters."""
+        if pruner is None or not (
+            pruner.shards_pruned or pruner.maybe_scans
+        ):
+            return
+        # onto the RECORD, not rec.metrics: the job's Metrics object is
+        # built at start flush and would wipe a direct inc — the parts
+        # builder seeds these fields into it instead
+        rec.index_shards_pruned += pruner.shards_pruned
+        rec.index_bytes_skipped += pruner.bytes_skipped
+        with self._index_lock:
+            self._index_stats["index_shards_pruned"] += pruner.shards_pruned
+            self._index_stats["index_bytes_skipped"] += pruner.bytes_skipped
+            self._index_stats["index_maybe_scans"] += pruner.maybe_scans
+
     def _plan_fused_assignment(self, rec: JobRecord,
                                reply: rpc.AssignTaskReply, worker_id: int,
                                order: list[str]) -> None:
@@ -1366,6 +1481,15 @@ class GrepService:
                 dict(self._fusion_stats)
                 if any(self._fusion_stats.values()) else {}
             )
+        with self._index_lock:
+            # planner-side shard-index counters, same nonzero-only
+            # contract (DGREP_INDEX=0 — or a never-pruning corpus —
+            # keeps the pre-index /status shape); engine-side counters
+            # ride the per-worker heartbeat piggyback rows
+            index_stats = (
+                dict(self._index_stats)
+                if any(self._index_stats.values()) else {}
+            )
         with self._lock:
             jobs = {
                 jid: {"state": rec.state}
@@ -1419,6 +1543,9 @@ class GrepService:
             # engine-side counters ride the per-worker heartbeat
             # piggyback rows (runtime/worker._engine_cache_counters)
             **({"fusion": fusion_stats} if fusion_stats else {}),
+            # shard-index routing (planner side): shards never dispatched
+            # because their trigram summary ruled the query out
+            **({"index": index_stats} if index_stats else {}),
         }
 
     # ------------------------------------------------------------- lifecycle

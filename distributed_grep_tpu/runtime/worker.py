@@ -67,6 +67,11 @@ def _engine_cache_counters() -> dict | None:
     fuse = _sys.modules.get("distributed_grep_tpu.ops.fuse")
     if fuse is not None:
         counters.update(fuse.fusion_counters())
+    idx = _sys.modules.get("distributed_grep_tpu.index.summary")
+    if idx is not None:
+        # shard-index engine-side counters (index_shards_pruned/
+        # bytes_skipped/maybe_scans/summaries_built), nonzero-only
+        counters.update(idx.index_counters())
     return counters or None
 
 

@@ -195,6 +195,14 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint32,
         ]
+    if hasattr(lib, "dgrep_trigram_summary"):
+        lib.dgrep_trigram_summary.restype = None
+        lib.dgrep_trigram_summary.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
 
 
 def native_available() -> bool:
@@ -690,3 +698,32 @@ def dfa_scan_mt(
         if n <= cap:
             return buf[:n].copy()
         cap = n
+
+
+# --- Trigram shard summaries (shard-index tier) ----------------------------
+#
+# dgrep_trigram_summary ORs the case-folded trigram bloom of `data` into a
+# caller-owned byte array — the native build half of the shard index
+# (distributed_grep_tpu/index/summary.py owns the format, the bit-identical
+# numpy fallback, and the DGREP_INDEX* knobs).  `bloom.size` must be a
+# power of two (summary.py enforces it); returns False when libdgrep (or a
+# pre-index build of it) is unavailable, and the caller falls back.
+
+def trigram_summary_available() -> bool:
+    lib = _try_load()
+    return lib is not None and hasattr(lib, "dgrep_trigram_summary")
+
+
+def trigram_summary_into(data: bytes, bloom: np.ndarray) -> bool:
+    """OR `data`'s folded trigram bits into `bloom` (uint8, C-contiguous,
+    power-of-two size) via the native pass; False = not available (the
+    caller runs the numpy fallback — identical bits)."""
+    lib = _try_load()
+    if lib is None or not hasattr(lib, "dgrep_trigram_summary"):
+        return False
+    assert bloom.dtype == np.uint8 and bloom.flags["C_CONTIGUOUS"]
+    lib.dgrep_trigram_summary(
+        data, len(data),
+        bloom.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), bloom.size,
+    )
+    return True

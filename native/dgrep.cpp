@@ -884,3 +884,41 @@ int64_t dgrep_merge_display(const uint8_t* data, const int64_t* buf_off,
 }
 
 }  // extern "C"
+
+// --------------------------------------------------------------------------
+// Trigram shard summaries (the shard-index tier): one pass over a shard's
+// bytes ORs its case-folded trigram presence bloom into `bloom`.  Two bits
+// per trigram position: the 24-bit folded trigram code is mixed with one
+// 64-bit Fibonacci multiply and the low/high 32-bit halves index the bit
+// array (bloom_bytes MUST be a power of two — the Python wrapper enforces
+// it).  The numpy fallback (distributed_grep_tpu/index/summary.py) computes
+// the IDENTICAL bits, so persisted summaries are interchangeable between
+// builds; a query's required literal is absent whenever any of its folded
+// trigrams' bit pairs is missing ("cannot match" — never the reverse).
+
+static inline uint32_t dgrep_tg_fold(uint8_t c) {
+    return (c >= 'A' && c <= 'Z') ? (uint32_t)c + 32u : (uint32_t)c;
+}
+
+extern "C" {
+
+void dgrep_trigram_summary(const uint8_t* data, size_t len,
+                           uint8_t* bloom, size_t bloom_bytes) {
+    if (len < 3 || bloom_bytes == 0) return;
+    const uint64_t mask = (uint64_t)bloom_bytes * 8u - 1u;
+    uint32_t a = dgrep_tg_fold(data[0]);
+    uint32_t b = dgrep_tg_fold(data[1]);
+    for (size_t i = 2; i < len; ++i) {
+        uint32_t c = dgrep_tg_fold(data[i]);
+        uint64_t v = ((uint64_t)a << 16) | ((uint64_t)b << 8) | (uint64_t)c;
+        uint64_t h = v * 0x9E3779B97F4A7C15ull;
+        uint64_t h1 = h & mask;
+        uint64_t h2 = (h >> 32) & mask;
+        bloom[h1 >> 3] = (uint8_t)(bloom[h1 >> 3] | (1u << (h1 & 7u)));
+        bloom[h2 >> 3] = (uint8_t)(bloom[h2 >> 3] | (1u << (h2 & 7u)));
+        a = b;
+        b = c;
+    }
+}
+
+}  // extern "C"

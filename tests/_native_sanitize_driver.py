@@ -76,6 +76,29 @@ def literal_dfa(needle: bytes) -> tuple[np.ndarray, np.ndarray]:
     return table, accept
 
 
+def py_trigram(blob: bytes, m: int) -> np.ndarray:
+    """Pure-numpy oracle of dgrep_trigram_summary (the shard-index bloom:
+    case-folded 24-bit trigram codes, one 64-bit Fibonacci mix, two bit
+    probes from the low/high halves)."""
+    bloom = np.zeros(m, dtype=np.uint8)
+    if len(blob) < 3:
+        return bloom
+    fold = np.arange(256, dtype=np.uint8)
+    fold[ord("A"):ord("Z") + 1] += 32
+    f = fold[np.frombuffer(blob, np.uint8)].astype(np.uint64)
+    v = (f[:-2] << np.uint64(16)) | (f[1:-1] << np.uint64(8)) | f[2:]
+    h = v * np.uint64(0x9E3779B97F4A7C15)
+    mask = np.uint64(m * 8 - 1)
+    idx = np.unique(
+        np.concatenate([h & mask, (h >> np.uint64(32)) & mask])
+    )
+    np.bitwise_or.at(
+        bloom, (idx >> np.uint64(3)).astype(np.int64),
+        np.uint8(1) << (idx & np.uint64(7)).astype(np.uint8),
+    )
+    return bloom
+
+
 def surface() -> None:
     rng = random.Random(7)
     data = bytes(rng.choice(b"abcnedle\n") for _ in range(200_000))
@@ -217,6 +240,16 @@ def surface() -> None:
         np.asarray([1], np.int64), prefix, 4) is None,
         "build_records refuses out-of-bounds span")
 
+    # --- trigram_summary (shard index: native == numpy oracle) -------------
+    for blob in (b"", b"a", b"ab", b"abc", data[:100_000],
+                 b"MiXeD CaSe needle\xff\xfe\n" * 50):
+        for m in (1024, 16384):
+            bloom = np.zeros(m, dtype=np.uint8)
+            check(native.trigram_summary_into(blob, bloom),
+                  "trigram_summary available")
+            check(np.array_equal(bloom, py_trigram(blob, m)),
+                  f"trigram_summary bits (len={len(blob)}, m={m})")
+
     # --- merge_display (k-way, codepoint path order, tie-break) ------------
     def rec(path: bytes, n: int, text: bytes) -> bytes:
         return path + b" (line number #" + str(n).encode() + b")\t" + text
@@ -265,11 +298,22 @@ def stress() -> None:
     want_parts = native.build_records(arr_u8, sp[0], sp[1], lns, prefix, 5)
     errors: list[str] = []
 
+    # trigram-summary stress inputs: concurrent builds over the SAME
+    # shared corpus bytes into private blooms (the production shape —
+    # worker threads summarize shared read-only buffers; the bloom each
+    # writes is its own)
+    want_tg = py_trigram(data, 4096)
+
     def pound(idx: int) -> None:
         for _ in range(6):
             got = native.dfa_scan_mt(data, table, accept, n_threads=4)
             if got.tolist() != seq:
                 errors.append(f"thread {idx}: dfa_scan_mt diverged")
+                return
+            bloom = np.zeros(4096, dtype=np.uint8)
+            if not native.trigram_summary_into(data, bloom) or \
+                    not np.array_equal(bloom, want_tg):
+                errors.append(f"thread {idx}: trigram_summary diverged")
                 return
             mask = cs.confirm(data, cand, n_threads=4)
             if not np.array_equal(mask, want_mask):

@@ -166,6 +166,19 @@ def _fresh_fusion_counters():
 
 
 @pytest.fixture(autouse=True)
+def _fresh_index():
+    """The shard-index summary cache, attached store, and telemetry
+    counters (distributed_grep_tpu/index) are process-global like the
+    corpus cache — cleared per test so one test's summaries (or its
+    attached persistence dir) never prune or pollute another's scans."""
+    from distributed_grep_tpu.index import summary as _idx
+
+    _idx.clear()
+    yield
+    _idx.clear()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_corpus_cache():
     """The device corpus cache (ops/layout.CorpusCache) is process-global
     by design — the service process WANTS shards shared across jobs.
