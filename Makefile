@@ -5,7 +5,7 @@
 # installed).  CI and editors wanting annotations: `python -m
 # distributed_grep_tpu analyze --sarif`.
 
-.PHONY: lint native test
+.PHONY: lint native test trend
 
 lint:
 	python -m distributed_grep_tpu analyze
@@ -16,3 +16,10 @@ native:
 
 test:
 	python -m pytest tests/ -x -q
+
+# Round-over-round bench trajectory (BENCH_r*.json) as one JSON line +
+# a markdown table.  Reporting only — no gating (this box's background
+# load swings ~2x; BASELINE.md's interleaved A/B medians are the honest
+# comparisons).
+trend:
+	python tools/bench_trend.py

@@ -1225,6 +1225,14 @@ def cmd_submit(args: argparse.Namespace) -> int:
             out["index_bytes_skipped"] = int(
                 counters.get("index_bytes_skipped", 0)
             )
+        if args.explain and status.get("state") in ("done", "failed"):
+            # the routing report, inline on the one JSON line — best
+            # effort: a daemon too old for /explain answers 404, the
+            # submit result must not fail over a diagnostics rider
+            try:
+                out["explain"] = call("GET", f"/jobs/{job_id}/explain")
+            except (OSError, ValueError):
+                pass
     except OSError as e:  # urllib.error.* are OSError subclasses
         out["error"] = f"lost service at {args.addr}: {e}"
     print(json.dumps(out))
@@ -1256,6 +1264,57 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     else:
         json.dump(doc, sys.stdout)
         print()
+    return 0
+
+
+def cmd_explain(args: argparse.Namespace) -> int:
+    """Per-query routing report (round 15): which kernel family ran,
+    host-vs-device route, shards index-pruned, fused or solo, model/
+    corpus cache verdicts, per-stage walls — one JSON document assembled
+    from the job's events.jsonl + piggybacked engine stats, so the "why
+    was this query fast/slow" answer needs no Perfetto session.  With
+    --addr the daemon assembles it (GET /jobs/<id>/explain); without,
+    TARGET is a local work dir (or events.jsonl path) and the report is
+    built from the event log alone."""
+    import urllib.error
+
+    if args.addr:
+        from distributed_grep_tpu.runtime.http_transport import client_call
+
+        try:
+            doc = client_call(args.addr, "GET",
+                              f"/jobs/{args.target}/explain",
+                              timeout=args.timeout)
+        except urllib.error.HTTPError as e:
+            detail = e.read()[:200].decode("utf-8", "replace")
+            print(f"error: explain failed ({e.code}): {detail}",
+                  file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"error: cannot reach service at {args.addr}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    from pathlib import Path
+
+    from distributed_grep_tpu.runtime import explain as explain_mod
+    from distributed_grep_tpu.utils.spans import EventLog
+
+    path = Path(args.target)
+    if path.is_dir():
+        path = path / EventLog.FILENAME
+    if not path.exists():
+        print(f"error: no event log at {path} (run the job with "
+              f"\"spans\": true or DGREP_SPANS=1, or pass --addr for a "
+              f"service job)", file=sys.stderr)
+        return 2
+    doc = explain_mod.assemble(
+        job_id=str(args.target), config=None, state="",
+        submitted_at=None, started_at=None, finished_at=None,
+        metrics_counters={}, events=EventLog.read(path),
+    )
+    print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
 
 
@@ -1512,7 +1571,25 @@ def main(argv: list[str] | None = None) -> int:
                         "completion")
     p.add_argument("--timeout", type=float, default=300.0,
                    help="overall wait budget in seconds (with waiting on)")
+    p.add_argument("--explain", action="store_true",
+                   help="include the per-query routing report "
+                        "(GET /jobs/<id>/explain) in the final JSON line")
     p.set_defaults(fn=cmd_submit, wait=True)
+
+    p = sub.add_parser(
+        "explain",
+        help="per-query routing report: kernel family, host/device "
+             "route, index prunes, fusion, cache hits — from a service "
+             "job (--addr JOB_ID) or a local work dir's events.jsonl",
+    )
+    p.add_argument("target",
+                   help="job id (with --addr) or a work dir / "
+                        "events.jsonl path")
+    p.add_argument("--addr", default=None,
+                   help="service http address host:port (assembles the "
+                        "report daemon-side)")
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.set_defaults(fn=cmd_explain)
 
     args = parser.parse_args(argv)
     return args.fn(args)

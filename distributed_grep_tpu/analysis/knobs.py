@@ -188,6 +188,13 @@ KNOBS: dict[str, Knob] = {
         "pre-index behavior exactly (accessor: "
         "index/summary.env_index_enabled).",
     ),
+    "DGREP_METRICS_WINDOW_S": Knob(
+        "utils/metrics.py", "300",
+        "Rolling-window width for the /metrics cache-hit rate gauges "
+        "(dgrep_window_* / *_hit_ratio): piggybacked counter deltas "
+        "older than this many seconds age out of the windowed totals "
+        "(accessor: utils/metrics.env_metrics_window_s).",
+    ),
     "DGREP_INDEX_SUMMARY_BYTES": Knob(
         "index/summary.py", "16384",
         "Per-shard trigram bloom size, rounded down to a power of two in "

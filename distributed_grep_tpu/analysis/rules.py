@@ -1278,6 +1278,75 @@ def rule_shard_map_rep(project: Project) -> Iterator[Violation]:
                 )
 
 
+# ------------------------------------------------------------------ rule R12
+
+# Instrument factory callables (utils/metrics.py): module-level
+# counter()/gauge()/histogram() and the MetricsRegistry methods share
+# these names; a first argument that is a "dgrep_"-prefixed string
+# constant marks the call as a series creation.
+_METRIC_FACTORIES = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}
+_SERIES_PREFIX = "dgrep_"
+
+
+def rule_metrics_registry(project: Project) -> Iterator[Violation]:
+    """R12: every exported metrics series name is declared once in
+    ``utils/metrics.SERIES`` (the env-knobs registry pattern — the table
+    doubles as the /metrics HELP text).  A ``counter()``/``gauge()``/
+    ``histogram()`` creation whose name is undeclared is unowned and
+    undocumented; a creation whose kind disagrees with the declaration
+    would render the series under the wrong Prometheus TYPE; a declared
+    name no call site creates is a stale registry entry (checked only
+    when the project carries utils/metrics.py — fixture mini-trees stay
+    silent, like the env-knobs stale check)."""
+    from distributed_grep_tpu.utils.metrics import SERIES
+
+    seen: dict[str, list[tuple[str, int, str]]] = {}
+    for rel in project.files():
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            kind = _METRIC_FACTORIES.get(_last_name(node.func))
+            if kind is None:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith(_SERIES_PREFIX)):
+                continue
+            seen.setdefault(arg.value, []).append((rel, node.lineno, kind))
+    for name in sorted(seen):
+        decl = SERIES.get(name)
+        for rel, line, kind in seen[name]:
+            if decl is None:
+                yield Violation(
+                    "metrics-registry", rel, line,
+                    f"undeclared metrics series {name}: add it (kind, "
+                    f"help) to utils/metrics.py SERIES — the registry is "
+                    f"the /metrics HELP text and the one place a series "
+                    f"name is owned",
+                )
+            elif decl[0] != kind:
+                yield Violation(
+                    "metrics-registry", rel, line,
+                    f"{name} created as a {kind} but declared "
+                    f"{decl[0]} in utils/metrics.py SERIES — the series "
+                    f"would render under the wrong Prometheus TYPE",
+                )
+    if (project.root / "utils/metrics.py").exists():
+        for name in SERIES:
+            if name not in seen:
+                yield Violation(
+                    "metrics-registry", "utils/metrics.py", 1,
+                    f"declared metrics series {name} is never created by "
+                    f"any counter()/gauge()/histogram() call site: stale "
+                    f"registry entry in utils/metrics.py SERIES",
+                )
+
+
 # ------------------------------------------------------------------ registry
 
 RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
@@ -1292,6 +1361,7 @@ RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
     "locked-blocking": rule_locked_blocking,
     "lock-order": rule_lock_order,
     "shard-map-rep": rule_shard_map_rep,
+    "metrics-registry": rule_metrics_registry,
 }
 
 RULE_DOCS: dict[str, str] = {

@@ -1,0 +1,212 @@
+"""Query-routing reports: why a grep was fast (or slow), from telemetry
+already on disk.
+
+The span pipeline persists everything an operator needs to answer "which
+kernel family ran, was it pruned/fused/cache-warm, where did the time go"
+— but only as a raw ``events.jsonl`` an operator had to replay in
+Perfetto.  ``assemble()`` folds one job's event log (plus the job
+record's planning tallies) into ONE JSON document: engine modes with
+bytes/seconds/matches, host-vs-device routing, index prune counts, fused
+attempts, model/corpus cache verdicts, per-stage walls, and task/attempt
+accounting.  Served as ``GET /jobs/<id>/explain`` by the service daemon
+and rendered by ``dgrep explain`` (and ``dgrep submit --explain``).
+
+Pure Python, no ops imports — the daemon control plane assembles reports
+without touching the jax stack (the runtime/fusion.py rule).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Engine modes that run on the host by construction; everything else is a
+# device kernel family (shift_and / nfa / fdr / pairset / approx / ...).
+_HOST_MODES = ("re", "native")
+
+# Instant-event names folded into the routing verdicts.
+_CACHE_INSTANTS = {
+    "cache:hit": ("model_cache", "hits"),
+    "cache:miss": ("model_cache", "misses"),
+    "cache:off": ("model_cache", "bypassed"),
+    "corpus:hit": ("corpus_cache", "hits"),
+    "corpus:miss": ("corpus_cache", "misses"),
+}
+
+
+def _query_view(app_options: dict) -> dict:
+    """The query half of the app options — what was asked, not how."""
+    out: dict = {}
+    if app_options.get("pattern") is not None:
+        out["pattern"] = app_options["pattern"]
+    pats = app_options.get("patterns")
+    if pats:
+        out["patterns"] = len(pats)
+    for k in ("ignore_case", "invert", "word_regexp", "line_regexp",
+              "max_errors", "count_only", "presence_only", "backend"):
+        v = app_options.get(k)
+        if v:
+            out[k] = v
+    return out
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Aggregate one job's span/event records into routing + stage
+    views.  Unknown record shapes are skipped — the report must survive
+    event logs written by newer/older peers."""
+    modes: dict[str, dict] = {}
+    stages: dict[str, dict] = {}
+    routing: dict[str, dict] = {}
+    fusion = {"fused_plans": 0, "fused_attempts": 0, "max_queries": 0}
+    index = {"prunes": 0, "bytes_skipped": 0, "maybes": 0}
+    tasks = {"map_assigns": 0, "reduce_assigns": 0, "timeouts": 0,
+             "map_commits": 0, "reduce_commits": 0}
+    device_fallbacks = 0
+    degrades = 0
+    for r in events:
+        name = r.get("name", "")
+        t = r.get("t")
+        if t == "span":
+            args = r.get("args") or {}
+            if name.startswith("scan:"):
+                row = modes.setdefault(
+                    name[len("scan:"):],
+                    {"scans": 0, "bytes": 0, "seconds": 0.0, "matches": 0},
+                )
+                row["scans"] += 1
+                row["bytes"] += int(args.get("bytes", 0))
+                row["seconds"] += float(r.get("dur", 0.0))
+                row["matches"] += int(args.get("matches", 0))
+                if args.get("device_fallback"):
+                    device_fallbacks += 1
+            else:
+                row = stages.setdefault(name, {"count": 0, "seconds": 0.0})
+                row["count"] += 1
+                row["seconds"] += float(r.get("dur", 0.0))
+        elif t == "instant":
+            hit = _CACHE_INSTANTS.get(name)
+            if hit is not None:
+                group, key = hit
+                routing.setdefault(group, {})[key] = (
+                    routing.get(group, {}).get(key, 0) + 1
+                )
+            elif name == "index:prune":
+                index["prunes"] += 1
+                index["bytes_skipped"] += int(
+                    (r.get("args") or {}).get("bytes", 0)
+                )
+            elif name == "index:maybe":
+                index["maybes"] += 1
+            elif name == "fuse:plan":
+                fusion["fused_plans"] += 1
+                fusion["max_queries"] = max(
+                    fusion["max_queries"],
+                    int((r.get("args") or {}).get("queries", 0)),
+                )
+            elif name == "fuse:split":
+                fusion["fused_attempts"] += 1
+            elif name in ("device_demoted", "device_recovered"):
+                degrades += 1
+            elif name == "assign_map":
+                tasks["map_assigns"] += 1
+            elif name == "assign_reduce":
+                tasks["reduce_assigns"] += 1
+            elif name == "task_timeout":
+                tasks["timeouts"] += 1
+            elif name == "map_committed":
+                tasks["map_commits"] += 1
+            elif name == "reduce_committed":
+                tasks["reduce_commits"] += 1
+    for row in modes.values():
+        row["seconds"] = round(row["seconds"], 6)
+    for row in stages.values():
+        row["seconds"] = round(row["seconds"], 6)
+    out: dict = {"modes": modes, "stages": stages, "tasks": tasks}
+    out.update(routing)  # model_cache / corpus_cache, present when seen
+    if any(fusion.values()):
+        out["fusion"] = fusion
+    if any(index.values()):
+        out["index"] = index
+    if device_fallbacks:
+        out["device_fallbacks"] = device_fallbacks
+    if degrades:
+        out["device_transitions"] = degrades
+    return out
+
+
+def _route_verdict(modes: dict[str, dict], device_fallbacks: int) -> str:
+    """host / device / mixed / degraded / unknown — the one-word answer.
+    ``scan:batch`` rows are EXCLUDED: a packed flush emits one batch span
+    AND the inner engine's own ``scan:<mode>`` span, so the batch row is
+    an envelope, not a route — counting it would report a pure-device
+    batched job as "mixed"."""
+    scored = {name: m for name, m in modes.items()
+              if not name.startswith("batch")}
+    if not scored:
+        return "unknown"
+    host = sum(m["scans"] for name, m in scored.items()
+               if name in _HOST_MODES)
+    device = sum(m["scans"] for name, m in scored.items()
+                 if name not in _HOST_MODES)
+    if device_fallbacks:
+        return "degraded"
+    if host and device:
+        return "mixed"
+    return "device" if device else "host"
+
+
+def assemble(
+    job_id: str,
+    config: Any,
+    state: str,
+    submitted_at: float | None,
+    started_at: float | None,
+    finished_at: float | None,
+    metrics_counters: dict,
+    events: list[dict],
+    index_shards_pruned: int = 0,
+    index_bytes_skipped: int = 0,
+) -> dict:
+    """One job's routing report.  ``config`` is the JobConfig (only the
+    application spec and app options are read); ``metrics_counters`` the
+    job Metrics piggyback snapshot; planner-side index tallies come from
+    the JobRecord (they fire at submit, before any worker span)."""
+    agg = summarize_events(events)
+    modes = agg.pop("modes")
+    stages = agg.pop("stages")
+    tasks = agg.pop("tasks")
+    timing: dict = {}
+    if submitted_at and started_at:
+        timing["queue_wait_s"] = round(started_at - submitted_at, 6)
+    if started_at and finished_at:
+        timing["run_s"] = round(finished_at - started_at, 6)
+    if submitted_at and finished_at:
+        timing["e2e_s"] = round(finished_at - submitted_at, 6)
+
+    routing: dict = {
+        "route": _route_verdict(modes, agg.get("device_fallbacks", 0)),
+        "engine_modes": modes,
+        **agg,  # model_cache/corpus_cache/fusion/index/device_* when seen
+    }
+    # planner-side prune tallies (fire before any worker span exists);
+    # merge over the event view, which only sees engine-side prunes
+    if index_shards_pruned:
+        idx = routing.setdefault("index", {})
+        idx["planner_shards_pruned"] = index_shards_pruned
+        idx["planner_bytes_skipped"] = index_bytes_skipped
+
+    counters = {
+        k: v for k, v in sorted((metrics_counters or {}).items()) if v
+    }
+    return {
+        "job_id": job_id,
+        "state": state,
+        "application": getattr(config, "application", ""),
+        "query": _query_view(getattr(config, "app_options", {}) or {}),
+        "timing": timing,
+        "routing": routing,
+        "stages": stages,
+        "tasks": tasks,
+        "metrics": counters,
+        # spans off = a skeleton report; say so instead of reading empty
+        "spans": bool(events),
+    }

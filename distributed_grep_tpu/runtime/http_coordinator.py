@@ -36,6 +36,7 @@ from distributed_grep_tpu.runtime.scheduler import Scheduler
 from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils import metrics as metrics_mod
 from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
 from distributed_grep_tpu.utils.logging import get_logger
@@ -206,6 +207,18 @@ class DataPlaneHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, text: str, code: int = 200) -> None:
+        """Plain-text reply — the Prometheus exposition content type
+        (GET /metrics on the coordinator and the service daemon)."""
+        body = text.encode("utf-8", "strict")
+        self.send_response(code)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _send_file(self, path) -> None:
         """Stream a file in BLOCK_BYTES chunks; honors a single
         'Range: bytes=N-' prefix range (206 + Content-Range) so a
@@ -317,6 +330,13 @@ def _make_handler(server: CoordinatorServer):
                     self._send_json(json.loads(server.config.to_json()))
                 elif self.path == "/status":
                     self._send_json(server.status())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition of this process's typed
+                    # instruments (utils/metrics.py round 15): scheduler
+                    # assign-poll/phase histograms + in-process worker
+                    # task walls — the one-shot coordinator's scrape
+                    # surface (the service daemon adds scale gauges)
+                    self._send_text(metrics_mod.render_prometheus())
                 elif self.path.startswith("/data/input/"):
                     fname = urllib.parse.unquote(self.path[len("/data/input/") :])
                     if fname not in server.input_allowlist:

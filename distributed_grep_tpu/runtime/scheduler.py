@@ -39,11 +39,22 @@ from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.journal import TaskJournal
 from distributed_grep_tpu.runtime.types import MapTask, ReduceTask, TaskState
 from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils import metrics as metrics_mod
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 from distributed_grep_tpu.utils.spans import ClockSync, EventLog
 
 log = get_logger("scheduler")
+
+# Process-global typed instruments (utils/metrics.py round 15): scheduling
+# latency + failure-detector activity, served at GET /metrics on both the
+# one-shot coordinator and the service daemon.  Leaf locks — safe to
+# touch under the scheduler lock.
+_H_ASSIGN_POLL = metrics_mod.histogram("dgrep_assign_poll_seconds")
+_H_MAP_PHASE = metrics_mod.histogram("dgrep_map_phase_seconds")
+_H_REDUCE_PHASE = metrics_mod.histogram("dgrep_reduce_phase_seconds")
+_C_REQUEUED = metrics_mod.counter("dgrep_tasks_requeued_total")
+_C_QUARANTINED = metrics_mod.counter("dgrep_workers_quarantined_total")
 
 # Consecutive attributed failures (task timeouts while holding the task)
 # before a worker is quarantined.  One timeout is routine (a long GC pause,
@@ -336,6 +347,12 @@ class Scheduler:
         # tables that made a 2,000-file `grep -r` job quadratic (round 5).
         self._maps_completed = 0
         self._reduces_completed = 0
+        # Phase-wall instrumentation anchors: construction -> last map
+        # commit = map phase; that instant -> last reduce commit = reduce
+        # phase.  Phases completed purely by journal replay observe
+        # nothing (a resumed job's wall would misprice the live phase).
+        self._phase_t0 = time.monotonic()
+        self._reduce_t0: float | None = None
 
         if resume_entries:
             self._replay(resume_entries)
@@ -542,6 +559,10 @@ class Scheduler:
         if task is not ...:
             info["task"] = task
         if metrics is not None:
+            # the per-process source token rides only for the service's
+            # delta tracker — it is not a counter, keep it out of the
+            # /status worker rows (the dict is built fresh per RPC)
+            metrics.pop("proc", None)
             info["metrics"] = metrics
 
     def _observe_clock(self, args: rpc.HeartbeatArgs,
@@ -624,9 +645,16 @@ class Scheduler:
         done (reply JOB_DONE), or `timeout` elapses (reply JOB_DONE only if
         actually done; otherwise an empty retry reply with task_id == -2)."""
         deadline = _Deadline(timeout)
+        t0 = time.monotonic()
         try:
             return self._assign_task_locked(args, deadline)
         finally:
+            if timeout > 0:
+                # real long-polls only: the service daemon sweeps every
+                # running job's scheduler with timeout=0 per pass, and
+                # those sub-millisecond probes would swamp the latency
+                # signal (the daemon observes its own outer poll).
+                _H_ASSIGN_POLL.observe(time.monotonic() - t0)
             self._flush_events()
 
     def _assign_task_locked(self, args: rpc.AssignTaskArgs,
@@ -829,6 +857,10 @@ class Scheduler:
                 parts = record["parts"]
             self._register_map_outputs(args.task_id, parts)
             self.metrics.inc("map_completed")
+            if self._map_phase_done_locked():
+                now = time.monotonic()
+                self._reduce_t0 = now
+                _H_MAP_PHASE.observe(now - self._phase_t0)
             if self.journal:
                 # staged under the lock (at most once per task — gated by
                 # the COMPLETED transition above), fsync'd by
@@ -875,6 +907,11 @@ class Scheduler:
                 task.state = TaskState.COMPLETED
                 self._reduces_completed += 1
                 self.metrics.inc("reduce_completed")
+                if self._done_locked():
+                    _H_REDUCE_PHASE.observe(
+                        time.monotonic()
+                        - (self._reduce_t0 or self._phase_t0)
+                    )
                 if self.journal:
                     # staged like the map branch; see _flush_journal
                     self._pending_journal.append((
@@ -1023,6 +1060,7 @@ class Scheduler:
                         requeued = True
                         self.metrics.inc("map_retries")
                         self.metrics.inc("tasks_requeued")
+                        _C_REQUEUED.inc()
                         self._event("task_timeout", type="map",
                                     task=task.task_id, attempt=task.attempts,
                                     worker=task.worker)
@@ -1044,6 +1082,7 @@ class Scheduler:
                         requeued = True
                         self.metrics.inc("reduce_retries")
                         self.metrics.inc("tasks_requeued")
+                        _C_REQUEUED.inc()
                         self._event("task_timeout", type="reduce",
                                     task=task.task_id, attempt=task.attempts,
                                     worker=task.worker)
@@ -1067,6 +1106,7 @@ class Scheduler:
                             QUARANTINE_AFTER_FAILURES,
                         )
                         self.metrics.inc("workers_quarantined")
+                        _C_QUARANTINED.inc()
                         self._event("quarantine", worker=wid,
                                     window_s=round(window, 3))
             self._flush_events()

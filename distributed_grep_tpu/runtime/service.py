@@ -63,6 +63,7 @@ from distributed_grep_tpu.runtime.scheduler import (
 from distributed_grep_tpu.runtime.store import make_store
 from distributed_grep_tpu.runtime.types import TaskState
 from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils import metrics as metrics_mod
 from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils.config import JobConfig
 from distributed_grep_tpu.utils.io import WorkDir, resolve_input_path
@@ -91,6 +92,32 @@ _SPAN_SEQ_WINDOW = 4096
 # schedulers' on_change hook, so this only bounds staleness for
 # transitions with no hook (nothing known today) — not assignment latency.
 _ASSIGN_SWEEP_S = 0.25
+
+# Typed job-lifecycle instruments (utils/metrics.py round 15), served as
+# Prometheus text at GET /metrics — the live scale signal the elastic
+# scale-out item needs (queue depth + queue-wait latency + throughput
+# rates), where /status keeps lifetime totals.  Every name is declared
+# in utils/metrics.SERIES (analyze rule `metrics-registry`); instrument
+# locks are leaves, safe to touch under the service lock.
+_C_SUBMITTED = metrics_mod.counter("dgrep_jobs_submitted_total")
+_C_REJECTED = metrics_mod.counter("dgrep_jobs_rejected_total")
+_C_DONE = metrics_mod.counter("dgrep_jobs_done_total")
+_C_FAILED = metrics_mod.counter("dgrep_jobs_failed_total")
+_C_CANCELLED = metrics_mod.counter("dgrep_jobs_cancelled_total")
+_H_QUEUE_WAIT = metrics_mod.histogram("dgrep_queue_wait_seconds")
+_H_JOB_RUN = metrics_mod.histogram("dgrep_job_run_seconds")
+_H_JOB_E2E = metrics_mod.histogram("dgrep_job_e2e_seconds")
+_H_FINALIZE = metrics_mod.histogram("dgrep_finalize_seconds")
+_H_SVC_ASSIGN_POLL = metrics_mod.histogram("dgrep_assign_poll_seconds")
+
+# Monotonic piggybacked counters the rolling-rate tracker follows (the
+# model/corpus/index/fusion telemetry the workers already ship).
+_TRACKED_COUNTERS = (
+    "compile_cache_hits", "compile_cache_misses",
+    "corpus_cache_hits", "corpus_cache_misses",
+    "index_shards_pruned", "index_bytes_skipped",
+    "fused_queries", "fusion_bytes_saved",
+)
 
 
 def env_service_max_jobs(default: int = DEFAULT_MAX_JOBS) -> int:
@@ -406,6 +433,15 @@ class GrepService:
         self._span_seqs: dict[int, set[int]] = {}
         self._span_seq_lock = lockdep.make_lock("span-seq")
 
+        # Rolling-window rate tracker over the piggybacked engine-cache
+        # counters: sources keyed by the workers' per-process PROC_TOKEN
+        # (fallback: service worker id), first report baselines — a
+        # reconnect under a fresh id or N same-process loops can neither
+        # double-count nor regress the windowed totals.
+        self._cache_rates = metrics_mod.CounterDeltaTracker(
+            _TRACKED_COUNTERS
+        )
+
         # ONE flaky-worker quarantine tracker shared by every job's
         # scheduler (runtime/scheduler.WorkerHealth): the service owns
         # worker identity, so a worker going dark under job A must stop
@@ -509,6 +545,7 @@ class GrepService:
                 rec.state = JobState.FAILED
                 rec.error = f"inputs unreadable at resume: {missing}"
                 rec.finished_at = time.time()
+                _C_FAILED.inc()
                 self._jobs[jid] = rec
                 self._registry_pending.append(
                     (jid, JobState.FAILED, rec.error, None)
@@ -655,7 +692,11 @@ class GrepService:
         # before this submit pays any filesystem walk over its inputs.
         # Re-checked under the lock at enqueue: the walk window can race
         # other submits past the cap.
-        self._check_admission_locked_or_raise()
+        try:
+            self._check_admission_locked_or_raise()
+        except AdmissionError:
+            _C_REJECTED.inc()
+            raise
         missing = [f for f in config.input_files
                    if not os.access(f, os.R_OK)]
         if missing:
@@ -712,6 +753,7 @@ class GrepService:
         except (OSError, ValueError) as e:
             # closed registry (stop() won the race) or a dead disk: a job
             # we cannot durably register is a job we must not accept
+            _C_REJECTED.inc()
             raise AdmissionError(f"cannot register job: {e}") from e
         rejected: AdmissionError | None = None
         with self._cond:
@@ -739,7 +781,9 @@ class GrepService:
         self._flush_starts()
         self._flush_registry()
         if rejected is not None:
+            _C_REJECTED.inc()
             raise rejected
+        _C_SUBMITTED.inc()
         return job_id
 
     def _check_admission_locked_or_raise(self, locked: bool = False) -> None:
@@ -769,6 +813,11 @@ class GrepService:
             rec = self._jobs[self._queue.pop(0)]
             rec.state = JobState.RUNNING
             rec.started_at = time.time()
+            if rec.submitted_at:
+                # submit-to-start queue wait — the scale-out signal
+                # (a growing p95 here means the running-slot cap or the
+                # worker pool is the bottleneck, not the scans)
+                _H_QUEUE_WAIT.observe(rec.started_at - rec.submitted_at)
             self._running.append(rec.job_id)
             self._stage_state(rec)  # "running" — flushed post-lock
             self._pending_starts.append(rec)
@@ -851,6 +900,7 @@ class GrepService:
                             rec.state = JobState.FAILED
                             rec.error = str(e)
                             rec.finished_at = time.time()
+                            _C_FAILED.inc()
                             if rec.job_id in self._running:
                                 self._running.remove(rec.job_id)
                             self._stage_state(rec)
@@ -906,13 +956,20 @@ class GrepService:
         # resolution reads commit records; one job's finalize must not
         # stall every tenant's RPCs on that I/O).  Wasted work only if a
         # cancel races us, in which case the locked section discards it.
+        t_fin = time.perf_counter()
         outputs = [str(p) for p in rec.workdir.list_outputs()]
+        _H_FINALIZE.observe(time.perf_counter() - t_fin)
         with self._cond:
             if rec.state is not JobState.RUNNING:
                 return
             rec.state = JobState.DONE
             rec.finished_at = time.time()
             rec.outputs = outputs
+            _C_DONE.inc()
+            if rec.submitted_at:
+                _H_JOB_E2E.observe(rec.finished_at - rec.submitted_at)
+            if rec.started_at:
+                _H_JOB_RUN.observe(rec.finished_at - rec.started_at)
             self._stage_state(rec, outputs=outputs)
             self._close_job_locked(rec)
             self._maybe_start_locked()
@@ -990,6 +1047,7 @@ class GrepService:
                 self._queue.remove(job_id)
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                _C_CANCELLED.inc()
                 self._stage_state(rec)
                 # terminal without a close: bound the table here too (a
                 # submit-then-cancel client loop never reaches _close)
@@ -997,6 +1055,7 @@ class GrepService:
             elif rec.state is JobState.RUNNING:
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                _C_CANCELLED.inc()
                 self._stage_state(rec)
                 self._close_job_locked(rec)
                 self._maybe_start_locked()
@@ -1037,6 +1096,15 @@ class GrepService:
                      task: str | None = ..., metrics: dict | None = None) -> None:
         if worker_id < 0:
             return
+        if metrics is not None:
+            # rolling-rate feed, BEFORE the service lock (leaf metric
+            # locks only, but there is no reason to hold the hot lock
+            # over it).  "proc" is the worker's per-process source token
+            # — consumed here, never stored into the /status rows.
+            src = metrics.pop("proc", None)
+            self._cache_rates.observe(
+                src if src is not None else float(worker_id), metrics
+            )
         with self._lock:
             info = self.workers.setdefault(
                 worker_id, {"job": None, "task": None}
@@ -1058,6 +1126,16 @@ class GrepService:
         carry job_id + application so one attached worker serves every
         job; JOB_DONE only on service shutdown — an idle service parks
         workers in retry long-polls, it does not dismiss them."""
+        t0 = time.monotonic()
+        try:
+            return self._assign_task_inner(args, timeout)
+        finally:
+            # the OUTER poll wall only: the per-job scheduler sweeps
+            # inside run with timeout=0 and observe nothing
+            _H_SVC_ASSIGN_POLL.observe(time.monotonic() - t0)
+
+    def _assign_task_inner(self, args: rpc.AssignTaskArgs,
+                           timeout: float) -> rpc.AssignTaskReply:
         deadline = _Deadline(timeout)
         with self._lock:
             worker_id = args.worker_id
@@ -1236,6 +1314,17 @@ class GrepService:
             self._index_stats["index_shards_pruned"] += pruner.shards_pruned
             self._index_stats["index_bytes_skipped"] += pruner.bytes_skipped
             self._index_stats["index_maybe_scans"] += pruner.maybe_scans
+        # planner-side prunes feed the rolling window DIRECTLY (they are
+        # per-plan deltas, not lifetime totals, and the pruned files
+        # never reach a worker — the piggybacked engine-side counters
+        # cannot double-count them)
+        if pruner.shards_pruned:
+            self._cache_rates.window.add(
+                "index_shards_pruned", float(pruner.shards_pruned)
+            )
+            self._cache_rates.window.add(
+                "index_bytes_skipped", float(pruner.bytes_skipped)
+            )
 
     def _plan_fused_assignment(self, rec: JobRecord,
                                reply: rpc.AssignTaskReply, worker_id: int,
@@ -1521,6 +1610,21 @@ class GrepService:
                     for t in rec.scheduler.map_tasks
                 )
                 jobs[jid]["map_total"] = len(rec.scheduler.map_tasks)
+        # compact latency summary from the round-15 histograms — health
+        # without a Prometheus scraper.  Nonzero-only: a daemon that has
+        # recorded nothing keeps the exact pre-metrics /status shape.
+        latency: dict = {}
+        for key, hist in (("queue_wait_s", _H_QUEUE_WAIT),
+                          ("job_e2e_s", _H_JOB_E2E)):
+            p50 = hist.quantile(0.5)
+            if p50 is None:
+                continue
+            p95 = hist.quantile(0.95)
+            latency[key] = {
+                "p50": round(p50, 6),
+                "p95": round(p95 if p95 is not None else p50, 6),
+                "count": hist.snapshot()[2],
+            }
         return {
             "service": True,
             "uptime_s": round(time.time() - self.started_at, 3),
@@ -1546,7 +1650,122 @@ class GrepService:
             # shard-index routing (planner side): shards never dispatched
             # because their trigram summary ruled the query out
             **({"index": index_stats} if index_stats else {}),
+            # p50/p95 from the round-15 lifecycle histograms (GET /metrics
+            # carries the full bucket vectors)
+            **({"latency": latency} if latency else {}),
         }
+
+    # ---------------------------------------------------------- /metrics
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (GET /metrics): the process-global
+        typed instruments, plus scrape-time gauges for the live scale
+        signal (queue depth / running / worker count), lifetime cache
+        totals, and the rolling-window cache rates.  The cache modules
+        are sys.modules-gated like status(); no I/O and no jax under any
+        lock (plain list lengths read under the service lock, module
+        counters and rendering outside it)."""
+        import sys as _sys
+
+        with self._lock:
+            queued = len(self._queue)
+            running = len(self._running)
+            workers = len(self.workers)
+        metrics_mod.gauge("dgrep_queue_depth").set(queued)
+        metrics_mod.gauge("dgrep_jobs_running").set(running)
+        metrics_mod.gauge("dgrep_workers_attached").set(workers)
+
+        counters: dict = {}
+        eng = _sys.modules.get("distributed_grep_tpu.ops.engine")
+        if eng is not None:
+            counters.update(eng.model_cache_counters())
+        lay = _sys.modules.get("distributed_grep_tpu.ops.layout")
+        if lay is not None:
+            counters.update(lay.corpus_cache_counters())
+        fuse = _sys.modules.get("distributed_grep_tpu.ops.fuse")
+        if fuse is not None:
+            counters.update(fuse.fusion_counters())
+        idx = _sys.modules.get("distributed_grep_tpu.index.summary")
+        if idx is not None:
+            counters.update(idx.index_counters())
+        if counters:
+            # this process's own counters feed the SAME tracker the
+            # piggybacks feed, under the same PROC_TOKEN — in-process
+            # worker loops and scrape-time reads dedup to one source
+            self._cache_rates.observe(metrics_mod.PROC_TOKEN, counters)
+        # explicit string-constant creation sites, one per series: the
+        # `metrics-registry` rule audits names lexically, so the names
+        # stay greppable and un-aliased here on purpose
+        def _c(name: str) -> float:
+            return float(counters.get(name, 0))
+
+        metrics_mod.gauge("dgrep_model_cache_hits").set(
+            _c("compile_cache_hits"))
+        metrics_mod.gauge("dgrep_model_cache_misses").set(
+            _c("compile_cache_misses"))
+        metrics_mod.gauge("dgrep_corpus_cache_hits").set(
+            _c("corpus_cache_hits"))
+        metrics_mod.gauge("dgrep_corpus_cache_misses").set(
+            _c("corpus_cache_misses"))
+        metrics_mod.gauge("dgrep_corpus_cache_bytes_resident").set(
+            _c("corpus_cache_bytes_resident"))
+
+        w = self._cache_rates.window_totals()
+        metrics_mod.gauge("dgrep_window_model_cache_hits").set(
+            w.get("compile_cache_hits", 0.0))
+        metrics_mod.gauge("dgrep_window_model_cache_misses").set(
+            w.get("compile_cache_misses", 0.0))
+        metrics_mod.gauge("dgrep_window_corpus_cache_hits").set(
+            w.get("corpus_cache_hits", 0.0))
+        metrics_mod.gauge("dgrep_window_corpus_cache_misses").set(
+            w.get("corpus_cache_misses", 0.0))
+        metrics_mod.gauge("dgrep_window_index_shards_pruned").set(
+            w.get("index_shards_pruned", 0.0))
+        metrics_mod.gauge("dgrep_window_index_bytes_skipped").set(
+            w.get("index_bytes_skipped", 0.0))
+        metrics_mod.gauge("dgrep_window_fused_queries").set(
+            w.get("fused_queries", 0.0))
+        metrics_mod.gauge("dgrep_window_fusion_bytes_saved").set(
+            w.get("fusion_bytes_saved", 0.0))
+
+        def _ratio(hits: float, misses: float) -> float:
+            total = hits + misses
+            return hits / total if total else 0.0
+
+        metrics_mod.gauge("dgrep_model_cache_hit_ratio").set(_ratio(
+            w.get("compile_cache_hits", 0.0),
+            w.get("compile_cache_misses", 0.0)))
+        metrics_mod.gauge("dgrep_corpus_cache_hit_ratio").set(_ratio(
+            w.get("corpus_cache_hits", 0.0),
+            w.get("corpus_cache_misses", 0.0)))
+        return metrics_mod.render_prometheus()
+
+    # ----------------------------------------------------------- explain
+    def job_explain(self, job_id: str) -> dict:
+        """Per-query routing report for one job (``dgrep explain``):
+        events.jsonl aggregation + the record's planning tallies, one
+        JSON-ready dict.  Reads the job's event log OUTSIDE every lock
+        (record() only locks the table lookup)."""
+        from distributed_grep_tpu.runtime import explain as explain_mod
+
+        rec = self.record(job_id)
+        events: list = []
+        workdir = rec.workdir
+        if workdir is not None:
+            path = workdir.root / spans_mod.EventLog.FILENAME
+            if path.exists():
+                events = spans_mod.EventLog.read(path)
+        return explain_mod.assemble(
+            job_id=rec.job_id,
+            config=rec.config,
+            state=rec.state,
+            submitted_at=rec.submitted_at,
+            started_at=rec.started_at,
+            finished_at=rec.finished_at,
+            metrics_counters=rec.metrics.piggyback(),
+            events=events,
+            index_shards_pruned=rec.index_shards_pruned,
+            index_bytes_skipped=rec.index_bytes_skipped,
+        )
 
     # ------------------------------------------------------------- lifecycle
     def start_local_workers(
@@ -1598,12 +1817,14 @@ class GrepService:
                 rec = self._jobs[jid]
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                _C_CANCELLED.inc()
                 self._stage_state(rec)
             self._queue.clear()
             for jid in list(self._running):
                 rec = self._jobs[jid]
                 rec.state = JobState.CANCELLED
                 rec.finished_at = time.time()
+                _C_CANCELLED.inc()
                 self._stage_state(rec)
                 self._close_job_locked(rec)
             self._cond.notify_all()
@@ -1814,6 +2035,11 @@ def _make_service_handler(server: ServiceServer):
                     self._send_json(json.loads(server._bootstrap.to_json()))
                 elif self.path == "/status":
                     self._send_json(service.status())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition (stable sort, byte-
+                    # stable): job-lifecycle histograms + the live scale
+                    # signal + rolling cache-hit rates
+                    self._send_text(service.metrics_text())
                 elif self.path.startswith("/jobs/"):
                     rest = self.path[len("/jobs/") :]
                     if rest.endswith("/result"):
@@ -1825,6 +2051,13 @@ def _make_service_handler(server: ServiceServer):
                                 {"error": f"unknown job: {job_id}"}, 404)
                         except RuntimeError as e:
                             self._send_json({"error": str(e)}, 409)
+                    elif rest.endswith("/explain"):
+                        job_id = _safe_segment(rest[: -len("/explain")])
+                        try:
+                            self._send_json(service.job_explain(job_id))
+                        except KeyError:
+                            self._send_json(
+                                {"error": f"unknown job: {job_id}"}, 404)
                     else:
                         job_id = _safe_segment(rest)
                         try:

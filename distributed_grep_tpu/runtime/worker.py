@@ -27,12 +27,19 @@ from distributed_grep_tpu.apps.loader import LoadedApplication
 from distributed_grep_tpu.runtime import rpc, shuffle
 from distributed_grep_tpu.runtime.extsort import ExternalReducer
 from distributed_grep_tpu.runtime.transport import Transport
+from distributed_grep_tpu.utils import metrics as metrics_mod
 from distributed_grep_tpu.utils import spans as spans_mod
 from distributed_grep_tpu.utils import trace
 from distributed_grep_tpu.utils.logging import get_logger
 from distributed_grep_tpu.utils.metrics import Metrics
 
 log = get_logger("worker")
+
+# Typed task-wall histograms (utils/metrics.py round 15): in-process
+# workers land in the daemon's /metrics; remote workers in their own
+# process's registry.
+_H_MAP_TASK = metrics_mod.histogram("dgrep_map_task_seconds")
+_H_REDUCE_TASK = metrics_mod.histogram("dgrep_reduce_task_seconds")
 
 
 class WorkerKilled(Exception):
@@ -172,6 +179,11 @@ class WorkerLoop:
             cc = _engine_cache_counters()
             if cc:
                 args.metrics.update(cc)
+            # source token for the service-side rolling-rate tracker:
+            # same-process loops share module-global cache counters, and
+            # a reconnect gets a fresh worker id — the token (not the id)
+            # is what keeps deltas counted exactly once per process
+            args.metrics["proc"] = metrics_mod.PROC_TOKEN
             args.sent_at = time.time()
             args.rtt_s = self._hb_rtt
         self._attach_rpc_retries(args)
@@ -338,6 +350,7 @@ class WorkerLoop:
             cc = _engine_cache_counters()
             if cc:
                 args.metrics.update(cc)
+            args.metrics["proc"] = metrics_mod.PROC_TOKEN  # see _heartbeat
         self._attach_rpc_retries(args)
         return args
 
@@ -403,6 +416,7 @@ class WorkerLoop:
             ))
         self.metrics.inc("map_tasks")
         self.metrics.observe("map_task_total", time.perf_counter() - t0)
+        _H_MAP_TASK.observe(time.perf_counter() - t0)
 
     def _map_attempt(self, a: rpc.AssignTaskReply, attempt: str,
                      t0: float) -> list[int]:
@@ -727,6 +741,7 @@ class WorkerLoop:
             )
         self.metrics.inc("fused_map_attempts")
         self.metrics.observe("map_task_total", time.perf_counter() - t0)
+        _H_MAP_TASK.observe(time.perf_counter() - t0)
         log.info(
             "fused map attempt served %d/%d co-tenant tasks (%s:%d + %d)",
             committed, len(participants), a.job_id, a.task_id,
@@ -856,6 +871,7 @@ class WorkerLoop:
             ))
         self.metrics.inc("reduce_tasks")
         self.metrics.observe("reduce_task_total", time.perf_counter() - t0)
+        _H_REDUCE_TASK.observe(time.perf_counter() - t0)
 
     def _reduce_attempt(self, a: rpc.AssignTaskReply, attempt: str) -> None:
         import os

@@ -179,6 +179,21 @@ def _fresh_index():
 
 
 @pytest.fixture(autouse=True)
+def _fresh_metrics():
+    """The typed-instrument registry (utils/metrics.py round 15) is
+    process-global by design — a daemon's /metrics aggregates across its
+    whole life.  Across tests that would leak one test's latency
+    histograms into another's /status "latency" summary and /metrics
+    golden checks, so every instrument is zeroed IN PLACE per test
+    (module-level instrument references stay valid)."""
+    from distributed_grep_tpu.utils import metrics as _metrics
+
+    _metrics.metrics_reset()
+    yield
+    _metrics.metrics_reset()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_corpus_cache():
     """The device corpus cache (ops/layout.CorpusCache) is process-global
     by design — the service process WANTS shards shared across jobs.

@@ -643,6 +643,46 @@ def test_shard_map_rep_silent_on_compliant_and_non_pallas(tmp_path):
     assert not _hits(tmp_path, "shard-map-rep")
 
 
+# ------------------------------------------------------ R12 metrics-registry
+
+def test_metrics_registry_fires_on_undeclared_and_mismatch(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "from distributed_grep_tpu.utils import metrics as m\n"
+        "c = m.counter('dgrep_bogus_total')\n"  # undeclared series
+        "g = m.gauge('dgrep_jobs_submitted_total')\n")  # declared counter
+    got = _hits(tmp_path, "metrics-registry")
+    msgs = "\n".join(v.message for v in got)
+    assert "undeclared metrics series dgrep_bogus_total" in msgs
+    assert ("dgrep_jobs_submitted_total created as a gauge but declared "
+            "counter") in msgs
+
+
+def test_metrics_registry_fires_on_stale_declaration(tmp_path):
+    # the registry owner exists but no call site creates any series:
+    # every declared name is stale (the env-knobs stale-entry shape)
+    _mk(tmp_path, "utils/metrics.py", "x = 1\n")
+    got = _hits(tmp_path, "metrics-registry")
+    msgs = "\n".join(v.message for v in got)
+    assert "declared metrics series dgrep_queue_wait_seconds is never " \
+           "created" in msgs
+
+
+def test_metrics_registry_silent_on_declared_and_mini_trees(tmp_path):
+    # correct usage: declared name, matching kind — silent even though
+    # the mini-tree has no utils/metrics.py (stale check gated on it)
+    _mk(tmp_path, "runtime/ok.py",
+        "from distributed_grep_tpu.utils import metrics as m\n"
+        "h = m.histogram('dgrep_queue_wait_seconds')\n"
+        "c = m.counter('dgrep_jobs_done_total')\n")
+    # non-series strings through same-named callables stay exempt (the
+    # dgrep_ prefix is the series namespace)
+    _mk(tmp_path, "apps/other.py",
+        "def counter(name):\n"
+        "    return name\n"
+        "x = counter('not_a_series')\n")
+    assert not _hits(tmp_path, "metrics-registry")
+
+
 # ----------------------------------------------------------- SARIF output
 
 def test_sarif_output_shape_and_stability(tmp_path, capsys):

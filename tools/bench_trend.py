@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Render the BENCH_r*.json trajectory as one JSON line + a markdown table.
+
+The driver snapshots ``bench.py``'s one-JSON-line contract into
+``BENCH_r<NN>.json`` per round ({n, cmd, rc, tail, parsed}); this tool
+folds them into the round-over-round throughput trajectory an operator
+(or a PR description) wants at a glance:
+
+    python tools/bench_trend.py            # JSON line, then the table
+    python tools/bench_trend.py --json     # the JSON line only
+    make trend
+
+Per round: the parsed headline GB/s (cpu-fallback rounds flagged — their
+numbers are NOT chip numbers), and the per-pass wall parsed from the
+bench tail's "N ms/pass" marker when present.  NO gating and no
+thresholds on purpose: this box's background load swings ~2x, so the
+trajectory is a report, not a check (BASELINE.md's interleaved A/B
+medians are the honest comparisons).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MS_RE = re.compile(r"\(([\d.]+) ms/pass")
+
+
+def load_rounds(root: Path) -> list[dict]:
+    rounds: list[dict] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = _ROUND_RE.search(path.name)
+        if m is None:
+            continue
+        try:
+            doc = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") or {}
+        tail = doc.get("tail") or ""
+        if not parsed:
+            # older snapshots: fall back to the last JSON line in the tail
+            for line in reversed(tail.splitlines()):
+                try:
+                    parsed = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        ms = _MS_RE.findall(tail)
+        metric = str(parsed.get("metric", ""))
+        row = {
+            "round": int(m.group(1)),
+            "gbps": parsed.get("value"),
+            "unit": parsed.get("unit", ""),
+            "metric": metric,
+            "cpu_fallback": "cpu_fallback" in metric,
+            "rc": doc.get("rc"),
+        }
+        if ms:
+            row["ms_per_pass"] = float(ms[-1])
+        rounds.append(row)
+    return rounds
+
+
+def markdown_table(rounds: list[dict]) -> str:
+    lines = ["| round | GB/s | ms/pass | notes |",
+             "| --- | --- | --- | --- |"]
+    for r in rounds:
+        notes = []
+        if r["cpu_fallback"]:
+            notes.append("cpu fallback (tunnel down)")
+        if r.get("rc"):
+            notes.append(f"rc={r['rc']}")
+        gbps = "?" if r["gbps"] is None else f"{r['gbps']:g}"
+        ms = r.get("ms_per_pass")
+        lines.append(
+            f"| r{r['round']:02d} | {gbps} | "
+            f"{'-' if ms is None else f'{ms:g}'} | {', '.join(notes)} |"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="BENCH_r*.json round-over-round trajectory")
+    p.add_argument("--root", default=".",
+                   help="directory holding BENCH_r*.json (default: cwd)")
+    p.add_argument("--json", action="store_true", dest="json_only",
+                   help="print only the one JSON line")
+    args = p.parse_args(argv)
+
+    rounds = load_rounds(Path(args.root))
+    if not rounds:
+        print(f"error: no BENCH_r*.json under {args.root}", file=sys.stderr)
+        return 1
+    chip = [r for r in rounds if not r["cpu_fallback"]
+            and r["gbps"] is not None]
+    doc = {
+        "rounds": rounds,
+        "latest_gbps": rounds[-1]["gbps"],
+        "best_chip_gbps": max((r["gbps"] for r in chip), default=None),
+    }
+    print(json.dumps(doc, sort_keys=True))
+    if not args.json_only:
+        print(markdown_table(rounds))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
