@@ -1109,6 +1109,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.workers:
         service.start_local_workers(args.workers)
     stop = threading.Event()
+    scaler = None
+    if args.max_workers and args.max_workers > args.workers:
+        # Elastic local pool (round 16): follow the service's own scale
+        # advice (queue depth / pending tasks / in-flight age) between
+        # the base --workers floor and the --max-workers ceiling.
+        # Attach/detach is safe by construction — service-allocated ids,
+        # fresh-id reconnect, quarantine; shrink drains loops at their
+        # next idle poll, never mid-task.
+        def scale_loop() -> None:
+            while not stop.wait(2.0):
+                advice = service.scale_advice()["advice"]
+                cur = service.local_pool_size()
+                if advice == "grow" and cur < args.max_workers:
+                    service.scale_local_pool(cur + 1)
+                elif advice == "shrink" and cur > args.workers:
+                    service.scale_local_pool(max(args.workers, cur - 1))
+
+        scaler = threading.Thread(target=scale_loop, name="svc-scaler",
+                                  daemon=True)
+        scaler.start()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             signal.signal(sig, lambda *_: stop.set())
@@ -1118,6 +1138,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
         stop.wait()
     except KeyboardInterrupt:
         pass
+    stop.set()
+    if scaler is not None:
+        scaler.join(timeout=5.0)
     server.shutdown()
     service.stop()
     # stdout contract (mirrors cmd_coordinator): exactly one JSON line —
@@ -1520,6 +1543,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--workers", type=int, default=2,
                    help="in-process worker loops to attach (0 = none; "
                         "remote workers attach via `worker --addr`)")
+    p.add_argument("--max-workers", type=int, default=None,
+                   help="elastic ceiling for the local pool: grow toward "
+                        "it on the /status scale advice (queue depth, "
+                        "pending tasks, in-flight age), shrink back to "
+                        "--workers when idle; unset = fixed pool")
     p.add_argument("--max-jobs", type=int, default=None,
                    help="concurrent running-job cap "
                         "(DGREP_SERVICE_MAX_JOBS overrides)")

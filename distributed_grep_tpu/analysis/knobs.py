@@ -195,6 +195,34 @@ KNOBS: dict[str, Knob] = {
         "older than this many seconds age out of the windowed totals "
         "(accessor: utils/metrics.env_metrics_window_s).",
     ),
+    "DGREP_PEER_SHUFFLE": Knob(
+        "runtime/peer.py", "1",
+        "Peer-to-peer shuffle (round 16): service-attached workers keep "
+        "map output on their local spool and reducers fetch it directly "
+        "from the producer — the daemon moves shuffle METADATA only; "
+        "0/false reverts to the relay data plane exactly (no server, no "
+        "spool, byte-identical wire payloads; accessor: "
+        "runtime/peer.env_peer_shuffle).",
+    ),
+    "DGREP_PEER_PORT": Knob(
+        "runtime/peer.py", "0",
+        "Worker shuffle data-server listen port (0 = ephemeral, the "
+        "default — N worker processes per host each bind their own; "
+        "accessor: runtime/peer.env_peer_port).",
+    ),
+    "DGREP_PEER_HOST": Knob(
+        "runtime/peer.py", "bind host",
+        "Advertised shuffle-endpoint host override for workers behind "
+        "NAT/wildcard binds — peers must dial a routable name "
+        "(accessor: runtime/peer.env_peer_host).",
+    ),
+    "DGREP_PEER_BIND": Knob(
+        "runtime/peer.py", "127.0.0.1; 0.0.0.0 when DGREP_PEER_HOST set",
+        "Shuffle data-server BIND address.  Loopback by default; a set "
+        "DGREP_PEER_HOST implies the wildcard (an advertised routable "
+        "name a loopback-bound server can never honor); set both for a "
+        "specific-interface bind (accessor: runtime/peer.env_peer_bind).",
+    ),
     "DGREP_INDEX_SUMMARY_BYTES": Knob(
         "index/summary.py", "16384",
         "Per-shard trigram bloom size, rounded down to a power of two in "

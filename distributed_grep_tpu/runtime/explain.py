@@ -58,6 +58,8 @@ def summarize_events(events: list[dict]) -> dict:
     routing: dict[str, dict] = {}
     fusion = {"fused_plans": 0, "fused_attempts": 0, "max_queries": 0}
     index = {"prunes": 0, "bytes_skipped": 0, "maybes": 0}
+    shuffle = {"peer_fetches": 0, "peer_bytes": 0, "relay_fetches": 0,
+               "relay_fallbacks": 0, "lost_outputs": 0}
     tasks = {"map_assigns": 0, "reduce_assigns": 0, "timeouts": 0,
              "map_commits": 0, "reduce_commits": 0}
     device_fallbacks = 0
@@ -104,6 +106,18 @@ def summarize_events(events: list[dict]) -> dict:
                 )
             elif name == "fuse:split":
                 fusion["fused_attempts"] += 1
+            elif name == "shuffle:peer":
+                shuffle["peer_fetches"] += 1
+                shuffle["peer_bytes"] += int(
+                    (r.get("args") or {}).get("bytes", 0)
+                )
+            elif name == "shuffle:relay":
+                if (r.get("args") or {}).get("fallback"):
+                    shuffle["relay_fallbacks"] += 1
+                else:
+                    shuffle["relay_fetches"] += 1
+            elif name == "map_lost_output":
+                shuffle["lost_outputs"] += 1
             elif name in ("device_demoted", "device_recovered"):
                 degrades += 1
             elif name == "assign_map":
@@ -126,6 +140,17 @@ def summarize_events(events: list[dict]) -> dict:
         out["fusion"] = fusion
     if any(index.values()):
         out["index"] = index
+    if any(shuffle.values()):
+        # shuffle route verdict (peer-to-peer shuffle, round 16): which
+        # data plane the job's reduce fetches actually rode
+        peer_n = shuffle["peer_fetches"]
+        relay_n = shuffle["relay_fetches"] + shuffle["relay_fallbacks"]
+        shuffle["route"] = (
+            "peer" if peer_n and not relay_n
+            else "relay" if relay_n and not peer_n
+            else "mixed"
+        )
+        out["shuffle"] = shuffle
     if device_fallbacks:
         out["device_fallbacks"] = device_fallbacks
     if degrades:

@@ -72,6 +72,9 @@ _WRAPPED = (
     "read_input", "read_input_path", "write_intermediate",
     "read_intermediate", "write_output", "write_output_from_file",
     "publish_task_commit",
+    # peer-to-peer shuffle fetch (round 16): present only on transports
+    # that expose it — the hasattr gate keeps feature probes truthful
+    "fetch_peer",
 )
 
 

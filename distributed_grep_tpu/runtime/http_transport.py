@@ -142,6 +142,32 @@ def _open_with_retries(build_request, timeout: float, desc: str,
             time.sleep(delay)
 
 
+def fetch_peer_data(endpoint: str, job_id: str, name: str,
+                    timeout: float = 30.0, on_retry=None) -> bytes:
+    """Fetch one peer-held shuffle file (``GET <endpoint>/shuffle/<job>/
+    <name>`` against a worker's PeerDataServer, runtime/peer.py) through
+    the SAME bounded-jittered retry loop every client call rides.
+    Raises CoordinatorGone when the schedule runs dry (the peer is gone)
+    and RuntimeError on an HTTP error status (the peer ANSWERED — a 404
+    means the spool entry is gone, not the worker) — both are the
+    reducer's declared relay-fallback/lost-output failures, never
+    retried harder."""
+    base = endpoint if endpoint.startswith("http") else f"http://{endpoint}"
+    url = (
+        f"{base.rstrip('/')}/shuffle/"
+        f"{urllib.parse.quote(job_id or '_', safe='')}/"
+        f"{urllib.parse.quote(name, safe='')}"
+    )
+
+    def build():
+        return urllib.request.Request(url)
+
+    try:
+        return _open_with_retries(build, timeout, f"GET {url}", on_retry)
+    except urllib.error.HTTPError as e:
+        raise RuntimeError(f"GET {url} -> {e.code}") from e
+
+
 class HttpTransport:
     def __init__(self, addr: str, rpc_timeout_s: float = 60.0):
         # addr: "host:port" or full "http://host:port".  rpc_timeout_s is the
@@ -316,6 +342,14 @@ class HttpTransport:
     def read_intermediate(self, name: str) -> bytes:
         return self._request("GET", self._data_path("intermediate", name))
 
+    def fetch_peer(self, endpoint: str, job_id: str, name: str) -> bytes:
+        """Peer-to-peer shuffle fetch (runtime/peer.py) — a transport
+        METHOD (not just the module helper) so the chaos tier's
+        FaultTransport can inject drops/delays on exactly this leg."""
+        return fetch_peer_data(endpoint, job_id, name,
+                               timeout=self.rpc_timeout_s,
+                               on_retry=self._count_retry)
+
     def write_output(self, name: str, data: bytes) -> None:
         self._request("PUT", self._data_path("out", name), data)
 
@@ -458,17 +492,44 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     # Service daemon detection (runtime/service.py): its /status answers
     # {"service": true}; such workers scope their data plane per job and
     # resolve the application per assignment instead of from /config.
-    is_service = False
+    daemon_status: dict = {}
     try:
-        is_service = bool(transport.fetch_status().get("service"))
+        daemon_status = transport.fetch_status()
     except Exception:  # noqa: BLE001 — plain coordinator without /status? no
         pass
+    is_service = bool(daemon_status.get("service"))
     app = load_application(config.application, **config.app_options)
     transport_cls = ServiceHttpTransport if is_service else HttpTransport
     if is_service:
         log.info("attached to a service daemon at %s", addr)
 
     from distributed_grep_tpu.utils import spans as spans_mod
+
+    # Peer-to-peer shuffle (round 16, runtime/peer.py): service-attached
+    # workers start ONE data server per process (all slots share it) and
+    # keep map output on their local spool — the daemon then moves
+    # shuffle METADATA only.  Default on for the service, not applicable
+    # to one-shot coordinators; DGREP_PEER_SHUFFLE=0 is a true no-op
+    # (no server, no spool, pre-peer wire payloads).  Gated on the
+    # daemon's /status "peer" capability key: a pre-peer daemon parses
+    # AssignTaskArgs with cls(**payload) and would 500 every poll on the
+    # unknown peer_endpoint key — with the knob default-ON the worker
+    # must not assume support.  A server that cannot bind degrades to
+    # the relay data plane instead of refusing to work.
+    peer = None
+    if is_service and daemon_status.get("peer"):
+        from distributed_grep_tpu.runtime.peer import (
+            PeerDataServer,
+            env_peer_shuffle,
+        )
+
+        if env_peer_shuffle():
+            try:
+                peer = PeerDataServer().start()
+            except OSError:
+                log.exception(
+                    "peer data server failed to start; relay shuffle")
+                peer = None
 
     def run_loop(slot: int) -> None:
         loop = WorkerLoop(
@@ -483,6 +544,7 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
             # would be pure payload), DGREP_SPANS forces on for debugging
             spans_enabled=spans_mod.enabled(config.spans),
             job_id=config.effective_job_id(),
+            peer=peer,
         )
         try:
             loop.run()
@@ -496,5 +558,9 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     ]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    try:
+        for t in threads:
+            t.join()
+    finally:
+        if peer is not None:
+            peer.close()

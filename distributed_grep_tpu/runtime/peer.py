@@ -1,0 +1,287 @@
+"""Peer-to-peer shuffle data plane (round 16): the worker data server.
+
+The reference ships every intermediate byte through the coordinator over
+SFTP (map_reduce/coordinator.go:316-327), and that star topology survived
+in our HTTP data plane: map output PUTs to the daemon, reducers GET it
+back — every shuffle byte transits the coordinator NIC twice.  Classic
+MapReduce's answer is the one the paper's lab-scale version skipped:
+reducers read map output DIRECTLY from the mapper that produced it, the
+coordinator keeping only metadata (who holds which partition) and
+re-executing map tasks whose output died with a worker.
+
+``PeerDataServer`` is the serving half: a lightweight HTTP server (the
+``DataPlaneHandler`` plumbing the workers already run the client half of)
+over a local map-output spool.  The worker's map commit writes
+``mr-<tid>-<r>`` into the spool (atomic tmp+rename, crc32 self-checksum —
+the NonAtomicStore record shape) and registers metadata on the commit
+record / TaskFinished RPC; reducers fetch ``GET /shuffle/<job>/<name>``
+through the transport retry helpers and verify the checksum.
+
+Loss model: the spool is PROCESS state — a dead worker takes its shuffle
+output with it.  That is the deliberate trade (the daemon never touches
+the bytes); the scheduler's lost-output path (reducer reports the failed
+fetch, the producing MAP task re-enqueues, quarantine charges the
+vanished producer) is the load-bearing recovery, proven in the chaos
+matrix.
+
+Kill-switch ``DGREP_PEER_SHUFFLE`` (default ON for workers attached to a
+service daemon, peer shuffle does not apply to one-shot coordinators):
+off is a TRUE no-op — no server starts, no spool exists, every wire
+payload stays byte-identical to the pre-peer protocol (the
+``DGREP_SERVICE_FUSE=0`` contract).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+import urllib.parse
+import zlib
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+from distributed_grep_tpu.runtime.http_coordinator import DataPlaneHandler
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("peer")
+
+# Spool entries for jobs untouched this long are pruned opportunistically
+# on the next put(): the worker never learns job completion (it serves a
+# stream of jobs), so age is the bound.  A pruned-but-still-wanted file is
+# a clean lost-output report — the map re-executes; it cannot be wrong.
+_SPOOL_PRUNE_S = 3600.0
+
+
+def env_peer_shuffle(default: bool = True) -> bool:
+    """Peer-to-peer shuffle switch — the ONE parser of DGREP_PEER_SHUFFLE.
+    On (the default for service-attached workers), map output stays on
+    the producing worker's spool and reducers fetch it directly;
+    "0"/"false"/"no" reverts to the relay data plane exactly (TRUE
+    no-op: no server, no spool, byte-identical wire payloads)."""
+    raw = os.environ.get("DGREP_PEER_SHUFFLE")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
+def env_peer_port(default: int = 0) -> int:
+    """Worker data-server listen port — the ONE parser of DGREP_PEER_PORT
+    (0 = ephemeral, the default: N worker processes per host each bind
+    their own; malformed or negative keeps the default)."""
+    raw = os.environ.get("DGREP_PEER_PORT")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def env_peer_host(default: str = "") -> str:
+    """Advertised shuffle-endpoint host — the ONE parser of
+    DGREP_PEER_HOST.  Empty (default) advertises the bind host; set it
+    when workers bind a wildcard/NAT'd interface and peers must dial a
+    routable name instead."""
+    raw = os.environ.get("DGREP_PEER_HOST")
+    return raw.strip() if raw else default
+
+
+def env_peer_bind(default: str = "") -> str:
+    """Data-server BIND address — the ONE parser of DGREP_PEER_BIND.
+    Empty (the default) binds loopback, UNLESS DGREP_PEER_HOST
+    advertises a routable name: an endpoint other hosts are told to
+    dial while the server listens on 127.0.0.1 can never connect, so
+    the advertise override implies a wildcard bind.  Set both for a
+    specific-interface bind behind NAT."""
+    raw = os.environ.get("DGREP_PEER_BIND")
+    if raw and raw.strip():
+        return raw.strip()
+    if default:
+        return default
+    return "0.0.0.0" if env_peer_host() else "127.0.0.1"
+
+
+def checksum(data: bytes) -> str:
+    """The peer-shuffle content self-checksum: crc32 as 8 hex digits —
+    the store record format's checksum (runtime/store.encode_record),
+    reused so one corruption story covers both commit paths."""
+    return f"{zlib.crc32(data):08x}"
+
+
+def _safe_segment(name: str) -> str:
+    name = urllib.parse.unquote(name)
+    if "/" in name or name.startswith("."):
+        raise ValueError(f"invalid shuffle path segment: {name!r}")
+    return name
+
+
+class PeerDataServer:
+    """One worker process's shuffle data server: a local spool of
+    committed map output plus an HTTP GET surface other workers' reducers
+    fetch from.  Shared by every task-loop slot of the process (names are
+    unique per (job, task, partition), so slots never collide)."""
+
+    def __init__(self, host: str | None = None, port: int | None = None,
+                 spool_dir: str | None = None):
+        import tempfile
+
+        self.spool_root = Path(
+            spool_dir or tempfile.mkdtemp(prefix="dgrep-peer-")
+        )
+        self._owns_spool = spool_dir is None
+        host = env_peer_bind() if host is None else host
+        self._httpd = ThreadingHTTPServer(
+            (host, env_peer_port() if port is None else port),
+            _make_peer_handler(self),
+        )
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        adv_host = env_peer_host() or host
+        if adv_host in ("0.0.0.0", "::"):
+            # explicit wildcard bind with no advertise override: a
+            # wildcard is not dialable — fall back to the host's name
+            import socket
+
+            adv_host = socket.gethostname()
+        self.endpoint = f"http://{adv_host}:{self._httpd.server_address[1]}"
+        # Live spool footprint: plain int updated under the GIL (a
+        # telemetry counter, not a synchronization primitive — the
+        # retry_count convention).
+        self._spool_bytes = 0
+        self._last_prune = time.monotonic()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "PeerDataServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="peer-data", daemon=True
+        )
+        self._thread.start()
+        log.info("peer shuffle data server serving on %s (spool %s)",
+                 self.endpoint, self.spool_root)
+        return self
+
+    # ----------------------------------------------------------- spool
+    def spool_path(self, job_id: str, name: str) -> Path:
+        return (self.spool_root / _safe_segment(job_id or "_")
+                / _safe_segment(name))
+
+    def put(self, job_id: str, name: str, data: bytes) -> tuple[int, str]:
+        """Commit one intermediate file into the spool (tmp + fsync-free
+        rename: a torn spool entry after a crash is indistinguishable
+        from a dead worker, and the lost-output path recovers both).
+        Returns (size, crc32-hex) — the metadata the commit record and
+        the TaskFinished RPC register with the scheduler."""
+        p = self.spool_path(job_id, name)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_name(p.name + ".tmp")
+        prev = p.stat().st_size if p.exists() else 0
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+        self._spool_bytes += len(data) - prev
+        self._maybe_prune()
+        return len(data), checksum(data)
+
+    def get_local(self, job_id: str, name: str) -> bytes:
+        """Serve a spool entry without HTTP — the reducer-is-the-producer
+        fast path (a worker fetching its own endpoint)."""
+        return self.spool_path(job_id, name).read_bytes()
+
+    def spool_bytes(self) -> int:
+        return max(0, self._spool_bytes)
+
+    def _maybe_prune(self, max_age_s: float = _SPOOL_PRUNE_S) -> None:
+        """Drop job spool dirs untouched for max_age_s (the worker never
+        learns job completion).  Opportunistic, at most once per minute;
+        a racing fetch of a pruned entry is a clean lost-output report."""
+        now = time.monotonic()
+        if now - self._last_prune < 60.0:
+            return
+        self._last_prune = now
+        cutoff = time.time() - max_age_s
+        try:
+            for d in self.spool_root.iterdir():
+                if not d.is_dir():
+                    continue
+                try:
+                    if d.stat().st_mtime < cutoff and not any(
+                        f.stat().st_mtime >= cutoff for f in d.iterdir()
+                    ):
+                        freed = sum(
+                            f.stat().st_size for f in d.iterdir()
+                            if f.is_file()
+                        )
+                        shutil.rmtree(d, ignore_errors=True)
+                        self._spool_bytes -= freed
+                        log.info("pruned idle shuffle spool %s (%d bytes)",
+                                 d.name, freed)
+                except OSError:
+                    continue
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Stop serving and (when the spool was ours) delete it.  Spool
+        entries still wanted by reducers become lost-output reports —
+        closing a peer server IS the producer-death event."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever — calling it on a
+            # never-started server blocks forever
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._owns_spool:
+            shutil.rmtree(self.spool_root, ignore_errors=True)
+
+
+def _make_peer_handler(server: PeerDataServer):
+    class Handler(DataPlaneHandler):
+        # --- GET /shuffle/<job>/<name>, /healthz -----------------------
+        def do_GET(self):
+            self._streaming_body = False  # per request (keep-alive)
+            try:
+                if self.path == "/healthz":
+                    self._send_json({
+                        "ok": True,
+                        "spool_bytes": server.spool_bytes(),
+                    })
+                    return
+                if not self.path.startswith("/shuffle/"):
+                    self._send_json({"error": "not found"}, 404)
+                    return
+                rest = self.path[len("/shuffle/"):]
+                parts = rest.split("/", 1)
+                if len(parts) != 2:
+                    self._send_json(
+                        {"error": f"bad shuffle path: {self.path!r}"}, 400)
+                    return
+                p = server.spool_path(parts[0], parts[1])
+                if not p.exists():
+                    # gone (pruned / never produced here): the reducer's
+                    # declared-failure path reports it lost and the map
+                    # re-executes — answer honestly, never hang
+                    self._send_json({"error": f"no such file: {rest}"}, 404)
+                    return
+                self._send_file(p)
+            except BrokenPipeError:
+                self.close_connection = True
+            except Exception as e:  # noqa: BLE001 — report, don't kill serving
+                self.close_connection = True
+                log.exception("peer get error on %s", self.path)
+                if getattr(self, "_streaming_body", False):
+                    return  # headers out: never splice JSON into a body
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+    return Handler

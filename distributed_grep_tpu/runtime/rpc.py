@@ -45,6 +45,13 @@ class Assignment:
 @dataclass
 class AssignTaskArgs:
     worker_id: int = -1  # -1 = not yet registered; coordinator allocates
+    # Peer-to-peer shuffle (round 16, runtime/peer.py): the worker's
+    # advertised shuffle data endpoint ("http://host:port"), shipped on
+    # every poll so the service worker table can show who holds shuffle
+    # state before an operator drains a worker.  "" (elided from the
+    # wire) everywhere peer shuffle is off — payloads then stay
+    # byte-identical to the pre-peer protocol.
+    peer_endpoint: str = ""
 
 
 @dataclass
@@ -120,6 +127,20 @@ class TaskFinishedArgs:
     spans: list[dict] = field(default_factory=list)
     spans_seq: int = -1
     metrics: dict[str, float] | None = None
+    # Peer-to-peer shuffle (round 16): a map commit that kept its output
+    # on the PRODUCING worker's local spool registers metadata instead of
+    # bytes — the worker's shuffle endpoint and per-partition
+    # {partition: [size, crc32-hex]} self-checksums (the NonAtomicStore
+    # commit-record shape).  The same metadata rides the per-task commit
+    # record (the durable unit of truth); these args are the fallback for
+    # transports without commit records — and, deliberately, the LIVE
+    # attempt's truth when a re-executed map replaces a vanished
+    # producer (the resolved record may still name the dead attempt's
+    # endpoint; the freshly finished attempt's args self-heal it).
+    # Empty/None (elided) on relay commits — pre-peer payloads are
+    # byte-identical.
+    peer_endpoint: str = ""
+    peer_parts: dict | None = None
 
 
 @dataclass
@@ -143,6 +164,14 @@ class ReduceNextFileArgs:
     # straggler's fetch must not set the `stamped` evidence that would
     # charge the REASSIGNED worker for a timeout it never caused.
     worker_id: int = -1
+    # Peer-to-peer shuffle lost-output report (round 16): the reducer
+    # could not fetch this intermediate file — the producing peer is gone
+    # (or served a checksum mismatch) after bounded retries AND the
+    # daemon relay has no copy.  The scheduler re-enqueues the producing
+    # MAP task (its output is gone with the worker — the load-bearing
+    # fault path P2P introduces) and this reducer's cursor waits for the
+    # re-executed attempt.  "" (elided) on ordinary fetches.
+    lost_file: str = ""
 
 
 @dataclass
@@ -153,6 +182,17 @@ class ReduceNextFileReply:
     # shuffle cursor belongs to a previous scheduler incarnation.
     # Elided when False — old peers interop.
     abort: bool = False
+    # Peer-to-peer shuffle (round 16): where next_file actually lives.
+    # Set when the producing map attempt kept its output on its own
+    # worker's spool — the reducer fetches GET <peer_endpoint>/shuffle/
+    # <job>/<name> directly (the daemon never touches the bytes) and
+    # verifies size + crc32 against these.  All three elide at their
+    # defaults (rpc._REPLY_ELIDE): a peer-shuffle-off daemon's replies
+    # stay byte-identical to the pre-peer protocol, and old workers only
+    # break when actually handed peer-held work.
+    peer_endpoint: str = ""
+    peer_size: int = 0
+    peer_checksum: str = ""
 
 
 @dataclass
@@ -207,6 +247,9 @@ _ELIDE_DEFAULTS: dict[str, Any] = {
     # service multiplexing riders (runtime/service.py): absent from the
     # wire on single-job coordinators, so pre-service peers interop
     "job_id": "", "application": "",
+    # peer-to-peer shuffle riders (round 16, runtime/peer.py): absent
+    # everywhere DGREP_PEER_SHUFFLE is off or the commit went relay-style
+    "peer_endpoint": "", "peer_parts": None, "lost_file": "",
 }
 
 # Reply serialization keeps the historical asdict shape (default-valued
@@ -215,7 +258,7 @@ _ELIDE_DEFAULTS: dict[str, Any] = {
 # daemon's replies are byte-identical to the pre-fusion protocol and old
 # workers (cls(**payload) constructors) only break when fusion is
 # actually handing them fused work.
-_REPLY_ELIDE = ("fused",)
+_REPLY_ELIDE = ("fused", "peer_endpoint", "peer_size", "peer_checksum")
 
 
 def reply_to_dict(msg: Any) -> dict:

@@ -224,6 +224,18 @@ def _split_label(members: tuple[str, ...]) -> str:
     return f"{members[0]} (+{len(members) - 1} batched)"
 
 
+def _producer_task_of(name: str) -> int | None:
+    """The producing map task id of an intermediate file name
+    ("mr-<tid>-<r>", the worker's own naming contract), or None for
+    anything else-shaped.  Gates shuffle serves on the producer's
+    COMPLETED state and resolves lost-output reports (peer shuffle,
+    round 16) to the map task that must re-run."""
+    parts = name.split("-")
+    if len(parts) == 3 and parts[0] == "mr" and parts[1].isdigit():
+        return int(parts[1])
+    return None
+
+
 class Scheduler:
     """Transport-agnostic coordinator state machine (thread-safe).
 
@@ -296,6 +308,13 @@ class Scheduler:
         self._pending_journal: list[tuple] = []
         self._journal_flush_lock = lockdep.make_lock("journal-flush",
                                                      io_ok=True)
+        # (kind, task_id) pairs already journaled (staged or replayed):
+        # a map task RE-COMPLETED after a lost-output re-execution (peer
+        # shuffle, round 16) must not append a second map_done line —
+        # the chaos matrix pins journal uniqueness per (kind, task), and
+        # replay treats the first line as done anyway (re-execution is
+        # deterministic, so the recorded parts still hold).
+        self._journaled: set[tuple[str, int]] = set()
         self._span_seqs: dict[int, set[int]] = {}  # worker -> persisted
         # batch seqs (retry dedup, _persist_spans)
         self._span_seq_lock = lockdep.make_lock("span-seq")
@@ -353,6 +372,13 @@ class Scheduler:
         # nothing (a resumed job's wall would misprice the live phase).
         self._phase_t0 = time.monotonic()
         self._reduce_t0: float | None = None
+        # The map phase can complete MORE than once: a lost-output
+        # revocation (peer shuffle) walks a COMPLETED map back to
+        # UNASSIGNED and the re-execution re-crosses the phase boundary.
+        # Observe the wall (and anchor _reduce_t0) at the FIRST crossing
+        # only — a re-crossing's "map phase wall" would include the
+        # elapsed reduce time.
+        self._phase_observed = False
 
         if resume_entries:
             self._replay(resume_entries)
@@ -402,6 +428,7 @@ class Scheduler:
                         )
                         continue
                     parts = e.get("parts", [])
+                    peer = None
                     if e.get("has_record"):
                         # This completion was committed via a task commit
                         # record — re-resolve it as the unit of truth.  A
@@ -417,8 +444,16 @@ class Scheduler:
                             continue
                         # malformed record (no "parts"): keep the journal's
                         parts = record.get("parts", parts)
+                        # peer-held output (round 16): the record's
+                        # metadata survives a coordinator restart — if
+                        # the producer also died, the first fetch fails
+                        # and the lost-output path re-runs this task
+                        if isinstance(record.get("peer"), dict):
+                            peer = record["peer"]
                     if t.state is not TaskState.COMPLETED:
                         t.state = TaskState.COMPLETED
+                        t.peer = peer
+                        self._journaled.add(("map", tid))
                         self._register_map_outputs(tid, parts)
                         if tid in self._map_queue:
                             self._map_queue.remove(tid)
@@ -433,6 +468,7 @@ class Scheduler:
                         continue
                     t = self.reduce_tasks[tid]
                     t.state = TaskState.COMPLETED
+                    self._journaled.add(("reduce", tid))
                     if tid in self._reduce_queue:
                         self._reduce_queue.remove(tid)
         # one-time O(n) resync of the incremental counters after replay
@@ -442,6 +478,11 @@ class Scheduler:
         self._reduces_completed = sum(
             t.state is TaskState.COMPLETED for t in self.reduce_tasks
         )
+        # A phase completed purely by replay observes nothing (the
+        # round-15 contract) — and must not observe later either, when a
+        # lost-output revocation makes a live commit re-cross it.
+        if self.map_tasks and self._map_phase_done_locked():
+            self._phase_observed = True
         log.info(
             "journal replay: %d map + %d reduce tasks already complete",
             self._maps_completed, self._reduces_completed,
@@ -855,16 +896,36 @@ class Scheduler:
             parts = args.produced_parts
             if record is not None and "parts" in record:
                 parts = record["parts"]
+            # Peer-held output metadata (round 16): the LIVE attempt's
+            # args win over the resolved record — record resolution picks
+            # the lexicographically-smallest attempt, which after a
+            # lost-output re-execution can still be the DEAD producer's;
+            # registering the freshly-finished attempt's endpoint is what
+            # lets recovery converge (a wrong endpoint only ever costs
+            # one more lost-output round, never serves wrong bytes — the
+            # checksum gate).  Relay commits carry neither and clear it.
+            peer = None
+            if record is not None and isinstance(record.get("peer"), dict):
+                peer = record["peer"]
+            if args.peer_endpoint:
+                peer = {"endpoint": args.peer_endpoint,
+                        "worker": args.worker_id,
+                        "parts": dict(args.peer_parts or {})}
+            task.peer = peer
             self._register_map_outputs(args.task_id, parts)
             self.metrics.inc("map_completed")
-            if self._map_phase_done_locked():
+            if self._map_phase_done_locked() and not self._phase_observed:
+                self._phase_observed = True
                 now = time.monotonic()
                 self._reduce_t0 = now
                 _H_MAP_PHASE.observe(now - self._phase_t0)
-            if self.journal:
-                # staged under the lock (at most once per task — gated by
-                # the COMPLETED transition above), fsync'd by
-                # _flush_journal after release
+            if self.journal and ("map", args.task_id) not in self._journaled:
+                # staged under the lock, at most once per task — the
+                # COMPLETED transition gates duplicates within one
+                # completion, the _journaled set gates RE-completions
+                # after a lost-output re-execution (peer shuffle);
+                # fsync'd by _flush_journal after release
+                self._journaled.add(("map", args.task_id))
                 self._pending_journal.append((
                     "map", args.task_id, task.file, parts,
                     record is not None, list(task.files) or None,
@@ -912,8 +973,11 @@ class Scheduler:
                         time.monotonic()
                         - (self._reduce_t0 or self._phase_t0)
                     )
-                if self.journal:
+                if self.journal and (
+                    ("reduce", args.task_id) not in self._journaled
+                ):
                     # staged like the map branch; see _flush_journal
+                    self._journaled.add(("reduce", args.task_id))
                     self._pending_journal.append((
                         "reduce", args.task_id, None, None,
                         record is not None, None,
@@ -934,7 +998,15 @@ class Scheduler:
     ) -> rpc.ReduceNextFileReply:
         """The pipelined shuffle feed (coordinator.go:159-174): block until the
         reducer's next intermediate file exists, or the map phase is done and
-        the cursor is exhausted (done=True).  Doubles as a heartbeat (:162)."""
+        the cursor is exhausted (done=True).  Doubles as a heartbeat (:162).
+
+        Peer shuffle (round 16): a reply for a peer-held file carries the
+        producing worker's endpoint + size + crc32 (wire-elided
+        otherwise); an ``args.lost_file`` report re-enqueues the producing
+        MAP task (``_report_lost_locked``) and this cursor then WAITS for
+        the re-executed attempt — its file entry is gated on the
+        producer's COMPLETED state, exactly like a file that has not
+        arrived yet."""
         deadline = _Deadline(timeout)
         if args.epoch and args.epoch != self.epoch:
             # a reduce attempt from a PREVIOUS scheduler incarnation (it
@@ -943,33 +1015,151 @@ class Scheduler:
             # arrival order — serving it from the rebuilt list would feed
             # it duplicate/missing shuffle files and its commit could WIN
             # attempt resolution with wrong bytes.  Abort the attempt;
-            # the re-issued one owns this incarnation.
+            # the re-issued one owns this incarnation.  Checked BEFORE
+            # any lost-output report is honored: a zombie must not
+            # re-enqueue this incarnation's completed maps.
             log.warning(
                 "aborting reduce attempt for task %d: stale scheduler "
                 "epoch %s (current %s)", args.task_id, args.epoch,
                 self.epoch,
             )
             return rpc.ReduceNextFileReply(abort=True)
-        with self._cond:
-            task = self.reduce_tasks[args.task_id]
-            while True:
-                task.heartbeat()
-                if args.worker_id < 0 or args.worker_id == task.worker:
-                    # the CURRENT assignee demonstrably holds it; a
-                    # same-life straggler's fetch must not plant the
-                    # evidence that would charge the reassigned worker
-                    task.stamped = True
-                if args.files_processed < len(task.task_files):
-                    return rpc.ReduceNextFileReply(
-                        next_file=task.task_files[args.files_processed], done=False
+        requeued = False
+        try:
+            with self._cond:
+                if args.lost_file:
+                    requeued = self._report_lost_locked(args)
+                    if requeued:
+                        # the reporter is ABORTED (and its task
+                        # re-enqueued) along with the map re-enqueue: its
+                        # cursor cannot advance until the map re-runs,
+                        # and a worker parked in a gated long-poll is a
+                        # worker that cannot run that map — with a small
+                        # pool (every live worker holding a reduce) the
+                        # job would deadlock.  Freed workers serve the
+                        # map queue first, so progress is guaranteed
+                        # with any one live worker; the re-issued reduce
+                        # attempt re-fetches from the fresh metadata.
+                        return rpc.ReduceNextFileReply(abort=True)
+                task = self.reduce_tasks[args.task_id]
+                while True:
+                    task.heartbeat()
+                    if args.worker_id < 0 or args.worker_id == task.worker:
+                        # the CURRENT assignee demonstrably holds it; a
+                        # same-life straggler's fetch must not plant the
+                        # evidence that would charge the reassigned worker
+                        task.stamped = True
+                    if args.files_processed < len(task.task_files):
+                        reply = self._serve_file_locked(
+                            task.task_files[args.files_processed]
+                        )
+                        if reply is not None:
+                            return reply
+                        # producer re-executing (lost output): hold the
+                        # cursor like a not-yet-arrived file — fall
+                        # through to the wait
+                    elif self._map_phase_done_locked():
+                        return rpc.ReduceNextFileReply(done=True)
+                    remaining = deadline.remaining()
+                    if remaining <= 0:
+                        # Not done — client should re-poll (long-poll
+                        # window expired).
+                        return rpc.ReduceNextFileReply(next_file="", done=False)
+                    self._cond.wait(
+                        timeout=min(remaining, self.sweep_interval_s)
                     )
-                if self._map_phase_done_locked():
-                    return rpc.ReduceNextFileReply(done=True)
-                remaining = deadline.remaining()
-                if remaining <= 0:
-                    # Not done — client should re-poll (long-poll window expired).
-                    return rpc.ReduceNextFileReply(next_file="", done=False)
-                self._cond.wait(timeout=min(remaining, self.sweep_interval_s))
+        finally:
+            self._flush_events()
+            if requeued:
+                self._notify_change()  # the map is assignable again
+
+    def _serve_file_locked(self, name: str) -> rpc.ReduceNextFileReply | None:
+        """One servable shuffle entry, or None while its producing map
+        task is being re-executed (lost peer output — pre-peer this state
+        was unreachable: files registered only at completion and were
+        never revoked).  Peer-held entries carry the fetch metadata."""
+        tid = _producer_task_of(name)
+        mt = (self.map_tasks[tid]
+              if tid is not None and 0 <= tid < len(self.map_tasks)
+              else None)
+        if mt is not None and mt.state is not TaskState.COMPLETED:
+            return None
+        reply = rpc.ReduceNextFileReply(next_file=name, done=False)
+        if mt is not None and mt.peer:
+            meta = mt.peer.get("parts", {}).get(name.rsplit("-", 1)[1])
+            if meta:
+                reply.peer_endpoint = str(mt.peer.get("endpoint", ""))
+                reply.peer_size = int(meta[0])
+                reply.peer_checksum = str(meta[1])
+        return reply
+
+    def _report_lost_locked(self, args: rpc.ReduceNextFileArgs) -> bool:
+        """Handle a reducer's lost-output report (caller holds the lock):
+        re-enqueue the producing map task — its output died with its
+        worker, the load-bearing P2P fault path — and charge the vanished
+        producer's health record.  Returns True when a task was actually
+        re-enqueued (first report wins; later reporters of the same task
+        see it already re-running and simply wait).  Only PEER-HELD
+        completed outputs are revocable: a relay 404 is a data-plane bug
+        the store layer owns, not a lost worker."""
+        name = args.lost_file
+        tid = _producer_task_of(name)
+        if tid is None or not 0 <= tid < len(self.map_tasks):
+            log.warning("ignoring lost-output report for %r: not an "
+                        "intermediate file name", name)
+            return False
+        task = self.map_tasks[tid]
+        if task.state is not TaskState.COMPLETED or not task.peer:
+            return False
+        producer = int(task.peer.get("worker", -1))
+        log.warning(
+            "map task %d output %s lost with its producer (worker %d, "
+            "reported by worker %d); re-executing", tid, name, producer,
+            args.worker_id,
+        )
+        task.state = TaskState.UNASSIGNED
+        task.peer = None
+        task.worker = -1
+        task.stamped = False
+        self._maps_completed -= 1
+        self._map_queue.append(tid)
+        self.metrics.inc("maps_lost_output")
+        self.metrics.inc("map_retries")
+        self.metrics.inc("tasks_requeued")
+        _C_REQUEUED.inc()
+        self._event("map_lost_output", task=tid, file=name,
+                    producer=producer, reporter=args.worker_id)
+        # the producer demonstrably held committed state and vanished —
+        # the direct analogue of the sweeper's attributed timeout
+        # (WorkerHealth is a leaf lock, safe here like in the sweeper)
+        if producer >= 0:
+            window = self.worker_health.record_failure(producer)
+            if window > 0:
+                self.metrics.inc("workers_quarantined")
+                _C_QUARANTINED.inc()
+                self._event("quarantine", worker=producer,
+                            window_s=round(window, 3))
+        # free the REPORTING worker (the caller answers abort=True): its
+        # reduce task re-enqueues now — NOT via a sweeper timeout later —
+        # so the pool can run the re-executed map without dead time.  The
+        # reporter takes no quarantine charge (it did nothing wrong).
+        # Current-assignee reports only: a same-life straggler's report
+        # re-enqueues the map above but must not yank the task from the
+        # worker that legitimately holds it.
+        rt = (self.reduce_tasks[args.task_id]
+              if 0 <= args.task_id < len(self.reduce_tasks) else None)
+        if rt is not None and rt.state is TaskState.IN_PROGRESS and (
+            args.worker_id < 0 or rt.worker in (-1, args.worker_id)
+        ):
+            rt.state = TaskState.UNASSIGNED
+            rt.worker = -1
+            rt.stamped = False
+            self._reduce_queue.append(args.task_id)
+            self.metrics.inc("reduce_retries")
+            self.metrics.inc("tasks_requeued")
+            _C_REQUEUED.inc()
+        self._cond.notify_all()
+        return True
 
     # -------------------------------------------------------------- liveness
     def heartbeat(self, task_type: str, task_id: int, grace_s: float = 0.0,
@@ -1132,6 +1322,36 @@ class Scheduler:
         """Pure predicate — no teardown side effects (unlike coordinator.go:291-296)."""
         with self._lock:
             return self._done_locked()
+
+    def backlog(self) -> dict:
+        """Live demand snapshot for the service's elastic scale advice
+        (round 16): ASSIGNABLE unassigned tasks (reduce tasks count only
+        once the map phase is done — they cannot be handed out earlier),
+        in-flight tasks, and the oldest in-flight heartbeat age (a
+        growing age with idle capacity means stalled recovery, the
+        other grow signal)."""
+        now = time.monotonic()
+        with self._lock:
+            unassigned = sum(
+                t.state is TaskState.UNASSIGNED for t in self.map_tasks
+            )
+            if self._map_phase_done_locked():
+                unassigned += sum(
+                    t.state is TaskState.UNASSIGNED
+                    for t in self.reduce_tasks
+                )
+            in_flight = 0
+            oldest = 0.0
+            for table in (self.map_tasks, self.reduce_tasks):
+                for t in table:
+                    if t.state is TaskState.IN_PROGRESS:
+                        in_flight += 1
+                        oldest = max(oldest, now - t.timestamp)
+            return {
+                "unassigned": unassigned,
+                "in_flight": in_flight,
+                "oldest_inflight_age_s": round(oldest, 3),
+            }
 
     def wait_done(self, timeout: Optional[float] = None) -> bool:
         with self._cond:

@@ -55,6 +55,7 @@ from distributed_grep_tpu.runtime.http_coordinator import (
     long_poll_window_s,
 )
 from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.runtime.peer import env_peer_shuffle
 from distributed_grep_tpu.runtime.scheduler import (
     Scheduler,
     WorkerHealth,
@@ -84,6 +85,10 @@ DEFAULT_QUEUE_DEPTH = 64
 # retry of a batch thousands of seqs old cannot happen).
 _MAX_TERMINAL_RECORDS = 256
 _WORKER_EXPIRE_S = 3600.0
+# scale_advice capacity freshness: a worker row older than this is not
+# counted as an attached worker when sizing the pool (the row itself
+# lives until _WORKER_EXPIRE_S — operators still see it in /status)
+_SCALE_FRESH_S = 90.0
 _SPAN_SEQ_WINDOW = 4096
 
 # How long an idle service-level AssignTask waits between sweeps over the
@@ -455,6 +460,17 @@ class GrepService:
         self._fusion_lock = lockdep.make_lock("fusion-stats")
         self._fusion_stats = {
             "fused_jobs": 0, "fused_dispatches": 0, "fusion_bytes_saved": 0,
+        }
+
+        # Peer-to-peer shuffle accounting (round 16, GET /status
+        # "shuffle" + the dgrep_daemon_shuffle_bytes gauge): intermediate
+        # bytes that actually transited THIS daemon's HTTP data plane
+        # (relay PUTs by producers + relay GETs by reducers).  With peer
+        # shuffle on these stay ~0 — the counter IS the receipt that the
+        # star topology is gone.  Leaf lock.
+        self._shuffle_lock = lockdep.make_lock("shuffle-stats")
+        self._shuffle_stats = {
+            "daemon_shuffle_bytes": 0, "relay_puts": 0, "relay_gets": 0,
         }
 
         # Shard-index planning counters (GET /status "index"): shards the
@@ -1092,8 +1108,19 @@ class GrepService:
         with self._cond:
             self._cond.notify_all()
 
+    def count_shuffle_bytes(self, direction: str, n_bytes: int) -> None:
+        """Account one relay shuffle transfer through the daemon's HTTP
+        data plane (direction: "relay_puts" | "relay_gets").  Called by
+        the service handler per intermediate PUT/GET — with peer shuffle
+        on, nothing calls it and the gauge stays at 0 (the receipt)."""
+        with self._shuffle_lock:
+            self._shuffle_stats["daemon_shuffle_bytes"] += int(n_bytes)
+            if direction in self._shuffle_stats:
+                self._shuffle_stats[direction] += 1
+
     def _worker_seen(self, worker_id: int, job: str | None = ...,
-                     task: str | None = ..., metrics: dict | None = None) -> None:
+                     task: str | None = ..., metrics: dict | None = None,
+                     data_endpoint: str | None = None) -> None:
         if worker_id < 0:
             return
         if metrics is not None:
@@ -1116,6 +1143,11 @@ class GrepService:
                 info["task"] = task
             if metrics is not None:
                 info["metrics"] = metrics
+            if data_endpoint:
+                # the worker's advertised peer-shuffle endpoint
+                # (AssignTaskArgs.peer_endpoint): operators see who holds
+                # spool state before draining a worker
+                info["data_endpoint"] = data_endpoint
 
     # ---------------------------------------------------------- control plane
     def assign_task(self, args: rpc.AssignTaskArgs,
@@ -1174,6 +1206,10 @@ class GrepService:
         # (single-threaded loops) — the lost-reply discriminator the
         # sweeper's quarantine attribution reads (WorkerHealth.saw)
         self._health.saw(worker_id)
+        if getattr(args, "peer_endpoint", ""):
+            # peer shuffle: every poll re-advertises the worker's data
+            # endpoint (a reconnect under a fresh id re-registers it)
+            self._worker_seen(worker_id, data_endpoint=args.peer_endpoint)
         while True:
             # Quarantined workers park here: no scheduler sweep, no
             # assignment — wait out the window (or the long-poll), then
@@ -1562,6 +1598,14 @@ class GrepService:
 
         now = time.monotonic()
         quarantine = self._health.snapshot()
+        with self._shuffle_lock:
+            # nonzero-only: a daemon whose shuffle never transited its
+            # data plane (pure peer, or no HTTP workers) keeps the exact
+            # pre-peer /status shape
+            shuffle_stats = (
+                dict(self._shuffle_stats)
+                if any(self._shuffle_stats.values()) else {}
+            )
         with self._fusion_lock:
             # nonzero-only, like the cache counter dicts: a fusion-free
             # (or fusion-disabled) daemon's /status keeps its exact
@@ -1590,6 +1634,10 @@ class GrepService:
                 rec.metrics.counters.get("tasks_requeued", 0)
                 for rec in self._jobs.values()
             )
+            maps_lost = sum(
+                rec.metrics.counters.get("maps_lost_output", 0)
+                for rec in self._jobs.values()
+            )
             workers = {}
             for wid, info in sorted(self.workers.items()):
                 row: dict = {
@@ -1599,9 +1647,25 @@ class GrepService:
                 }
                 if info.get("metrics") is not None:
                     row["metrics"] = info["metrics"]
+                if info.get("data_endpoint"):
+                    # peer shuffle: who holds spool state (spool size
+                    # rides the metrics row as peer_spool_bytes)
+                    row["data_endpoint"] = info["data_endpoint"]
                 if str(wid) in quarantine["active"]:
                     row["quarantined_s"] = quarantine["active"][str(wid)]
                 workers[str(wid)] = row
+        if maps_lost:
+            # lost peer outputs recovered by map re-execution — part of
+            # the shuffle story, so it rides (and un-gates) the same view
+            shuffle_stats["maps_lost_output"] = int(maps_lost)
+        # elastic scale signal (round 16): queue-depth / pending-task /
+        # in-flight-age derived advice — computed outside the service
+        # lock (it takes the running schedulers' own locks).  Gated on a
+        # non-idle daemon so an empty /status keeps its pre-peer shape.
+        scale = (
+            self.scale_advice()
+            if (queued or running or workers) else {}
+        )
         for jid in jobs:
             rec = self._jobs.get(jid)  # pruning may race this unlocked read
             if rec is not None and rec.scheduler is not None:
@@ -1627,6 +1691,16 @@ class GrepService:
             }
         return {
             "service": True,
+            # peer-shuffle capability advertisement (round 16): a NEW
+            # worker only sends AssignTaskArgs.peer_endpoint (and starts
+            # its data server) when the daemon it attached to answers
+            # True here — a pre-peer daemon's AssignTaskArgs(**payload)
+            # would TypeError on the unknown key, so with the knob
+            # default-ON the worker must not assume support (the elide
+            # contract's "only fails when actually switched on", kept).
+            # Nonzero-only: DGREP_PEER_SHUFFLE=0 keeps the pre-peer
+            # /status shape byte for byte.
+            **({"peer": True} if env_peer_shuffle() else {}),
             "uptime_s": round(time.time() - self.started_at, 3),
             "max_jobs": self.max_jobs,
             "queue_depth_cap": self.queue_depth,
@@ -1650,6 +1724,13 @@ class GrepService:
             # shard-index routing (planner side): shards never dispatched
             # because their trigram summary ruled the query out
             **({"index": index_stats} if index_stats else {}),
+            # peer-to-peer shuffle (round 16): relay bytes that transited
+            # THIS daemon's data plane (~0 with peer shuffle on) + lost
+            # peer outputs recovered by map re-execution
+            **({"shuffle": shuffle_stats} if shuffle_stats else {}),
+            # elastic scale advice (grow/shrink/hold + the inputs it was
+            # derived from) — `dgrep serve --max-workers` follows it
+            **({"scale": scale} if scale else {}),
             # p50/p95 from the round-15 lifecycle histograms (GET /metrics
             # carries the full bucket vectors)
             **({"latency": latency} if latency else {}),
@@ -1709,6 +1790,12 @@ class GrepService:
         metrics_mod.gauge("dgrep_corpus_cache_bytes_resident").set(
             _c("corpus_cache_bytes_resident"))
 
+        with self._shuffle_lock:
+            shuffle_bytes = self._shuffle_stats["daemon_shuffle_bytes"]
+        # the P2P receipt gauge: intermediate bytes that transited this
+        # daemon's data plane — ~0 with peer shuffle on
+        metrics_mod.gauge("dgrep_daemon_shuffle_bytes").set(shuffle_bytes)
+
         w = self._cache_rates.window_totals()
         metrics_mod.gauge("dgrep_window_model_cache_hits").set(
             w.get("compile_cache_hits", 0.0))
@@ -1767,6 +1854,111 @@ class GrepService:
             index_bytes_skipped=rec.index_bytes_skipped,
         )
 
+    # --------------------------------------------------- elastic scale
+    def scale_advice(self) -> dict:
+        """Queue-depth / pending-task / in-flight-age derived pool
+        advice: "grow" when assignable demand exceeds the attached
+        workers (or recovery is stalling — old in-flight heartbeats with
+        no idle capacity), "shrink" when the daemon is idle with workers
+        attached, else "hold".  ``dgrep serve --max-workers`` follows it
+        for the local pool; operators of remote fleets read it from
+        GET /status.  Snapshots under the service lock, then consults
+        the running schedulers OUTSIDE it (their own locks)."""
+        with self._lock:
+            queued = len(self._queue)
+            running = list(self._running)
+            recs = [self._jobs.get(jid) for jid in running]
+            # Only FRESH rows count as capacity: the worker table keeps
+            # rows for 1 h of silence, but a drained local loop or a
+            # dead remote worker stops polling immediately — counting
+            # its stale row as an idle worker suppresses grow advice
+            # exactly when recovery needs it.  Live workers refresh
+            # every long-poll retry, so a generous multiple of the poll
+            # cadence bounds the staleness.
+            now = time.monotonic()
+            workers = sum(
+                1 for info in self.workers.values()
+                if now - info["seen"] <= _SCALE_FRESH_S
+            )
+        pending = 0
+        in_flight = 0
+        oldest_age = 0.0
+        for rec in recs:
+            if rec is None or rec.scheduler is None:
+                # start staged, setup in flight: at least its tasks are
+                # coming — count it as demand like a queued job
+                pending += 1
+                continue
+            b = rec.scheduler.backlog()
+            pending += b["unassigned"]
+            in_flight += b["in_flight"]
+            oldest_age = max(oldest_age, b["oldest_inflight_age_s"])
+        demand = pending + queued
+        if demand > 0 and demand > max(0, workers - in_flight):
+            advice, reason = "grow", "assignable demand exceeds idle workers"
+        elif workers and not running and not queued:
+            advice, reason = "shrink", "no jobs queued or running"
+        else:
+            advice, reason = "hold", ""
+        out = {
+            "advice": advice,
+            "queued_jobs": queued,
+            "running_jobs": len(running),
+            "pending_tasks": pending,
+            "in_flight_tasks": in_flight,
+            "oldest_inflight_age_s": oldest_age,
+            "workers_attached": workers,
+        }
+        if reason:
+            out["reason"] = reason
+        return out
+
+    def local_pool_size(self) -> int:
+        """In-process worker loops not yet draining."""
+        return len([
+            lp for lp in getattr(self, "_local_loops", [])
+            if not lp.drain.is_set()
+        ])
+
+    def scale_local_pool(self, target: int) -> int:
+        """Grow or shrink the in-process worker pool toward ``target``;
+        returns the delta actually applied.  Grow attaches fresh loops
+        (attach is always safe — service-allocated ids); shrink DRAINS
+        the newest loops: each exits at its next idle poll, never
+        mid-task, and its id simply ages out of the worker table."""
+        target = max(0, int(target))
+        self._prune_local_pool()
+        loops = [lp for lp in getattr(self, "_local_loops", [])
+                 if not lp.drain.is_set()]
+        if target > len(loops):
+            self.start_local_workers(target - len(loops))
+            return target - len(loops)
+        if target < len(loops):
+            for lp in loops[target:]:
+                lp.drain.set()
+            self._wake()  # long-polling drainees re-check at next wake
+            return target - len(loops)
+        return 0
+
+    def _prune_local_pool(self) -> None:
+        """Drop local pool entries whose loop drained AND whose thread
+        exited — grow/shrink cycles must not grow the lists (and the
+        retained WorkerLoop transports/metrics) for the daemon's
+        lifetime.  The two lists extend in lockstep (start_local_workers
+        is the only writer), so index i pairs loop i with thread i;
+        anything still alive — or desynced lists — is kept untouched."""
+        loops = getattr(self, "_local_loops", [])
+        threads = getattr(self, "_local_workers", [])
+        if not loops or len(loops) != len(threads):
+            return
+        kept = [
+            (lp, t) for lp, t in zip(loops, threads)
+            if not (lp.drain.is_set() and not t.is_alive())
+        ]
+        if len(kept) != len(loops):
+            self._local_loops = [lp for lp, _ in kept]
+            self._local_workers = [t for _, t in kept]
+
     # ------------------------------------------------------------- lifecycle
     def start_local_workers(
         self,
@@ -1780,18 +1972,20 @@ class GrepService:
         from distributed_grep_tpu.runtime.worker import WorkerKilled, WorkerLoop
 
         metrics = Metrics()
-
-        def worker_main(idx: int) -> None:
-            hooks = (fault_hooks_per_worker or [{}] * n)[idx]
-            loop = WorkerLoop(
+        loops = [
+            WorkerLoop(
                 ServiceLocalTransport(self, rpc_timeout_s=self.rpc_timeout_s),
                 app=None,  # resolved per assignment (reply.application)
                 metrics=metrics,
-                fault_hooks=hooks,
+                fault_hooks=(fault_hooks_per_worker or [{}] * n)[i],
                 spans_enabled=self.spans,
             )
+            for i in range(n)
+        ]
+
+        def worker_main(idx: int) -> None:
             try:
-                loop.run()
+                loops[idx].run()
             except WorkerKilled:
                 log.info("service worker %d killed by fault injection", idx)
             except Exception:
@@ -1806,6 +2000,9 @@ class GrepService:
             t.start()
         self._local_workers = getattr(self, "_local_workers", [])
         self._local_workers.extend(threads)
+        # tracked for the elastic pool (scale_local_pool drains the tail)
+        self._local_loops = getattr(self, "_local_loops", [])
+        self._local_loops.extend(loops)
         return threads
 
     def stop(self, join_timeout_s: float = 10.0) -> None:
@@ -2087,6 +2284,12 @@ def _make_service_handler(server: ServiceServer):
                             self._send_json(
                                 {"error": f"no such file: {name}"}, 404)
                             return
+                        # relay shuffle byte accounting (round 16): with
+                        # peer shuffle on, reducers never GET here and
+                        # the counter stays flat — the P2P receipt
+                        service.count_shuffle_bytes(
+                            "relay_gets", p.stat().st_size
+                        )
                         self._send_file(p)
                     else:
                         self._send_json({"error": "not found"}, 404)
@@ -2116,7 +2319,10 @@ def _make_service_handler(server: ServiceServer):
                 rec = service.record(job_id)
                 wd = rec.workdir
                 if kind == "intermediate":
+                    length = int(self.headers.get("Content-Length", 0))
                     self._receive_file(wd.store, wd.root / "intermediate" / name)
+                    # relay shuffle byte accounting (see the GET branch)
+                    service.count_shuffle_bytes("relay_puts", length)
                     self._send_json({"ok": True})
                 elif kind == "out":
                     self._receive_file(wd.store, wd.root / "out" / name)

@@ -61,6 +61,13 @@ class MapTask:
     # one health tracker, and a single lost fused attempt must count as
     # ONE dark-worker event (the primary assignment's charge), not K.
     fused_claim: bool = False
+    # Peer-to-peer shuffle (round 16, runtime/peer.py): where this map
+    # task's committed output lives when it was spooled on the PRODUCING
+    # worker instead of the coordinator — {"endpoint": "http://host:port",
+    # "worker": service worker id, "parts": {partition: [size, crc32hex]}}.
+    # None on relay commits (bytes on the coordinator, pre-peer behavior).
+    # Cleared when a lost-output report re-enqueues the task.
+    peer: dict | None = None
 
     def heartbeat(self, grace_s: float = 0.0) -> None:
         self.timestamp = time.monotonic()

@@ -20,6 +20,7 @@ Differences from the reference, on purpose:
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -93,8 +94,20 @@ class WorkerLoop:
         spill_dir: Optional[str] = None,
         spans_enabled: Optional[bool] = None,
         job_id: str = "",
+        peer=None,
     ):
         self.transport = transport
+        # Peer-to-peer shuffle (round 16, runtime/peer.py): when a
+        # PeerDataServer is attached, map commits spool their output
+        # LOCALLY and register metadata instead of uploading bytes to the
+        # daemon; one server is shared by every slot of the process.
+        # None = relay shuffle, the pre-peer data plane exactly.
+        self.peer = peer
+        # Elastic shrink signal (dgrep serve --max-workers): set by the
+        # service's local-pool scaler; the loop exits at the next idle
+        # moment (never mid-task — attach/detach safety is the round-8
+        # fresh-id/quarantine machinery).
+        self.drain = threading.Event()
         # ``app`` may be None for workers attached to the service daemon
         # (runtime/service.py): there every assignment names its own
         # application module (AssignTaskReply.application) and the loop
@@ -142,6 +155,23 @@ class WorkerLoop:
             if args.metrics is None:
                 args.metrics = {}
             args.metrics["rpc_retries"] = retries
+        # Peer-shuffle telemetry rides the same ungated-but-nonzero-only
+        # contract: an operator watching a fleet drain looks for spool
+        # state and fetch-failure counts in /status with spans off, and
+        # the zero defaults keep peer-free payloads byte-identical.
+        stats: dict[str, float] = {}
+        for k in ("peer_fetches", "peer_fetch_failures", "relay_fallbacks"):
+            v = self.metrics.counters.get(k, 0)
+            if v:
+                stats[k] = v
+        if self.peer is not None:
+            sb = self.peer.spool_bytes()
+            if sb:
+                stats["peer_spool_bytes"] = float(sb)
+        if stats:
+            if args.metrics is None:
+                args.metrics = {}
+            args.metrics.update(stats)
 
     # --------------------------------------------------------------- liveness
     def _hb_interval(self, window_s: float) -> float:
@@ -256,8 +286,18 @@ class WorkerLoop:
     def run(self) -> None:
         """The infinite task loop (worker.go:126-178), with a clean exit."""
         while True:
+            if self.drain.is_set():
+                # elastic shrink: exit at an idle loop top, never mid-task
+                log.info("worker %d: drained (elastic shrink), exiting",
+                         self.worker_id)
+                return
             t_wait = time.monotonic()
-            reply = self.transport.assign_task(rpc.AssignTaskArgs(worker_id=self.worker_id))
+            args = rpc.AssignTaskArgs(worker_id=self.worker_id)
+            if self.peer is not None:
+                # advertise the shuffle endpoint on every poll so /status
+                # shows who holds spool state before an operator drains
+                args.peer_endpoint = self.peer.endpoint
+            reply = self.transport.assign_task(args)
             # idle wait for work — reported as an arg on the task span
             self._assign_wait_s = time.monotonic() - t_wait
             self.worker_id = reply.worker_id
@@ -401,25 +441,56 @@ class WorkerLoop:
         t0_wall = time.time()
         attempt = new_attempt_id()
         with self._task_ctx("map", a.task_id, attempt):
-            produced = self._map_attempt(a, attempt, t0)
+            produced, peer_meta = self._map_attempt(a, attempt, t0)
             spans_mod.complete(
                 "map:task", t0_wall, time.time() - t0_wall, cat="map",
                 assign_wait_s=round(self._assign_wait_s, 6),
             )
             self._fault("before_map_finished")
-            self.transport.map_finished(self._finished_args(
-                rpc.TaskFinishedArgs(
-                    task_id=a.task_id, job_id=self._rpc_job_id,
-                    worker_id=self.worker_id,
-                    produced_parts=produced,
-                )
-            ))
+            finished = rpc.TaskFinishedArgs(
+                task_id=a.task_id, job_id=self._rpc_job_id,
+                worker_id=self.worker_id,
+                produced_parts=produced,
+            )
+            if peer_meta is not None:
+                finished.peer_endpoint = peer_meta["endpoint"]
+                finished.peer_parts = peer_meta["parts"]
+            self.transport.map_finished(self._finished_args(finished))
         self.metrics.inc("map_tasks")
         self.metrics.observe("map_task_total", time.perf_counter() - t0)
         _H_MAP_TASK.observe(time.perf_counter() - t0)
 
+    def _write_map_outputs(self, task_id: int, buckets: dict
+                           ) -> tuple[list[int], dict | None]:
+        """Commit one map attempt's partition files and return (produced
+        partitions, peer metadata or None).  Peer shuffle active (a
+        PeerDataServer attached and a service job bound): the bytes land
+        on THIS worker's spool — atomic rename, crc32 self-checksum —
+        and only the metadata travels; otherwise the pre-peer transport
+        PUT (relay) runs unchanged."""
+        produced: list[int] = []
+        peer_active = self.peer is not None and bool(self._rpc_job_id)
+        parts_meta: dict[str, list] = {}
+        for r, kvs in sorted(buckets.items()):
+            data = shuffle.encode_records(kvs)
+            name = f"mr-{task_id}-{r}"
+            if peer_active:
+                size, crc = self.peer.put(self._rpc_job_id, name, data)
+                parts_meta[str(r)] = [size, crc]
+            else:
+                # Atomic write == the temp-file + rename commit (worker.go:103).
+                self.transport.write_intermediate(name, data)
+            produced.append(r)
+        if not peer_active:
+            return produced, None
+        return produced, {
+            "endpoint": self.peer.endpoint,
+            "worker": self.worker_id,
+            "parts": parts_meta,
+        }
+
     def _map_attempt(self, a: rpc.AssignTaskReply, attempt: str,
-                     t0: float) -> list[int]:
+                     t0: float) -> tuple[list[int], dict | None]:
         self.app.configure(**a.app_options)
         # Streaming boundary: an app exposing map_path_fn receives a local
         # file path and reads it in bounded chunks (engine.scan_file) —
@@ -565,15 +636,14 @@ class WorkerLoop:
         with shuffle_guard(), spans_mod.span("map:shuffle", cat="map"):
             buckets = shuffle.bucketize(records, a.n_reduce)
             self._fault("before_map_commit")
-            produced: list[int] = []
-            for r, kvs in sorted(buckets.items()):
-                # Atomic write == the temp-file + rename commit (worker.go:103).
-                self.transport.write_intermediate(
-                    f"mr-{a.task_id}-{r}", shuffle.encode_records(kvs)
-                )
-                produced.append(r)
-        self._publish_commit("map", a.task_id, attempt, {"parts": produced})
-        return produced
+            produced, peer_meta = self._write_map_outputs(a.task_id, buckets)
+        payload: dict = {"parts": produced}
+        if peer_meta is not None:
+            # the commit record carries the peer metadata too — it is
+            # the durable copy a restarted daemon re-registers from
+            payload["peer"] = peer_meta
+        self._publish_commit("map", a.task_id, attempt, payload)
+        return produced, peer_meta
 
     # ------------------------------------------------------------ fused map
     def _run_map_fused(self, a: rpc.AssignTaskReply) -> None:
@@ -824,20 +894,20 @@ class WorkerLoop:
             with spans_mod.span("map:shuffle", cat="map"):
                 buckets = shuffle.bucketize(records, part["n_reduce"])
                 self._fault("before_map_commit")
-                produced: list[int] = []
-                for r, kvs in sorted(buckets.items()):
-                    self.transport.write_intermediate(
-                        f"mr-{tid}-{r}", shuffle.encode_records(kvs)
-                    )
-                    produced.append(r)
-            self._publish_commit("map", tid, attempt, {"parts": produced})
+                produced, peer_meta = self._write_map_outputs(tid, buckets)
+            payload: dict = {"parts": produced}
+            if peer_meta is not None:
+                payload["peer"] = peer_meta
+            self._publish_commit("map", tid, attempt, payload)
             self._fault("before_map_finished")
-            self.transport.map_finished(self._finished_args(
-                rpc.TaskFinishedArgs(
-                    task_id=tid, job_id=jid, worker_id=self.worker_id,
-                    produced_parts=produced,
-                )
-            ))
+            finished = rpc.TaskFinishedArgs(
+                task_id=tid, job_id=jid, worker_id=self.worker_id,
+                produced_parts=produced,
+            )
+            if peer_meta is not None:
+                finished.peer_endpoint = peer_meta["endpoint"]
+                finished.peer_parts = peer_meta["parts"]
+            self.transport.map_finished(self._finished_args(finished))
         self.metrics.inc("map_tasks")
 
     # ---------------------------------------------------------------- reduce
@@ -919,22 +989,32 @@ class WorkerLoop:
             progress_stride = 4096
         try:
             files_processed = 0
+            lost = ""
             t_shuffle = time.time()
             while True:
                 r = self.transport.reduce_next_file(
                     rpc.ReduceNextFileArgs(
                         task_id=a.task_id, files_processed=files_processed,
                         job_id=self._rpc_job_id, epoch=a.epoch,
-                        worker_id=self.worker_id,
+                        worker_id=self.worker_id, lost_file=lost,
                     )
                 )
+                lost = ""
                 if getattr(r, "abort", False):
                     raise TaskAborted(a.task_id)
                 if r.done:
                     break
                 if not r.next_file:
                     continue  # long-poll window expired; re-poll (worker.go:153-160)
-                data = self.transport.read_intermediate(r.next_file)
+                data = self._fetch_shuffle(r)
+                if data is None:
+                    # unfetchable peer output (producer gone / checksum
+                    # mismatch / no relay copy): report it on the next
+                    # poll WITHOUT advancing the cursor — the scheduler
+                    # re-executes the producing map and this cursor waits
+                    # for the fresh attempt
+                    lost = r.next_file
+                    continue
                 sink.add_many(shuffle.decode_records(data))
                 files_processed += 1
                 self._fault("after_reduce_file")
@@ -953,6 +1033,72 @@ class WorkerLoop:
         self._publish_commit(
             "reduce", a.task_id, attempt, {"output": f"mr-out-{a.task_id}"}
         )
+
+    def _fetch_shuffle(self, r: rpc.ReduceNextFileReply) -> bytes | None:
+        """Fetch one shuffle file.  No peer metadata on the reply: the
+        pre-peer relay read, byte-identical behavior (errors propagate —
+        the daemon answered wrong, not a vanished peer).  Peer-held:
+        fetch directly from the producer through the transport retry
+        helpers, verify size + crc32, fall back to the daemon relay on
+        the DECLARED failures (peer gone after bounded retries, HTTP
+        error, checksum mismatch — a mixed/migrating cluster may hold a
+        relay copy), and return None when both fail — the caller reports
+        the file lost and the producing map re-executes."""
+        name = r.next_file
+        endpoint = getattr(r, "peer_endpoint", "")
+        if not endpoint:
+            data = self.transport.read_intermediate(name)
+            if self.peer is not None:
+                # relay route in a peer-shuffle deployment (a local/relay
+                # co-worker produced this one) — route telemetry only;
+                # peer-free runs emit nothing
+                spans_mod.instant("shuffle:relay", cat="reduce", file=name)
+            return data
+        try:
+            if self.peer is not None and endpoint == self.peer.endpoint:
+                # reducer IS the producer: serve from our own spool
+                data = self.peer.get_local(self._rpc_job_id, name)
+            else:
+                fetch = getattr(self.transport, "fetch_peer", None)
+                if fetch is not None:
+                    data = fetch(endpoint, self._rpc_job_id, name)
+                else:
+                    from distributed_grep_tpu.runtime.http_transport import (
+                        fetch_peer_data,
+                    )
+
+                    data = fetch_peer_data(endpoint, self._rpc_job_id, name)
+            from distributed_grep_tpu.runtime.peer import checksum
+
+            if (r.peer_size and len(data) != r.peer_size) or (
+                r.peer_checksum and checksum(data) != r.peer_checksum
+            ):
+                raise OSError(
+                    f"peer shuffle integrity failure for {name}: got "
+                    f"{len(data)} bytes, crc {checksum(data)} (expected "
+                    f"{r.peer_size}, {r.peer_checksum})"
+                )
+            self.metrics.inc("peer_fetches")
+            spans_mod.instant("shuffle:peer", cat="reduce", file=name,
+                              bytes=len(data))
+            return data
+        except (OSError, RuntimeError) as e:
+            # CoordinatorGone (retry schedule dry) is an OSError; an HTTP
+            # error status surfaces as RuntimeError — the declared
+            # fallback set.  Anything else (a bug) propagates.
+            self.metrics.inc("peer_fetch_failures")
+            log.warning("peer fetch of %s from %s failed (%s); trying the "
+                        "daemon relay", name, endpoint, e)
+        try:
+            data = self.transport.read_intermediate(name)
+        except (OSError, RuntimeError):
+            # no relay copy either (the common pure-P2P case: the bytes
+            # died with the producer) — lost output
+            return None
+        self.metrics.inc("relay_fallbacks")
+        spans_mod.instant("shuffle:relay", cat="reduce", file=name,
+                          fallback=True)
+        return data
 
     def _write_reduce_output(self, a: rpc.AssignTaskReply, chunks,
                              progress_stride: int) -> None:

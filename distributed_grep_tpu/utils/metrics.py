@@ -190,6 +190,11 @@ SERIES: dict[str, tuple[str, str]] = {
     "dgrep_queue_depth": ("gauge", "Jobs queued, awaiting a running slot."),
     "dgrep_jobs_running": ("gauge", "Jobs currently running."),
     "dgrep_workers_attached": ("gauge", "Worker rows in the service table."),
+    # peer-to-peer shuffle (round 16, runtime/peer.py): intermediate
+    # bytes that transited the DAEMON's data plane — ~0 with peer
+    # shuffle on (reducers fetch directly from producers)
+    "dgrep_daemon_shuffle_bytes": (
+        "gauge", "Relay shuffle bytes through the daemon data plane."),
     # lifetime cache totals (set at scrape from the owning modules,
     # sys.modules-gated — a remote-worker daemon reports zeros)
     "dgrep_model_cache_hits": ("gauge", "Compiled-model cache hits, lifetime."),

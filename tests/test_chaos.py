@@ -787,3 +787,197 @@ def test_chaos_worker_killed_mid_fused_attempt(tmp_path, monkeypatch,
         )
         seen = [(e["kind"], e["task_id"]) for e in entries]
         assert len(seen) == len(set(seen)), (jid, seen)
+
+
+# ------------------------------------- peer-shuffle producer death (round 16)
+
+def _peer_chaos_service(tmp_path):
+    """In-process service with the chaos-matrix detector cadence: short
+    timeouts so a dead producer's held task re-enqueues fast."""
+    svc = GrepService(work_root=tmp_path / "svc-root", resume=False,
+                      task_timeout_s=2.0, sweep_interval_s=0.2)
+    server = ServiceServer(svc)
+    server.start()
+    return svc, server, f"127.0.0.1:{server.port}"
+
+
+def test_chaos_producer_killed_between_map_commit_and_reduce_fetch(
+        tmp_path, corpus, monkeypatch):
+    """ISSUE 14 chaos bar: the PRODUCING worker dies after its map
+    commits (output on its spool, metadata registered) and before any
+    reducer fetches — the load-bearing P2P fault path.  Surviving
+    workers' fetch failures report the outputs lost, the producing map
+    tasks re-execute (COMPLETED -> UNASSIGNED), and the job completes
+    byte-identical to a fault-free run with journal entries unique per
+    (kind, task)."""
+    from distributed_grep_tpu.runtime.peer import PeerDataServer
+
+    # dials against the dead producer's endpoint refuse instantly; a
+    # full 6-step backoff schedule per fetch only slows the matrix down
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "1")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.1")
+
+    svc, server, addr = _peer_chaos_service(tmp_path)
+
+    class DieOnReduce(WorkerLoop):
+        # the producer's death instant: maps committed (peer-held),
+        # first reduce assignment arrives, worker vanishes before any
+        # fetch is served
+        def _run_reduce(self, a):
+            raise WorkerKilled("producer dies before the reduce fetch")
+
+    peer_a = PeerDataServer().start()
+    loop_a = DieOnReduce(
+        ServiceHttpTransport(addr, rpc_timeout_s=10.0), app=None,
+        peer=peer_a,
+    )
+
+    def producer_main():
+        try:
+            loop_a.run()
+        except WorkerKilled:
+            pass
+
+    t_a = threading.Thread(target=producer_main, daemon=True)
+    survivors: list[threading.Thread] = []
+    loops_b: list[WorkerLoop] = []
+    try:
+        cfg = grep_config(corpus, pattern="hello", n_reduce=2,
+                          work_dir=str(tmp_path / "sub"))
+        jid = svc.submit(cfg)
+        t_a.start()
+        t_a.join(timeout=60)  # exits at its first reduce assignment
+        assert not t_a.is_alive()
+        peer_a.close()  # the spool dies with the worker
+        # every map completed peer-held before the death
+        st = svc.job_status(jid)
+        assert st["map"]["completed"] == st["map"]["total"]
+        assert st["state"] == "running"
+        # survivors (relay data plane — no peer) take over: reducers hit
+        # the dead endpoint, report the outputs lost, and re-execute the
+        # maps through the relay path
+        for _ in range(2):
+            loop = WorkerLoop(
+                ServiceHttpTransport(addr, rpc_timeout_s=10.0), app=None
+            )
+            loops_b.append(loop)
+            t = threading.Thread(target=loop.run, daemon=True)
+            t.start()
+            survivors.append(t)
+        assert svc.wait_job(jid, timeout=120), svc.job_status(jid)
+        outputs = svc.job_result(jid)["outputs"]
+    finally:
+        svc.stop()
+        server.shutdown()
+        peer_a.close()
+        for t in survivors:
+            t.join(timeout=10)
+
+    oracle = outputs_by_name(run_job(
+        grep_config(corpus, pattern="hello", n_reduce=2,
+                    work_dir=str(tmp_path / "oracle")),
+        n_workers=2,
+    ).output_files)
+    assert outputs_by_name(outputs) == oracle
+
+    # the recovery actually ran through the lost-output path
+    rec = svc.record(jid)
+    assert rec.metrics.counters.get("maps_lost_output", 0) >= 1
+    failures = sum(lp.metrics.counters.get("peer_fetch_failures", 0)
+                   for lp in loops_b)
+    assert failures >= 1
+    # journal: unique per (kind, task) despite the re-executions
+    entries = TaskJournal.replay(
+        WorkDir(str((tmp_path / "svc-root") / jid)).journal_path()
+    )
+    seen = [(e["kind"], e["task_id"]) for e in entries]
+    assert len(seen) == len(set(seen)), seen
+
+
+def test_chaos_drop_reply_on_peer_fetch_leg(tmp_path, corpus):
+    """A FaultTransport DROP_REPLY on the peer-fetch leg: the fetch
+    reaches the (healthy) peer but the reply dies on the wire.  The
+    reducer's declared-failure path runs (fetch failure counted, relay
+    fallback attempted), the lost-output report re-executes the map, and
+    the job completes byte-identical with a unique journal.  The one
+    surviving reducer recovers ALONE — the report aborts its own attempt
+    so it is free to run the re-executed maps (the small-pool deadlock
+    guard)."""
+    svc, server, addr = _peer_chaos_service(tmp_path)
+    from distributed_grep_tpu.runtime.peer import PeerDataServer
+
+    class DieOnReduce(WorkerLoop):
+        # a map-only producer: its task loop dies at the first reduce
+        # assignment but its DATA SERVER stays up — every map output is
+        # peer-held on a healthy endpoint, so the surviving reducer's
+        # fetches MUST cross the wire (no self-serve fast path)
+        def _run_reduce(self, a):
+            raise WorkerKilled("map-only producer")
+
+    drops = {"left": 2}  # first two peer fetches lose their replies
+
+    def drop_reply(ctx):
+        if ctx == "fetch_peer" and drops["left"] > 0:
+            drops["left"] -= 1
+            return 1
+        return 0
+
+    peer_a = PeerDataServer().start()
+    loop_a = DieOnReduce(
+        ServiceHttpTransport(addr, rpc_timeout_s=10.0), app=None,
+        peer=peer_a,
+    )
+    loop_b = WorkerLoop(
+        FaultTransport(
+            ServiceHttpTransport(addr, rpc_timeout_s=10.0),
+            {FaultPoint.DROP_REPLY: drop_reply},
+        ),
+        app=None,  # no peer: relay re-commits, peer fetches cross-wire
+    )
+    loops = [loop_b]
+    t_b = None
+    try:
+        cfg = grep_config(corpus, pattern="fox", n_reduce=2,
+                          work_dir=str(tmp_path / "sub"))
+        jid = svc.submit(cfg)
+
+        def producer_main():
+            try:
+                loop_a.run()
+            except WorkerKilled:
+                pass
+
+        t_a = threading.Thread(target=producer_main, daemon=True)
+        t_a.start()
+        t_a.join(timeout=60)  # all maps committed peer-held, loop gone
+        assert not t_a.is_alive()
+        t_b = threading.Thread(target=loop_b.run, daemon=True)
+        t_b.start()
+        assert svc.wait_job(jid, timeout=120), svc.job_status(jid)
+        outputs = svc.job_result(jid)["outputs"]
+        rec = svc.record(jid)
+    finally:
+        svc.stop()
+        server.shutdown()
+        peer_a.close()
+        if t_b is not None:
+            t_b.join(timeout=10)
+
+    oracle = outputs_by_name(run_job(
+        grep_config(corpus, pattern="fox", n_reduce=2,
+                    work_dir=str(tmp_path / "oracle-fox")),
+        n_workers=2,
+    ).output_files)
+    assert outputs_by_name(outputs) == oracle
+    assert drops["left"] == 0  # the faults actually fired
+    failures = sum(lp.metrics.counters.get("peer_fetch_failures", 0)
+                   for lp in loops)
+    assert failures >= 1
+    # dropped replies forced lost-output re-execution (the daemon held
+    # no relay copy), each journaled at most once
+    assert rec.metrics.counters.get("maps_lost_output", 0) >= 1
+    entries = TaskJournal.replay(
+        WorkDir(str((tmp_path / "svc-root") / jid)).journal_path()
+    )
+    seen = [(e["kind"], e["task_id"]) for e in entries]
+    assert len(seen) == len(set(seen)), seen
