@@ -1,0 +1,207 @@
+"""Standing-query streaming receipt (round 17, runtime/follow.py).
+
+An appender thread grows a log file while a follow job stands over it on
+a real daemon (ServiceServer HTTP API: POST /jobs with follow=true, then
+GET /jobs/<id>/stream driven with a moving cursor).  Reports exactly ONE
+JSON line: matched lines/s through the stream, and append-to-emit
+latency p50/p95 (per appended batch: the wall from the append's flush to
+the stream reply that carried its lines — poll cadence + suffix scan +
+long-poll delivery, the whole wake path).
+
+    python benchmarks/follow_stream.py [--lines 4000] [--batch 50]
+        [--append-hz 40] [--poll-s 0.05] [--check]
+
+``--check`` additionally pins the exactness contract: the streamed
+(line, text) set must equal a one-shot engine scan over the FINAL file
+bytes (the oracle every follow test pins — append boundaries, the
+mid-line split carry, and the unterminated tail must all be invisible).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+# Runnable as `python benchmarks/...` from anywhere: the repo root joins
+# the FRONT of sys.path so the checkout being benchmarked always wins.
+_root = Path(__file__).resolve().parent
+if not (_root / "distributed_grep_tpu").is_dir():
+    _root = _root.parent
+if (_root / "distributed_grep_tpu").is_dir():
+    sys.path.insert(0, str(_root))
+
+# CPU-pinned (CLAUDE.md environment rules): ASSIGN, never setdefault, and
+# pop the axon plugin factory — backend discovery calls every registered
+# factory even under jax_platforms=cpu, and a black-holed tunnel blocks
+# that call forever (same as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DGREP_NO_CALIBRATE", "1")
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+
+def _pct(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[i]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lines", type=int, default=4000,
+                    help="matched lines to append in total")
+    ap.add_argument("--batch", type=int, default=50,
+                    help="lines per append flush (one latency sample each)")
+    ap.add_argument("--append-hz", type=float, default=40.0,
+                    help="append flushes per second (0 = as fast as possible)")
+    ap.add_argument("--poll-s", type=float, default=0.05,
+                    help="standing-query wake cadence (DGREP_FOLLOW_POLL_S)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless the streamed set equals the "
+                         "one-shot oracle over the final file bytes")
+    args = ap.parse_args()
+
+    os.environ["DGREP_FOLLOW_POLL_S"] = str(args.poll_s)
+
+    from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
+    from distributed_grep_tpu.utils.config import JobConfig
+
+    root = Path(tempfile.mkdtemp(prefix="dgrep-follow-"))
+    log_path = root / "app.log"
+    log_path.write_bytes(b"")
+
+    service = GrepService(work_root=root / "svc")
+    server = ServiceServer(service)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method: str, path: str, body: bytes | None = None,
+             timeout: float = 30.0) -> dict:
+        req = urllib.request.Request(f"{base}{path}", data=body,
+                                     method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    cfg = JobConfig(
+        input_files=[str(log_path)],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello", "backend": "cpu"},
+        work_dir="ignored",
+        follow=True,
+    )
+    jid = call("POST", "/jobs", cfg.to_json().encode("utf-8"))["job_id"]
+
+    n_batches = max(1, args.lines // args.batch)
+    # per appended line: perf_counter at the flush that made it visible
+    flush_t: dict[int, float] = {}
+    period = 1.0 / args.append_hz if args.append_hz > 0 else 0.0
+
+    def appender() -> None:
+        ln = 0
+        with open(log_path, "ab") as f:
+            for _b in range(n_batches):
+                chunk = b"".join(
+                    b"hello line %d payload xyz\n" % (ln + i)
+                    for i in range(args.batch)
+                )
+                # mid-line split carry exercised every other batch: the
+                # next flush completes the torn line (the streamed set
+                # must still equal the oracle — --check pins it)
+                if _b % 2 == 0:
+                    f.write(chunk[:-9])
+                    f.flush()
+                    f.write(chunk[-9:])
+                else:
+                    f.write(chunk)
+                f.flush()
+                t = time.perf_counter()
+                for i in range(args.batch):
+                    flush_t[ln + i] = t
+                ln += args.batch
+                if period:
+                    time.sleep(period)
+
+    t_app = threading.Thread(target=appender)
+    t0 = time.perf_counter()
+    t_app.start()
+
+    streamed: dict[int, str] = {}  # 0-based appended index -> text
+    latency: list[float] = []
+    cursor = 0
+    dropped = 0
+    deadline = time.monotonic() + 120.0
+    while len(streamed) < n_batches * args.batch:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"stream stuck at {len(streamed)}/{n_batches * args.batch}"
+            )
+        r = call("GET", f"/jobs/{jid}/stream?cursor={cursor}&timeout=5")
+        now = time.perf_counter()
+        cursor = int(r.get("next", cursor))
+        dropped += int(r.get("dropped", 0))
+        for rec in r.get("records") or []:
+            idx = rec["line"] - 1
+            streamed[idx] = rec["text"]
+            if idx in flush_t:
+                latency.append(now - flush_t[idx])
+    wall = time.perf_counter() - t0
+    t_app.join()
+
+    final = log_path.read_bytes()
+    status = call("GET", "/status")
+    call("POST", f"/jobs/{jid}/cancel", b"")
+    service.stop()
+    server.shutdown()
+
+    ok = True
+    if args.check:
+        # oracle: a one-shot engine scan of the final file state — the
+        # streamed emissions across every wake must equal it exactly
+        from distributed_grep_tpu.ops import lines as lines_mod
+        from distributed_grep_tpu.ops.engine import GrepEngine
+
+        eng = GrepEngine("hello", backend="cpu")
+        res = eng.scan(final)
+        nl = lines_mod.newline_index(final)
+        want = {}
+        for ln in res.matched_lines.tolist():
+            s, e = lines_mod.line_span(nl, int(ln), len(final))
+            # span end excludes the newline
+            want[int(ln) - 1] = final[s:e].decode("utf-8", "surrogateescape")
+        ok = streamed == want and dropped == 0
+
+    fol = status.get("follow", {})
+    rec = {
+        "bench": "follow_stream",
+        "lines": n_batches * args.batch,
+        "batch": args.batch,
+        "poll_s": args.poll_s,
+        "wall_s": round(wall, 4),
+        "lines_per_s": round(len(streamed) / wall, 1) if wall else 0.0,
+        "latency_p50_ms": round(_pct(latency, 0.50) * 1e3, 2),
+        "latency_p95_ms": round(_pct(latency, 0.95) * 1e3, 2),
+        "follow_wakes": int(fol.get("follow_wakes", 0)),
+        "suffix_bytes_scanned": int(fol.get("suffix_bytes_scanned", 0)),
+        "dropped": dropped,
+        **({"check": "ok" if ok else "FAIL"} if args.check else {}),
+    }
+    print(json.dumps(rec))  # exactly one JSON line
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

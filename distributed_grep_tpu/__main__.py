@@ -339,6 +339,33 @@ def cmd_grep(args: argparse.Namespace) -> int:
         return rc
     import os as _os
 
+    if getattr(args, "follow", False):
+        # Streaming tier (round 17): a standing query polls its inputs
+        # for growth and suffix-scans appends.  Modes that re-read whole
+        # files or need a final line set cannot stream; approximate
+        # matching and -w/-x keep their one-shot paths.
+        conflicts = [
+            flag for flag, on in (
+                ("-o", args.only_matching),
+                ("-A/-B/-C", args.context is not None
+                 or args.before_context or args.after_context),
+                ("-b", args.byte_offset),
+                ("-m", args.max_count is not None),
+                ("-w", args.word_regexp),
+                ("-x", args.line_regexp),
+                ("-L", args.files_without_match),
+                ("--max-errors", bool(args.max_errors)),
+            ) if on
+        ]
+        if conflicts:
+            print(f"error: --follow does not support "
+                  f"{', '.join(conflicts)}", file=sys.stderr)
+            return 2
+        if (not args.files and not args.recursive) or "-" in args.files:
+            print("error: --follow needs named FILE arguments (cannot "
+                  "follow standard input)", file=sys.stderr)
+            return 2
+
     if args.max_errors:
         # validated BEFORE any stdin spooling: a guaranteed exit-2
         # invocation must not first drain (and write to disk) the pipe
@@ -580,6 +607,11 @@ def cmd_grep(args: argparse.Namespace) -> int:
         # stdin is not a file name: --include/--exclude never apply (GNU)
         if not args.files:
             return 2 if had_file_errors else 1  # everything --include-filtered
+
+    if getattr(args, "follow", False):
+        # the expanded, readability-filtered file set is final: hand it
+        # to the standing-query loop (tail -f semantics, grep output)
+        return _grep_follow(args, patterns, had_file_errors)
 
     # Count queries (-c/-l/-L/-q) with no mode that needs per-line output
     # downstream: the app emits ONE count record per file instead of one
@@ -835,6 +867,119 @@ def cmd_grep(args: argparse.Namespace) -> int:
     if args.metrics:
         print(json.dumps(res.metrics, indent=2, sort_keys=True), file=sys.stderr)
     return rc_final
+
+
+def _follow_record_line(rec: dict, *, no_filename: bool = False) -> str | None:
+    """THE display formatting for a follow/stream text record — the one
+    place the local follow loop and the stream client share, so the
+    dialect cannot drift between them (or from the one-shot print path):
+    surrogateescape round-trip from the scanner, then the replace-decode
+    the one-shot leg uses.  None for records with no text line (count
+    deltas, presence marks, resets — caller-specific rendering)."""
+    if "text" not in rec:
+        return None
+    text = rec["text"].encode("utf-8", "surrogateescape").decode(
+        "utf-8", "replace"
+    )
+    head = "" if no_filename else f"{rec['file']} "
+    return f"{head}(line number #{rec['line']}) {text}"
+
+
+def _print_follow_reset(rec: dict) -> None:
+    """Truncation/replacement notice — stderr, like tail's 'file
+    truncated': the stream's line numbers restart for a new file
+    generation and the consumer must not splice them onto the old one."""
+    print(f"dgrep: {rec['file']}: file truncated or replaced; "
+          f"following new data", file=sys.stderr)
+
+
+def _grep_follow(args: argparse.Namespace, patterns, had_file_errors) -> int:
+    """One-shot CLI standing query (``dgrep grep --follow``): build the
+    engine once, poll the inputs at the DGREP_FOLLOW_POLL_S cadence, and
+    print matches as they arrive in the default print format.  Count-only
+    modes (-c/-l/-q) never materialize lines.  ``--follow-idle-s S``
+    exits once no input has grown for S seconds (the testable/benchmark
+    shape); 0 runs until interrupted.  On exit the unterminated tail
+    line (if any) is scanned too, so the printed set is byte-identical
+    to a one-shot run over the final file state."""
+    import time as _time
+    from pathlib import Path
+
+    from distributed_grep_tpu.ops.engine import cached_engine
+    from distributed_grep_tpu.runtime.follow import (
+        FollowScanner,
+        env_follow_poll_s,
+    )
+
+    # resolve to absolute like the one-shot print path does — the
+    # displayed filename prefix must match a one-shot run's byte for
+    # byte (pinned by the relative-path parity test)
+    files = [str(Path(f).resolve()) for f in args.files]
+    backend = (
+        "cpu" if (args.backend == "cpu" or args.backend is None) else "device"
+    )
+    eng, _verdict = cached_engine(
+        args.pattern if patterns is None else None,
+        patterns=patterns,
+        ignore_case=args.ignore_case,
+        backend=backend,
+    )
+    count_only = bool(args.count or args.quiet or args.files_with_matches)
+    scanner = FollowScanner(
+        eng, files, invert=args.invert, count_only=count_only,
+        presence_only=count_only and not args.count,
+    )
+    poll_s = env_follow_poll_s()
+    idle_s = max(0.0, float(getattr(args, "follow_idle_s", 0.0) or 0.0))
+
+    def print_records(groups) -> None:
+        for _path, records, _cur in groups:
+            for rec in records:
+                if rec.get("reset"):
+                    _print_follow_reset(rec)
+                    continue
+                line = _follow_record_line(
+                    rec, no_filename=args.no_filename
+                )
+                if line is not None:
+                    print(line, flush=True)
+                elif rec.get("match") and args.files_with_matches:
+                    print(rec["file"], flush=True)
+
+    last_news = _time.monotonic()
+    try:
+        while True:
+            groups = scanner.poll_once()
+            print_records(groups)
+            if groups:
+                last_news = _time.monotonic()
+            if args.quiet and scanner.any_selected():
+                return 0
+            if idle_s and _time.monotonic() - last_news >= idle_s:
+                break
+            _time.sleep(poll_s)
+    except KeyboardInterrupt:
+        pass
+    # finalize: the oracle (a one-shot scan of the final state) includes
+    # a last line with no trailing newline — scan it before reporting.
+    # LOOP until nothing drains: one final poll consumes at most one
+    # per-wake read window per file, and a writer that raced ahead of the
+    # last regular wake may have left more than a window behind.
+    while True:
+        groups = scanner.poll_once(final=True)
+        if not groups:
+            break
+        print_records(groups)
+    if args.count:
+        for f in files:
+            prefix = (f"{f}:"
+                      if (len(files) > 1 or args.with_filename)
+                      and not args.no_filename else "")
+            print(f"{prefix}{scanner.cursors[f].emitted}")
+    any_selected = scanner.any_selected()
+    if args.quiet:
+        return 0 if any_selected else (2 if had_file_errors else 1)
+    return 2 if had_file_errors else (0 if any_selected else 1)
 
 
 def _line_offsets(matched: dict[str, set[int]]) -> dict[str, dict[int, int]]:
@@ -1191,6 +1336,16 @@ def cmd_submit(args: argparse.Namespace) -> int:
         print("error: need --config, or PATTERN/-e/-f and FILE arguments",
               file=sys.stderr)
         return 2
+    if getattr(args, "follow", False) and not cfg.follow:
+        from dataclasses import replace as _dc_replace
+
+        cfg = _dc_replace(cfg, follow=True)
+    if getattr(args, "follow_poll_s", None) and cfg.follow:
+        # applied even when --config already set follow=true: the
+        # command-line cadence override must never be silently dropped
+        from dataclasses import replace as _dc_replace
+
+        cfg = _dc_replace(cfg, follow_poll_s=args.follow_poll_s)
     def call(method: str, path: str, body: bytes | None = None) -> dict:
         # the transport's bounded-jittered-retry helper: a transient
         # connection reset mid-poll retries instead of killing the client
@@ -1217,6 +1372,14 @@ def cmd_submit(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     job_id = reply["job_id"]
+    if cfg.follow:
+        # a standing query has no completion to wait for: stream it on
+        # request, else hand back the subscription endpoint
+        if getattr(args, "stream", False):
+            return _stream_follow(call, job_id, args)
+        print(json.dumps({"job_id": job_id, "state": "following",
+                          "stream": f"/jobs/{job_id}/stream"}))
+        return 0
     if not args.wait:
         print(json.dumps({"job_id": job_id, "state": "submitted"}))
         return 0
@@ -1260,6 +1423,66 @@ def cmd_submit(args: argparse.Namespace) -> int:
         out["error"] = f"lost service at {args.addr}: {e}"
     print(json.dumps(out))
     return 0 if out["state"] == "done" else 1
+
+
+def _stream_follow(call, job_id: str, args: argparse.Namespace) -> int:
+    """Drive GET /jobs/<id>/stream with a moving cursor, printing each
+    record as a grep-shaped line (count records as "+N" deltas), until
+    --timeout elapses or the job leaves RUNNING; then exactly one JSON
+    summary line (the submit stdout contract, streamed lines above it)."""
+    import time as _time
+
+    deadline = _time.monotonic() + args.timeout
+    cursor = 0
+    printed = 0
+    dropped = 0
+    state = "running"
+    while _time.monotonic() < deadline:
+        # the server-side long-poll window must sit comfortably INSIDE
+        # the transport's socket timeout (args.timeout — the same value
+        # bounds each request): a window equal to the remaining budget
+        # races the socket timer and the final poll reports a bogus
+        # "lost service" instead of draining cleanly
+        window = min(10.0, max(0.5, deadline - _time.monotonic()),
+                     max(0.5, args.timeout - 2.0))
+        try:
+            reply = call(
+                "GET",
+                f"/jobs/{job_id}/stream?cursor={cursor}"
+                f"&timeout={window:.1f}",
+            )
+        except OSError as e:
+            print(f"error: lost service mid-stream: {e}", file=sys.stderr)
+            break
+        cursor = int(reply.get("next", cursor))
+        state = reply.get("state", state)
+        dropped += int(reply.get("dropped", 0))
+        records = reply.get("records") or []
+        for rec in records:
+            printed += 1
+            if rec.get("reset"):
+                _print_follow_reset(rec)
+                continue
+            line = _follow_record_line(rec)
+            if line is not None:
+                print(line, flush=True)
+            elif "count" in rec:
+                print(f"{rec['file']}: +{int(rec['count'])}", flush=True)
+            elif rec.get("match"):
+                print(rec["file"], flush=True)
+        if state in ("done", "failed", "cancelled") and not records:
+            break  # terminal and drained; "queued" keeps polling — the
+            # standing query starts once an admission slot frees up
+        if not records and state != "running":
+            # a queued job's page answers immediately (no runner, no
+            # long-poll yet): pace the re-poll instead of hot-spinning
+            _time.sleep(min(0.5, max(0.0, deadline - _time.monotonic())))
+    out: dict = {"job_id": job_id, "state": state, "records": printed,
+                 "cursor": cursor}
+    if dropped:
+        out["dropped"] = dropped
+    print(json.dumps(out))
+    return 0
 
 
 def cmd_trace_export(args: argparse.Namespace) -> int:
@@ -1448,6 +1671,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-R", "--dereference-recursive", action="store_true",
                    help="like -r, but follow all symlinks (grep -R); "
                         "directory cycles are pruned silently")
+    p.add_argument("--follow", action="store_true",
+                   help="standing query (round 17): poll the inputs for "
+                        "appended data and print matches as they arrive "
+                        "(tail -f | grep, with per-file cursors and "
+                        "truncation-aware rescans)")
+    p.add_argument("--follow-idle-s", type=float, default=0.0, metavar="S",
+                   help="with --follow: exit once no input has grown for "
+                        "S seconds (0 = run until interrupted)")
     p.add_argument("-b", "--byte-offset", action="store_true",
                    help="print each line's starting byte offset (grep -b)")
     p.add_argument("-h", "--no-filename", action="store_true",
@@ -1602,6 +1833,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--explain", action="store_true",
                    help="include the per-query routing report "
                         "(GET /jobs/<id>/explain) in the final JSON line")
+    p.add_argument("--follow", action="store_true",
+                   help="submit a STANDING query (round 17): the daemon "
+                        "suffix-scans the inputs as they grow; subscribe "
+                        "via GET /jobs/<id>/stream (or --stream here)")
+    p.add_argument("--follow-poll-s", type=float, default=None, metavar="S",
+                   help="with --follow: wake cadence override "
+                        "(DGREP_FOLLOW_POLL_S wins; default 0.5 s)")
+    p.add_argument("--stream", action="store_true",
+                   help="with --follow: print stream records as they "
+                        "arrive until --timeout elapses, then one JSON "
+                        "summary line")
     p.set_defaults(fn=cmd_submit, wait=True)
 
     p = sub.add_parser(

@@ -223,6 +223,21 @@ KNOBS: dict[str, Knob] = {
         "name a loopback-bound server can never honor); set both for a "
         "specific-interface bind (accessor: runtime/peer.env_peer_bind).",
     ),
+    "DGREP_FOLLOW_POLL_S": Knob(
+        "runtime/follow.py", "0.5",
+        "Standing-query wake cadence (round 17): how often a follow "
+        "job's runner stats its inputs and suffix-scans growth; wins "
+        "over JobConfig.follow_poll_s as the operator override "
+        "(accessor: runtime/follow.env_follow_poll_s).",
+    ),
+    "DGREP_STREAM_BUFFER": Knob(
+        "runtime/follow.py", "4194304",
+        "Per-subscriber stream buffer byte cap for GET "
+        "/jobs/<id>/stream: past it the oldest records shed (counted in "
+        "stream_dropped_records, surfaced as an explicit `dropped` "
+        "count to the lagging consumer) — the scan loop never blocks "
+        "(accessor: runtime/follow.env_stream_buffer).",
+    ),
     "DGREP_INDEX_SUMMARY_BYTES": Knob(
         "index/summary.py", "16384",
         "Per-shard trigram bloom size, rounded down to a power of two in "

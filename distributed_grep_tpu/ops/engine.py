@@ -1115,6 +1115,12 @@ class GrepEngine:
             # gated so index-free processes never import the tier just
             # to report nothing
             self.stats.update(idx_mod.index_counters())
+        fol_mod = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+        if fol_mod is not None:
+            # streaming-tier telemetry (follow_wakes/suffix_bytes_scanned/
+            # stream_dropped_records), same nonzero-only sys.modules-gated
+            # contract — rides engine.stats onto the heartbeat piggyback
+            self.stats.update(fol_mod.follow_counters())
         if t0 is not None:
             # after the EOL fix-up: the record's match count must equal the
             # ScanResult the caller actually receives
@@ -1805,6 +1811,74 @@ class GrepEngine:
         self.stats["end_offsets"] = end_offsets
         self.stats["read_wait_seconds"] = read_wait
         return ScanResult(np.asarray(matched, dtype=np.int64), n_matches, total)
+
+    # ------------------------------------------------- live-append suffix
+    def scan_file_suffix(self, path, offset: int = 0, *, final: bool = False,
+                         max_bytes: int | None = None, progress=None):
+        """Scan the LIVE-APPEND suffix of ``path`` from ``offset`` — which
+        MUST be a line start (the streaming tier's cursor invariant) — up
+        to the last complete line.  Returns ``(res, consumed, data)``:
+        the ScanResult over the suffix (matched_lines are suffix-local,
+        1-based), the byte length actually consumed (the caller's cursor
+        advance), and the scanned bytes (line text extraction happens
+        while they are in hand).
+
+        The partial tail line past the last newline is NOT consumed —
+        the line-carry: the next wake re-reads it from the same offset,
+        extended by whatever arrived since, so the emitted line set is
+        byte-identical to a one-shot scan over the final file state.
+        ``final=True`` (stream teardown / idle exit) includes an
+        unterminated tail, matching the one-shot scanners' missing-
+        trailing-newline behavior.  Exactness at every append boundary
+        rides the DFA "'\\n' column == start state" invariant: the
+        buffer begins at a line start and ends at a line boundary, so
+        every kernel family scans it exactly like the same lines inside
+        a whole-file scan (the same argument as cross-file batching).
+
+        Live-append stat handling: the suffix NEVER threads a corpus key
+        (appending content has no stable validator tuple — the cache's
+        stale-never-served contract) and never consults the shard index
+        (a stale trigram summary must not prune a standing query).
+        ``max_bytes`` bounds one call's read (catch-up over a huge
+        existing file proceeds in bounded steps; a capped read is cut at
+        its last newline even under ``final``, and the caller simply
+        continues from the advanced offset) — EXCEPT for a single line
+        larger than the window: the read extends until a newline (or
+        EOF) lands, because a newline-free full window would otherwise
+        consume 0 bytes forever and permanently stall the cursor behind
+        the giant line (memory is bounded by that one line, the same
+        bound materializing it for emit needs anyway)."""
+        cap = max_bytes or max(self.segment_bytes, 1 << 26)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(cap)
+            # window_full: the last read filled its request, so the file
+            # may extend beyond what we hold — the tail past the last
+            # newline is then NEVER consumable, even under ``final``
+            window_full = len(data) == cap
+            if window_full and data.rfind(b"\n") < 0:
+                while True:  # each chunk is newline-probed exactly once
+                    more = f.read(cap)
+                    if not more:
+                        window_full = False
+                        break
+                    data += more
+                    window_full = len(more) == cap
+                    if not window_full or more.rfind(b"\n") >= 0:
+                        break
+        if not final or window_full:
+            cut = data.rfind(b"\n")
+            data = data[: cut + 1] if cut >= 0 else b""
+        if not data:
+            return (
+                ScanResult(np.zeros(0, dtype=np.int64), 0, 0), 0, b""
+            )
+        res = self.scan(data, progress=progress)
+        # per-scan suffix accounting (the module-level follow counters
+        # aggregate across wakes; scan()'s tail merge may later overwrite
+        # this key with the monotonic global — both are telemetry-only)
+        self.stats["suffix_bytes_scanned"] = len(data)
+        return res, len(data), data
 
     # ------------------------------------------------- cross-file batching
     def scan_batch(self, items, progress=None, emit=None,

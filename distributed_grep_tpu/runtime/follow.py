@@ -1,0 +1,711 @@
+"""Streaming tier (round 17): standing queries over live-append inputs.
+
+The batch runtime answers "what matched" for a corpus frozen at submit
+time; the workload a production grep service actually carries is the log
+tail — files that GROW while the query is standing.  This module makes
+live-append a first-class regime:
+
+* ``FollowScanner`` — per-file durable cursors (byte offset of the first
+  INCOMPLETE line, always a line start) + suffix scans through
+  ``GrepEngine.scan_file_suffix``: each wake scans ONLY the appended
+  complete-line suffix; the partial tail line is carried and re-scanned
+  extended on the next wake, so emitted lines are byte-identical to a
+  one-shot scan over the final file state (the oracle every test pins).
+  Exactness at every append boundary rides the repo's load-bearing
+  invariant — the DFA '\\n'-column==start reset means a buffer that
+  begins at a line start and ends at a line boundary scans exactly like
+  the same lines inside a whole-file scan, on every kernel family.
+  Truncation/replacement is detected via the validator-tuple rule (size
+  below the cursor, or a changed inode — the cp -p + mv case) and
+  answers with a ``reset`` record + a full rescan from offset 0.
+* ``FollowLog`` — the durable half (TaskJournal mechanics: fsync per
+  line, torn tail truncated on reopen): ONE json line per (wake, file)
+  carrying the new cursor AND the records it emitted, atomically — a
+  daemon restart resumes every standing query from its cursors with no
+  duplicate and no lost line (a torn wake line never advanced the
+  cursor, so its records simply re-emit; a complete line advanced it
+  exactly once).
+* ``StreamRing`` — the bounded per-job subscriber buffer behind
+  ``GET /jobs/<id>/stream``: the scan loop publishes and NEVER blocks;
+  past ``DGREP_STREAM_BUFFER`` bytes the oldest records shed (counted in
+  ``stream_dropped_records``) and a consumer whose cursor fell behind
+  receives an explicit ``dropped`` count, then continues from the
+  oldest retained record.
+* ``FollowRunner`` — one daemon-side standing query: engine build
+  (ops.engine.cached_engine — imported lazily; this module stays
+  importable without the ops stack, like runtime/fusion), wake loop at
+  the ``DGREP_FOLLOW_POLL_S`` cadence, journal-before-publish ordering
+  (durability before visibility, the registry's submit contract).
+
+Count-only standing queries (``count_only``/``presence_only`` app
+options — the CLI's -c/-l/-q) never materialize lines: wake records
+carry per-file count deltas, so the match-dense worst case is a
+bandwidth-bound counter update.
+
+The follow path never consults the shard index: a stale trigram summary
+can therefore never prune a standing query (and the batch entries'
+lookups revalidate fresh stats anyway — an append IS stat drift).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("follow")
+
+DEFAULT_FOLLOW_POLL_S = 0.5
+DEFAULT_STREAM_BUFFER = 4 << 20
+
+# Per-wake suffix read cap: one wake scans at most this much appended
+# data (bounded memory — the catch-up over a huge existing file proceeds
+# cap-sized wake by wake; the cursor simply advances in steps).
+MAX_WAKE_BYTES = 64 << 20
+
+
+def env_follow_poll_s(default: float = DEFAULT_FOLLOW_POLL_S) -> float:
+    """Standing-query wake cadence — the ONE parser of
+    DGREP_FOLLOW_POLL_S (operator override; malformed or <= 0 keeps the
+    default, the env_batch_bytes shrug-off policy)."""
+    raw = os.environ.get("DGREP_FOLLOW_POLL_S")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def env_stream_buffer(default: int = DEFAULT_STREAM_BUFFER) -> int:
+    """Per-subscriber stream buffer byte cap — the ONE parser of
+    DGREP_STREAM_BUFFER (a slow consumer sheds oldest-first past it;
+    malformed or < 1 keeps the default)."""
+    raw = os.environ.get("DGREP_STREAM_BUFFER")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+# ------------------------------------------------------ module telemetry
+# Process-global follow counters, the fusion_counters contract: leaf
+# lock, nonzero-only reads, merged into engine.stats (ops/engine.scan
+# tail), the worker heartbeat piggyback (worker._engine_cache_counters),
+# and the service /status "follow" view — all sys.modules-gated so
+# follow-free processes never import this module just to report nothing.
+_stats_lock = lockdep.make_lock("follow-stats")
+_stats = {
+    "follow_wakes": 0,
+    "suffix_bytes_scanned": 0,
+    "stream_dropped_records": 0,
+}
+
+
+def _count(name: str, n: int = 1) -> None:
+    with _stats_lock:
+        _stats[name] += n
+
+
+def follow_counters() -> dict:
+    """Copy of the follow counters, or {} when never touched (the
+    nonzero-only piggyback/stats contract)."""
+    with _stats_lock:
+        if not any(_stats.values()):
+            return {}
+        return dict(_stats)
+
+
+def follow_counters_clear() -> None:
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+# ------------------------------------------------------------- cursors
+@dataclass
+class FileCursor:
+    """Durable per-file scan position: ``offset`` is the byte offset of
+    the first INCOMPLETE line (always a line start — the suffix-scan
+    exactness invariant), ``line`` the 1-based line number at that
+    offset.  ``ino`` anchors the validator-tuple truncation rule."""
+
+    path: str
+    offset: int = 0
+    line: int = 1
+    ino: int = -1
+    emitted: int = 0  # selected lines so far (exit codes, -c display)
+    done: bool = False  # presence settled (presence_only queries)
+    # TRANSIENT (not journaled — a restart just rescans once): the stat
+    # size of the last no-progress scan, so an unterminated tail is not
+    # re-read from disk every wake until the file actually grows
+    seen: int = -1
+
+    def state(self) -> dict:
+        return {"offset": self.offset, "line": self.line, "ino": self.ino,
+                "emitted": self.emitted, "done": self.done}
+
+    def restore(self, st: dict) -> None:
+        self.offset = int(st.get("offset", 0))
+        self.line = int(st.get("line", 1))
+        self.ino = int(st.get("ino", -1))
+        self.emitted = int(st.get("emitted", 0))
+        self.done = bool(st.get("done", False))
+
+
+class FollowScanner:
+    """Cursors + suffix scans for one standing query.  ``poll_once``
+    returns per-file groups ``(path, records, cursor_state)`` so the
+    caller can land each file's records and its advanced cursor in ONE
+    atomic journal line.  Match semantics handled here: ``invert``
+    (complement over the suffix's lines), ``count_only`` (records carry
+    per-wake count deltas, no line text), ``presence_only`` (one record
+    per file, scanning stops for that file)."""
+
+    def __init__(self, engine, files, *, invert: bool = False,
+                 count_only: bool = False, presence_only: bool = False):
+        self.engine = engine
+        self.invert = bool(invert)
+        self.count_only = bool(count_only)
+        self.presence_only = bool(presence_only)
+        self.cursors: dict[str, FileCursor] = {
+            str(f): FileCursor(path=str(f)) for f in files
+        }
+
+    # -- durable state ---------------------------------------------------
+    def restore(self, state: dict[str, dict]) -> None:
+        for path, st in state.items():
+            cur = self.cursors.get(path)
+            if cur is not None:
+                cur.restore(st)
+
+    def any_selected(self) -> bool:
+        return any(c.emitted for c in self.cursors.values())
+
+    # -- scanning --------------------------------------------------------
+    def poll_once(self, final: bool = False) -> list[tuple[str, list[dict], dict]]:
+        """One wake over every file: scan grown suffixes, return
+        ``[(path, records, cursor_state), ...]`` for files with news.
+        ``final=True`` additionally scans an unterminated tail line
+        (stream teardown — the idle-exit/finalize path that makes the
+        output equal the one-shot oracle even without a trailing
+        newline)."""
+        groups: list[tuple[str, list[dict], dict]] = []
+        scanned = 0
+        for cur in self.cursors.values():
+            snap = cur.state()
+            try:
+                records = self._poll_file(cur, final)
+            except OSError:
+                # per-file fault isolation: a file unlinked between the
+                # stat and the open (or any transient read error) must
+                # not discard the OTHER files' already-scanned groups —
+                # restore THIS cursor (a half-applied reset/advance would
+                # otherwise skip lines) and move on; next wake retries
+                cur.restore(snap)
+                log.exception("follow poll failed for %s", cur.path)
+                continue
+            if records is None:
+                continue
+            recs, n_bytes = records
+            scanned += n_bytes
+            if recs or n_bytes:
+                groups.append((cur.path, recs, cur.state()))
+        if groups:
+            _count("follow_wakes")
+        if scanned:
+            _count("suffix_bytes_scanned", scanned)
+        return groups
+
+    def _poll_file(self, cur: FileCursor, final: bool):
+        """(records, suffix_bytes) for one file, or None when nothing
+        changed.  Truncation/replacement (validator-tuple drift: size
+        below the cursor, or a new inode) emits a ``reset`` record and
+        rescans from offset 0 — the stream consumer drops its view of
+        that file's earlier lines; everything after the reset is again
+        byte-identical to a one-shot scan of the new content."""
+        try:
+            st = os.stat(cur.path)
+        except OSError:
+            return None  # not created yet / vanished: keep the cursor
+        records: list[dict] = []
+        if st.st_size < cur.offset or (cur.ino >= 0 and st.st_ino != cur.ino):
+            records.append({"file": cur.path, "reset": True})
+            cur.offset = 0
+            cur.line = 1
+            cur.emitted = 0
+            cur.done = False
+            cur.seen = -1  # a same-size replacement must rescan
+        cur.ino = int(st.st_ino)
+        if st.st_size <= cur.offset:
+            return (records, 0) if records else None
+        if self.presence_only and cur.done:
+            return (records, 0) if records else None
+        if not final and st.st_size == cur.seen:
+            # the bytes past the cursor are a known unterminated tail and
+            # the file has not grown since the last no-progress scan:
+            # skip the re-read (a giant newline-free tail would otherwise
+            # be re-read from disk at every poll)
+            return (records, 0) if records else None
+        res, consumed, data = self.engine.scan_file_suffix(
+            cur.path, cur.offset, final=final, max_bytes=MAX_WAKE_BYTES
+        )
+        if consumed == 0:
+            # no complete line in the suffix: remember the size so the
+            # carry is not re-read until growth (cleared above on reset)
+            cur.seen = int(st.st_size)
+            return (records, 0) if records else None
+        records.extend(self._emit(cur, res, data))
+        cur.offset += consumed
+        return records, consumed
+
+    def _emit(self, cur: FileCursor, res, data: bytes) -> list[dict]:
+        """Records for one scanned suffix; advances ``cur.line`` and
+        ``cur.emitted``.  Line numbers are file-global: suffix-local line
+        ``k`` is global ``cur.line + k - 1`` (the cursor sits at a line
+        start by construction)."""
+        import numpy as np
+
+        from distributed_grep_tpu.ops import lines as lines_mod
+
+        nl_idx = lines_mod.newline_index(data)
+        n_lines = len(nl_idx) + (0 if data.endswith(b"\n") else 1)
+        matched = res.matched_lines
+        if self.invert:
+            matched = np.setdiff1d(
+                np.arange(1, n_lines + 1, dtype=np.int64), matched
+            )
+        records: list[dict] = []
+        selected = int(matched.size)
+        if self.presence_only:
+            if selected:
+                records.append({"file": cur.path, "match": True})
+                cur.emitted += selected
+                cur.done = True
+        elif self.count_only:
+            if selected:
+                # never materialize lines: the match-dense worst case is
+                # a bandwidth-bound counter update
+                records.append({"file": cur.path, "count": selected})
+                cur.emitted += selected
+        else:
+            for ln in matched.tolist():
+                # line_span's end EXCLUDES the newline — the slice is the
+                # line text verbatim
+                s, e = lines_mod.line_span(nl_idx, int(ln), len(data))
+                text = data[s:e]
+                records.append({
+                    "file": cur.path,
+                    "line": cur.line + int(ln) - 1,
+                    # surrogateescape: arbitrary bytes round-trip through
+                    # the json journal/stream exactly (the repo-wide
+                    # pattern-bytes convention); display layers
+                    # re-encode and replace-decode
+                    "text": text.decode("utf-8", "surrogateescape"),
+                })
+            cur.emitted += selected
+        cur.line += n_lines
+        return records
+
+
+# ------------------------------------------------------------ durability
+class FollowLog:
+    """Durable wake log in the job workdir (TaskJournal mechanics).  One
+    line per (wake, file): the advanced cursor and the records it
+    emitted land ATOMICALLY — replay can neither lose a line whose
+    cursor advanced nor duplicate one whose advance never committed."""
+
+    FILENAME = "follow.jsonl"
+    # Startup compaction threshold: a log past this size rewrites as a
+    # bounded snapshot (cursors + retained tail) at runner construction —
+    # the wake stream is unbounded, the durable state it encodes is not.
+    COMPACT_BYTES = 1 << 20
+    # Records retained by replay (and therefore by compaction): bounds
+    # restart memory no matter how long the standing query streamed.
+    REPLAY_TAIL_RECORDS = 8192
+
+    def __init__(self, path: str | Path):
+        self._journal = TaskJournal(path)
+
+    def record_wake(self, path: str, cursor: dict, seq0: int,
+                    records: list[dict]) -> None:
+        self._journal.record({
+            "kind": "wake", "file": path, "cursor": cursor,
+            "seq0": seq0, "records": records, "t": time.time(),
+        })
+
+    def close(self) -> None:
+        self._journal.close()
+
+    @staticmethod
+    def replay(path: str | Path):
+        """(cursors, next_seq, tail): per-file latest cursor state, the
+        next record sequence number, and the last REPLAY_TAIL_RECORDS
+        (seq, record) pairs in order (the caller preloads them into its
+        ring — retaining the full history would make restart memory
+        proportional to everything the query ever streamed).  Records
+        whose seq was already assigned are SKIPPED: a wake whose journal
+        line landed but whose fsync failed re-journals the same records
+        under the same seq0 after the cursor rollback, and first-
+        occurrence-wins keeps the ring's contiguous-seq invariant."""
+        cursors: dict[str, dict] = {}
+        next_seq = 1
+        tail: deque = deque(maxlen=FollowLog.REPLAY_TAIL_RECORDS)
+        for e in TaskJournal.replay(path):
+            if e.get("kind") != "wake":
+                continue
+            f = e.get("file")
+            if isinstance(f, str) and isinstance(e.get("cursor"), dict):
+                cursors[f] = e["cursor"]
+            seq = int(e.get("seq0", next_seq))
+            for rec in e.get("records") or []:
+                if seq >= next_seq:
+                    tail.append((seq, rec))
+                seq += 1
+            next_seq = max(next_seq, seq)
+        return cursors, next_seq, list(tail)
+
+    @staticmethod
+    def compact(path: str | Path, cursors: dict[str, dict], next_seq: int,
+                tail: list[tuple[int, dict]]) -> None:
+        """Rewrite the wake log as its bounded snapshot (tmp + fsync +
+        rename, the registry-compaction mechanics): the retained tail in
+        seq order — replayable records-only lines — then one cursor line
+        per file stamped seq0=next_seq so a replay reproduces the exact
+        (cursors, next_seq, tail) it was built from."""
+        p = Path(path)
+        tmp = p.with_name(p.name + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            for seq, rec in tail:
+                f.write(json.dumps(
+                    {"kind": "wake", "file": str(rec.get("file", "")),
+                     "seq0": seq, "records": [rec]},
+                    sort_keys=True) + "\n")
+            for fp, st in cursors.items():
+                f.write(json.dumps(
+                    {"kind": "wake", "file": fp, "cursor": st,
+                     "seq0": next_seq, "records": []},
+                    sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+
+# ------------------------------------------------------------ streaming
+class StreamRing:
+    """Bounded subscriber buffer: publish never blocks (the scan loop is
+    the producer), eviction is oldest-first past the byte cap, and a
+    reader whose cursor fell behind learns HOW MANY records it lost
+    (the explicit ``dropped`` count) before continuing from the oldest
+    retained record."""
+
+    # Per-read response bound: a catch-up reader drains in pages instead
+    # of one giant JSON body.
+    MAX_READ_RECORDS = 1024
+
+    def __init__(self, cap_bytes: int | None = None, start_seq: int = 1):
+        self.cap_bytes = (
+            env_stream_buffer() if cap_bytes is None else int(cap_bytes)
+        )
+        self._lock = lockdep.make_lock("follow-stream")
+        self._cond = threading.Condition(self._lock)
+        self._dq: deque = deque()  # (seq, record, approx_bytes)
+        self._bytes = 0
+        self.next_seq = int(start_seq)
+        self._closed = False
+
+    @staticmethod
+    def _size(rec: dict) -> int:
+        return 48 + sum(len(str(k)) + len(str(v)) for k, v in rec.items())
+
+    def publish(self, records: list[dict]) -> int:
+        """Append records (assigning sequence numbers), shed oldest past
+        the cap.  Returns the first assigned seq."""
+        if not records:
+            return self.next_seq
+        dropped = 0
+        with self._cond:
+            seq0 = self.next_seq
+            for rec in records:
+                sz = self._size(rec)
+                self._dq.append((self.next_seq, rec, sz))
+                self._bytes += sz
+                self.next_seq += 1
+            while self._bytes > self.cap_bytes and len(self._dq) > 1:
+                _seq, _rec, sz = self._dq.popleft()
+                self._bytes -= sz
+                dropped += 1
+            self._cond.notify_all()
+        if dropped:
+            _count("stream_dropped_records", dropped)
+        return seq0
+
+    def read_since(self, cursor: int, timeout: float = 0.0):
+        """(records, next_cursor, dropped): records with seq > ``cursor``
+        (each carries its ``seq``), the cursor to pass next, and how many
+        records between ``cursor`` and the oldest retained one were shed
+        (0 for a keeping-up consumer).  Waits up to ``timeout`` for news
+        when nothing is pending (long-poll)."""
+        cursor = max(0, int(cursor))
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            while not self._closed:
+                if self._dq and self._dq[-1][0] > cursor:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.5))
+            out: list[dict] = []
+            dropped = 0
+            nxt = cursor
+            if self._dq and self._dq[-1][0] > cursor:
+                first_seq = self._dq[0][0]
+                if first_seq > cursor + 1:
+                    dropped = first_seq - 1 - cursor
+                # ring seqs are CONTIGUOUS (publish appends consecutive
+                # seqs, shed pops the head, preload seeds a journal tail
+                # whose wake lines assigned them consecutively), so the
+                # page start is arithmetic — never a scan of the ring
+                start = max(0, cursor + 1 - first_seq)
+                for seq, rec, _sz in itertools.islice(
+                    self._dq, start, start + self.MAX_READ_RECORDS
+                ):
+                    out.append({"seq": seq, **rec})
+                    nxt = seq
+        return out, nxt, dropped
+
+    def preload(self, tail: list[tuple[int, dict]]) -> None:
+        """Seed the ring from a replayed journal tail (restart path): the
+        records keep their original sequence numbers; anything beyond
+        the byte cap sheds oldest-first exactly like a live publish —
+        but WITHOUT counting into stream_dropped_records (nothing was
+        dropped; the full history stays in the journal)."""
+        with self._cond:
+            for seq, rec in tail:
+                if seq >= self.next_seq:
+                    continue  # replay seeded next_seq past the tail
+                sz = self._size(rec)
+                self._dq.append((seq, rec, sz))
+                self._bytes += sz
+            while self._bytes > self.cap_bytes and len(self._dq) > 1:
+                _seq, _rec, sz = self._dq.popleft()
+                self._bytes -= sz
+
+    def close(self) -> None:
+        """Wake every long-polling reader (daemon stop / job cancel)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+# --------------------------------------------------------------- runner
+class FollowRunner:
+    """One daemon-side standing query: engine + scanner + wake loop +
+    durable log + subscriber ring.  Constructed OUTSIDE the service lock
+    (journal open + log replay are filesystem work — the _flush_starts
+    contract); the engine builds lazily on the runner thread (model
+    compile can take seconds; on a chip, the first XLA compile 20-40 s).
+
+    Ordering per wake and file: journal line FIRST (fsync), ring publish
+    second — durability before visibility, so a crash between the two
+    re-serves the already-durable records from the replayed tail instead
+    of losing them."""
+
+    def __init__(self, job_id: str, config, work_root: str | Path, *,
+                 event_log=None, on_fail=None):
+        self.job_id = job_id
+        self.config = config
+        self.event_log = event_log
+        self.on_fail = on_fail
+        self.poll_s = env_follow_poll_s(
+            float(config.follow_poll_s or DEFAULT_FOLLOW_POLL_S)
+        )
+        self._log_path = Path(work_root) / FollowLog.FILENAME
+        cursors, next_seq, tail = FollowLog.replay(self._log_path)
+        self._resume_cursors = cursors
+        self.resumed = bool(cursors)
+        self.ring = StreamRing(start_seq=next_seq)
+        # preload the durable tail so a subscriber reconnecting across a
+        # restart continues from its cursor without a gap (older records
+        # beyond the cap shed exactly like a slow consumer's)
+        self.ring.preload(tail)
+        try:
+            if (self._log_path.exists()
+                    and self._log_path.stat().st_size
+                    > FollowLog.COMPACT_BYTES):
+                # the wake stream is unbounded; its durable state is not —
+                # rewrite the log as the snapshot replay just produced
+                # (disk stays bounded, the NEXT restart replays in O(tail))
+                FollowLog.compact(self._log_path, cursors, next_seq, tail)
+        except OSError:
+            log.exception("follow log compaction failed for %s", job_id)
+        self._log = FollowLog(self._log_path)
+        self._log_dirty = False
+        self._scanner: FollowScanner | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.wakes = 0
+        self.error = ""
+        self.started_at = time.time()
+
+    # -- engine construction (lazy: ops stack imports live here only) ----
+    def _build_scanner(self) -> FollowScanner:
+        from distributed_grep_tpu.ops.engine import cached_engine
+
+        opts = dict(self.config.effective_app_options())
+        patterns = opts.get("patterns")
+        pattern = opts.get("pattern") if patterns is None else None
+        if isinstance(pattern, bytes):
+            pattern = pattern.decode("utf-8", "surrogateescape")
+        engine, _verdict = cached_engine(
+            pattern,
+            patterns=list(patterns) if patterns is not None else None,
+            ignore_case=bool(opts.get("ignore_case", False)),
+            # host scanning by default: the daemon's standing queries are
+            # latency-bound small suffixes; "device" opts in explicitly
+            backend=("device" if opts.get("backend") == "device" else "cpu"),
+        )
+        scanner = FollowScanner(
+            engine, list(self.config.input_files),
+            invert=bool(opts.get("invert", False)),
+            count_only=bool(opts.get("count_only", False)),
+            presence_only=bool(opts.get("presence_only", False)),
+        )
+        scanner.restore(self._resume_cursors)
+        return scanner
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"follow-{self.job_id}"
+        )
+        self._thread.start()
+
+    def request_stop(self) -> None:
+        """Pure state (safe under any lock): the loop exits at its next
+        wake check; readers wake via ring.close()."""
+        self._stop.set()
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Teardown outside every service lock: stop the loop, wake the
+        subscribers, close the log.  Safe from the runner thread itself
+        (the engine-build-failure path: on_fail → service close flush
+        runs ON this thread — joining it would raise and skip the log
+        close below)."""
+        self._stop.set()
+        self.ring.close()
+        if (self._thread is not None
+                and self._thread is not threading.current_thread()):
+            self._thread.join(timeout=join_timeout_s)
+        try:
+            self._log.close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            log.exception("follow log close failed for %s", self.job_id)
+
+    def _run(self) -> None:
+        if self._stop.is_set():
+            return  # cancelled between publish and start: skip the build
+        try:
+            self._scanner = self._build_scanner()
+        except Exception as e:  # noqa: BLE001 — bad query, healthy daemon
+            log.exception("follow job %s failed to build its engine",
+                          self.job_id)
+            self.error = str(e)
+            self.ring.close()
+            if self.on_fail is not None:
+                self.on_fail(self.job_id, str(e))
+            return
+        while not self._stop.is_set():
+            try:
+                self.wake_once()
+            except Exception:  # noqa: BLE001 — one bad wake must not kill
+                # the standing query (the file may reappear/recover)
+                log.exception("follow wake failed for %s", self.job_id)
+            self._stop.wait(self.poll_s)
+
+    def wake_once(self) -> int:
+        """One wake: scan, journal, publish.  Returns records emitted
+        (tests and the benchmark drive this directly)."""
+        if self._scanner is None:
+            self._scanner = self._build_scanner()
+        if self._log_dirty:
+            # a failed journal write may have torn a line mid-file; a
+            # plain append would glue the next record onto the fragment
+            # and make replay discard everything after it — reopen first
+            # (the TaskJournal constructor truncates the torn tail)
+            try:
+                self._log.close()
+            except Exception:  # noqa: BLE001 — the handle may be dead
+                log.exception("follow log close-for-reopen failed")
+            self._log = FollowLog(self._log_path)
+            self._log_dirty = False
+        # pre-wake cursor snapshot: a journal write failing mid-loop
+        # (disk-full blip) must roll the NOT-yet-journaled groups'
+        # in-memory cursors back, or the next wake would scan past lines
+        # nobody ever saw — the live no-lost-line half of the contract
+        # (the journaled groups keep their advance; restart replays the
+        # same state either way)
+        snap = {p: c.state() for p, c in self._scanner.cursors.items()}
+        groups = self._scanner.poll_once()
+        emitted = 0
+        for i, (path, records, cursor) in enumerate(groups):
+            seq0 = self.ring.next_seq
+            # durability before visibility (and the cursor advance rides
+            # the SAME fsync'd line as its records — the no-dup/no-loss
+            # restart argument)
+            try:
+                self._log.record_wake(path, cursor, seq0, records)
+            except Exception:
+                self._log_dirty = True  # reopen before the next append
+                for p2, _recs2, _cur2 in groups[i:]:
+                    c2 = self._scanner.cursors.get(p2)
+                    if c2 is not None and p2 in snap:
+                        c2.restore(snap[p2])
+                raise
+            self.ring.publish(records)
+            emitted += len(records)
+        if groups:
+            self.wakes += 1
+            if self.event_log is not None:
+                try:
+                    self.event_log.write({
+                        "t": "instant", "name": "follow:wake",
+                        "cat": "follow", "ts": time.time(),
+                        "job": self.job_id,
+                        "args": {"files": len(groups), "records": emitted},
+                    })
+                except Exception:  # noqa: BLE001 — telemetry only
+                    log.exception("follow:wake event write failed")
+        return emitted
+
+    def status(self) -> dict:
+        out: dict = {
+            "poll_s": self.poll_s,
+            "wakes": self.wakes,
+            "files": len(self.config.input_files),
+            "next_seq": self.ring.next_seq,
+        }
+        if self.resumed:
+            out["resumed"] = True
+        if self.error:
+            out["error"] = self.error
+        sc = self._scanner
+        if sc is not None:
+            out["selected"] = int(
+                sum(c.emitted for c in sc.cursors.values())
+            )
+        return out

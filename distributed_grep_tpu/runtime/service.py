@@ -363,6 +363,18 @@ class JobRecord:
     # /jobs/<id> and dgrep submit's final line can surface routing.
     index_shards_pruned: int = 0
     index_bytes_skipped: int = 0
+    # Streaming tier (round 17, runtime/follow.py): the standing-query
+    # runner of a follow job — such records have NO scheduler (every
+    # assign-loop/consumer already None-guards it); the runner owns the
+    # wake loop, the durable cursor log, and the subscriber ring behind
+    # GET /jobs/<id>/stream.  A follow job holds its running slot until
+    # cancelled (admission control therefore bounds standing queries
+    # exactly like batch jobs).
+    follow: object = None
+    # set by _resume_replayed for a follow job that was RUNNING when the
+    # daemon died: the start flush then keeps the workdir (cursor log!)
+    # instead of clearing it, and the runner resumes from its cursors
+    resume_follow: bool = False
 
 
 class GrepService:
@@ -549,6 +561,24 @@ class GrepService:
                 rec.outputs = list(info.get("outputs") or [])
                 self._jobs[jid] = rec
                 continue
+            if getattr(cfg, "follow", False):
+                # Standing query (round 17): no planning, and a missing
+                # input is legal (the cursor waits for creation).  A
+                # RUNNING row resumes through the normal start flush
+                # with resume_follow set — the workdir is KEPT and the
+                # runner restores every cursor from follow.jsonl (the
+                # no-duplicate/no-lost-line restart contract).
+                self._jobs[jid] = rec
+                if state == JobState.RUNNING:
+                    rec.state = JobState.RUNNING
+                    rec.started_at = time.time()
+                    rec.resume_follow = True
+                    self._running.append(jid)
+                    self._pending_starts.append(rec)
+                else:
+                    rec.state = JobState.QUEUED
+                    self._queue.append(jid)
+                continue
             # queued or running: the work must be (re)scheduled.  Re-run
             # submit's readability validation FIRST — an input deleted
             # during the outage would otherwise re-enqueue its map task
@@ -713,31 +743,46 @@ class GrepService:
         except AdmissionError:
             _C_REJECTED.inc()
             raise
-        missing = [f for f in config.input_files
-                   if not os.access(f, os.R_OK)]
-        if missing:
-            raise ValueError(f"unreadable input files: {missing}")
-        # Shard index (distributed_grep_tpu/index): thread the service's
-        # persistence root through the grep app BEFORE planning, so the
-        # stored config (registry), the fusion key, and the workers all
-        # see one consistent option set; with DGREP_INDEX=0 nothing is
-        # injected and the daemon is byte-for-byte pre-index.
-        idx_dir = self._index_app_dir(config)
-        if idx_dir is not None:
-            config = _dc_replace(
-                config,
-                app_options={**config.app_options, "index_dir": idx_dir},
+        if getattr(config, "follow", False):
+            # Standing query (round 17): no map/reduce planning, no
+            # fusion, no index injection — the follow runner suffix-scans
+            # the inputs itself.  Inputs MAY be missing (a standing query
+            # over a log that does not exist yet is the tail -F shape;
+            # the cursor waits for creation).  Validation instead gates
+            # on what the follow scanner can actually serve.
+            self._validate_follow_config(config)
+            pruner = None
+            splits: list = []
+            fuse_key, identities, fuse_index = None, [], {}
+        else:
+            missing = [f for f in config.input_files
+                       if not os.access(f, os.R_OK)]
+            if missing:
+                raise ValueError(f"unreadable input files: {missing}")
+            # Shard index (distributed_grep_tpu/index): thread the
+            # service's persistence root through the grep app BEFORE
+            # planning, so the stored config (registry), the fusion key,
+            # and the workers all see one consistent option set; with
+            # DGREP_INDEX=0 nothing is injected and the daemon is
+            # byte-for-byte pre-index.
+            idx_dir = self._index_app_dir(config)
+            if idx_dir is not None:
+                config = _dc_replace(
+                    config,
+                    app_options={**config.app_options, "index_dir": idx_dir},
+                )
+            # splits depend only on (input_files, batch window) — stat the
+            # inputs here, outside the lock (see JobRecord.map_splits); the
+            # index pruner's summary/store reads run here too (never under
+            # the service lock — locked-blocking)
+            pruner = self._index_pruner(config)
+            splits = plan_map_splits(
+                list(config.input_files), config.effective_batch_bytes(),
+                pruner=pruner,
             )
-        # splits depend only on (input_files, batch window) — stat the
-        # inputs here, outside the lock (see JobRecord.map_splits); the
-        # index pruner's summary/store reads run here too (never under
-        # the service lock — locked-blocking)
-        pruner = self._index_pruner(config)
-        splits = plan_map_splits(
-            list(config.input_files), config.effective_batch_bytes(),
-            pruner=pruner,
-        )
-        fuse_key, identities, fuse_index = self._fusion_plan(config, splits)
+            fuse_key, identities, fuse_index = self._fusion_plan(
+                config, splits
+            )
         with self._cond:
             self._check_admission_locked_or_raise(locked=True)
             job_id = f"job-{next(self._ids)}"
@@ -815,6 +860,25 @@ class GrepService:
                 f"admission control: {len(self._running)} running "
                 f"(cap {self.max_jobs}), {len(self._queue)} queued "
                 f"(cap {self.queue_depth})"
+            )
+
+    @staticmethod
+    def _validate_follow_config(config: JobConfig) -> None:
+        """Reject standing-query configs the follow scanner cannot serve
+        honestly — at SUBMIT, not at first wake (a standing query that
+        can never emit must not silently hold a running slot)."""
+        opts = config.effective_app_options()
+        if opts.get("pattern") is None and not opts.get("patterns"):
+            raise ValueError("follow jobs need a pattern (or patterns) "
+                             "app option")
+        if not config.input_files:
+            raise ValueError("follow jobs need at least one input file")
+        unsupported = [k for k in ("word_regexp", "line_regexp",
+                                   "max_errors", "mesh_shape")
+                       if opts.get(k)]
+        if unsupported:
+            raise ValueError(
+                f"app options unsupported with follow: {unsupported}"
             )
 
     def _maybe_start_locked(self) -> None:
@@ -904,6 +968,9 @@ class GrepService:
                     if not self._pending_starts:
                         return
                     rec = self._pending_starts.pop(0)
+                if getattr(rec.config, "follow", False):
+                    self._flush_follow_start(rec)
+                    continue
                 try:
                     parts = self._build_job_runtime(rec)
                 except Exception as e:  # noqa: BLE001 — bad job, healthy service
@@ -953,6 +1020,102 @@ class GrepService:
                     rec.job_id, len(scheduler.map_tasks), rec.config.n_reduce,
                     len(self._running), len(self._queue),
                 )
+
+    def _flush_follow_start(self, rec: JobRecord) -> None:
+        """The follow half of _flush_starts (no service lock held): build
+        the workdir + event log + FollowRunner (journal open and cursor
+        replay are filesystem work), publish under the lock, start the
+        wake loop.  A cancel/stop that won the race mid-setup tears the
+        fresh runner down exactly like the scheduler path."""
+        from distributed_grep_tpu.runtime.follow import FollowRunner
+
+        cfg = rec.config
+        event_log = None
+        try:
+            store = make_store(cfg.store)
+            workdir = WorkDir(cfg.work_dir, store=store)
+            if not rec.resume_follow:
+                workdir.clear()  # fresh standing query: no stale cursors
+            spans_on = spans_mod.enabled(cfg.spans) or self.spans
+            event_log = (
+                spans_mod.EventLog(
+                    workdir.root / spans_mod.EventLog.FILENAME,
+                    fresh=not rec.resume_follow,
+                )
+                if spans_on else None
+            )
+            runner = FollowRunner(
+                rec.job_id, cfg, workdir.root,
+                event_log=event_log, on_fail=self._fail_follow_job,
+            )
+        except Exception as e:  # noqa: BLE001 — bad job, healthy service
+            log.exception("follow job %s failed to start", rec.job_id)
+            if event_log is not None:
+                # the runner construction failed AFTER the event log
+                # opened: close it here or the fd leaks for the daemon's
+                # lifetime (the published path hands it to the close flush)
+                try:
+                    event_log.close()
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    log.exception("event log close failed for %s",
+                                  rec.job_id)
+            with self._cond:
+                if rec.state is JobState.RUNNING:
+                    rec.state = JobState.FAILED
+                    rec.error = str(e)
+                    rec.finished_at = time.time()
+                    _C_FAILED.inc()
+                    if rec.job_id in self._running:
+                        self._running.remove(rec.job_id)
+                    self._stage_state(rec)
+                    self._prune_terminal_locked()
+                    self._maybe_start_locked()
+                    self._cond.notify_all()
+            return
+        published = False
+        with self._cond:
+            if rec.state is JobState.RUNNING:
+                rec.workdir = workdir
+                rec.event_log = event_log
+                rec.metrics = Metrics()
+                rec.follow = runner
+                published = True
+                self._cond.notify_all()
+        if not published:
+            runner.close()
+            if event_log is not None:
+                event_log.close()
+            return
+        runner.start()  # standing: no completion watcher — the job runs
+        # until cancel/stop (or an engine-build failure fails it)
+        log.info(
+            "follow job %s standing over %d inputs (poll %.3gs%s)",
+            rec.job_id, len(cfg.input_files), runner.poll_s,
+            ", resumed" if runner.resumed else "",
+        )
+
+    def _fail_follow_job(self, job_id: str, error: str) -> None:
+        """Runner-thread callback: a standing query whose engine cannot
+        build (bad pattern reaching the compile) fails like any job.
+        Takes the service lock — the runner calls it with NO follow
+        locks held (lock-order: service is never inner to follow)."""
+        rec = self._jobs.get(job_id)
+        if rec is None:
+            return
+        with self._cond:
+            if rec.state is not JobState.RUNNING:
+                return
+            rec.state = JobState.FAILED
+            rec.error = error
+            rec.finished_at = time.time()
+            _C_FAILED.inc()
+            self._stage_state(rec)
+            self._close_job_locked(rec)
+            self._maybe_start_locked()
+            self._cond.notify_all()
+        self._flush_starts()
+        self._flush_closes()
+        self._flush_registry()
 
     def _watch_job(self, rec: JobRecord) -> None:
         """Per-running-job completion watcher: finalize when the job's
@@ -1006,9 +1169,15 @@ class GrepService:
         # locked-blocking).
         if rec.scheduler is not None:
             rec.scheduler.stop()
-        if rec.journal is not None or rec.event_log is not None:
+        if rec.follow is not None:
+            # pure state (Event.set): the wake loop exits at its next
+            # check; the blocking teardown — thread join, log close,
+            # subscriber wakeup — is staged below (locked-blocking)
+            rec.follow.request_stop()
+        if (rec.journal is not None or rec.event_log is not None
+                or rec.follow is not None):
             self._pending_closes.append(
-                (rec.scheduler, rec.journal, rec.event_log)
+                (rec.scheduler, rec.journal, rec.event_log, rec.follow)
             )
         if rec.job_id in self._running:
             self._running.remove(rec.job_id)
@@ -1026,8 +1195,12 @@ class GrepService:
             if not self._pending_closes:
                 return
             pending, self._pending_closes = self._pending_closes, []
-        for scheduler, journal, event_log in pending:
+        for scheduler, journal, event_log, follow in pending:
             try:
+                if follow is not None:
+                    # stops the wake loop, wakes long-polling stream
+                    # subscribers, closes the cursor log
+                    follow.close()
                 if scheduler is not None and journal is not None:
                     scheduler.close_journal()
                 elif journal is not None:
@@ -1513,9 +1686,16 @@ class GrepService:
         if rec is None or rec.scheduler is None or (
             rec.state is not JobState.RUNNING
         ):
-            # job cancelled/gone mid-reduce: end the stream so the worker
-            # wraps up instead of long-polling a dead job forever
-            return rpc.ReduceNextFileReply(done=True)
+            # Job finalized/cancelled/gone mid-reduce: ABORT the attempt.
+            # Answering done=True here (the pre-round-17 behavior) let a
+            # LATE DUPLICATE reduce attempt — spawned by timeout churn,
+            # still mid-shuffle when the first attempt finalized the job —
+            # treat its partial cursor as complete and rename a SHORT
+            # output over the finalized job's committed file (posix
+            # rename-last-wins; caught by the chaos matrix as a
+            # byte-identity failure).  TaskAborted walks the worker away
+            # with NO commit record and no rename.
+            return rpc.ReduceNextFileReply(abort=True)
         return rec.scheduler.reduce_next_file(args, timeout=timeout)
 
     def heartbeat(self, args: rpc.HeartbeatArgs) -> None:
@@ -1554,8 +1734,55 @@ class GrepService:
                 ),
             }
             out["metrics"] = rec.metrics.snapshot()
+        if rec.follow is not None:
+            # standing query: wake/cursor/stream state instead of phase
+            # progress (nonzero-only gate not needed — the key only
+            # exists on follow jobs, so batch payloads keep their shape)
+            out["follow"] = rec.follow.status()
         if rec.state is JobState.DONE:
             out["outputs"] = rec.outputs
+        return out
+
+    def job_stream(self, job_id: str, cursor: int = 0,
+                   timeout: float = 25.0) -> dict:
+        """Long-poll one page of a standing query's record stream
+        (GET /jobs/<id>/stream?cursor=N): records with seq > cursor (each
+        carries its seq — the client passes the reply's ``next`` back),
+        plus an explicit ``dropped`` count when the subscriber fell
+        behind the bounded ring (oldest-first shed — the records are
+        gone from the ring; the full history stays in follow.jsonl).
+        Raises RuntimeError for non-follow jobs (HTTP answers 409).
+        A terminal follow job drains its remaining ring, then answers
+        empty pages with its state — clients stop on it."""
+        rec = self.record(job_id)
+        runner = rec.follow
+        if runner is None:
+            if getattr(rec.config, "follow", False):
+                # queued (admission-full) or start flush in flight: the
+                # runner is not published yet — an empty page with the
+                # state, not a 409 (the subscriber simply polls again).
+                # A waiting client is PACED (no ring to long-poll on, so
+                # an immediate empty answer would let it hot-spin against
+                # a daemon that may be busy replaying the cursor log);
+                # no lock is held here.
+                if timeout > 0:
+                    time.sleep(min(timeout, 0.5))
+                return {"job_id": job_id, "state": rec.state,
+                        "records": [], "next": max(0, int(cursor))}
+            raise RuntimeError(f"job {job_id} is not a follow job")
+        if rec.state is not JobState.RUNNING:
+            timeout = 0.0  # terminal: drain, never park the client
+        records, nxt, dropped = runner.ring.read_since(
+            cursor, timeout=max(0.0, min(timeout, 60.0))
+        )
+        out: dict = {
+            "job_id": job_id,
+            "state": rec.state,
+            "records": records,
+            "next": nxt,
+        }
+        if dropped:
+            out["dropped"] = dropped
         return out
 
     def job_result(self, job_id: str) -> dict:
@@ -1630,6 +1857,10 @@ class GrepService:
             }
             queued = len(self._queue)
             running = list(self._running)
+            standing = [
+                rec.job_id for rec in self._jobs.values()
+                if rec.state is JobState.RUNNING and rec.follow is not None
+            ]
             tasks_requeued = sum(
                 rec.metrics.counters.get("tasks_requeued", 0)
                 for rec in self._jobs.values()
@@ -1666,6 +1897,18 @@ class GrepService:
             self.scale_advice()
             if (queued or running or workers) else {}
         )
+        # Streaming tier (round 17): standing-query view — nonzero-only
+        # (a follow-free daemon keeps the exact pre-follow /status shape),
+        # sys.modules-gated like the cache dicts so a batch daemon never
+        # imports the follow module just to report nothing.
+        fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+        follow_view: dict = {}
+        if standing or (fol is not None and fol.follow_counters()):
+            follow_view = {"standing": len(standing)}
+            if standing:
+                follow_view["jobs"] = standing
+            if fol is not None:
+                follow_view.update(fol.follow_counters())
         for jid in jobs:
             rec = self._jobs.get(jid)  # pruning may race this unlocked read
             if rec is not None and rec.scheduler is not None:
@@ -1724,6 +1967,10 @@ class GrepService:
             # shard-index routing (planner side): shards never dispatched
             # because their trigram summary ruled the query out
             **({"index": index_stats} if index_stats else {}),
+            # streaming tier (round 17): standing queries + the follow
+            # wake/suffix/shed counters (nonzero-only — a follow-free
+            # daemon keeps the exact pre-follow /status shape)
+            **({"follow": follow_view} if follow_view else {}),
             # peer-to-peer shuffle (round 16): relay bytes that transited
             # THIS daemon's data plane (~0 with peer shuffle on) + lost
             # peer outputs recovered by map re-execution
@@ -1751,9 +1998,29 @@ class GrepService:
             queued = len(self._queue)
             running = len(self._running)
             workers = len(self.workers)
+            standing = sum(
+                1 for rec in self._jobs.values()
+                if rec.state is JobState.RUNNING and rec.follow is not None
+            )
         metrics_mod.gauge("dgrep_queue_depth").set(queued)
         metrics_mod.gauge("dgrep_jobs_running").set(running)
         metrics_mod.gauge("dgrep_workers_attached").set(workers)
+
+        # Streaming tier (round 17): follow gauges are touched only when
+        # the tier has activity — an untouched instrument never renders,
+        # so a follow-free daemon's /metrics stays byte-identical to the
+        # round-15 exposition (the golden pin).  Explicit string-constant
+        # creation sites (metrics-registry rule).
+        fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+        fc = fol.follow_counters() if fol is not None else {}
+        if standing or fc:
+            metrics_mod.gauge("dgrep_follow_standing").set(standing)
+            metrics_mod.gauge("dgrep_follow_wakes").set(
+                fc.get("follow_wakes", 0))
+            metrics_mod.gauge("dgrep_follow_suffix_bytes").set(
+                fc.get("suffix_bytes_scanned", 0))
+            metrics_mod.gauge("dgrep_stream_dropped_records").set(
+                fc.get("stream_dropped_records", 0))
 
         counters: dict = {}
         eng = _sys.modules.get("distributed_grep_tpu.ops.engine")
@@ -1884,6 +2151,11 @@ class GrepService:
         in_flight = 0
         oldest_age = 0.0
         for rec in recs:
+            if rec is not None and getattr(rec.config, "follow", False):
+                # standing queries scan daemon-side: they occupy a
+                # running slot but never produce worker tasks — counting
+                # one as demand would advise "grow" forever
+                continue
             if rec is None or rec.scheduler is None:
                 # start staged, setup in flight: at least its tasks are
                 # coming — count it as demand like a queued job
@@ -2237,6 +2509,35 @@ def _make_service_handler(server: ServiceServer):
                     # stable): job-lifecycle histograms + the live scale
                     # signal + rolling cache-hit rates
                     self._send_text(service.metrics_text())
+                elif self.path.startswith("/jobs/") and (
+                    urllib.parse.urlsplit(self.path).path.endswith("/stream")
+                ):
+                    # standing-query subscription (round 17): long-poll a
+                    # page of records past ?cursor=N; the reply's "next"
+                    # is the cursor to pass back.  Bounded server state —
+                    # the subscriber's only identity IS its cursor.
+                    parsed = urllib.parse.urlsplit(self.path)
+                    job_id = _safe_segment(
+                        parsed.path[len("/jobs/") : -len("/stream")]
+                    )
+                    q = urllib.parse.parse_qs(parsed.query)
+
+                    def _q(name: str, default: float) -> float:
+                        try:
+                            return float(q.get(name, [default])[0])
+                        except (TypeError, ValueError):
+                            return default
+
+                    try:
+                        self._send_json(service.job_stream(
+                            job_id, cursor=int(_q("cursor", 0)),
+                            timeout=_q("timeout", 25.0),
+                        ))
+                    except KeyError:
+                        self._send_json(
+                            {"error": f"unknown job: {job_id}"}, 404)
+                    except RuntimeError as e:
+                        self._send_json({"error": str(e)}, 409)
                 elif self.path.startswith("/jobs/"):
                     rest = self.path[len("/jobs/") :]
                     if rest.endswith("/result"):

@@ -80,6 +80,11 @@ def _engine_cache_counters() -> dict | None:
         # shard-index engine-side counters (index_shards_pruned/
         # bytes_skipped/maybe_scans/summaries_built), nonzero-only
         counters.update(idx.index_counters())
+    fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+    if fol is not None:
+        # streaming-tier counters (follow_wakes/suffix_bytes_scanned/
+        # stream_dropped_records), nonzero-only — same contract
+        counters.update(fol.follow_counters())
     return counters or None
 
 

@@ -87,6 +87,18 @@ class JobConfig:
     # real disk when the temp dir is RAM-backed tmpfs.
     spill_dir: str | None = None
 
+    # --- Streaming / follow mode (round 17, runtime/follow.py) -------------
+    # follow=True turns the job into a STANDING query over live-append
+    # inputs: no map/reduce phases — a daemon-side wake loop suffix-scans
+    # each input as it grows and streams records to GET /jobs/<id>/stream
+    # subscribers; per-file cursors persist in the job workdir
+    # (follow.jsonl) so a daemon restart resumes from them.  Both fields
+    # ELIDE from to_json at their defaults: a follow-free client/daemon
+    # pair exchanges payloads byte-identical to every pre-follow peer.
+    follow: bool = False
+    follow_poll_s: float | None = None  # wake cadence; None = the
+    # DGREP_FOLLOW_POLL_S knob (0.5 s default; env wins either way)
+
     # --- TPU execution -----------------------------------------------------
     backend: str = "auto"  # "cpu" | "tpu" | "auto" — pick the grep map engine
     mesh_shape: tuple[int, ...] = ()  # () = all local devices on one data axis
@@ -142,7 +154,19 @@ class JobConfig:
 
     # --- (De)serialization -------------------------------------------------
     def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+        d = dataclasses.asdict(self)
+        # wire-shape pin: the round-17 follow fields elide at their
+        # defaults (the rpc._ELIDE_DEFAULTS contract applied to the job
+        # config) — submit bodies, registry lines, and /config bootstrap
+        # payloads of follow-free jobs stay byte-identical to pre-follow
+        # peers, and an old daemon only rejects a config that actually
+        # asks for the new behavior.
+        if not d.get("follow"):
+            d.pop("follow", None)
+            d.pop("follow_poll_s", None)
+        elif d.get("follow_poll_s") is None:
+            d.pop("follow_poll_s", None)
+        return json.dumps(d, indent=2, sort_keys=True)
 
     @classmethod
     def from_json(cls, text: str) -> "JobConfig":

@@ -228,6 +228,17 @@ SERIES: dict[str, tuple[str, str]] = {
         "gauge", "Windowed model-cache hit ratio (hits/(hits+misses))."),
     "dgrep_corpus_cache_hit_ratio": (
         "gauge", "Windowed corpus-cache hit ratio (hits/(hits+misses))."),
+    # streaming tier (round 17, runtime/follow.py): set at scrape, and
+    # only once the tier has activity — an untouched instrument never
+    # renders, so follow-free daemons keep the round-15 exposition bytes
+    "dgrep_follow_standing": (
+        "gauge", "Standing follow queries currently running."),
+    "dgrep_follow_wakes": (
+        "gauge", "Follow wakes that scanned appended data, lifetime."),
+    "dgrep_follow_suffix_bytes": (
+        "gauge", "Appended bytes suffix-scanned by standing queries."),
+    "dgrep_stream_dropped_records": (
+        "gauge", "Stream records shed oldest-first by bounded buffers."),
 }
 
 

@@ -89,7 +89,8 @@ def corpus(tmp_path):
 @pytest.fixture(autouse=True)
 def _lockdep_audit(request):
     """The dynamic half of the concurrency-discipline layer (round 11):
-    under the `service`, `chaos`, and `soak_mini` suites every lock built
+    under the `service`, `chaos`, `soak_mini`, and `follow` suites every
+    lock built
     through utils/lockdep.make_lock is instrumented — per-thread
     acquisition stacks, lock-order inversion detection, blocking-syscall-
     while-held detection — and the test FAILS if the run observed either.
@@ -102,7 +103,7 @@ def _lockdep_audit(request):
     reach — the env-enabled path that covers them is pinned by a
     subprocess test in tests/test_lockdep.py."""
     markers = {m.name for m in request.node.iter_markers()}
-    if not markers & {"service", "chaos", "soak_mini"}:
+    if not markers & {"service", "chaos", "soak_mini", "follow"}:
         yield
         return
     from distributed_grep_tpu.utils import lockdep
@@ -176,6 +177,22 @@ def _fresh_index():
     _idx.clear()
     yield
     _idx.clear()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_follow():
+    """The streaming-tier counters (runtime/follow.py) are process-global
+    like the fusion counters — zero them per test (sys.modules-gated so
+    tests that never touch the tier never import it)."""
+    import sys as _sys
+
+    fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+    if fol is not None:
+        fol.follow_counters_clear()
+    yield
+    fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
+    if fol is not None:
+        fol.follow_counters_clear()
 
 
 @pytest.fixture(autouse=True)

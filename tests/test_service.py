@@ -170,6 +170,34 @@ def test_cache_counters_stamped_into_engine_stats():
 
 # ------------------------------------------------------- service end to end
 
+def test_late_reduce_attempt_on_terminal_job_aborts_not_done(
+        tmp_path, corpus, service):
+    """A duplicate reduce attempt that outlives its job's finalize must
+    be ABORTED, never told done: done=True let a late attempt (timeout
+    churn spawns several) treat its PARTIAL shuffle cursor as complete
+    and rename a short output over the finalized job's committed file
+    (posix rename-last-wins — caught by the chaos matrix as a rare
+    byte-identity failure)."""
+    from distributed_grep_tpu.runtime import rpc as rpc_mod
+
+    service.start_local_workers(2)
+    jid = service.submit(grep_config(corpus))
+    assert service.wait_job(jid, timeout=60), service.job_status(jid)
+    reply = service.reduce_next_file(
+        rpc_mod.ReduceNextFileArgs(task_id=0, files_processed=1,
+                                   job_id=jid, worker_id=99),
+        timeout=0.1,
+    )
+    assert getattr(reply, "abort", False) and not reply.done
+    # unknown/evicted job ids abort the attempt too
+    reply2 = service.reduce_next_file(
+        rpc_mod.ReduceNextFileArgs(task_id=0, files_processed=0,
+                                   job_id="job-999", worker_id=99),
+        timeout=0.1,
+    )
+    assert getattr(reply2, "abort", False) and not reply2.done
+
+
 def test_service_single_job_matches_run_job(tmp_path, corpus, service):
     service.start_local_workers(2)
     jid = service.submit(grep_config(corpus))
