@@ -5,7 +5,7 @@
 # installed).  CI and editors wanting annotations: `python -m
 # distributed_grep_tpu analyze --sarif`.
 
-.PHONY: lint native test trend
+.PHONY: lint native test chaos trend
 
 lint:
 	python -m distributed_grep_tpu analyze
@@ -16,6 +16,14 @@ native:
 
 test:
 	python -m pytest tests/ -x -q
+
+# The chaos tier standalone: real `dgrep serve` subprocesses SIGKILLed
+# mid-stream (incl. the round-18 active/standby failover cases) with
+# FaultTransport-injected network faults.  The tests zero
+# DGREP_RPC_RETRIES themselves before daemon teardown (retry schedules
+# are built per call from the env) — no extra env needed here.
+chaos:
+	python -m pytest tests/test_chaos.py -q
 
 # Round-over-round bench trajectory (BENCH_r*.json) as one JSON line +
 # a markdown table.  Reporting only — no gating (this box's background

@@ -1234,14 +1234,31 @@ def cmd_serve(args: argparse.Namespace) -> int:
     """Grep-as-a-service daemon (runtime/service.py): a long-lived
     multi-tenant coordinator serving a stream of jobs over persistent
     workers and engines.  Blocks until SIGINT/SIGTERM; remote workers
-    attach with `worker --addr`, clients submit with `submit --addr`."""
+    attach with `worker --addr`, clients submit with `submit --addr`.
+
+    HA mode (round 18, runtime/lease.py) switches on via ``--standby``
+    or a set DGREP_LEASE_TTL_S: the daemon contends for the work-root
+    lease — winner serves (with every durable flush fenced on lease
+    ownership), loser parks as a standby that polls the lease and
+    promotes through the normal resume path the moment it goes stale.
+    Without either switch this is the exact pre-lease single-daemon
+    path: no lease file, no /status "role" key."""
     import signal
     import tempfile
     import threading
 
+    from distributed_grep_tpu.runtime.lease import lease_configured
     from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
 
     work_root = args.work_root or tempfile.mkdtemp(prefix="dgrep-svc-")
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # non-main thread (tests drive the service directly)
+    if getattr(args, "standby", False) or lease_configured():
+        return _serve_ha(args, work_root, stop)
     service = GrepService(
         work_root=work_root,
         max_jobs=args.max_jobs,
@@ -1251,34 +1268,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     )
     server = ServiceServer(service, host=args.host, port=args.port)
     server.start()
-    if args.workers:
-        service.start_local_workers(args.workers)
-    stop = threading.Event()
-    scaler = None
-    if args.max_workers and args.max_workers > args.workers:
-        # Elastic local pool (round 16): follow the service's own scale
-        # advice (queue depth / pending tasks / in-flight age) between
-        # the base --workers floor and the --max-workers ceiling.
-        # Attach/detach is safe by construction — service-allocated ids,
-        # fresh-id reconnect, quarantine; shrink drains loops at their
-        # next idle poll, never mid-task.
-        def scale_loop() -> None:
-            while not stop.wait(2.0):
-                advice = service.scale_advice()["advice"]
-                cur = service.local_pool_size()
-                if advice == "grow" and cur < args.max_workers:
-                    service.scale_local_pool(cur + 1)
-                elif advice == "shrink" and cur > args.workers:
-                    service.scale_local_pool(max(args.workers, cur - 1))
-
-        scaler = threading.Thread(target=scale_loop, name="svc-scaler",
-                                  daemon=True)
-        scaler.start()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        try:
-            signal.signal(sig, lambda *_: stop.set())
-        except ValueError:
-            pass  # non-main thread (tests drive the service directly)
+    scaler = _start_worker_pool(args, service, stop)
     try:
         stop.wait()
     except KeyboardInterrupt:
@@ -1294,13 +1284,147 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _start_worker_pool(args: argparse.Namespace, service, stop):
+    """Local worker loops + (optionally) the elastic scaler thread.
+    Returns the scaler thread (joined at teardown) or None."""
+    import threading
+
+    if args.workers:
+        service.start_local_workers(args.workers)
+    if not (args.max_workers and args.max_workers > args.workers):
+        return None
+
+    # Elastic local pool (round 16): follow the service's own scale
+    # advice (queue depth / pending tasks / in-flight age) between
+    # the base --workers floor and the --max-workers ceiling.
+    # Attach/detach is safe by construction — service-allocated ids,
+    # fresh-id reconnect, quarantine; shrink drains loops at their
+    # next idle poll, never mid-task.
+    def scale_loop() -> None:
+        while not stop.wait(2.0):
+            advice = service.scale_advice()["advice"]
+            cur = service.local_pool_size()
+            if advice == "grow" and cur < args.max_workers:
+                service.scale_local_pool(cur + 1)
+            elif advice == "shrink" and cur > args.workers:
+                service.scale_local_pool(max(args.workers, cur - 1))
+
+    scaler = threading.Thread(target=scale_loop, name="svc-scaler",
+                              daemon=True)
+    scaler.start()
+    return scaler
+
+
+def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
+    """The active/standby loop behind ``dgrep serve --standby`` (or a
+    set DGREP_LEASE_TTL_S): contend for the work-root lease; serve while
+    holding it (renewal heartbeat + write fence), park as a StandbyServer
+    while not.  A deposed active — its lease stolen after a stall —
+    demotes back to standby instead of exiting, and a standby promotes
+    via the normal registry-resume path, so failover is just "the other
+    daemon restarts the service from the shared work root"."""
+    from pathlib import Path
+
+    from distributed_grep_tpu.runtime.lease import (
+        WorkRootLease,
+        env_lease_renew_s,
+    )
+    from distributed_grep_tpu.runtime.service import (
+        GrepService,
+        ServiceServer,
+        StandbyServer,
+    )
+
+    port = args.port
+    standby = None
+    last_status: dict = {}
+    try:
+        while not stop.is_set():
+            if port == 0 and standby is None:
+                # pin the ephemeral port BEFORE the lease advertises it:
+                # workers and clients dial one stable address per daemon
+                # across its standby/active transitions
+                standby = StandbyServer(work_root, host=args.host,
+                                        port=0).start()
+                port = standby.port
+            lease = WorkRootLease(Path(work_root),
+                                  addr=f"{args.host}:{port}")
+            poll_s = env_lease_renew_s()
+            while not lease.acquire():
+                if standby is None:
+                    standby = StandbyServer(work_root, host=args.host,
+                                            port=port).start()
+                    last_status = standby.status()
+                if stop.wait(poll_s):
+                    return _emit_final(last_status or
+                                       {"service": True, "role": "standby"})
+            if standby is not None:
+                # promotion: free the port for the real server (HTTPServer
+                # sets allow_reuse_address, so the rebind is immediate)
+                standby.shutdown()
+                standby = None
+            service = GrepService(
+                work_root=work_root,
+                max_jobs=args.max_jobs,
+                queue_depth=args.queue,
+                spans=args.spans,
+                # promotion IS resume: registry replay re-admits queued
+                # jobs, resumes running ones, reloads follow cursors
+                resume=False if args.no_resume else None,
+                lease=lease,
+            )
+            server = ServiceServer(service, host=args.host, port=port)
+            server.start()
+            port = server.port
+            lease.start_renewal(on_lost=service._on_lease_lost,
+                                on_renew=service.lease_renewed)
+            import threading as _threading
+
+            pool_stop = _threading.Event()  # per incarnation: a deposed
+            # service's scaler must not keep scaling it from the afterlife
+            scaler = _start_worker_pool(args, service, pool_stop)
+            try:
+                while not stop.wait(0.5):
+                    if service.deposed_event.is_set():
+                        break
+            except KeyboardInterrupt:
+                stop.set()
+            pool_stop.set()
+            if scaler is not None:
+                scaler.join(timeout=5.0)
+            server.shutdown()
+            lease.stop_renewal()
+            # a deposed service's stop() stages cancellations whose
+            # flushes the fence DROPS (by design — no deposed writes);
+            # a stopping owner's stop() flushes then releases the lease
+            service.stop()
+            last_status = service.status()
+            if stop.is_set():
+                return _emit_final(last_status)
+            # deposed: demote and contend again as a standby
+    finally:
+        if standby is not None:
+            standby.shutdown()
+    return _emit_final(last_status or {"service": True, "role": "standby"})
+
+
+def _emit_final(status: dict) -> int:
+    # stdout contract (mirrors cmd_serve's single-daemon path): exactly
+    # one JSON line — the final status snapshot
+    print(json.dumps(status))
+    return 0
+
+
 def cmd_submit(args: argparse.Namespace) -> int:
     """Client for a running service daemon: POST the job, optionally wait
     for completion, print exactly ONE JSON line (job_id/state/outputs)."""
     import time as _time
     import urllib.error
 
-    from distributed_grep_tpu.runtime.http_transport import client_call
+    from distributed_grep_tpu.runtime.http_transport import (
+        client_call,
+        split_addrs,
+    )
 
     if args.config:
         cfg = JobConfig.load(args.config)
@@ -1349,28 +1473,55 @@ def cmd_submit(args: argparse.Namespace) -> int:
     def call(method: str, path: str, body: bytes | None = None) -> dict:
         # the transport's bounded-jittered-retry helper: a transient
         # connection reset mid-poll retries instead of killing the client
-        # before the daemon-death JSON fallback below can fire
+        # before the daemon-death JSON fallback below can fire (with an
+        # address LIST, each retry also rotates to the next daemon)
         return client_call(args.addr, method, path, body=body,
                            timeout=args.timeout)
 
-    try:
-        # to_json() is ensure_ascii json.dumps output: strict is exact.
-        # SINGLE-SHOT on purpose: submission is not idempotent — a reply
-        # lost after the daemon registered the job would re-POST a
-        # duplicate job (the polls below retry; they're reads).
-        reply = client_call(
-            args.addr, "POST", "/jobs",
-            cfg.to_json().encode("utf-8", "strict"),
-            timeout=args.timeout, retry=False,
-        )
-    except urllib.error.HTTPError as e:
-        detail = e.read()[:500].decode("utf-8", "replace")
-        print(f"error: submit rejected ({e.code}): {detail}", file=sys.stderr)
-        return 2
-    except OSError as e:  # incl. CoordinatorGone: the retry schedule ran dry
-        print(f"error: cannot reach service at {args.addr}: {e}",
-              file=sys.stderr)
-        return 2
+    # HA address list (round 18): with several --addr members the client
+    # mints a submit_token so the POST becomes IDEMPOTENT — the service
+    # dedups on it, so a reply lost to a failover can safely re-POST to
+    # the promoted daemon and land on the SAME job.  Single-address
+    # submits stay the historical token-free single-shot (byte-identical
+    # wire payloads).
+    multi_addr = len(split_addrs(args.addr)) > 1
+    if multi_addr and not cfg.submit_token:
+        import secrets
+        from dataclasses import replace as _dc_replace
+
+        cfg = _dc_replace(cfg, submit_token=secrets.token_hex(16))
+    submit_deadline = _time.monotonic() + args.timeout
+    while True:
+        try:
+            # to_json() is ensure_ascii json.dumps output: strict is
+            # exact.  Single-address: SINGLE-SHOT on purpose — submission
+            # without a token is not idempotent, and a reply lost after
+            # the daemon registered the job would re-POST a duplicate job
+            # (the polls below retry; they're reads).  Multi-address: the
+            # token above makes re-POSTs dedup, so the retry loop (which
+            # rotates addresses) is safe to engage.
+            reply = client_call(
+                args.addr, "POST", "/jobs",
+                cfg.to_json().encode("utf-8", "strict"),
+                timeout=args.timeout, retry=multi_addr,
+            )
+            break
+        except urllib.error.HTTPError as e:
+            if (multi_addr and e.code == 503
+                    and _time.monotonic() < submit_deadline):
+                # failover window: a STANDBY answered (503) — the
+                # transport never retries an answered request, but the
+                # tokenized submit may re-POST until a daemon promotes
+                _time.sleep(0.5)
+                continue
+            detail = e.read()[:500].decode("utf-8", "replace")
+            print(f"error: submit rejected ({e.code}): {detail}",
+                  file=sys.stderr)
+            return 2
+        except OSError as e:  # incl. CoordinatorGone: retry schedule dry
+            print(f"error: cannot reach service at {args.addr}: {e}",
+                  file=sys.stderr)
+            return 2
     job_id = reply["job_id"]
     if cfg.follow:
         # a standing query has no completion to wait for: stream it on
@@ -1390,7 +1541,18 @@ def cmd_submit(args: argparse.Namespace) -> int:
         # the job is admitted: from here every outcome — daemon restart
         # mid-poll included — still prints exactly ONE JSON line
         while _time.monotonic() < deadline:
-            status = call("GET", f"/jobs/{job_id}")
+            try:
+                status = call("GET", f"/jobs/{job_id}")
+            except OSError:
+                # failover window (HTTPError is an OSError subclass: a
+                # standby answers polls 503 until it promotes) — with an
+                # address list, keep polling out the budget; the promoted
+                # daemon resumes the job and answers.  Single-address
+                # keeps the historical fail-fast.
+                if not multi_addr:
+                    raise
+                _time.sleep(0.5)
+                continue
             if status.get("state") in ("done", "failed", "cancelled"):
                 break
             _time.sleep(0.2)
@@ -1755,7 +1917,11 @@ def main(argv: list[str] | None = None) -> int:
                         "see `analyze --help` for rules/baseline/knobs)")
 
     p = sub.add_parser("worker", help="connect to a coordinator and process tasks")
-    p.add_argument("--addr", required=True, help="coordinator http address host:port")
+    p.add_argument("--addr", required=True,
+                   help="coordinator http address host:port — or a comma-"
+                        "separated active,standby list: retries rotate "
+                        "across it, and the worker parks while only "
+                        "standbys answer")
     p.add_argument("--slots", type=int, default=1, help="parallel task slots")
     p.set_defaults(fn=cmd_worker)
 
@@ -1792,6 +1958,14 @@ def main(argv: list[str] | None = None) -> int:
                         "(default: a restarted daemon re-admits queued jobs "
                         "and resumes running ones; DGREP_SERVICE_RESUME=0 "
                         "is the env equivalent)")
+    p.add_argument("--standby", action="store_true",
+                   help="active/standby failover: contend for the work "
+                        "root's lease file — serve while holding it, park "
+                        "as a standby (answering /status role=standby) "
+                        "while another daemon does, and promote via the "
+                        "resume path when its lease goes stale past "
+                        "DGREP_LEASE_TTL_S (setting that env var enables "
+                        "the same mode without this flag)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -1799,7 +1973,10 @@ def main(argv: list[str] | None = None) -> int:
         help="submit a job to a running service daemon and print one JSON "
              "line (job_id, state, outputs)",
     )
-    p.add_argument("--addr", required=True, help="service http address host:port")
+    p.add_argument("--addr", required=True,
+                   help="service http address host:port — or a comma-"
+                        "separated active,standby list: the submit is "
+                        "tokenized (idempotent) and follows a failover")
     p.add_argument("--config", default=None,
                    help="job config JSON (like `run --config`); otherwise "
                         "give PATTERN and FILE arguments")

@@ -238,6 +238,23 @@ KNOBS: dict[str, Knob] = {
         "count to the lagging consumer) — the scan loop never blocks "
         "(accessor: runtime/follow.env_stream_buffer).",
     ),
+    "DGREP_LEASE_TTL_S": Knob(
+        "runtime/lease.py", "10",
+        "Work-root lease staleness wall (round 18 active/standby "
+        "failover): a standby steals the lease — and promotes via the "
+        "resume path — once the active's renewal stamp is older than "
+        "this many seconds.  Setting it is also the env-side HA switch "
+        "(like `dgrep serve --standby`); unset single-daemon "
+        "deployments never create a lease file (accessor: "
+        "runtime/lease.env_lease_ttl_s).",
+    ),
+    "DGREP_LEASE_RENEW_S": Knob(
+        "runtime/lease.py", "ttl/3",
+        "Active daemon's lease renewal cadence (and the standby's "
+        "lease-poll interval).  Default ttl/3 — three missed renewals "
+        "before the lease goes stale (accessor: "
+        "runtime/lease.env_lease_renew_s).",
+    ),
     "DGREP_INDEX_SUMMARY_BYTES": Knob(
         "index/summary.py", "16384",
         "Per-shard trigram bloom size, rounded down to a power of two in "

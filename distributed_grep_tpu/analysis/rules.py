@@ -703,7 +703,15 @@ def rule_net_retry(project: Project) -> Iterator[Violation]:
     dies on the first transient connection reset, exactly the failure the
     retry layer exists to absorb (a daemon restart resets EVERY attached
     client at once), and silently forks the retry policy the
-    DGREP_RPC_RETRIES/DGREP_RPC_BACKOFF_S knobs are supposed to govern."""
+    DGREP_RPC_RETRIES/DGREP_RPC_BACKOFF_S knobs are supposed to govern.
+
+    Round 18 extension (active/standby failover): comma-splitting an
+    address outside http_transport is also flagged — address-list
+    rotation lives INSIDE the shared retry loop (``split_addrs`` + the
+    transport's rotating ``base``), and a hand-rolled split grows a
+    second rotation policy that the failover machinery can't see (it
+    would pin one member, or rotate on HTTPError, or skip the jittered
+    backoff)."""
     for rel in project.files():
         if not (rel.startswith(_NET_SCOPE) or rel in _NET_FILES):
             continue
@@ -724,6 +732,20 @@ def rule_net_retry(project: Project) -> Iterator[Violation]:
                     f"helpers (http_transport._request / client_call) — a "
                     f"bare call dies on the first transient reset and "
                     f"bypasses the DGREP_RPC_RETRIES policy",
+                )
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "split"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == ","
+                    and "addr" in ast.unparse(node.func.value).lower()):
+                yield Violation(
+                    "net-retry", rel, node.lineno,
+                    "address list split outside http_transport: use "
+                    "split_addrs / the transport's rotating base — a "
+                    "hand-rolled comma split forks the failover rotation "
+                    "policy out of the shared retry loop",
                 )
 
 

@@ -526,11 +526,19 @@ class FollowRunner:
     of losing them."""
 
     def __init__(self, job_id: str, config, work_root: str | Path, *,
-                 event_log=None, on_fail=None):
+                 event_log=None, on_fail=None, write_gate=None):
         self.job_id = job_id
         self.config = config
         self.event_log = event_log
         self.on_fail = on_fail
+        # Daemon-scope write fence (round 18 HA failover): consulted
+        # before each wake's journal writes.  A False answer means this
+        # daemon lost the work-root lease — the wake is ABANDONED before
+        # any cursor advances or record publishes (the promoted daemon
+        # resumed the standing query from follow.jsonl; a stale append
+        # would corrupt ITS cursor replay) and the loop stops.  None
+        # (single-daemon) skips the check entirely.
+        self.write_gate = write_gate
         self.poll_s = env_follow_poll_s(
             float(config.follow_poll_s or DEFAULT_FOLLOW_POLL_S)
         )
@@ -640,6 +648,11 @@ class FollowRunner:
     def wake_once(self) -> int:
         """One wake: scan, journal, publish.  Returns records emitted
         (tests and the benchmark drive this directly)."""
+        if self.write_gate is not None and not self.write_gate():
+            # deposed: no scan, no journal line, no publish — and no
+            # further wakes (request_stop is pure state, safe here)
+            self.request_stop()
+            return 0
         if self._scanner is None:
             self._scanner = self._build_scanner()
         if self._log_dirty:

@@ -97,9 +97,29 @@ class CoordinatorGone(OSError):
     without naming the transport layer."""
 
 
+def split_addrs(addr: str) -> list[str]:
+    """Parse a comma-separated daemon address list ("hostA:port,hostB:port")
+    into its members — the ONE place address lists are split (the
+    ``net-retry`` analyze rule flags stray copies): failover rotation
+    lives inside the shared retry loop below, so every client path —
+    worker RPCs, data plane, CLI — inherits it without growing a second
+    rotation loop to drift."""
+    return [a.strip() for a in str(addr).split(",") if a.strip()]
+
+
+def _normalize_bases(addr: str) -> list[str]:
+    bases = [
+        a if a.startswith("http") else f"http://{a}"
+        for a in split_addrs(addr)
+    ]
+    if not bases:
+        raise ValueError(f"no address in {addr!r}")
+    return [b.rstrip("/") for b in bases]
+
+
 def _open_with_retries(build_request, timeout: float, desc: str,
                        on_retry=None, deadline: float | None = None,
-                       delays=None) -> bytes:
+                       delays=None, rotate_on_503: bool = False) -> bytes:
     """The ONE transient-retry loop every JSON-over-HTTP client call
     shares (worker `_request` and the CLI's `client_call` — the net-retry
     analyze rule exists so no third copy grows): urlopen the freshly
@@ -108,6 +128,17 @@ def _open_with_retries(build_request, timeout: float, desc: str,
     untouched (the server ANSWERED — disposition is the caller's).
     ``on_retry`` (optional) is called once per retry — the transport
     counts them for the rpc_retries telemetry.
+
+    ``rotate_on_503`` (multi-address callers only): a 503 is the
+    StandbyServer's park answer — the one status the real daemon never
+    sends (its rejections are 400/404/409/429) — and the standby
+    registered NOTHING, so re-sending the same request to the NEXT
+    listed address is safe for any method.  It steps through the same
+    schedule as a transient failure (on_retry rotates, the backoff
+    bounds the both-sides-parked promotion window); a dry schedule
+    re-raises the HTTPError so callers' 503 handling still sees the
+    code.  Single-address callers keep the strict
+    HTTPError-never-retries contract byte-for-byte.
 
     ``deadline`` (monotonic) bounds the WHOLE call, retries included:
     CLI clients pass their --timeout as a wall-clock promise, and
@@ -128,8 +159,18 @@ def _open_with_retries(build_request, timeout: float, desc: str,
             with urllib.request.urlopen(build_request(),
                                         timeout=attempt_timeout) as resp:
                 return resp.read()
-        except urllib.error.HTTPError:
-            raise
+        except urllib.error.HTTPError as e:
+            if not (rotate_on_503 and e.code == 503):
+                raise
+            delay = next(delays, None)
+            if delay is None or (
+                deadline is not None
+                and time.monotonic() + delay >= deadline
+            ):
+                raise
+            if on_retry is not None:
+                on_retry()
+            time.sleep(delay)
         except TRANSIENT_ERRORS as e:
             delay = next(delays, None)
             if delay is None or (
@@ -170,14 +211,17 @@ def fetch_peer_data(endpoint: str, job_id: str, name: str,
 
 class HttpTransport:
     def __init__(self, addr: str, rpc_timeout_s: float = 60.0):
-        # addr: "host:port" or full "http://host:port".  rpc_timeout_s is the
-        # client socket timeout; the coordinator derives its long-poll window
-        # as half of this (bounded to 30s, http_coordinator.long_poll_window_s)
-        # so a healthy idle long-poll always returns before the socket times
-        # out.  Pass the job's JobConfig.rpc_timeout_s.
-        if not addr.startswith("http"):
-            addr = f"http://{addr}"
-        self.base = addr.rstrip("/")
+        # addr: "host:port" or full "http://host:port" — or a COMMA-SEPARATED
+        # list of them (active/standby failover, round 18): every retry
+        # rotates to the next address, so a worker parked against a dead
+        # active finds the promoted standby inside its existing retry
+        # budget.  rpc_timeout_s is the client socket timeout; the
+        # coordinator derives its long-poll window as half of this (bounded
+        # to 30s, http_coordinator.long_poll_window_s) so a healthy idle
+        # long-poll always returns before the socket times out.  Pass the
+        # job's JobConfig.rpc_timeout_s.
+        self._bases = _normalize_bases(addr)
+        self._base_i = 0
         self.rpc_timeout_s = rpc_timeout_s
         # Transient retries performed so far, process-lifetime (telemetry:
         # the worker piggybacks it as ``rpc_retries`` so /status shows
@@ -185,9 +229,22 @@ class HttpTransport:
         # under the GIL — a counter, not a synchronization primitive.
         self.retry_count = 0
 
+    @property
+    def base(self) -> str:
+        """The address currently in rotation.  Every request builder reads
+        it PER ATTEMPT (the retry loop calls build_request each try), so a
+        rotation performed by _count_retry lands on the very next attempt."""
+        return self._bases[self._base_i]
+
     # ------------------------------------------------------------- plumbing
     def _count_retry(self) -> None:
         self.retry_count += 1
+        if len(self._bases) > 1:
+            # failover rotation rides the retry hook: fires BEFORE the
+            # backoff sleep, so the next attempt dials the next address.
+            # HTTPError never reaches here (the server ANSWERED) — only
+            # connectivity failures rotate.
+            self._base_i = (self._base_i + 1) % len(self._bases)
 
     def _sleep_or_give_up(self, delays, desc: str, err: Exception) -> None:
         """One step of the bounded-jittered retry policy: sleep the next
@@ -201,9 +258,10 @@ class HttpTransport:
         time.sleep(delay)
 
     def _request(self, method: str, path: str, body: bytes | None = None) -> bytes:
-        url = f"{self.base}{path}"
-
         def build():
+            # URL built per attempt: self.base rotates across the address
+            # list on every counted retry (failover)
+            url = f"{self.base}{path}"
             req = urllib.request.Request(url, data=body, method=method)
             if body is not None:
                 req.add_header("Content-Type", "application/json")
@@ -211,7 +269,8 @@ class HttpTransport:
 
         try:
             return _open_with_retries(build, self.rpc_timeout_s,
-                                      f"{method} {path}", self._count_retry)
+                                      f"{method} {path}", self._count_retry,
+                                      rotate_on_503=len(self._bases) > 1)
         except urllib.error.HTTPError as e:
             # Server answered: 4xx/5xx are not liveness failures.
             raise RuntimeError(
@@ -299,13 +358,16 @@ class HttpTransport:
         import tempfile
 
         spool_dir = os.environ.get("DGREP_SPOOL_DIR") or None
-        url = f"{self.base}{self._data_path('input', filename)}"
         delays = retry_delays()
         tmp = tempfile.NamedTemporaryFile(
             prefix="dgrep-in-", dir=spool_dir, delete=False
         )
         try:
             while True:
+                # per-attempt URL: base rotates on counted retries; every
+                # address of an HA pair serves the same input split, so a
+                # Range resume across the rotation stays exact
+                url = f"{self.base}{self._data_path('input', filename)}"
                 try:
                     req = urllib.request.Request(url)
                     got = tmp.tell()
@@ -370,10 +432,10 @@ class HttpTransport:
         reduce output larger than worker RAM commits without ever being
         held whole.  Same liveness/retry policy as _request; each retry
         reopens the file from the start."""
-        url = f"{self.base}{self._data_path('out', name)}"
         size = os.path.getsize(path)
         delays = retry_delays()
         while True:
+            url = f"{self.base}{self._data_path('out', name)}"
             try:
                 with open(path, "rb") as f:
                     req = urllib.request.Request(url, data=f, method="PUT")
@@ -411,30 +473,42 @@ def client_call(addr: str, method: str, path: str,
     raises CoordinatorGone): for NON-idempotent requests — job submission
     above all, where a reply lost after the daemon durably registered the
     job would mint a duplicate job on the re-POST.  Only retry what a
-    duplicate delivery cannot change."""
-    base = addr if addr.startswith("http") else f"http://{addr}"
-    url = f"{base.rstrip('/')}{path}"
+    duplicate delivery cannot change.
+
+    ``addr`` may be a comma-separated list (active/standby failover):
+    each retry rotates to the next address, so a CLI client pointed at
+    both daemons follows a promotion inside its retry budget.  HTTPError
+    never rotates — except a 503 (the standby's park answer, which
+    registered nothing): rotating past a parked standby to the active
+    is exactly what the address list is for."""
+    bases = _normalize_bases(addr)
+    state = {"i": 0}
 
     def build():
+        url = f"{bases[state['i']]}{path}"
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
         return req
 
+    def rotate():
+        state["i"] = (state["i"] + 1) % len(bases)
+
+    desc = f"{method} {addr}{path}"
     if retry:
         # timeout is the caller's overall wall-clock promise — pass it as
         # the retry loop's deadline too, not just the per-attempt socket
         # timeout (see _open_with_retries)
         return json.loads(
-            _open_with_retries(build, timeout, f"{method} {url}",
-                               deadline=time.monotonic() + timeout)
+            _open_with_retries(build, timeout, desc, on_retry=rotate,
+                               deadline=time.monotonic() + timeout,
+                               rotate_on_503=len(bases) > 1)
         )
     # single-shot: the SAME loop with an empty schedule (first transient
     # failure raises CoordinatorGone) — never a second transient-error
     # classification to drift from the retried path
     return json.loads(
-        _open_with_retries(build, timeout, f"{method} {url}",
-                           delays=iter(()))
+        _open_with_retries(build, timeout, desc, delays=iter(()))
     )
 
 
@@ -483,6 +557,40 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
 
     init_distributed()
 
+    # HA park-and-poll (round 18, runtime/lease.py): probe each address's
+    # /status single-shot.  An ACTIVE daemon (no "role" key, or anything
+    # but "standby") wins and is moved to the FRONT of the rotation; when
+    # only standbys answer, the worker parks and re-polls instead of
+    # erroring — the standby will promote within the lease TTL and the
+    # same poll finds it.  When NOTHING answers, fall through to the
+    # historical path: fetch_config burns the normal retry budget
+    # (rotating through the list) and exits via CoordinatorGone.
+    bases = split_addrs(addr)
+    daemon_status: dict = {}
+    while True:
+        active = None
+        saw_standby = False
+        for b in bases:
+            try:
+                st = client_call(b, "GET", "/status", timeout=5.0,
+                                 retry=False)
+            except OSError:
+                continue
+            if st.get("role") == "standby":
+                saw_standby = True
+                continue
+            active = b
+            daemon_status = st
+            break
+        if active is not None:
+            addr = ",".join([active] + [b for b in bases if b != active])
+            break
+        if not saw_standby:
+            break
+        log.info("all of %s answer standby; parking until one promotes",
+                 addr)
+        time.sleep(2.0)
+
     transport = HttpTransport(addr)
     try:
         config = transport.fetch_config()
@@ -492,11 +600,11 @@ def run_http_worker(addr: str, n_parallel: int = 1) -> None:
     # Service daemon detection (runtime/service.py): its /status answers
     # {"service": true}; such workers scope their data plane per job and
     # resolve the application per assignment instead of from /config.
-    daemon_status: dict = {}
-    try:
-        daemon_status = transport.fetch_status()
-    except Exception:  # noqa: BLE001 — plain coordinator without /status? no
-        pass
+    if not daemon_status:
+        try:
+            daemon_status = transport.fetch_status()
+        except Exception:  # noqa: BLE001 — plain coordinator, no /status
+            pass
     is_service = bool(daemon_status.get("service"))
     app = load_application(config.application, **config.app_options)
     transport_cls = ServiceHttpTransport if is_service else HttpTransport

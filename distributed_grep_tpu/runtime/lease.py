@@ -1,0 +1,265 @@
+"""Work-root lease — the active/standby election primitive (round 18).
+
+One JSON lease file (``<work_root>/LEASE``) names the daemon currently
+allowed to WRITE the work root's durable state (jobs.jsonl registry,
+per-job task journals, follow logs).  The active creates it O_EXCL,
+renews it on a heartbeat cadence (``DGREP_LEASE_RENEW_S``), and a
+standby steals it — atomic tmp+``os.replace`` with the epoch bumped and
+a FRESH random token — once the ``renewed`` stamp is stale past
+``DGREP_LEASE_TTL_S``.
+
+Ownership identity is the (epoch, token) PAIR: the epoch orders
+incarnations (a revived deposed active always sees a larger epoch than
+its own and demotes), the token disambiguates two same-instant stealers
+(both replace; the last writer wins; the loser's re-read token
+mismatches).  The lease is advisory at acquisition time but MANDATORY at
+write time: every registry/journal flush batch re-verifies ownership via
+``verify()`` before touching disk (the daemon-scope extension of the
+round-16 zombie epoch fence), so a deposed active's late staged flush is
+DROPPED, never interleaved — split-brain loses at most the one unflushed
+batch, and replay stays uncorrupted.
+
+Clock discipline: staleness compares ``time.time()`` deltas on ONE host
+(active and standby share the work root's filesystem); the lease never
+compares clocks across hosts.  Renewal cadence must clear the TTL with
+margin — the default renew interval is ttl/3.
+
+Lock discipline: the lease has its own small mutex (``make_lock("lease",
+io_ok=True)`` — serializing lease-file I/O is its declared purpose) and
+is NEVER touched under the service lock; fence checks run inside the
+io_ok flush locks (registry-flush / journal-flush), i.e. in staged-flush
+context only (rule ``locked-blocking``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from pathlib import Path
+
+from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("lease")
+
+LEASE_FILENAME = "LEASE"
+
+_DEFAULT_TTL_S = 10.0
+
+
+def env_lease_ttl_s(default: float = _DEFAULT_TTL_S) -> float:
+    """The ONE parser of DGREP_LEASE_TTL_S: seconds of renewal silence
+    after which a lease is stealable.  Malformed or non-positive values
+    fall back to the default (a zero TTL would make every lease
+    instantly stealable — never what an operator means)."""
+    raw = os.environ.get("DGREP_LEASE_TTL_S")
+    if raw is None or raw == "":
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        return default
+    return val if val > 0 else default
+
+
+def env_lease_renew_s(default: float | None = None) -> float:
+    """The ONE parser of DGREP_LEASE_RENEW_S: the active's renewal (and
+    the standby's poll) cadence.  Default ttl/3 — three missed renewals
+    before the lease goes stale."""
+    raw = os.environ.get("DGREP_LEASE_RENEW_S")
+    fallback = default if default is not None else env_lease_ttl_s() / 3.0
+    if raw is None or raw == "":
+        return fallback
+    try:
+        val = float(raw)
+    except ValueError:
+        return fallback
+    return val if val > 0 else fallback
+
+
+def lease_configured() -> bool:
+    """True when the operator set DGREP_LEASE_TTL_S — the env-side HA
+    switch (the other is ``dgrep serve --standby``).  Single-daemon
+    deployments without either never create a lease file."""
+    return bool(os.environ.get("DGREP_LEASE_TTL_S"))
+
+
+class WorkRootLease:
+    """Epoch-stamped lease file under one work root.
+
+    States: unacquired (``epoch == 0``), held (acquire/steal succeeded,
+    ``verify()`` true), lost (a later incarnation replaced the file —
+    ``verify()`` false, every subsequent ``renew()`` false)."""
+
+    def __init__(self, work_root: str | Path, *, addr: str = "",
+                 ttl_s: float | None = None):
+        self.work_root = Path(work_root)
+        self.path = self.work_root / LEASE_FILENAME
+        self.addr = addr
+        self.ttl_s = float(ttl_s) if ttl_s is not None else env_lease_ttl_s()
+        self.epoch = 0
+        self.token = ""
+        self._mutex = lockdep.make_lock("lease", io_ok=True)
+        self._renew_stop: threading.Event | None = None
+        self._renew_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- file I/O
+    @staticmethod
+    def read(work_root: str | Path) -> dict | None:
+        """The current lease record, or None (no file / torn write).  The
+        standby's poll surface; also how a standby learns the active's
+        advertised address for its /status answer."""
+        path = Path(work_root) / LEASE_FILENAME
+        try:
+            doc = json.loads(path.read_text("utf-8"))
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def _payload(self, renewed: float) -> dict:
+        return {"epoch": self.epoch, "token": self.token,
+                "renewed": renewed, "addr": self.addr}
+
+    def _write_replace(self) -> None:
+        """tmp + os.replace under this lease's own path — atomic against
+        concurrent stealers; readers see the old or the new record,
+        never a torn one."""
+        tmp = self.path.with_name(
+            f".{LEASE_FILENAME}.tmp.{os.getpid()}.{self.token[:8]}")
+        tmp.write_text(json.dumps(self._payload(time.time()),
+                                  sort_keys=True), "utf-8")
+        os.replace(tmp, self.path)
+
+    # ------------------------------------------------------------ lifecycle
+    def acquire(self) -> bool:
+        """Take the lease: O_EXCL-create when absent, steal when stale.
+        False when a live active holds it (the caller becomes a
+        standby)."""
+        with self._mutex:
+            self.work_root.mkdir(parents=True, exist_ok=True)
+            token = secrets.token_hex(16)
+            try:
+                fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                             0o644)
+            except FileExistsError:
+                pass
+            else:
+                self.epoch, self.token = 1, token
+                payload = json.dumps(self._payload(time.time()),
+                                     sort_keys=True).encode("utf-8")
+                try:
+                    os.write(fd, payload)
+                finally:
+                    os.close(fd)
+                log.info("lease acquired at %s (epoch %d)",
+                         self.path, self.epoch)
+                return True
+            current = self.read(self.work_root)
+            if current is None:
+                # torn/unreadable lease: treat as stale — replace it
+                stale = True
+                old_epoch = 0
+            else:
+                stale = (time.time() - float(current.get("renewed", 0.0))
+                         > self.ttl_s)
+                old_epoch = int(current.get("epoch", 0))
+            if not stale:
+                return False
+            # Steal: bump the epoch, mint a fresh token, replace
+            # atomically, then RE-READ — two concurrent stealers both
+            # replace; the one whose token survives won.
+            self.epoch, self.token = old_epoch + 1, token
+            self._write_replace()
+            after = self.read(self.work_root)
+            if after is None or after.get("token") != self.token:
+                self.epoch, self.token = 0, ""
+                return False
+            log.info("lease stolen at %s (epoch %d <- stale epoch %d)",
+                     self.path, self.epoch, old_epoch)
+            return True
+
+    def renew(self) -> bool:
+        """Refresh the ``renewed`` stamp.  False — WITHOUT writing — when
+        the on-disk record is no longer ours (a standby stole it: we are
+        deposed; never clobber the winner)."""
+        with self._mutex:
+            if not self.token:
+                return False
+            current = self.read(self.work_root)
+            if (current is None or current.get("token") != self.token
+                    or int(current.get("epoch", -1)) != self.epoch):
+                return False
+            self._write_replace()
+            return True
+
+    def verify(self) -> bool:
+        """The write fence: does the on-disk lease still name us?  Called
+        by every registry/journal flush batch before it writes."""
+        if not self.token:
+            return False
+        current = self.read(self.work_root)
+        return (current is not None
+                and current.get("token") == self.token
+                and int(current.get("epoch", -1)) == self.epoch)
+
+    def release(self) -> None:
+        """Graceful handoff: delete the lease iff still ours, so a
+        standby promotes immediately instead of waiting out the TTL."""
+        self.stop_renewal()
+        with self._mutex:
+            if not self.token:
+                return
+            current = self.read(self.work_root)
+            if (current is not None and current.get("token") == self.token):
+                try:
+                    self.path.unlink()
+                except OSError:
+                    pass
+            self.epoch, self.token = 0, ""
+
+    # -------------------------------------------------------------- renewal
+    def start_renewal(self, on_lost, on_renew=None,
+                      interval_s: float | None = None) -> None:
+        """Daemon renewal thread: every ``interval_s`` (default
+        DGREP_LEASE_RENEW_S = ttl/3) call ``renew()``; a False answer
+        fires ``on_lost()`` once and stops.  ``on_renew()`` (optional)
+        runs after each successful renewal — the service's worker-table
+        snapshot hook rides it (satellite: a promoted daemon seeds its
+        worker rows from the last pre-failover snapshot)."""
+        if self._renew_thread is not None:
+            return
+        period = interval_s if interval_s is not None else env_lease_renew_s()
+        stop = threading.Event()
+
+        def _loop() -> None:
+            while not stop.wait(period):
+                if not self.renew():
+                    log.warning("lease lost at %s (our epoch %d)",
+                                self.path, self.epoch)
+                    try:
+                        on_lost()
+                    except Exception:
+                        log.exception("lease on_lost callback failed")
+                    return
+                if on_renew is not None:
+                    try:
+                        on_renew()
+                    except Exception:
+                        log.exception("lease on_renew callback failed")
+
+        self._renew_stop = stop
+        self._renew_thread = threading.Thread(
+            target=_loop, name="lease-renew", daemon=True)
+        self._renew_thread.start()
+
+    def stop_renewal(self) -> None:
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+        t = self._renew_thread
+        if t is not None:
+            t.join(timeout=10)
+        self._renew_stop = None
+        self._renew_thread = None

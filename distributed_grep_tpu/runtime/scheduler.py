@@ -259,6 +259,7 @@ class Scheduler:
         event_log: Optional[EventLog] = None,
         on_change: Optional[Any] = None,
         worker_health: Optional[WorkerHealth] = None,
+        journal_gate: Optional[Any] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -308,6 +309,14 @@ class Scheduler:
         self._pending_journal: list[tuple] = []
         self._journal_flush_lock = lockdep.make_lock("journal-flush",
                                                      io_ok=True)
+        # Daemon-scope write fence (round 18 HA failover): an optional
+        # callable consulted by every journal flush batch before it
+        # writes.  A False answer means this daemon lost the work-root
+        # lease — the batch is DROPPED (the promoted daemon owns the
+        # journal now; a stale interleaved line would poison its replay).
+        # None (single-daemon, one-shot coordinators) skips the check
+        # entirely.
+        self.journal_gate = journal_gate
         # (kind, task_id) pairs already journaled (staged or replayed):
         # a map task RE-COMPLETED after a lost-output re-execution (peer
         # shuffle, round 16) must not append a second map_done line —
@@ -545,6 +554,13 @@ class Scheduler:
             if not self._pending_journal:
                 return
             pending, self._pending_journal = self._pending_journal, []
+        if self.journal_gate is not None and not self.journal_gate():
+            # deposed (lease lost): drop the batch — commit records keep
+            # the tasks' truth; the promoted daemon's replay re-resolves
+            # them without ever seeing a stale interleaved line
+            log.warning("journal flush fenced: lease lost, %d staged "
+                        "entries dropped", len(pending))
+            return
         for kind, task_id, file, parts, has_record, files in pending:
             try:
                 if kind == "map":

@@ -204,9 +204,35 @@ class ServiceRegistry:
         with self._lock:
             self._journal.record(entry)
 
+    def record_workers(self, rows: dict[str, dict]) -> None:
+        """Worker-table snapshot (round 18 HA only — written from the
+        lease renewal thread, change-gated): the last snapshot before a
+        failover seeds the promoted daemon's worker table, so
+        scale_advice does not advise grow against an attached-but-not-
+        yet-reconnected fleet.  Replay treats the LAST record as truth;
+        compaction drops them (a compacted registry just means the next
+        promotion seeds nothing — workers re-register on their first
+        poll anyway)."""
+        with self._lock:
+            self._journal.record({
+                "kind": "workers", "rows": rows, "t": time.time(),
+            })
+
     def close(self) -> None:
         with self._lock:
             self._journal.close()
+
+    @staticmethod
+    def replay_workers(work_root: Path) -> dict[str, dict]:
+        """The newest worker-table snapshot in the registry (see
+        record_workers), or {}.  Read BEFORE compaction at startup —
+        compact drops snapshot records."""
+        path = Path(work_root) / ServiceRegistry.FILENAME
+        rows: dict[str, dict] = {}
+        for e in TaskJournal.replay(path):
+            if e.get("kind") == "workers" and isinstance(e.get("rows"), dict):
+                rows = e["rows"]
+        return rows
 
     @staticmethod
     def replay(work_root: Path) -> tuple[dict[str, dict], int]:
@@ -391,6 +417,7 @@ class GrepService:
         sweep_interval_s: float | None = None,
         rpc_timeout_s: float = 60.0,
         resume: bool | None = None,
+        lease=None,
     ):
         self.work_root = Path(work_root)
         self.work_root.mkdir(parents=True, exist_ok=True)
@@ -412,6 +439,17 @@ class GrepService:
         self._task_timeout_s = task_timeout_s
         self._sweep_interval_s = sweep_interval_s
         self.rpc_timeout_s = rpc_timeout_s
+        # Active/standby failover (round 18, runtime/lease.py): when a
+        # WorkRootLease is attached, every durable-write flush batch
+        # (registry, per-job journals, follow logs) re-verifies ownership
+        # before writing — a deposed active's late staged flush is
+        # DROPPED, never interleaved with the promoted daemon's records.
+        # None (the default, single-daemon deployments) is a true no-op:
+        # no lease file, no fence reads, byte-identical /status.
+        self._lease = lease
+        self._deposed = False
+        self.deposed_event = threading.Event()
+        self._last_worker_snapshot: dict[str, dict] | None = None
 
         self._lock = lockdep.make_lock("service")
         self._cond = threading.Condition(self._lock)
@@ -442,6 +480,13 @@ class GrepService:
         # otherwise be different processes).
         self._next_worker_id = 0
         self.workers: dict[int, dict] = {}
+        # submit_token -> job_id dedup map (round 18 satellite): the CLI
+        # sends a client-generated token with failover-aware submits so a
+        # re-POST to the promoted daemon lands on the SAME job instead of
+        # a duplicate.  Rebuilt from registry submit lines at resume
+        # (the token rides JobConfig, wire-elided when absent); pruned
+        # with the terminal-job table.
+        self._tokens: dict[str, str] = {}
 
         # Span-batch dedup across RPC retries, service-level: batches are
         # drained per WORKER buffer, and one batch may carry records from
@@ -505,6 +550,14 @@ class GrepService:
         # journals/commit records then short-circuit it — never lose or
         # duplicate a result.
         replayed, id_floor = ServiceRegistry.replay(self.work_root)
+        if self._lease is not None:
+            # HA promotion (satellite): seed the worker table from the
+            # deposed active's last renewal-time snapshot — read BEFORE
+            # compaction drops the snapshot records.  Without this,
+            # scale_advice on the promoted daemon counts zero attached
+            # workers until each one's next poll and advises grow
+            # against an invisible-but-attached fleet.
+            self._seed_workers(ServiceRegistry.replay_workers(self.work_root))
         # bound + compact BEFORE the append handle opens: the registry is
         # append-only over an unbounded job stream, so each restart
         # rewrites it down to the live jobs + the newest terminal history
@@ -553,6 +606,10 @@ class GrepService:
                 log.warning("registry job %s has unknown state %r; "
                             "dropping", jid, info["state"])
                 continue
+            if getattr(cfg, "submit_token", ""):
+                # rebuild the submit dedup map: a client re-POSTing its
+                # token to the promoted daemon must land on THIS job
+                self._tokens[cfg.submit_token] = jid
             rec = JobRecord(job_id=jid, config=cfg, state=state,
                             submitted_at=info.get("t", 0.0))
             if state in _TERMINAL:
@@ -677,6 +734,7 @@ class GrepService:
             event_log=rec.event_log,
             on_change=self._wake,
             worker_health=self._health,
+            journal_gate=self._write_gate(),
         )
         rec.state = JobState.RUNNING
         rec.started_at = time.time()
@@ -716,6 +774,18 @@ class GrepService:
                 if not self._registry_pending:
                     return
                 pending, self._registry_pending = self._registry_pending, []
+            if not self._lease_ok():
+                # The daemon-scope write fence (round 18): a standby
+                # stole the lease while this batch sat staged — we are
+                # deposed.  DROP the batch (the promoted daemon owns
+                # these jobs' records now; an interleaved stale append
+                # would become replay's trusted last state) and fence
+                # the rest of the daemon.  Split-brain loses at most
+                # this one unflushed batch.
+                log.warning("registry flush fenced: lease lost, %d staged "
+                            "records dropped", len(pending))
+                self._on_lease_lost()
+                return
             for job_id, state, error, outputs in pending:
                 try:
                     self._registry.record_state(
@@ -725,6 +795,102 @@ class GrepService:
                     log.exception("registry append failed for job %s",
                                   job_id)
 
+    # ------------------------------------------------------------- HA lease
+    def _lease_ok(self) -> bool:
+        """The daemon-scope write fence: no lease (single-daemon) is
+        always OK; with one attached, the on-disk record must still name
+        this incarnation.  File read — called from flush context (inside
+        the io_ok flush locks) or unlocked paths only, never under the
+        service lock (locked-blocking)."""
+        lease = self._lease
+        if lease is None:
+            return True
+        return not self._deposed and lease.verify()
+
+    def _on_lease_lost(self) -> None:
+        """A standby stole the lease: fence this daemon.  Idempotent and
+        I/O-free — flips the deposed flag, closes admission (_stopped),
+        and signals the serve loop (deposed_event) to demote this
+        process back to standby.  Jobs keep their on-disk state; the
+        promoted daemon resumed them already."""
+        with self._cond:
+            if self._deposed:
+                return
+            self._deposed = True
+            self._stopped = True
+            self._cond.notify_all()
+        log.warning("daemon deposed: durable writes fenced, admission "
+                    "closed (work root %s)", self.work_root)
+        self.deposed_event.set()
+
+    def _write_gate(self):
+        """The per-job durable-write fence (Scheduler journal_gate /
+        FollowRunner write_gate): None when no lease is attached (the
+        single-daemon no-op — schedulers skip the check entirely), else
+        a callable the journal/follow flush paths consult before
+        writing.  A False answer both drops that batch and deposes the
+        daemon."""
+        if self._lease is None:
+            return None
+
+        def gate() -> bool:
+            if self._lease_ok():
+                return True
+            self._on_lease_lost()
+            return False
+
+        return gate
+
+    def lease_renewed(self) -> None:
+        """Renewal-thread hook (WorkRootLease.start_renewal on_renew):
+        persist a change-gated worker-table snapshot so a failover
+        inherits the fleet view (see ServiceRegistry.record_workers).
+        Runs with no service lock held; the registry append serializes
+        on the registry's own io_ok lock."""
+        with self._lock:
+            rows = {
+                str(wid): {
+                    k: info[k]
+                    for k in ("job", "task", "metrics", "data_endpoint")
+                    if info.get(k) is not None
+                }
+                for wid, info in self.workers.items()
+            }
+        if rows == self._last_worker_snapshot:
+            return
+        try:
+            self._registry.record_workers(rows)
+        except Exception:  # noqa: BLE001 — telemetry, never fatal
+            log.exception("worker-table snapshot append failed")
+            return
+        self._last_worker_snapshot = rows
+
+    def _seed_workers(self, rows: dict[str, dict]) -> None:
+        """Adopt a replayed worker-table snapshot at promotion: rows get
+        FRESH seen stamps (monotonic clocks are process-local) so
+        scale_advice counts the attached fleet as capacity immediately;
+        the 1 h expiry still ages out workers that never reconnect.  The
+        id allocator jumps past every seeded id — reconnecting workers
+        that kept their old ids must not collide with fresh allocations."""
+        if not rows:
+            return
+        now = time.monotonic()
+        for wid_str, row in rows.items():
+            try:
+                wid = int(wid_str)
+            except (TypeError, ValueError):
+                continue
+            info: dict = {"job": None, "task": None, "seen": now}
+            if isinstance(row, dict):
+                for k in ("job", "task", "metrics", "data_endpoint"):
+                    if row.get(k) is not None:
+                        info[k] = row[k]
+            self.workers[wid] = info
+            self._next_worker_id = max(self._next_worker_id, wid + 1)
+        self._last_worker_snapshot = dict(rows)
+        log.info("promotion seeded %d worker rows from registry snapshot",
+                 len(self.workers))
+
     # ---------------------------------------------------------------- submit
     def submit(self, config: JobConfig) -> str:
         """Admit a job: validate, queue, start if a slot is free.  Raises
@@ -733,6 +899,16 @@ class GrepService:
         would re-enqueue their map task forever)."""
         from distributed_grep_tpu.runtime.job import plan_map_splits
 
+        # submit_token dedup (round 18): a failover-aware client re-POSTs
+        # its submit to the promoted daemon with the SAME token — answer
+        # the job the first delivery registered instead of admitting a
+        # duplicate.  Checked again (and claimed) under the lock at mint.
+        token = getattr(config, "submit_token", "")
+        if token:
+            with self._lock:
+                dup = self._tokens.get(token)
+            if dup is not None:
+                return dup
         # admission FIRST: 429-destined submits in the overload regime —
         # the exact traffic load-shedding exists for — must be rejected
         # before this submit pays any filesystem walk over its inputs.
@@ -784,8 +960,16 @@ class GrepService:
                 config, splits
             )
         with self._cond:
+            if token:
+                # the planning window above is unlocked: a concurrent
+                # duplicate may have claimed the token first
+                dup = self._tokens.get(token)
+                if dup is not None:
+                    return dup
             self._check_admission_locked_or_raise(locked=True)
             job_id = f"job-{next(self._ids)}"
+            if token:
+                self._tokens[token] = job_id
             # The service owns job identity and placement: the work dir is
             # ALWAYS <work_root>/<job_id> (two submits naming one work_dir
             # would corrupt each other's commits) and the span job tag is
@@ -809,11 +993,25 @@ class GrepService:
         # happens outside the lock and before the id is handed to the
         # client — from this line on a daemon crash re-admits the job at
         # restart instead of silently forgetting an acknowledged submit.
+        if not self._lease_ok():
+            # deposed mid-submit: this daemon must not durably register
+            # a job the promoted active will never learn about — the
+            # client's rotation retries the POST against the new active
+            # (the submit_token makes the re-POST safe either way)
+            self._on_lease_lost()
+            with self._lock:
+                if token:
+                    self._tokens.pop(token, None)
+            _C_REJECTED.inc()
+            raise AdmissionError("daemon deposed: lease lost")
         try:
             self._registry.record_submit(job_id, cfg)
         except (OSError, ValueError) as e:
             # closed registry (stop() won the race) or a dead disk: a job
             # we cannot durably register is a job we must not accept
+            with self._lock:
+                if token:
+                    self._tokens.pop(token, None)
             _C_REJECTED.inc()
             raise AdmissionError(f"cannot register job: {e}") from e
         rejected: AdmissionError | None = None
@@ -940,6 +1138,7 @@ class GrepService:
             event_log=event_log,
             on_change=self._wake,
             worker_health=self._health,
+            journal_gate=self._write_gate(),
         )
         return workdir, journal, event_log, metrics, scheduler
 
@@ -1047,6 +1246,7 @@ class GrepService:
             runner = FollowRunner(
                 rec.job_id, cfg, workdir.root,
                 event_log=event_log, on_fail=self._fail_follow_job,
+                write_gate=self._write_gate(),
             )
         except Exception as e:  # noqa: BLE001 — bad job, healthy service
             log.exception("follow job %s failed to start", rec.job_id)
@@ -1223,6 +1423,12 @@ class GrepService:
         terminal.sort(key=lambda r: r.finished_at or 0.0)
         for rec in terminal[:excess]:
             del self._jobs[rec.job_id]
+        if self._tokens:
+            # keep the submit-token dedup map bounded with the table: a
+            # token whose job was evicted answers like a fresh submit
+            # (the job is 404 history either way)
+            self._tokens = {t: j for t, j in self._tokens.items()
+                            if j in self._jobs}
 
     # ---------------------------------------------------------------- cancel
     def cancel(self, job_id: str) -> str:
@@ -1934,6 +2140,12 @@ class GrepService:
             }
         return {
             "service": True,
+            # HA role advertisement (round 18): present ONLY when a lease
+            # is attached — single-daemon /status keeps its exact
+            # pre-lease shape (golden-pinned).  Workers and clients read
+            # it to distinguish the active from a parked standby.
+            **({"role": "deposed" if self._deposed else "active"}
+               if self._lease is not None else {}),
             # peer-shuffle capability advertisement (round 16): a NEW
             # worker only sends AssignTaskArgs.peer_endpoint (and starts
             # its data server) when the daemon it attached to answers
@@ -2303,6 +2515,12 @@ class GrepService:
         for t in getattr(self, "_local_workers", []):
             t.join(timeout=join_timeout_s)
         self._registry.close()
+        if self._lease is not None:
+            # graceful handoff: delete the lease iff still ours so a
+            # standby promotes immediately instead of waiting out the
+            # TTL.  A deposed daemon's release is a no-op (the token no
+            # longer matches — never unlink the winner's lease).
+            self._lease.release()
 
 
 # ---------------------------------------------------------------- transports
@@ -2662,5 +2880,135 @@ def _make_service_handler(server: ServiceServer):
             else:
                 name = _safe_segment(parts[2])
             return job_id, kind, name
+
+    return Handler
+
+
+# ------------------------------------------------------------ standby surface
+class StandbyServer:
+    """Park surface of a daemon WAITING on the work-root lease (round 18
+    active/standby failover, runtime/lease.py).  NO service state lives
+    behind it — everything a client or worker can hit answers "not me,
+    yet": ``/status`` names the role plus the active's advertised address
+    read from the lease file (run_http_worker parks-and-polls on it
+    instead of erroring), assign polls get a plain retry +
+    ``retry_after_s`` reply (the WorkerLoop sleeps on it and re-polls —
+    rotation then finds whichever daemon holds the lease), reduce pulls
+    get ``abort=True`` (the attempt abandons cleanly, exactly the zombie
+    fence's answer), and submits/data traffic get 503 (the CLI's address
+    rotation retries against the active).  Promotion shuts this server
+    down and binds the real ServiceServer on the same (host, port)."""
+
+    PARK_RETRY_S = 2.0
+
+    def __init__(self, work_root: str | Path, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.work_root = Path(work_root)
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_standby_handler(self)
+        )
+        self._httpd.daemon_threads = True
+        self.host = host
+        self._serve_thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "StandbyServer":
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-standby",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        log.info("standby parked on %s:%d (watching %s)",
+                 self.host, self.port, self.work_root)
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def status(self) -> dict:
+        from distributed_grep_tpu.runtime.lease import WorkRootLease
+
+        rec = WorkRootLease.read(self.work_root) or {}
+        # "service": true keeps the readiness probes (tests/service_proc)
+        # and worker sniffing working; "role" is what distinguishes us.
+        return {
+            "service": True,
+            "role": "standby",
+            "active": rec.get("addr", ""),
+        }
+
+    def rpc_reply(self, verb: str, payload: dict):
+        if verb == rpc.Verb.ASSIGN_TASK:
+            # echo the caller's worker id — the WorkerLoop adopts
+            # reply.worker_id unconditionally, and the default -1 would
+            # un-register a parked worker
+            return rpc.AssignTaskReply(
+                assignment="retry",
+                task_id=-2,
+                worker_id=int(payload.get("worker_id", -1)),
+                retry_after_s=self.PARK_RETRY_S,
+            )
+        if verb == rpc.Verb.REDUCE_NEXT_FILE:
+            return rpc.ReduceNextFileReply(abort=True)
+        if verb in (rpc.Verb.MAP_FINISHED, rpc.Verb.REDUCE_FINISHED):
+            return rpc.TaskFinishedReply()
+        if verb == rpc.Verb.HEARTBEAT:
+            return rpc.HeartbeatReply()
+        raise KeyError(f"unknown RPC verb: {verb}")
+
+
+def _make_standby_handler(server: StandbyServer):
+    class Handler(DataPlaneHandler):
+        def do_POST(self):
+            try:
+                if self.path.startswith("/rpc/"):
+                    verb = self.path[len("/rpc/") :]
+                    payload = json.loads(self._read_body() or b"{}")
+                    self._send_json(
+                        rpc.reply_to_dict(server.rpc_reply(verb, payload))
+                    )
+                else:
+                    self._drain_body()
+                    self._send_json(
+                        {"error": "standby: no lease held here"}, 503)
+            except BrokenPipeError:
+                pass
+            except KeyError as e:
+                self._send_json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                log.exception("standby rpc error on %s", self.path)
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        def do_GET(self):
+            self._streaming_body = False
+            try:
+                if self.path == "/status":
+                    self._send_json(server.status())
+                else:
+                    self._send_json(
+                        {"error": "standby: no lease held here"}, 503)
+            except BrokenPipeError:
+                self.close_connection = True
+            except Exception as e:  # noqa: BLE001
+                self.close_connection = True
+                try:
+                    self._send_json({"error": str(e)}, 500)
+                except OSError:
+                    pass
+
+        def do_PUT(self):
+            try:
+                self._drain_body()
+                self._send_json(
+                    {"error": "standby: no lease held here"}, 503)
+            except (BrokenPipeError, OSError):
+                pass
 
     return Handler

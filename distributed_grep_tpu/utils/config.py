@@ -99,6 +99,14 @@ class JobConfig:
     follow_poll_s: float | None = None  # wake cadence; None = the
     # DGREP_FOLLOW_POLL_S knob (0.5 s default; env wins either way)
 
+    # --- HA submit dedup (round 18, runtime/lease.py failover) --------------
+    # Client-generated idempotency token: the service dedups submits on
+    # it, so a client whose POST reply was lost to a failover can re-POST
+    # to the promoted daemon and land on the SAME job.  Elides from
+    # to_json when empty — token-free submit bodies and registry lines
+    # stay byte-identical to every pre-lease peer.
+    submit_token: str = ""
+
     # --- TPU execution -----------------------------------------------------
     backend: str = "auto"  # "cpu" | "tpu" | "auto" — pick the grep map engine
     mesh_shape: tuple[int, ...] = ()  # () = all local devices on one data axis
@@ -166,6 +174,10 @@ class JobConfig:
             d.pop("follow_poll_s", None)
         elif d.get("follow_poll_s") is None:
             d.pop("follow_poll_s", None)
+        if not d.get("submit_token"):
+            # same contract, round 18: the HA submit-dedup token elides
+            # when absent so old payloads stay byte-identical
+            d.pop("submit_token", None)
         return json.dumps(d, indent=2, sort_keys=True)
 
     @classmethod

@@ -45,10 +45,15 @@ class ServiceProc:
     (the jobs.jsonl registry + per-job journals drive the resume)."""
 
     def __init__(self, work_root: Path, port: int | None = None,
-                 workers: int = 0, env: dict | None = None):
+                 workers: int = 0, env: dict | None = None,
+                 extra_args: list[str] | None = None):
         self.work_root = Path(work_root)
         self.port = port or free_port()
         self.workers = workers
+        # e.g. ["--standby"] for the HA tier; a parked standby still
+        # answers /status {"service": true, "role": "standby"}, so the
+        # start() readiness probe works unchanged
+        self.extra_args = list(extra_args or [])
         self.base = f"http://127.0.0.1:{self.port}"
         self.env = {
             "PYTHONPATH": REPO, "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
@@ -69,7 +74,7 @@ class ServiceProc:
             [sys.executable, "-m", "distributed_grep_tpu", "serve",
              "--host", "127.0.0.1", "--port", str(self.port),
              "--work-root", str(self.work_root), "--workers",
-             str(self.workers)],
+             str(self.workers), *self.extra_args],
             stdout=subprocess.DEVNULL,
             stderr=open(log_path, "wb"),
             env=self.env,

@@ -343,6 +343,38 @@ def test_net_retry_silent_on_transport_module_and_out_of_scope(tmp_path):
     assert not _hits(tmp_path, "net-retry")
 
 
+def test_net_retry_fires_on_addr_comma_split_outside_transport(tmp_path):
+    # round 18: a hand-rolled address-list split forks the failover
+    # rotation out of the shared retry loop — flagged everywhere in
+    # scope except http_transport itself (where split_addrs lives)
+    _mk(tmp_path, "runtime/x.py",
+        "def pick(addr):\n"
+        "    return addr.split(',')[0]\n")
+    _mk(tmp_path, "__main__.py",
+        "def first(args):\n"
+        "    return args.addr.split(',')[0]\n")
+    got = _hits(tmp_path, "net-retry")
+    assert [(v.path, v.line) for v in got] == [
+        ("__main__.py", 2), ("runtime/x.py", 2),
+    ]
+    assert all("split_addrs" in v.message for v in got)
+
+
+def test_net_retry_silent_on_non_addr_splits_and_transport_split(tmp_path):
+    # split_addrs' own comma split is exempt with its module
+    _mk(tmp_path, "runtime/http_transport.py",
+        "def split_addrs(addr):\n"
+        "    return [a for a in addr.split(',') if a]\n")
+    # comma splits of non-address strings stay silent (Range headers,
+    # CSV-ish option parsing)
+    _mk(tmp_path, "runtime/y.py",
+        "def parse_range(rng):\n"
+        "    return rng.split(',')[0]\n"
+        "def split_other(addr):\n"
+        "    return addr.split(';')\n")
+    assert not _hits(tmp_path, "net-retry")
+
+
 # ------------------------------------------------------ R9 locked-blocking
 
 def test_locked_blocking_fires_in_with_block_and_locked_method(tmp_path):

@@ -981,3 +981,213 @@ def test_chaos_drop_reply_on_peer_fetch_leg(tmp_path, corpus):
     )
     seen = [(e["kind"], e["task_id"]) for e in entries]
     assert len(seen) == len(set(seen)), seen
+
+
+# --------------------------------------- lease-fenced failover (round 18)
+
+@pytest.mark.parametrize("phase", ["map", "reduce"])
+def test_chaos_failover_sigkill_active_with_standby(tmp_path, monkeypatch,
+                                                    phase, matrix_corpus):
+    """Round-18 acceptance: SIGKILL the ACTIVE daemon mid-{map,reduce}
+    with a live --standby watching the same work root.  The standby
+    steals the lease after the TTL, promotes through the resume path,
+    and finishes the job byte-identical to a fault-free run with journal
+    entries unique per (kind, task) across both daemon lives.  Workers
+    ride a comma-separated address list: their polls rotate to the
+    standby (which parks them with retry + retry_after_s) until the
+    promotion, then resume work — no worker restart, no reconfiguration.
+    Finally the old active REVIVES as a standby and demotes instead of
+    split-braining."""
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "10")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.2")
+    corpus = matrix_corpus
+    work_root = tmp_path / "svc-root"
+    work_root.mkdir()
+    ha_env = {"DGREP_LEASE_TTL_S": "2", "DGREP_SERVICE_FUSE": "0"}
+    active = service_proc.ServiceProc(work_root, workers=0,
+                                      env=ha_env).start()
+    standby = service_proc.ServiceProc(work_root, workers=0, env=ha_env,
+                                       extra_args=["--standby"]).start()
+    assert active.status().get("role") == "active"
+    assert standby.status().get("role") == "standby"
+    addrs = f"127.0.0.1:{active.port},127.0.0.1:{standby.port}"
+
+    stop = threading.Event()
+
+    def worker_main() -> None:
+        # crash-replace loop on the ADDRESS LIST: a loop that dies in
+        # the failover gap reattaches and its rotation finds whichever
+        # daemon holds the lease
+        while not stop.is_set():
+            loop = WorkerLoop(
+                ServiceHttpTransport(addrs, rpc_timeout_s=15.0), app=None
+            )
+            try:
+                loop.run()
+                return  # JOB_DONE: service shut down
+            except Exception:  # noqa: BLE001 — worker died; replace it
+                time.sleep(0.2)
+
+    threads = [threading.Thread(target=worker_main, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        jid = active.submit(grep_config(
+            corpus, pattern="hello", n_reduce=2, task_timeout_s=2.0,
+            sweep_interval_s=0.2, work_dir=str(tmp_path / "sub"),
+        ))
+        # catch the kill phase mid-stream (same recipe as the matrix)
+        deadline = time.monotonic() + 90
+        while True:
+            assert time.monotonic() < deadline, active.tail_log()
+            try:
+                st = active.job_status(jid)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            m = st.get("map", {})
+            if phase == "map":
+                if m.get("completed", 0) >= 1:
+                    break
+            else:
+                if m and m.get("completed") == m.get("total"):
+                    break
+            if st.get("state") == "done":
+                break  # too fast to catch — failover still exercises resume
+            time.sleep(0.03)
+        active.sigkill()  # no teardown of any kind: the lease goes stale
+        # the standby steals the lease after the TTL and promotes
+        deadline = time.monotonic() + 60
+        while True:
+            assert time.monotonic() < deadline, standby.tail_log()
+            try:
+                if standby.status().get("role") == "active":
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        st = standby.wait_job(jid, timeout=150)
+        assert st["state"] == "done", (st, standby.tail_log())
+        outputs = standby.job_result(jid)["outputs"]
+
+        # the old active revives pointed at the same work root: it must
+        # DEMOTE to standby (the lease names a larger epoch), never
+        # split-brain a second active
+        active.extra_args = ["--standby"]
+        active.start()
+        assert active.status().get("role") == "standby", active.tail_log()
+    finally:
+        stop.set()
+        monkeypatch.setenv("DGREP_RPC_RETRIES", "0")
+        active.terminate()
+        standby.terminate()
+        for t in threads:
+            t.join(timeout=10)
+
+    key = ("hello", "posix")
+    if key not in _ORACLE_CACHE:
+        _ORACLE_CACHE[key] = outputs_by_name(run_job(
+            grep_config(corpus, pattern="hello", n_reduce=2,
+                        work_dir=str(tmp_path / "oracle")),
+            n_workers=2,
+        ).output_files)
+    assert outputs_by_name(outputs) == _ORACLE_CACHE[key]
+    # journal unique per (kind, task) across BOTH daemon lives
+    entries = TaskJournal.replay(WorkDir(str(work_root / jid)).journal_path())
+    seen = [(e["kind"], e["task_id"]) for e in entries]
+    assert len(seen) == len(set(seen)), seen
+
+
+def test_chaos_failover_sigkill_active_mid_stream(tmp_path, monkeypatch):
+    """Round-18 acceptance, streaming leg: SIGKILL the active while a
+    standing query streams a live-append log.  The promoted standby
+    resumes the follow job from its durable cursors; a subscriber
+    continuing from its last cursor sees the union across both daemon
+    lives equal the oracle — no duplicate seq, no lost line — including
+    lines appended DURING the outage."""
+    monkeypatch.setenv("DGREP_RPC_RETRIES", "10")
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.2")
+    work_root = tmp_path / "svc-root"
+    work_root.mkdir()
+    ha_env = {"DGREP_LEASE_TTL_S": "2", "DGREP_FOLLOW_POLL_S": "0.05"}
+    active = service_proc.ServiceProc(work_root, workers=0,
+                                      env=ha_env).start()
+    standby = service_proc.ServiceProc(work_root, workers=0, env=ha_env,
+                                       extra_args=["--standby"]).start()
+
+    log_path = tmp_path / "app.log"
+    log_path.write_bytes(b"hello 0\n")
+    n_lines = {"n": 1}
+    stop_append = threading.Event()
+
+    def appender() -> None:
+        # keeps appending straight through the kill and the outage
+        while not stop_append.is_set():
+            with open(log_path, "ab") as f:
+                f.write(b"hello %d\n" % n_lines["n"])
+            n_lines["n"] += 1
+            time.sleep(0.02)
+
+    cfg = JobConfig(
+        input_files=[str(log_path)],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello", "backend": "cpu"},
+        follow=True, follow_poll_s=0.05,
+    )
+    at = threading.Thread(target=appender, daemon=True)
+    collected: list[dict] = []
+    try:
+        jid = active.submit(cfg)
+        at.start()
+
+        def read_page(proc, cursor: int) -> tuple[list[dict], int]:
+            doc = service_proc._http_json(
+                "GET",
+                f"{proc.base}/jobs/{jid}/stream?cursor={cursor}&timeout=1",
+                timeout=10.0,
+            )
+            assert "dropped" not in doc  # big default ring: nothing shed
+            return doc["records"], doc["next"]
+
+        cursor = 0
+        deadline = time.monotonic() + 60
+        while len(collected) < 10:  # streaming demonstrably live
+            assert time.monotonic() < deadline, active.tail_log()
+            recs, cursor = read_page(active, cursor)
+            collected.extend(recs)
+        active.sigkill()  # mid-stream, appender still running
+        deadline = time.monotonic() + 60
+        while True:
+            assert time.monotonic() < deadline, standby.tail_log()
+            try:
+                if standby.status().get("role") == "active":
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        # outage lines + post-promotion lines keep flowing; stop the
+        # appender, then drain until the stream catches the final line
+        time.sleep(1.0)
+        stop_append.set()
+        at.join(timeout=10)
+        total = n_lines["n"]
+        deadline = time.monotonic() + 60
+        while not collected or collected[-1]["line"] < total:
+            assert time.monotonic() < deadline, (
+                len(collected), total, standby.tail_log()
+            )
+            recs, cursor = read_page(standby, cursor)
+            collected.extend(recs)
+    finally:
+        stop_append.set()
+        monkeypatch.setenv("DGREP_RPC_RETRIES", "0")
+        active.terminate()
+        standby.terminate()
+
+    # union across both lives == the one-shot oracle: every line, once
+    assert [(r["line"], r["text"]) for r in collected] == [
+        (i + 1, f"hello {i}") for i in range(total)
+    ]
+    seqs = [r["seq"] for r in collected]
+    assert seqs == sorted(set(seqs))  # no duplicate, no regression

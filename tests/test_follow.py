@@ -922,3 +922,59 @@ def test_daemon_sigkill_restart_resumes_stream(tmp_path):
         proc.terminate()
     got = [collected[s] for s in sorted(collected)]
     assert got == [(i + 1, "hello %d" % i) for i in range(20)]
+
+
+def test_stream_stale_cursor_dropped_across_daemon_restart(tmp_path,
+                                                           monkeypatch):
+    """Satellite pin (round 18): a subscriber reconnecting with a STALE
+    cursor after a ring shed gets the explicit ``dropped`` count — and
+    still gets it ACROSS a daemon restart: StreamRing.preload re-seeds
+    the replayed journal tail under the same byte cap, so the restarted
+    /stream page reports what the bounded ring no longer holds instead
+    of silently renumbering or starting empty."""
+    monkeypatch.setenv("DGREP_FOLLOW_POLL_S", "0.05")
+    monkeypatch.setenv("DGREP_STREAM_BUFFER", "600")
+    from distributed_grep_tpu.runtime.service import GrepService
+
+    log_path = tmp_path / "app.log"
+    log_path.write_bytes(b"".join(
+        b"hello %02d %s\n" % (i, b"x" * 40) for i in range(50)
+    ))
+    cfg = _mk_cfg(str(log_path), "ignored")
+    svc_a = GrepService(work_root=tmp_path / "svc")
+    jid = svc_a.submit(cfg)
+    deadline = time.monotonic() + 30
+    page = {}
+    while page.get("next") != 50:
+        assert time.monotonic() < deadline, page
+        page = svc_a.job_stream(jid, cursor=0, timeout=0.2)
+    # same-life shed: the 600-byte ring kept only a tail; a stale
+    # cursor=0 reader learns exactly how much it lost
+    assert page["dropped"] > 0
+    first_live = page["records"][0]["seq"]
+    assert page["dropped"] == first_live - 1
+    # daemon "crash": stop only the wake loop — no cancel record, the
+    # registry row stays RUNNING (the abandoned-not-stopped idiom; a
+    # graceful stop would record CANCELLED and nothing would resume)
+    svc_a.record(jid).follow.request_stop()
+    time.sleep(0.3)
+
+    svc_b = GrepService(work_root=tmp_path / "svc")
+    try:
+        deadline = time.monotonic() + 30
+        page2 = {}
+        while page2.get("next") != 50:
+            assert time.monotonic() < deadline, page2
+            page2 = svc_b.job_stream(jid, cursor=0, timeout=0.2)
+        # the stale cursor's explicit dropped count survived the restart
+        assert page2["dropped"] > 0
+        assert page2["records"][0]["seq"] == page2["dropped"] + 1
+        # sequence numbers are the SAME stream, not a renumbering: the
+        # retained tail ends at the pre-crash high-water seq
+        assert page2["records"][-1]["seq"] == 50
+        # a caught-up cursor sees no drop marker after the restart either
+        page3 = svc_b.job_stream(jid, cursor=page2["records"][0]["seq"],
+                                 timeout=0)
+        assert "dropped" not in page3
+    finally:
+        svc_b.stop()

@@ -1,0 +1,495 @@
+"""Lease-fenced daemon failover (round 18, runtime/lease.py).
+
+The active/standby control plane's primitives and fences, in-process:
+
+* ``WorkRootLease`` lifecycle — O_EXCL acquire, TTL-gated steal with the
+  epoch bumped and a fresh token, loser-detects on concurrent steals,
+  deposed ``renew()`` never clobbers the winner, graceful ``release``;
+* the daemon-scope WRITE FENCE — a ``GrepService`` whose lease was
+  stolen drops its staged registry flush (never interleaves), deposes
+  itself, and closes admission;
+* single-daemon NO-OP pins — no lease attached means no LEASE file, no
+  "role" key in /status, token-free registry submit lines (the PR-15
+  wire shapes, unchanged);
+* the satellites — submit_token dedup (in-process and across a resume),
+  the promoted daemon seeding its worker table from the registry's last
+  pre-failover snapshot, and the ``StandbyServer`` park surface;
+* client rotation — ``client_call`` over a comma-separated address list
+  fails over from a dead address to the live one inside the one shared
+  retry loop.
+
+The subprocess SIGKILL-the-active matrix lives in tests/test_chaos.py.
+Standalone: ``python -m pytest tests/test_lease.py -q`` (marker
+``service``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+from dataclasses import replace as dc_replace
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.runtime.http_transport import (
+    HttpTransport,
+    client_call,
+    split_addrs,
+)
+from distributed_grep_tpu.runtime.lease import (
+    WorkRootLease,
+    env_lease_renew_s,
+    env_lease_ttl_s,
+    lease_configured,
+)
+from distributed_grep_tpu.runtime.service import (
+    AdmissionError,
+    GrepService,
+    ServiceRegistry,
+    ServiceServer,
+    StandbyServer,
+)
+from distributed_grep_tpu.utils.config import JobConfig
+
+pytestmark = pytest.mark.service
+
+
+# ---------------------------------------------------------------- env knobs
+
+def test_lease_env_knob_parsers(monkeypatch):
+    monkeypatch.delenv("DGREP_LEASE_TTL_S", raising=False)
+    monkeypatch.delenv("DGREP_LEASE_RENEW_S", raising=False)
+    assert env_lease_ttl_s() == 10.0
+    assert env_lease_renew_s() == pytest.approx(10.0 / 3.0)
+    assert lease_configured() is False
+    monkeypatch.setenv("DGREP_LEASE_TTL_S", "6")
+    assert env_lease_ttl_s() == 6.0
+    assert env_lease_renew_s() == pytest.approx(2.0)  # ttl/3 default
+    assert lease_configured() is True
+    monkeypatch.setenv("DGREP_LEASE_RENEW_S", "0.5")
+    assert env_lease_renew_s() == 0.5
+    # malformed / non-positive fall back (a zero TTL would make every
+    # lease instantly stealable — never what an operator means)
+    monkeypatch.setenv("DGREP_LEASE_TTL_S", "banana")
+    assert env_lease_ttl_s() == 10.0
+    monkeypatch.setenv("DGREP_LEASE_TTL_S", "-3")
+    assert env_lease_ttl_s() == 10.0
+    monkeypatch.setenv("DGREP_LEASE_RENEW_S", "0")
+    monkeypatch.setenv("DGREP_LEASE_TTL_S", "9")
+    assert env_lease_renew_s() == pytest.approx(3.0)
+
+
+# ----------------------------------------------------------- lease lifecycle
+
+def _backdate(work_root: Path, by_s: float) -> None:
+    """Age the on-disk lease record: the stamp a stale active leaves."""
+    path = work_root / "LEASE"
+    doc = json.loads(path.read_text())
+    doc["renewed"] -= by_s
+    path.write_text(json.dumps(doc, sort_keys=True))
+
+
+def test_acquire_fresh_then_contender_parks(tmp_path):
+    a = WorkRootLease(tmp_path, addr="127.0.0.1:1", ttl_s=60.0)
+    assert a.acquire() is True
+    assert a.epoch == 1 and a.token
+    assert a.verify() is True
+    rec = WorkRootLease.read(tmp_path)
+    assert rec["addr"] == "127.0.0.1:1" and rec["epoch"] == 1
+    # a second daemon against a LIVE lease parks (becomes a standby)
+    b = WorkRootLease(tmp_path, ttl_s=60.0)
+    assert b.acquire() is False
+    assert b.verify() is False
+    # the live holder renews
+    before = WorkRootLease.read(tmp_path)["renewed"]
+    time.sleep(0.01)
+    assert a.renew() is True
+    assert WorkRootLease.read(tmp_path)["renewed"] > before
+
+
+def test_steal_after_ttl_deposed_renew_never_clobbers(tmp_path):
+    a = WorkRootLease(tmp_path, addr="old", ttl_s=0.5)
+    assert a.acquire()
+    _backdate(tmp_path, 5.0)
+    b = WorkRootLease(tmp_path, addr="new", ttl_s=0.5)
+    assert b.acquire() is True
+    assert b.epoch == 2  # the steal bumps the epoch past the stale holder
+    assert b.token != a.token
+    assert WorkRootLease.read(tmp_path)["addr"] == "new"
+    # the deposed holder: verify false, renew false WITHOUT writing
+    assert a.verify() is False
+    on_disk = (tmp_path / "LEASE").read_bytes()
+    assert a.renew() is False
+    assert (tmp_path / "LEASE").read_bytes() == on_disk  # never clobbered
+    # a deposed release is a no-op: the winner's lease file survives
+    a.release()
+    assert b.verify() is True
+    # the winner's release removes it — the graceful-handoff path
+    b.release()
+    assert not (tmp_path / "LEASE").exists()
+    assert b.verify() is False
+
+
+def test_concurrent_stealers_loser_detects(tmp_path):
+    """Two stealers race a stale lease: both replace, the LAST writer
+    wins, and the loser's re-read token mismatch demotes it — modeled
+    as back-to-back steals (the second lands after the first's re-read,
+    the worst interleave the token check must catch)."""
+    a = WorkRootLease(tmp_path, ttl_s=0.2)
+    assert a.acquire()
+    _backdate(tmp_path, 5.0)
+    b = WorkRootLease(tmp_path, ttl_s=0.2)
+    assert b.acquire() is True and b.epoch == 2
+    _backdate(tmp_path, 5.0)  # b goes silent too
+    c = WorkRootLease(tmp_path, ttl_s=0.2)
+    assert c.acquire() is True and c.epoch == 3
+    # b is now the loser: every ownership probe answers deposed
+    assert b.verify() is False and b.renew() is False
+    assert c.verify() is True
+    # epochs strictly order incarnations — a revived deposed holder
+    # always sees a larger epoch than its own
+    assert WorkRootLease.read(tmp_path)["epoch"] > b.epoch - 1
+
+
+def test_torn_lease_file_treated_stale(tmp_path):
+    (tmp_path / "LEASE").write_bytes(b'{"epoch": 7, "tok')  # torn write
+    assert WorkRootLease.read(tmp_path) is None
+    b = WorkRootLease(tmp_path, ttl_s=60.0)
+    assert b.acquire() is True  # unreadable record never wedges election
+    assert b.verify() is True
+
+
+def test_release_hands_off_without_ttl_wait(tmp_path):
+    a = WorkRootLease(tmp_path, ttl_s=3600.0)
+    assert a.acquire()
+    a.release()
+    b = WorkRootLease(tmp_path, ttl_s=3600.0)
+    assert b.acquire() is True  # O_EXCL path: no TTL wait after release
+    assert b.epoch == 1
+
+
+def test_renewal_thread_fires_on_lost_once_and_stops(tmp_path):
+    a = WorkRootLease(tmp_path, ttl_s=60.0)
+    assert a.acquire()
+    lost = threading.Event()
+    lost_calls = []
+    renews = []
+    a.start_renewal(
+        on_lost=lambda: (lost_calls.append(1), lost.set()),
+        on_renew=lambda: renews.append(1),
+        interval_s=0.05,
+    )
+    deadline = time.monotonic() + 5
+    while not renews:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    assert not lost.is_set()
+    # a stealer replaces the record out from under the renewal thread
+    (tmp_path / "LEASE").unlink()
+    b = WorkRootLease(tmp_path, ttl_s=60.0)
+    assert b.acquire()
+    assert lost.wait(timeout=5)
+    time.sleep(0.2)  # the loop must have STOPPED: one on_lost, ever
+    assert lost_calls == [1]
+    assert b.verify() is True  # the winner's record was never touched
+    a.stop_renewal()
+    b.release()
+
+
+# ---------------------------------------------------- single-daemon no-op
+
+def _tiny_cfg(tmp_path: Path, **kw) -> JobConfig:
+    p = tmp_path / "in.txt"
+    if not p.exists():
+        p.write_text("hello\nmiss\n")
+    return JobConfig(
+        input_files=[str(p)],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello", "backend": "cpu"},
+        n_reduce=1,
+        **kw,
+    )
+
+
+def test_no_lease_single_daemon_true_noop(tmp_path):
+    """The PR-15 shapes, unchanged: a lease-free daemon writes no LEASE
+    file, answers /status without a "role" key, and its registry submit
+    lines carry no submit_token key (wire-elided when absent)."""
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    try:
+        jid = svc.submit(_tiny_cfg(tmp_path))
+        svc.start_local_workers(1)
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+        status = svc.status()
+        assert "role" not in status
+        assert not (tmp_path / "svc" / "LEASE").exists()
+    finally:
+        svc.stop()
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "svc" / "jobs.jsonl").read_text().splitlines()
+             if ln.strip()]
+    submits = [e for e in lines if e.get("kind") == "job_submit"]
+    assert submits
+    for e in submits:
+        assert "submit_token" not in (e.get("config") or {})
+    assert not any(e.get("kind") == "workers" for e in lines)
+
+
+# ------------------------------------------------------------ write fence
+
+def test_fence_drops_staged_flush_and_deposes(tmp_path):
+    """The tentpole fence: a standby steals the lease while a registry
+    batch sits staged — the deposed daemon DROPS the batch (the promoted
+    daemon owns those records now; an interleaved stale append would
+    become replay's trusted last state), flips to deposed, and closes
+    admission."""
+    root = tmp_path / "svc"
+    lease = WorkRootLease(root, addr="me", ttl_s=0.3)
+    root.mkdir()
+    assert lease.acquire()
+    svc = GrepService(work_root=root, lease=lease,
+                      task_timeout_s=5.0, sweep_interval_s=0.1)
+    jid = svc.submit(_tiny_cfg(tmp_path))
+    assert svc.status()["role"] == "active"
+    registry = root / "jobs.jsonl"
+    before = registry.read_bytes()
+    # the standby steals (the active went silent past the TTL)
+    _backdate(root, 5.0)
+    thief = WorkRootLease(root, addr="thief", ttl_s=0.3)
+    assert thief.acquire()
+    # next durable transition: staged, then FENCED at flush time
+    svc.cancel(jid)
+    assert svc.deposed_event.wait(timeout=5)
+    assert registry.read_bytes() == before  # the stale batch never landed
+    assert svc.status()["role"] == "deposed"
+    with pytest.raises(AdmissionError):
+        svc.submit(_tiny_cfg(tmp_path))  # admission closed
+    # deposed stop() must not delete the winner's lease file
+    svc.stop()
+    assert thief.verify() is True
+    thief.release()
+
+
+def test_deposed_submit_rejected_before_durable_register(tmp_path):
+    """A submit racing the deposition must not durably register a job
+    the promoted active will never learn about — the client's rotation
+    re-POSTs against the winner (the submit_token makes that safe)."""
+    root = tmp_path / "svc"
+    lease = WorkRootLease(root, addr="me", ttl_s=0.3)
+    root.mkdir()
+    assert lease.acquire()
+    svc = GrepService(work_root=root, lease=lease)
+    _backdate(root, 5.0)
+    thief = WorkRootLease(root, addr="thief", ttl_s=0.3)
+    assert thief.acquire()
+    before = (root / "jobs.jsonl").read_bytes() \
+        if (root / "jobs.jsonl").exists() else b""
+    with pytest.raises(AdmissionError):
+        svc.submit(_tiny_cfg(tmp_path, submit_token="tok-race"))
+    after = (root / "jobs.jsonl").read_bytes() \
+        if (root / "jobs.jsonl").exists() else b""
+    assert after == before  # no job_submit line from the deposed daemon
+    svc.stop()
+    thief.release()
+
+
+# --------------------------------------------------- submit-token satellite
+
+def test_submit_token_dedup_inprocess_and_across_resume(tmp_path):
+    cfg = _tiny_cfg(tmp_path, submit_token="tok-abc")
+    svc = GrepService(work_root=tmp_path / "svc", task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    jid = svc.submit(cfg)
+    assert svc.submit(cfg) == jid  # duplicate delivery: same job
+    # distinct tokens mint distinct jobs
+    assert svc.submit(dc_replace(cfg, submit_token="tok-xyz")) != jid
+    svc.start_local_workers(1)
+    assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+    svc.stop()
+    # the dedup map survives a restart: rebuilt from registry submit
+    # lines, so a re-POST to the PROMOTED daemon lands on the same job
+    svc2 = GrepService(work_root=tmp_path / "svc")
+    try:
+        assert svc2.submit(cfg) == jid
+    finally:
+        svc2.stop()
+
+
+# ----------------------------------------------- worker-seeding satellite
+
+def test_promotion_seeds_worker_table_from_snapshot(tmp_path):
+    """The promoted daemon adopts the deposed active's last renewal-time
+    worker snapshot: scale_advice sees the attached fleet immediately,
+    and the id allocator jumps past every seeded id."""
+    root = tmp_path / "svc"
+    root.mkdir()
+    reg = ServiceRegistry(root)
+    reg.record_workers({
+        "3": {"job": "job-1", "data_endpoint": "http://w3:9"},
+        "7": {"job": None},
+        "bogus": {"job": None},  # non-numeric ids are skipped, not fatal
+    })
+    reg.close()
+    assert ServiceRegistry.replay_workers(root)["3"]["job"] == "job-1"
+    lease = WorkRootLease(root, ttl_s=60.0)
+    assert lease.acquire()
+    svc = GrepService(work_root=root, lease=lease)
+    try:
+        assert set(svc.workers) == {3, 7}
+        assert svc.workers[3]["data_endpoint"] == "http://w3:9"
+        assert svc._next_worker_id >= 8  # fresh ids never collide
+        rows = svc.status()["workers"]
+        assert set(rows) == {"3", "7"}
+    finally:
+        svc.stop()
+        lease.release()
+    # startup compaction dropped the snapshot records: the next
+    # promotion seeds nothing (workers re-register on their first poll)
+    assert ServiceRegistry.replay_workers(root) == {}
+    # lease-FREE construction never seeds, even with a snapshot present
+    root2 = tmp_path / "svc2"
+    root2.mkdir()
+    reg2 = ServiceRegistry(root2)
+    reg2.record_workers({"5": {"job": None}})
+    reg2.close()
+    svc2 = GrepService(work_root=root2)
+    try:
+        assert svc2.workers == {}
+    finally:
+        svc2.stop()
+
+
+def test_lease_renewal_snapshots_worker_rows_change_gated(tmp_path):
+    root = tmp_path / "svc"
+    lease = WorkRootLease(root, ttl_s=60.0)
+    root.mkdir()
+    assert lease.acquire()
+    svc = GrepService(work_root=root, lease=lease)
+    try:
+        svc.workers[4] = {"job": None, "task": None,
+                          "seen": time.monotonic()}
+        svc.lease_renewed()
+        rows = ServiceRegistry.replay_workers(root)
+        assert set(rows) == {"4"}
+        size = (root / "jobs.jsonl").stat().st_size
+        svc.lease_renewed()  # unchanged fleet: no second snapshot line
+        assert (root / "jobs.jsonl").stat().st_size == size
+    finally:
+        svc.stop()
+        lease.release()
+
+
+# ------------------------------------------------------- standby surface
+
+def test_standby_server_parks_workers_and_points_at_active(tmp_path):
+    lease = WorkRootLease(tmp_path, addr="127.0.0.1:4242", ttl_s=60.0)
+    assert lease.acquire()
+    standby = StandbyServer(tmp_path, host="127.0.0.1", port=0).start()
+    addr = f"127.0.0.1:{standby.port}"
+    try:
+        st = client_call(addr, "GET", "/status", retry=False)
+        assert st == {"service": True, "role": "standby",
+                      "active": "127.0.0.1:4242"}
+        # assign polls park: retry + retry_after_s, the caller's id echoed
+        # (WorkerLoop adopts reply.worker_id unconditionally — a -1 here
+        # would un-register a parked worker)
+        r = client_call(addr, "POST", "/rpc/AssignTask",
+                        json.dumps({"worker_id": 9}).encode(), retry=False)
+        assert r["assignment"] == "retry" and r["worker_id"] == 9
+        assert r["retry_after_s"] == StandbyServer.PARK_RETRY_S
+        # reduce pulls abort cleanly (the zombie fence's answer)
+        r = client_call(addr, "POST", "/rpc/ReduceNextFile",
+                        json.dumps({"task_id": 0}).encode(), retry=False)
+        assert r["abort"] is True
+        # submits and data traffic answer 503: rotation finds the active
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client_call(addr, "POST", "/jobs", b"{}", retry=False)
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client_call(addr, "GET", "/jobs/job-1", retry=False)
+        assert ei.value.code == 503
+    finally:
+        standby.shutdown()
+        lease.release()
+
+
+# ------------------------------------------------------- client rotation
+
+def test_split_addrs_and_transport_rotation():
+    assert split_addrs("a:1, b:2 ,,c:3") == ["a:1", "b:2", "c:3"]
+    t = HttpTransport("127.0.0.1:1,127.0.0.1:2")
+    assert t.base == "http://127.0.0.1:1"
+    t._count_retry()  # a connectivity failure rotates to the next base
+    assert t.base == "http://127.0.0.1:2"
+    t._count_retry()
+    assert t.base == "http://127.0.0.1:1"  # round-robin wraps
+    # single-address transports never rotate (the historical behavior)
+    s = HttpTransport("127.0.0.1:1")
+    s._count_retry()
+    assert s.base == "http://127.0.0.1:1"
+
+
+def test_client_call_rotates_to_live_address(tmp_path, monkeypatch):
+    """The failover dial: first address dead (connection refused), the
+    shared retry loop rotates to the live standby-list peer and the call
+    lands — no new retry machinery, the round-10 loop grew a hook."""
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    svc = GrepService(work_root=tmp_path / "svc")
+    server = ServiceServer(svc)
+    server.start()
+    try:
+        dead = "127.0.0.1:9"  # discard port: refuses fast
+        status = client_call(f"{dead},127.0.0.1:{server.port}",
+                             "GET", "/status", timeout=5.0)
+        assert status["service"] is True
+    finally:
+        svc.stop()
+        server.shutdown()
+
+
+def test_client_call_rotates_past_parked_standby(tmp_path, monkeypatch):
+    """The OTHER failover dial (caught live by an operator drive): the
+    first listed address is a PARKED STANDBY — it ANSWERS, with 503, so
+    the connectivity-failure rotation never fires.  A 503 is the one
+    status the real daemon never sends (400/404/409/429 are its
+    rejections) and the standby registered nothing, so the shared retry
+    loop rotates and re-sends: a submit dialed standby-first must land
+    on the active, not spin 503s until the client deadline."""
+    monkeypatch.setenv("DGREP_RPC_BACKOFF_S", "0.05")
+    lease = WorkRootLease(tmp_path / "root", addr="x", ttl_s=60.0)
+    (tmp_path / "root").mkdir()
+    assert lease.acquire()
+    standby = StandbyServer(tmp_path / "root", host="127.0.0.1",
+                            port=0).start()
+    svc = GrepService(work_root=tmp_path / "svc")
+    server = ServiceServer(svc)
+    server.start()
+    try:
+        addrs = f"127.0.0.1:{standby.port},127.0.0.1:{server.port}"
+        # a WRITE (the submit POST shape) rotates and is registered
+        # exactly once (note /status would NOT rotate: the standby
+        # answers it 200 with its own role — deliberately probeable)
+        cfg = _tiny_cfg(tmp_path)
+        reply = client_call(addrs, "POST", "/jobs",
+                            cfg.to_json().encode(), timeout=10.0)
+        svc.start_local_workers(1)
+        assert svc.wait_job(reply["job_id"], timeout=30)
+        # a 503'd READ rotates too: job polls dialed standby-first land
+        st = client_call(addrs, "GET", f"/jobs/{reply['job_id']}",
+                         timeout=5.0)
+        assert st["state"] == "done"
+        # single-address 503 keeps the strict no-retry contract
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client_call(f"127.0.0.1:{standby.port}", "GET", "/jobs/j",
+                        timeout=5.0)
+        assert ei.value.code == 503
+    finally:
+        svc.stop()
+        server.shutdown()
+        standby.shutdown()
+        lease.release()

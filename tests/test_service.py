@@ -792,3 +792,32 @@ def test_cmd_coordinator_stdout_one_json_line(tmp_path, corpus, capsys,
     lines = [ln for ln in out.splitlines() if ln.strip()]
     assert len(lines) == 1
     assert json.loads(lines[0]) == {"outputs": ["a", "b"]}
+
+
+def test_quarantine_expiry_reprobation_streak_resumes():
+    """Satellite pin (round 18): quarantine EXPIRY is re-probation, not
+    absolution — the failure streak resumes at threshold-1, so ONE more
+    attributed timeout after a real wall-clock expiry re-quarantines the
+    worker immediately, with the window doubled (episode 2); a committed
+    task is what clears the whole record."""
+    from distributed_grep_tpu.runtime.scheduler import (
+        QUARANTINE_AFTER_FAILURES,
+        WorkerHealth,
+    )
+
+    h = WorkerHealth(base_s=0.1)
+    for i in range(QUARANTINE_AFTER_FAILURES - 1):
+        assert h.record_failure(5) == 0.0, i  # probation: no window yet
+    assert h.record_failure(5) == pytest.approx(0.1)  # episode 1
+    assert h.quarantine_remaining(5) > 0
+    time.sleep(0.15)  # REAL expiry — no by-hand state surgery
+    assert h.quarantine_remaining(5) == 0.0  # assignable again
+    # one more timeout: straight back in, doubled window — no second
+    # run-up of QUARANTINE_AFTER_FAILURES consecutive failures needed
+    assert h.record_failure(5) == pytest.approx(0.2)
+    time.sleep(0.25)
+    assert h.quarantine_remaining(5) == 0.0
+    h.record_success(5)  # a committed task clears streak AND episodes
+    for _ in range(QUARANTINE_AFTER_FAILURES - 1):
+        assert h.record_failure(5) == 0.0
+    assert h.record_failure(5) == pytest.approx(0.1)  # episode 1 again
