@@ -1247,6 +1247,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     import tempfile
     import threading
 
+    from distributed_grep_tpu.runtime.daemon_log import DaemonLog, env_daemon_log
     from distributed_grep_tpu.runtime.lease import lease_configured
     from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
 
@@ -1259,12 +1260,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
             pass  # non-main thread (tests drive the service directly)
     if getattr(args, "standby", False) or lease_configured():
         return _serve_ha(args, work_root, stop)
+    # fleet timeline (round 19): daemon.jsonl in the work root; off is a
+    # true no-op — no file, no staged list, service hooks never installed
+    daemon_log = DaemonLog(work_root) if env_daemon_log() else None
     service = GrepService(
         work_root=work_root,
         max_jobs=args.max_jobs,
         queue_depth=args.queue,
         spans=args.spans,
         resume=False if args.no_resume else None,
+        daemon_log=daemon_log,
     )
     server = ServiceServer(service, host=args.host, port=args.port)
     server.start()
@@ -1323,8 +1328,10 @@ def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
     demotes back to standby instead of exiting, and a standby promotes
     via the normal registry-resume path, so failover is just "the other
     daemon restarts the service from the shared work root"."""
+    import time as _time
     from pathlib import Path
 
+    from distributed_grep_tpu.runtime.daemon_log import DaemonLog, env_daemon_log
     from distributed_grep_tpu.runtime.lease import (
         WorkRootLease,
         env_lease_renew_s,
@@ -1334,6 +1341,7 @@ def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
         ServiceServer,
         StandbyServer,
     )
+    from distributed_grep_tpu.utils import metrics as metrics_mod
 
     port = args.port
     standby = None
@@ -1350,19 +1358,44 @@ def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
             lease = WorkRootLease(Path(work_root),
                                   addr=f"{args.host}:{port}")
             poll_s = env_lease_renew_s()
+            park_t0 = None
+            # detection→serving clock for the failover SLO: reset before
+            # every acquire attempt, so after the SUCCESSFUL one it marks
+            # the poll that noticed the stale lease
+            detect_t = _time.monotonic()
             while not lease.acquire():
                 if standby is None:
                     standby = StandbyServer(work_root, host=args.host,
                                             port=port).start()
                     last_status = standby.status()
+                if park_t0 is None:
+                    park_t0 = _time.monotonic()
                 if stop.wait(poll_s):
                     return _emit_final(last_status or
                                        {"service": True, "role": "standby"})
+                detect_t = _time.monotonic()
             if standby is not None:
                 # promotion: free the port for the real server (HTTPServer
                 # sets allow_reuse_address, so the rebind is immediate)
                 standby.shutdown()
                 standby = None
+            stolen = lease.epoch > 1
+            # Fleet timeline: ONLY the lease holder opens daemon.jsonl
+            # (TaskJournal's open truncates a torn tail — a standby
+            # opening the active's live file would corrupt it), so the
+            # log is built per incarnation, after acquire.
+            daemon_log = None
+            if env_daemon_log():
+                daemon_log = DaemonLog(work_root, epoch=lease.epoch,
+                                       role="active")
+                if park_t0 is not None:
+                    daemon_log.stage(
+                        "standby_park",
+                        parked_s=round(_time.monotonic() - park_t0, 3))
+                daemon_log.append_now(
+                    "lease_steal" if stolen else "lease_acquire",
+                    addr=f"{args.host}:{port}",
+                    **({"prev_epoch": lease.epoch - 1} if stolen else {}))
             service = GrepService(
                 work_root=work_root,
                 max_jobs=args.max_jobs,
@@ -1372,12 +1405,25 @@ def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
                 # jobs, resumes running ones, reloads follow cursors
                 resume=False if args.no_resume else None,
                 lease=lease,
+                daemon_log=daemon_log,
             )
             server = ServiceServer(service, host=args.host, port=port)
             server.start()
             port = server.port
             lease.start_renewal(on_lost=service._on_lease_lost,
                                 on_renew=service.lease_renewed)
+            if daemon_log is not None and (stolen or park_t0 is not None):
+                # serving point: registry replayed, server bound, renewal
+                # running — the failover SLO sample and the trace-side
+                # promotion span's right edge
+                failover_s = _time.monotonic() - detect_t
+                metrics_mod.histogram(
+                    "dgrep_daemon_failover_seconds").observe(failover_s)
+                daemon_log.append_now(
+                    "promoted", addr=f"{args.host}:{port}",
+                    failover_s=round(failover_s, 6),
+                    running=len(service._running),
+                    queued=len(service._queue))
             import threading as _threading
 
             pool_stop = _threading.Event()  # per incarnation: a deposed
@@ -1398,6 +1444,12 @@ def _serve_ha(args: argparse.Namespace, work_root: str, stop) -> int:
             # flushes the fence DROPS (by design — no deposed writes);
             # a stopping owner's stop() flushes then releases the lease
             service.stop()
+            if daemon_log is not None:
+                # deposed path: stop() left the log open (close is
+                # lease-gated); discard drops the fenced stage and frees
+                # the handle before the next contention cycle.  No-op
+                # after a graceful close.
+                daemon_log.discard()
             last_status = service.status()
             if stop.is_set():
                 return _emit_final(last_status)
@@ -1651,20 +1703,44 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     """Render a job's events.jsonl (the span pipeline's persisted event
     log, utils/spans.py) as Chrome trace_event JSON — loadable in Perfetto
     (ui.perfetto.dev), chrome://tracing, and TensorBoard's trace viewer,
-    next to the jax.profiler device trace from DGREP_TRACE_DIR."""
+    next to the jax.profiler device trace from DGREP_TRACE_DIR.
+
+    ``--fleet``: the positional is a service WORK ROOT instead — the
+    daemon.jsonl fleet timeline (all incarnations, epoch-ordered) merges
+    with every job's events.jsonl into one trace, daemon rows above
+    worker rows, promotion latency rendered as a span."""
     from pathlib import Path
 
-    from distributed_grep_tpu.utils.spans import EventLog, export_chrome_trace
+    from distributed_grep_tpu.utils.spans import (
+        EventLog,
+        export_chrome_trace,
+        export_fleet_trace,
+    )
 
-    path = Path(args.events)
-    if path.is_dir():  # a work dir: the log lives at its root
-        path = path / EventLog.FILENAME
-    if not path.exists():
-        print(f"error: no event log at {path} (run the job with "
-              f"JobConfig.spans=true or DGREP_SPANS=1)", file=sys.stderr)
-        return 2
-    events = EventLog.read(path)
-    doc = export_chrome_trace(events)
+    if getattr(args, "fleet", False):
+        from distributed_grep_tpu.runtime import daemon_log as daemon_log_mod
+
+        root = Path(args.events)
+        if root.is_file():  # a daemon.jsonl path: the root holds it
+            root = root.parent
+        if not (root / daemon_log_mod.FILENAME).exists():
+            print(f"error: no {daemon_log_mod.FILENAME} under {root} "
+                  f"(serve with DGREP_DAEMON_LOG on)", file=sys.stderr)
+            return 2
+        jobs = {
+            p.parent.name: EventLog.read(p)
+            for p in sorted(root.glob(f"*/{EventLog.FILENAME}"))
+        }
+        doc = export_fleet_trace(daemon_log_mod.DaemonLog.read(root), jobs)
+    else:
+        path = Path(args.events)
+        if path.is_dir():  # a work dir: the log lives at its root
+            path = path / EventLog.FILENAME
+        if not path.exists():
+            print(f"error: no event log at {path} (run the job with "
+                  f"JobConfig.spans=true or DGREP_SPANS=1)", file=sys.stderr)
+            return 2
+        doc = export_chrome_trace(EventLog.read(path))
     if args.out and args.out != "-":
         Path(args.out).write_text(json.dumps(doc))
         print(f"{len(doc['traceEvents'])} trace events -> {args.out}",
@@ -1717,10 +1793,19 @@ def cmd_explain(args: argparse.Namespace) -> int:
               f"\"spans\": true or DGREP_SPANS=1, or pass --addr for a "
               f"service job)", file=sys.stderr)
         return 2
+    # a service job's workdir is <work_root>/<job_id>: when the fleet
+    # timeline sits next to it, the disruptions section rides along
+    from distributed_grep_tpu.runtime import daemon_log as daemon_log_mod
+
+    daemon_events = None
+    work_root = path.parent.parent
+    if (work_root / daemon_log_mod.FILENAME).exists():
+        daemon_events = daemon_log_mod.DaemonLog.read(work_root)
     doc = explain_mod.assemble(
-        job_id=str(args.target), config=None, state="",
+        job_id=path.parent.name, config=None, state="",
         submitted_at=None, started_at=None, finished_at=None,
         metrics_counters={}, events=EventLog.read(path),
+        daemon_events=daemon_events,
     )
     print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
@@ -1752,6 +1837,175 @@ def cmd_status(args: argparse.Namespace) -> int:
         return 2
     print(json.dumps(status, indent=2, sort_keys=True))
     return 0
+
+
+def env_top_interval_s(default: float = 2.0) -> float:
+    """`dgrep top` refresh cadence — the ONE parser of
+    DGREP_TOP_INTERVAL_S (malformed or <= 0 keeps the default, the
+    env_batch_bytes shrug-off policy)."""
+    import os
+
+    raw = os.environ.get("DGREP_TOP_INTERVAL_S")
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _parse_metrics_text(text: str) -> dict[str, float]:
+    """Prometheus exposition -> {name: value} for UNLABELED samples
+    (gauges/counters and histogram _sum/_count lines; labeled bucket
+    lines are skipped — top reads only the plain series)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2 or "{" in parts[0]:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+def _kv_line(d: dict) -> str:
+    return "  ".join(f"{k}={d[k]}" for k in sorted(d))
+
+
+def _render_top(statuses: dict[str, dict | None],
+                active_addr: str | None,
+                metrics: dict[str, float]) -> str:
+    """One refresh of the console as plain text: role banner per address,
+    headline gauges, scale/quarantine state, windowed cache-hit ratios
+    (from /metrics), and the per-worker table with the SAME freshness
+    signal the scale advisor reads (last_event_age_s)."""
+    lines: list[str] = []
+    roles = []
+    for addr, st in statuses.items():
+        role = "down" if st is None else str(st.get("role", "active"))
+        roles.append(f"{addr} [{role.upper()}]")
+    lines.append("dgrep top — " + "   ".join(roles))
+    st = statuses.get(active_addr) if active_addr else None
+    if st is None:
+        standby = next((s for s in statuses.values() if s), None)
+        if standby is None:
+            lines.append("no daemon reachable")
+        else:
+            lines.append("no ACTIVE daemon — parked standby answers; "
+                         f"lease names {standby.get('active', '?')}")
+        return "\n".join(lines)
+    lines.append(
+        f"uptime {st.get('uptime_s', 0.0):8.1f}s   "
+        f"queued {st.get('queued', 0)}/{st.get('queue_depth_cap', '?')}   "
+        f"running {len(st.get('running', []))}/{st.get('max_jobs', '?')}   "
+        f"workers {len(st.get('workers', {}))}   "
+        f"quarantined {st.get('workers_quarantined', 0)}"
+    )
+    scale = st.get("scale")
+    if scale:
+        lines.append(f"scale: {_kv_line(scale)}")
+    ratios = {
+        k.replace("dgrep_", "").replace("_hit_ratio", ""): round(v, 3)
+        for k, v in metrics.items() if k.endswith("_hit_ratio")
+    }
+    if ratios:
+        lines.append("cache hit ratios (window): " + _kv_line(ratios))
+    failovers = metrics.get("dgrep_daemon_failover_seconds_count")
+    if failovers:
+        mean = (metrics.get("dgrep_daemon_failover_seconds_sum", 0.0)
+                / failovers)
+        lines.append(f"failovers: {int(failovers)} "
+                     f"(mean {mean:.2f}s promotion latency)")
+    latency = st.get("latency")
+    if latency:
+        for key, summ in sorted(latency.items()):
+            lines.append(f"latency {key}: {_kv_line(summ)}")
+    follow = st.get("follow")
+    if follow:
+        lines.append(f"follow: {_kv_line(follow)}")
+    workers = st.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines.append(f"{'WID':>4} {'EVENT AGE':>10} {'JOB':>8} "
+                     f"{'TASK':>6} {'QUAR':>6}  GBPS")
+        for wid in sorted(workers, key=lambda w: int(w)):
+            row = workers[wid]
+            m = row.get("metrics") or {}
+            quar = row.get("quarantined_s")
+            lines.append(
+                f"{wid:>4} {row.get('last_event_age_s', 0.0):>9.1f}s "
+                f"{str(row.get('job') or '-'):>8} "
+                f"{str(row.get('task') if row.get('task') is not None else '-'):>6} "
+                f"{(f'{quar:.0f}s' if quar else '-'):>6}  "
+                f"{m.get('gbps', 0.0):.3f}"
+            )
+    jobs = st.get("jobs") or {}
+    active_jobs = {j: d for j, d in jobs.items()
+                   if d.get("state") in ("running", "queued")}
+    if active_jobs:
+        lines.append("")
+        for jid in sorted(active_jobs):
+            d = active_jobs[jid]
+            prog = ""
+            if "map_total" in d:
+                prog = f"  map {d.get('map_completed', 0)}/{d['map_total']}"
+            lines.append(f"job {jid}: {d.get('state')}{prog}")
+    return "\n".join(lines)
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    """Live fleet console (round 19): poll /status + /metrics across the
+    address list — each address queried directly (single-shot, no retry
+    burn on a dead daemon) so the banner shows the WHOLE fleet's roles,
+    and the body renders the active's view (standby-aware: a parked
+    standby never masks the active the way first-listed-wins would)."""
+    import time as _time
+
+    from distributed_grep_tpu.runtime.http_transport import (
+        client_call,
+        client_text,
+        split_addrs,
+    )
+
+    addrs = split_addrs(args.addr)
+    interval = args.interval if args.interval else env_top_interval_s()
+    try:
+        while True:
+            statuses: dict[str, dict | None] = {}
+            for a in addrs:
+                try:
+                    st = client_call(a, "GET", "/status",
+                                     timeout=args.timeout, retry=False)
+                    statuses[a] = st if isinstance(st, dict) else None
+                except Exception:  # noqa: BLE001 — down/parked/not-ours
+                    statuses[a] = None
+            active_addr = next(
+                (a for a, s in statuses.items()
+                 if s and s.get("service")
+                 and s.get("role", "active") == "active"),
+                None)
+            metrics: dict[str, float] = {}
+            if active_addr is not None:
+                try:
+                    metrics = _parse_metrics_text(client_text(
+                        active_addr, "/metrics", timeout=args.timeout))
+                except Exception:  # noqa: BLE001 — console stays up
+                    pass
+            screen = _render_top(statuses, active_addr, metrics)
+            if args.once:
+                print(screen)
+                return 0 if any(statuses.values()) else 2
+            # redraw in place, top(1)-style
+            sys.stdout.write("\x1b[H\x1b[2J" + screen + "\n")
+            sys.stdout.flush()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 class _GlobFilterAction(argparse.Action):
@@ -1899,15 +2153,41 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser(
+        "top",
+        help="live fleet console: roles, queue/running/workers, cache "
+             "hit ratios, per-worker freshness and quarantine — "
+             "refreshed from /status + /metrics",
+    )
+    p.add_argument("--addr", required=True,
+                   help="daemon http address host:port — or a comma-"
+                        "separated active,standby list: every member is "
+                        "polled, the banner shows each one's role, the "
+                        "body renders the active's view")
+    p.add_argument("--interval", type=float, default=None, metavar="S",
+                   help="refresh cadence (default DGREP_TOP_INTERVAL_S, "
+                        "2 s)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (no screen redraw; "
+                        "exit 2 when no daemon answers)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
         "trace-export",
         help="render a job's events.jsonl span log as Chrome trace JSON "
              "(Perfetto/TensorBoard-loadable)",
     )
     p.add_argument("events",
                    help="path to events.jsonl, or the job work dir "
-                        "containing it")
+                        "containing it (with --fleet: the service WORK "
+                        "ROOT holding daemon.jsonl)")
     p.add_argument("-o", "--out", default="-",
                    help="output file (default: stdout)")
+    p.add_argument("--fleet", action="store_true",
+                   help="render a whole work root: the daemon.jsonl fleet "
+                        "timeline (every incarnation, epoch-ordered, "
+                        "promotion latency as a span) merged with every "
+                        "job's events.jsonl")
     p.set_defaults(fn=cmd_trace_export)
 
     # listed for --help discoverability; the real dispatch (with the

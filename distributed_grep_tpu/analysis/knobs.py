@@ -255,6 +255,21 @@ KNOBS: dict[str, Knob] = {
         "before the lease goes stale (accessor: "
         "runtime/lease.env_lease_renew_s).",
     ),
+    "DGREP_DAEMON_LOG": Knob(
+        "runtime/daemon_log.py", "1",
+        "Daemon lifecycle event log (round 19): serving daemons append "
+        "lease/quarantine/scale/admission/terminal events to "
+        "<work_root>/daemon.jsonl for trace-export --fleet and dgrep "
+        "explain disruptions; 0 is a true no-op — no file, no staged "
+        "list, /status byte-identical (accessor: "
+        "runtime/daemon_log.env_daemon_log).",
+    ),
+    "DGREP_TOP_INTERVAL_S": Knob(
+        "__main__.py", "2",
+        "Refresh cadence of the `dgrep top` live console between "
+        "/status + /metrics polls (accessor: "
+        "__main__.env_top_interval_s).",
+    ),
     "DGREP_INDEX_SUMMARY_BYTES": Knob(
         "index/summary.py", "16384",
         "Per-shard trigram bloom size, rounded down to a power of two in "

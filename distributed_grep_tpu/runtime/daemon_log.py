@@ -1,0 +1,156 @@
+"""Daemon-scope lifecycle event log — the fleet timeline (round 19).
+
+The per-job ``events.jsonl`` records what happened INSIDE a job; nothing
+records what the daemon itself decided — lease steals, promotions,
+quarantine episodes, scale actions, admission 429s, lost-output
+revocations.  ``DaemonLog`` writes those as one JSON line each to
+``<work_root>/daemon.jsonl`` (TaskJournal mechanics: fsync per line,
+torn tail truncated at reopen), shared by every daemon incarnation over
+the same work root — the ``epoch`` field orders incarnations, so
+``trace-export --fleet`` can render a whole failover as one timeline.
+
+Concurrency contract (round-11 rules): event SITES run under the
+service or scheduler locks, so ``stage()`` only appends to a list under
+its own leaf lock (``daemon-log`` — safe under either hot lock, the
+lock graph stays acyclic); ``flush()`` swaps the staged batch and
+writes under the io_ok ``daemon-log-flush`` lock from UNLOCKED call
+sites, re-verifying the round-18 lease write-fence after the swap — a
+deposed daemon's late staged events are DROPPED, never interleaved
+with the promoted daemon's records (same contract as
+``_flush_registry``).
+
+``DGREP_DAEMON_LOG=0`` is a true no-op: the serve paths construct no
+DaemonLog at all (no file, no staged list); the service's hook is a
+None-guarded attribute, exactly like per-job event logs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+from distributed_grep_tpu.runtime.journal import TaskJournal
+from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils.logging import get_logger
+
+log = get_logger("daemon_log")
+
+FILENAME = "daemon.jsonl"
+
+
+def env_daemon_log() -> bool:
+    """DGREP_DAEMON_LOG: daemon lifecycle event log (``daemon.jsonl``)
+    when serving.  Default on; ``0`` disables — no file is created and
+    the event hooks are never installed."""
+    return os.environ.get("DGREP_DAEMON_LOG", "").strip() != "0"
+
+
+class DaemonLog:
+    """Staged-flush journal of daemon lifecycle events.
+
+    Each line: ``{"ts", "epoch", "pid", "role", "kind", "payload"}``
+    (payload elided when empty).  ``epoch``/``role`` come from the
+    attached lease identity (epoch 0 / role "active" for single-daemon
+    deployments) and update in place at promotion/demotion via
+    ``set_identity`` — events carry the identity current at STAGE time.
+    """
+
+    def __init__(self, work_root: str | Path, epoch: int = 0,
+                 role: str = "active"):
+        self.path = Path(work_root) / FILENAME
+        self.pid = os.getpid()
+        self.epoch = int(epoch)
+        self.role = str(role)
+        self._pending: list[dict] = []
+        # Leaf staging lock: stage() is called under the service AND
+        # scheduler locks (service -> daemon-log, scheduler ->
+        # daemon-log are both leaf edges); the io_ok flush lock orders
+        # swap + fsync'ing appends end to end, entered from unlocked
+        # flush contexts only.
+        self._stage_lock = lockdep.make_lock("daemon-log")
+        self._flush_lock = lockdep.make_lock("daemon-log-flush",
+                                             io_ok=True)
+        self._journal = TaskJournal(self.path)
+        self._closed = False
+
+    # ------------------------------------------------------------- identity
+    def set_identity(self, epoch: int, role: str) -> None:
+        """Adopt a lease identity (promotion/demotion).  Events staged
+        after this carry the new (epoch, role)."""
+        self.epoch = int(epoch)
+        self.role = str(role)
+
+    # --------------------------------------------------------------- events
+    def stage(self, kind: str, **payload) -> None:
+        """Stage one event under the leaf lock — callable from under any
+        hot lock (list append only; the fsync happens in flush())."""
+        rec = {"ts": time.time(), "epoch": self.epoch, "pid": self.pid,
+               "role": self.role, "kind": str(kind)}
+        if payload:
+            rec["payload"] = payload
+        with self._stage_lock:
+            self._pending.append(rec)
+
+    def flush(self, gate=None) -> bool:
+        """Write staged events outside the hot locks.  ``gate`` is the
+        service's ``_write_gate()`` answer (None for single-daemon):
+        consulted AFTER the swap — a fenced batch is dropped whole (the
+        gate itself deposes the daemon), never partially interleaved.
+        Never raises: a full disk degrades the timeline, not the
+        control plane."""
+        with self._flush_lock:
+            with self._stage_lock:
+                if not self._pending:
+                    return True
+                pending, self._pending = self._pending, []
+            if gate is not None and not gate():
+                log.warning("daemon log flush fenced: lease lost, %d "
+                            "staged events dropped", len(pending))
+                return False
+            if self._closed:
+                log.warning("daemon log closed: %d staged events dropped",
+                            len(pending))
+                return False
+            for rec in pending:
+                try:
+                    self._journal.record(rec)
+                except Exception:  # noqa: BLE001
+                    log.exception("daemon log append failed")
+        return True
+
+    def append_now(self, kind: str, **payload) -> None:
+        """Stage + flush in one call — for unlocked lifecycle sites
+        (serve start/stop, lease acquire/steal, promotion) where the
+        event should be durable before the next step runs."""
+        self.stage(kind, **payload)
+        self.flush()
+
+    def close(self) -> None:
+        self.flush()
+        with self._flush_lock:
+            if not self._closed:
+                self._closed = True
+                self._journal.close()
+
+    def discard(self) -> None:
+        """Close WITHOUT flushing — the deposed-demotion path: staged
+        events are fenced anyway, and the file handle must not leak
+        across an active→standby→active cycle.  No-op when already
+        closed (the graceful stop path closed via the service)."""
+        with self._flush_lock:
+            with self._stage_lock:
+                self._pending.clear()
+            if not self._closed:
+                self._closed = True
+                self._journal.close()
+
+    # --------------------------------------------------------------- replay
+    @staticmethod
+    def read(work_root: str | Path) -> list[dict]:
+        """All durable events for a work root (torn tail excluded),
+        epoch-then-ts ordered — the ``--fleet`` renderer's and
+        ``dgrep explain``'s input.  Missing file answers []."""
+        events = TaskJournal.replay(Path(work_root) / FILENAME)
+        events.sort(key=lambda r: (r.get("epoch", 0), r.get("ts", 0.0)))
+        return events

@@ -158,6 +158,48 @@ def summarize_events(events: list[dict]) -> dict:
     return out
 
 
+def disruptions_view(daemon_events: list[dict], job_id: str,
+                     submitted_at: float | None = None,
+                     finished_at: float | None = None) -> dict:
+    """Daemon-scope disruptions overlapping one job's lifetime, from the
+    fleet timeline (runtime/daemon_log.py): quarantine episodes, this
+    job's lost-output revocations, daemon restarts and failovers that
+    happened while the job was live.  Nonzero-only — an undisturbed
+    job's report keeps its pre-round-19 shape."""
+    if not daemon_events:
+        return {}
+    lo = submitted_at or 0.0
+    hi = finished_at if finished_at else float("inf")
+    out = {"quarantines": 0, "lost_outputs": 0, "daemon_restarts": 0,
+           "failovers": 0}
+    max_failover = 0.0
+    for r in daemon_events:
+        kind = r.get("kind")
+        payload = r.get("payload") or {}
+        ts = float(r.get("ts", 0.0))
+        if kind == "map_lost_output":
+            # job-tagged: the revocation names its tenant directly
+            if payload.get("job") == job_id:
+                out["lost_outputs"] += 1
+        elif kind == "quarantine":
+            if lo <= ts <= hi:
+                out["quarantines"] += 1
+        elif kind in ("start", "resume"):
+            # strictly after submit: the boot that ADMITTED the job is
+            # not a disruption, a restart mid-job is
+            if lo < ts <= hi:
+                out["daemon_restarts"] += 1
+        elif kind == "promoted":
+            if lo < ts <= hi:
+                out["failovers"] += 1
+                max_failover = max(max_failover,
+                                   float(payload.get("failover_s", 0.0)))
+    view = {k: v for k, v in out.items() if v}
+    if max_failover:
+        view["max_failover_s"] = round(max_failover, 6)
+    return view
+
+
 def _route_verdict(modes: dict[str, dict], device_fallbacks: int) -> str:
     """host / device / mixed / degraded / unknown — the one-word answer.
     ``scan:batch`` rows are EXCLUDED: a packed flush emits one batch span
@@ -190,11 +232,14 @@ def assemble(
     events: list[dict],
     index_shards_pruned: int = 0,
     index_bytes_skipped: int = 0,
+    daemon_events: list[dict] | None = None,
 ) -> dict:
     """One job's routing report.  ``config`` is the JobConfig (only the
     application spec and app options are read); ``metrics_counters`` the
     job Metrics piggyback snapshot; planner-side index tallies come from
-    the JobRecord (they fire at submit, before any worker span)."""
+    the JobRecord (they fire at submit, before any worker span);
+    ``daemon_events`` (the fleet timeline, when the daemon log is on)
+    feeds the nonzero-only ``disruptions`` section."""
     agg = summarize_events(events)
     modes = agg.pop("modes")
     stages = agg.pop("stages")
@@ -222,6 +267,10 @@ def assemble(
     counters = {
         k: v for k, v in sorted((metrics_counters or {}).items()) if v
     }
+    disruptions = disruptions_view(
+        daemon_events or [], job_id,
+        submitted_at=submitted_at, finished_at=finished_at,
+    )
     return {
         "job_id": job_id,
         "state": state,
@@ -232,6 +281,10 @@ def assemble(
         "stages": stages,
         "tasks": tasks,
         "metrics": counters,
+        # daemon-scope disruptions that overlapped this job (quarantine,
+        # lost outputs, restarts/failovers) — nonzero-only, so a quiet
+        # job's report keeps its pre-round-19 shape
+        **({"disruptions": disruptions} if disruptions else {}),
         # spans off = a skeleton report; say so instead of reading empty
         "spans": bool(events),
     }

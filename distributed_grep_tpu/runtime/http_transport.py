@@ -512,6 +512,30 @@ def client_call(addr: str, method: str, path: str,
     )
 
 
+def client_text(addr: str, path: str, timeout: float = 30.0) -> str:
+    """client_call's text-body sibling for non-JSON GET surfaces —
+    ``/metrics`` Prometheus exposition above all (``dgrep top`` scrapes
+    it).  Same bounded-jittered retry loop, same address-list rotation
+    (transient failures AND the standby's 503 park answer), utf-8
+    decoded body returned verbatim."""
+    bases = _normalize_bases(addr)
+    state = {"i": 0}
+
+    def build():
+        return urllib.request.Request(
+            f"{bases[state['i']]}{path}", method="GET"
+        )
+
+    def rotate():
+        state["i"] = (state["i"] + 1) % len(bases)
+
+    return _open_with_retries(
+        build, timeout, f"GET {addr}{path}", on_retry=rotate,
+        deadline=time.monotonic() + timeout,
+        rotate_on_503=len(bases) > 1,
+    ).decode("utf-8", "replace")
+
+
 class ServiceHttpTransport(HttpTransport):
     """HttpTransport against the service daemon (runtime/service.py): the
     control plane is identical, but the data plane is scoped per job —

@@ -120,6 +120,20 @@ class WorkerHealth:
         self._touched: dict[int, float] = {}  # recency (prune order)
         self._polls: dict[int, float] = {}  # last assign poll per worker
         self.quarantined_total = 0  # counter: episodes ever entered
+        # Optional fleet-timeline hook (round 19): the service points it
+        # at DaemonLog staging so quarantine enter/expire/clear land on
+        # daemon.jsonl exactly once per episode even with K per-job
+        # schedulers sharing this tracker.  Called OUTSIDE self._lock
+        # (the callback takes its own leaf lock); never raises upward.
+        self.on_event = None
+
+    def _emit(self, kind: str, **payload) -> None:
+        cb = self.on_event
+        if cb is not None:
+            try:
+                cb(kind, **payload)
+            except Exception:  # noqa: BLE001 — telemetry, never fatal
+                log.exception("worker-health event hook failed")
 
     def _prune_locked(self, now: float) -> None:
         if len(self._touched) <= self.MAX_TRACKED:
@@ -156,6 +170,7 @@ class WorkerHealth:
         if worker_id < 0:
             return
         with self._lock:
+            had_episode = worker_id in self._episodes
             # drop the WHOLE record, _polls included: _prune_locked only
             # walks _touched, so an entry left in any sibling dict here
             # would leak for the daemon's lifetime
@@ -164,6 +179,8 @@ class WorkerHealth:
             self._until.pop(worker_id, None)
             self._touched.pop(worker_id, None)
             self._polls.pop(worker_id, None)
+        if had_episode:
+            self._emit("quarantine_clear", worker=worker_id)
 
     def record_failure(self, worker_id: int) -> float:
         """Register an attributed failure; returns the quarantine window
@@ -171,6 +188,7 @@ class WorkerHealth:
         probation."""
         if worker_id < 0:
             return 0.0
+        episode = 0
         with self._lock:
             now = time.monotonic()
             self._touched[worker_id] = now
@@ -183,13 +201,16 @@ class WorkerHealth:
                 return 0.0
             ep = self._episodes.get(worker_id, 0) + 1
             self._episodes[worker_id] = ep
+            episode = ep
             window = self.base_s * min(2 ** (ep - 1), _QUARANTINE_MAX_FACTOR)
             self._until[worker_id] = now + window
             # re-probation: one step below the threshold, so the next
             # failure after expiry re-quarantines immediately
             self._fails[worker_id] = QUARANTINE_AFTER_FAILURES - 1
             self.quarantined_total += 1
-            return window
+        self._emit("quarantine", worker=worker_id, episode=episode,
+                   window_s=round(window, 3))
+        return window
 
     def quarantine_remaining(self, worker_id: int) -> float:
         """Seconds of quarantine left for this worker (0.0 = assignable)."""
@@ -198,10 +219,11 @@ class WorkerHealth:
             if until is None:
                 return 0.0
             rem = until - time.monotonic()
-            if rem <= 0:
-                del self._until[worker_id]  # expired: re-probation
-                return 0.0
-            return rem
+            if rem > 0:
+                return rem
+            del self._until[worker_id]  # expired: re-probation
+        self._emit("quarantine_expire", worker=worker_id)
+        return 0.0
 
     def snapshot(self) -> dict:
         """Status view: active quarantines + the episode counter."""
@@ -260,6 +282,7 @@ class Scheduler:
         on_change: Optional[Any] = None,
         worker_health: Optional[WorkerHealth] = None,
         journal_gate: Optional[Any] = None,
+        daemon_events: Optional[Any] = None,
     ):
         self.n_reduce = n_reduce
         self.task_timeout_s = task_timeout_s
@@ -317,6 +340,12 @@ class Scheduler:
         # None (single-daemon, one-shot coordinators) skips the check
         # entirely.
         self.journal_gate = journal_gate
+        # Fleet-timeline hook (round 19, runtime/daemon_log.py): a
+        # callable(kind, **payload) the service points at DaemonLog
+        # staging, called for daemon-consequential decisions (lost-output
+        # revocations) — leaf-lock list append, safe under self._lock.
+        # None (one-shot coordinators) costs nothing.
+        self.daemon_events = daemon_events
         # (kind, task_id) pairs already journaled (staged or replayed):
         # a map task RE-COMPLETED after a lost-output re-execution (peer
         # shuffle, round 16) must not append a second map_done line —
@@ -1143,8 +1172,16 @@ class Scheduler:
         self.metrics.inc("map_retries")
         self.metrics.inc("tasks_requeued")
         _C_REQUEUED.inc()
+        # SLO counter (round 19): created lazily at this event site so
+        # deployments that never lose an output never render the series
+        metrics_mod.counter("dgrep_maps_lost_output_total").inc()
         self._event("map_lost_output", task=tid, file=name,
                     producer=producer, reporter=args.worker_id)
+        if self.daemon_events is not None:
+            # lost-output revocation is a daemon-consequential decision:
+            # put it on the fleet timeline too (leaf-lock stage)
+            self.daemon_events("map_lost_output", task=tid,
+                               producer=producer)
         # the producer demonstrably held committed state and vanished —
         # the direct analogue of the sweeper's attributed timeout
         # (WorkerHealth is a leaf lock, safe here like in the sweeper)

@@ -48,6 +48,7 @@ from dataclasses import replace as _dc_replace
 from http.server import ThreadingHTTPServer
 from pathlib import Path
 
+from distributed_grep_tpu.runtime import daemon_log as daemon_log_mod
 from distributed_grep_tpu.runtime import fusion as fusion_mod
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.http_coordinator import (
@@ -418,6 +419,7 @@ class GrepService:
         rpc_timeout_s: float = 60.0,
         resume: bool | None = None,
         lease=None,
+        daemon_log=None,
     ):
         self.work_root = Path(work_root)
         self.work_root.mkdir(parents=True, exist_ok=True)
@@ -450,6 +452,15 @@ class GrepService:
         self._deposed = False
         self.deposed_event = threading.Event()
         self._last_worker_snapshot: dict[str, dict] | None = None
+        # Daemon lifecycle event log (round 19, runtime/daemon_log.py):
+        # None (DGREP_DAEMON_LOG=0, or in-process embedding) is a true
+        # no-op — every event site is None-guarded, no staged list
+        # exists.  Event sites under the service/scheduler locks only
+        # stage() (leaf-lock list append); _flush_daemon_log runs next
+        # to the other post-release flushes, through the lease write
+        # fence.
+        self._daemon_log = daemon_log
+        self._last_scale_advice: str | None = None
 
         self._lock = lockdep.make_lock("service")
         self._cond = threading.Condition(self._lock)
@@ -509,6 +520,12 @@ class GrepService:
         # worker identity, so a worker going dark under job A must stop
         # receiving job B's tasks too.
         self._health = WorkerHealth()
+        if self._daemon_log is not None:
+            # quarantine enter/expire/clear land on the fleet timeline
+            # exactly once per episode (the tracker is shared by every
+            # job's scheduler); staging only — flushed at the service's
+            # post-release flush points
+            self._health.on_event = self._daemon_event
 
         # Cross-tenant fusion planning counters (GET /status "fusion"):
         # fused_jobs = participant tasks served by shared attempts,
@@ -578,6 +595,15 @@ class GrepService:
         # matter what: even a resume-disabled restart must never mint an
         # id whose work dir an earlier incarnation owns
         self._ids = itertools.count(id_floor)
+        if self._daemon_log is not None:
+            # fleet timeline: one "start" line per daemon incarnation
+            # (the resume line with replay counts follows when the
+            # registry held live jobs)
+            self._daemon_event(
+                "start", work_root=str(self.work_root),
+                max_jobs=self.max_jobs, queue_depth=self.queue_depth,
+            )
+            self._flush_daemon_log()
         if env_service_resume() if resume is None else resume:
             self._resume_replayed(replayed)
 
@@ -688,6 +714,11 @@ class GrepService:
         self._flush_starts()
         self._flush_registry()
         if self._jobs:
+            self._daemon_event(
+                "resume", jobs=len(self._jobs),
+                running=len(self._running), queued=len(self._queue),
+            )
+            self._flush_daemon_log()
             log.info(
                 "service resume: %d jobs from registry (%d running, %d "
                 "queued)", len(self._jobs), len(self._running),
@@ -735,6 +766,7 @@ class GrepService:
             on_change=self._wake,
             worker_health=self._health,
             journal_gate=self._write_gate(),
+            daemon_events=self._job_daemon_events(rec.job_id),
         )
         rec.state = JobState.RUNNING
         rec.started_at = time.time()
@@ -761,6 +793,14 @@ class GrepService:
         self._registry_pending.append(
             (rec.job_id, rec.state, rec.error, outputs)
         )
+        if rec.state in _TERMINAL:
+            # every terminal transition — done, failed, cancelled, the
+            # enqueue-recheck 429, stop()'s mass-cancel — lands on the
+            # fleet timeline through this one staging point
+            self._daemon_event(
+                "job_terminal", job=rec.job_id, state=rec.state,
+                **({"error": rec.error} if rec.error else {}),
+            )
 
     def _flush_registry(self) -> None:
         """Write staged registry records outside the service lock.  The
@@ -795,6 +835,35 @@ class GrepService:
                     log.exception("registry append failed for job %s",
                                   job_id)
 
+    def _daemon_event(self, kind: str, **payload) -> None:
+        """Stage one fleet-timeline event (runtime/daemon_log.py).  Leaf-
+        lock list append only — safe under the service lock; written by
+        `_flush_daemon_log` after release.  No-op when the log is off."""
+        dl = self._daemon_log
+        if dl is not None:
+            dl.stage(kind, **payload)
+
+    def _job_daemon_events(self, job_id: str):
+        """The per-job Scheduler's fleet-timeline hook: stage with the
+        job tag folded in, or None when the daemon log is off (the
+        scheduler then skips the call entirely)."""
+        if self._daemon_log is None:
+            return None
+
+        def stage(kind: str, **payload) -> None:
+            self._daemon_event(kind, job=job_id, **payload)
+
+        return stage
+
+    def _flush_daemon_log(self) -> None:
+        """Write staged daemon events outside the service lock, through
+        the round-18 lease write fence (a deposed daemon's late events
+        are dropped whole, never interleaved with the promoted
+        daemon's)."""
+        dl = self._daemon_log
+        if dl is not None:
+            dl.flush(self._write_gate())
+
     # ------------------------------------------------------------- HA lease
     def _lease_ok(self) -> bool:
         """The daemon-scope write fence: no lease (single-daemon) is
@@ -821,6 +890,10 @@ class GrepService:
             self._cond.notify_all()
         log.warning("daemon deposed: durable writes fenced, admission "
                     "closed (work root %s)", self.work_root)
+        # Staged for completeness; the write fence DROPS it (a deposed
+        # daemon's late events never interleave) — the thief's
+        # lease_steal line is the durable record of this transition.
+        self._daemon_event("lease_lost")
         self.deposed_event.set()
 
     def _write_gate(self):
@@ -916,8 +989,10 @@ class GrepService:
         # other submits past the cap.
         try:
             self._check_admission_locked_or_raise()
-        except AdmissionError:
+        except AdmissionError as e:
             _C_REJECTED.inc()
+            self._daemon_event("admission_reject", reason=str(e))
+            self._flush_daemon_log()
             raise
         if getattr(config, "follow", False):
             # Standing query (round 17): no map/reduce planning, no
@@ -1013,6 +1088,9 @@ class GrepService:
                 if token:
                     self._tokens.pop(token, None)
             _C_REJECTED.inc()
+            self._daemon_event("admission_reject", job=job_id,
+                               reason=f"cannot register job: {e}")
+            self._flush_daemon_log()
             raise AdmissionError(f"cannot register job: {e}") from e
         rejected: AdmissionError | None = None
         with self._cond:
@@ -1031,6 +1109,8 @@ class GrepService:
                 rec.finished_at = time.time()
                 self._jobs[job_id] = rec
                 self._stage_state(rec)
+                self._daemon_event("admission_reject", job=job_id,
+                                   reason=rec.error)
                 self._prune_terminal_locked()
             else:
                 self._jobs[job_id] = rec
@@ -1039,6 +1119,7 @@ class GrepService:
             self._cond.notify_all()
         self._flush_starts()
         self._flush_registry()
+        self._flush_daemon_log()
         if rejected is not None:
             _C_REJECTED.inc()
             raise rejected
@@ -1139,6 +1220,7 @@ class GrepService:
             on_change=self._wake,
             worker_health=self._health,
             journal_gate=self._write_gate(),
+            daemon_events=self._job_daemon_events(rec.job_id),
         )
         return workdir, journal, event_log, metrics, scheduler
 
@@ -1316,6 +1398,7 @@ class GrepService:
         self._flush_starts()
         self._flush_closes()
         self._flush_registry()
+        self._flush_daemon_log()
 
     def _watch_job(self, rec: JobRecord) -> None:
         """Per-running-job completion watcher: finalize when the job's
@@ -1356,6 +1439,7 @@ class GrepService:
         self._flush_starts()
         self._flush_closes()
         self._flush_registry()
+        self._flush_daemon_log()
         log.info(
             "job %s done in %.3fs (%d outputs)", rec.job_id,
             rec.finished_at - (rec.started_at or rec.finished_at),
@@ -1458,6 +1542,7 @@ class GrepService:
         self._flush_starts()
         self._flush_closes()
         self._flush_registry()
+        self._flush_daemon_log()
         log.info("job %s cancelled", job_id)
         return rec.state
 
@@ -1566,6 +1651,7 @@ class GrepService:
                 self.workers[worker_id] = {
                     "job": None, "task": None, "seen": time.monotonic(),
                 }
+                self._daemon_event("worker_attach", worker=worker_id)
                 # an attach is the natural moment to drop rows (and
                 # dedup sets) of workers long gone — attached-but-idle
                 # workers refresh their row every long-poll retry, so
@@ -1577,6 +1663,7 @@ class GrepService:
                 ]
                 for wid in stale:
                     del self.workers[wid]
+                    self._daemon_event("worker_expire", worker=wid)
                 if stale:
                     with self._span_seq_lock:
                         for wid in stale:
@@ -1989,6 +2076,11 @@ class GrepService:
         }
         if dropped:
             out["dropped"] = dropped
+            # stream-ring shed: the subscriber fell behind the bounded
+            # buffer — a fleet-timeline event (no lock held here, so
+            # stage + flush directly)
+            self._daemon_event("stream_shed", job=job_id, dropped=dropped)
+            self._flush_daemon_log()
         return out
 
     def job_result(self, job_id: str) -> dict:
@@ -2079,6 +2171,11 @@ class GrepService:
             for wid, info in sorted(self.workers.items()):
                 row: dict = {
                     "last_heartbeat_age_s": round(now - info["seen"], 3),
+                    # the freshness signal scale_advice gates capacity on
+                    # (_SCALE_FRESH_S compares this same age) — exposed
+                    # so `dgrep top` and operators read what the advisor
+                    # reads instead of inferring it
+                    "last_event_age_s": round(now - info["seen"], 3),
                     "job": info.get("job"),
                     "task": info.get("task"),
                 }
@@ -2303,6 +2400,13 @@ class GrepService:
         metrics_mod.gauge("dgrep_corpus_cache_hit_ratio").set(_ratio(
             w.get("corpus_cache_hits", 0.0),
             w.get("corpus_cache_misses", 0.0)))
+
+        if self._lease is not None:
+            # HA role SLO gauge (round 19): touched only when a lease is
+            # attached, so non-HA daemons keep the round-15 golden
+            # exposition bytes (same contract as the follow gauges)
+            metrics_mod.gauge("dgrep_daemon_role").set(
+                0 if self._deposed else 1)
         return metrics_mod.render_prometheus()
 
     # ----------------------------------------------------------- explain
@@ -2320,6 +2424,12 @@ class GrepService:
             path = workdir.root / spans_mod.EventLog.FILENAME
             if path.exists():
                 events = spans_mod.EventLog.read(path)
+        daemon_events = None
+        if self._daemon_log is not None:
+            # Fresh view for still-running jobs: drain staged lifecycle
+            # events first (unlocked site), then read the durable file.
+            self._flush_daemon_log()
+            daemon_events = daemon_log_mod.DaemonLog.read(self.work_root)
         return explain_mod.assemble(
             job_id=rec.job_id,
             config=rec.config,
@@ -2331,6 +2441,7 @@ class GrepService:
             events=events,
             index_shards_pruned=rec.index_shards_pruned,
             index_bytes_skipped=rec.index_bytes_skipped,
+            daemon_events=daemon_events,
         )
 
     # --------------------------------------------------- elastic scale
@@ -2395,6 +2506,15 @@ class GrepService:
         }
         if reason:
             out["reason"] = reason
+        if advice != self._last_scale_advice:
+            # verdict CHANGES only — /status polls this every scrape and
+            # a steady-state "hold" per poll would flood the timeline
+            self._last_scale_advice = advice
+            self._daemon_event(
+                "scale_advice", advice=advice, pending_tasks=pending,
+                workers=workers, **({"reason": reason} if reason else {}),
+            )
+            self._flush_daemon_log()
         return out
 
     def local_pool_size(self) -> int:
@@ -2416,13 +2536,23 @@ class GrepService:
                  if not lp.drain.is_set()]
         if target > len(loops):
             self.start_local_workers(target - len(loops))
+            self._scale_action("grow", target - len(loops))
             return target - len(loops)
         if target < len(loops):
             for lp in loops[target:]:
                 lp.drain.set()
             self._wake()  # long-polling drainees re-check at next wake
+            self._scale_action("drain", len(loops) - target)
             return target - len(loops)
         return 0
+
+    def _scale_action(self, action: str, n: int) -> None:
+        """One applied elastic-pool action: SLO counter (created lazily —
+        an inelastic daemon never renders the series) + fleet-timeline
+        event.  Runs unlocked (scale_local_pool call sites)."""
+        metrics_mod.counter("dgrep_scale_actions_total").inc()
+        self._daemon_event("scale_action", action=action, workers=n)
+        self._flush_daemon_log()
 
     def _prune_local_pool(self) -> None:
         """Drop local pool entries whose loop drained AND whose thread
@@ -2512,6 +2642,13 @@ class GrepService:
         self._flush_starts()  # drains (and tears down) cancelled pendings
         self._flush_closes()
         self._flush_registry()
+        if self._daemon_log is not None:
+            # graceful stop is a timeline event; a deposed daemon's stop
+            # is fenced at flush (the promoted daemon owns the file now)
+            self._daemon_event("stop")
+            self._flush_daemon_log()
+            if self._lease_ok():
+                self._daemon_log.close()
         for t in getattr(self, "_local_workers", []):
             t.join(timeout=join_timeout_s)
         self._registry.close()

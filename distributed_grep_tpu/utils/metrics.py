@@ -239,6 +239,18 @@ SERIES: dict[str, tuple[str, str]] = {
         "gauge", "Appended bytes suffix-scanned by standing queries."),
     "dgrep_stream_dropped_records": (
         "gauge", "Stream records shed oldest-first by bounded buffers."),
+    # fleet timeline / HA SLOs (round 19): created LAZILY at their event
+    # sites (string-constant names — the metrics-registry rule reads
+    # them lexically), so non-HA deployments never render them and the
+    # round-15 golden /metrics bytes hold
+    "dgrep_daemon_failover_seconds": (
+        "histogram", "Lease-stale detection to promoted-and-serving wall."),
+    "dgrep_daemon_role": (
+        "gauge", "Lease role of this daemon: 1 active, 0 deposed."),
+    "dgrep_scale_actions_total": (
+        "counter", "Elastic pool grow/drain actions applied."),
+    "dgrep_maps_lost_output_total": (
+        "counter", "Map tasks revoked after a lost peer shuffle output."),
 }
 
 

@@ -389,3 +389,83 @@ def export_chrome_trace(events: list[dict]) -> dict:
             ev["s"] = "t"
         out.append(ev)
     return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_fleet_trace(daemon_events: list[dict],
+                       jobs: dict[str, list[dict]] | None = None) -> dict:
+    """Render a whole work root — the daemon.jsonl fleet timeline
+    (runtime/daemon_log.py) merged with every job's events.jsonl — as one
+    Chrome trace (``trace-export --fleet``).
+
+    Layout: pid 1 is the daemon fleet (sorted ABOVE the jobs), one row
+    per lease epoch (epoch 0 = single-daemon) carrying the incarnation's
+    lifetime as a span, its lifecycle events as instants, and — when a
+    steal/acquire is followed by a ``promoted`` event — a synthesized
+    ``promotion`` span whose width IS the failover latency.  Each job is
+    its own process (pids 2+), rendered by export_chrome_trace
+    unchanged, so a chaos SIGKILL-failover run reads top-to-bottom:
+    which daemon served when, and what every job's workers were doing
+    through the transition."""
+    out: list[dict] = []
+    pid = 1
+    out.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                "args": {"name": "dgrep daemon fleet"}})
+    out.append({"ph": "M", "pid": pid, "tid": 0,
+                "name": "process_sort_index", "args": {"sort_index": 0}})
+    by_epoch: dict[int, list[dict]] = {}
+    for r in daemon_events:
+        by_epoch.setdefault(int(r.get("epoch", 0)), []).append(r)
+    for tid, epoch in enumerate(sorted(by_epoch)):
+        recs = sorted(by_epoch[epoch], key=lambda r: r.get("ts", 0.0))
+        pids = sorted({r["pid"] for r in recs if r.get("pid") is not None})
+        label = f"daemon epoch {epoch}"
+        if pids:
+            label += f" (pid {pids[0]})"
+        out.append({"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                    "args": {"name": label}})
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_sort_index", "args": {"sort_index": tid}})
+        stamps = [float(r["ts"]) for r in recs if "ts" in r]
+        if stamps:
+            # the incarnation's observed lifetime (first to last event)
+            out.append({
+                "name": f"lease epoch {epoch}", "cat": "lease", "ph": "X",
+                "pid": pid, "tid": tid, "ts": min(stamps) * 1e6,
+                "dur": max(0.0, max(stamps) - min(stamps)) * 1e6,
+                "args": {"epoch": epoch},
+            })
+        steal_ts: float | None = None
+        for r in recs:
+            kind = str(r.get("kind", "?"))
+            ts = float(r.get("ts", 0.0))
+            args: dict = {"role": r.get("role"), "pid": r.get("pid")}
+            args.update(r.get("payload") or {})
+            if kind in ("lease_steal", "lease_acquire"):
+                steal_ts = ts
+            elif kind == "promoted" and steal_ts is not None:
+                # promotion latency: stale-lease detection (the steal)
+                # to serving — the gap the failover SLO histogram samples
+                out.append({
+                    "name": "promotion", "cat": "lease", "ph": "X",
+                    "pid": pid, "tid": tid, "ts": steal_ts * 1e6,
+                    "dur": max(0.0, ts - steal_ts) * 1e6,
+                    "args": dict(args),
+                })
+                steal_ts = None
+            out.append({"name": kind, "cat": "daemon", "ph": "i", "s": "t",
+                        "pid": pid, "tid": tid, "ts": ts * 1e6,
+                        "args": args})
+    job_pid = 2
+    for job_id in sorted(jobs or {}):
+        doc = export_chrome_trace(jobs[job_id])
+        out.append({"ph": "M", "pid": job_pid, "tid": 0,
+                    "name": "process_sort_index",
+                    "args": {"sort_index": job_pid}})
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = job_pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev["args"] = {"name": f"dgrep job {job_id}"}
+            out.append(ev)
+        job_pid += 1
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

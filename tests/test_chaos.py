@@ -37,6 +37,7 @@ from pathlib import Path
 import pytest
 
 import service_proc
+from distributed_grep_tpu.runtime.daemon_log import DaemonLog
 from distributed_grep_tpu.runtime.fault_transport import (
     FaultPoint,
     FaultTransport,
@@ -1097,6 +1098,16 @@ def test_chaos_failover_sigkill_active_with_standby(tmp_path, monkeypatch,
     entries = TaskJournal.replay(WorkDir(str(work_root / jid)).journal_path())
     seen = [(e["kind"], e["task_id"]) for e in entries]
     assert len(seen) == len(set(seen)), seen
+    # round 19: the fleet timeline records exactly one steal+promotion
+    # pair across both daemon lives — one failover happened, once (the
+    # revived old active DEMOTED instead of stealing a third epoch)
+    dl_events = DaemonLog.read(work_root)
+    steals = [e for e in dl_events if e["kind"] == "lease_steal"]
+    promotions = [e for e in dl_events if e["kind"] == "promoted"]
+    assert len(steals) == 1 and len(promotions) == 1, \
+        [(e["epoch"], e["kind"]) for e in dl_events]
+    assert steals[0]["epoch"] == promotions[0]["epoch"]
+    assert promotions[0]["payload"]["failover_s"] > 0
 
 
 def test_chaos_failover_sigkill_active_mid_stream(tmp_path, monkeypatch):

@@ -1,0 +1,284 @@
+"""Fleet timeline (round 19, runtime/daemon_log.py + trace-export
+--fleet + dgrep explain disruptions).
+
+* ``DaemonLog`` mechanics — staged-flush roundtrip, the round-18 write
+  fence DROPPING a deposed daemon's staged batch with the file bytes
+  provably unchanged, torn-tail truncation at reopen, ``discard()``;
+* the ``DGREP_DAEMON_LOG=0`` no-op pin — a log-free service writes no
+  daemon.jsonl and keeps its /status shape;
+* an in-process service lifecycle run — start / worker_attach /
+  job_terminal / stop land on the timeline, and /status worker rows
+  carry ``last_event_age_s`` (the freshness signal ``dgrep top`` and
+  the scale advisor now share);
+* the ``--fleet`` Chrome-trace golden over a synthetic two-incarnation
+  work root — epoch-ordered daemon rows, the promotion-latency span,
+  job events merged as their own process;
+* ``disruptions_view`` windowing for ``dgrep explain``.
+
+The subprocess SIGKILL-failover daemon.jsonl assertion lives in
+tests/test_chaos.py.  Standalone: ``python -m pytest
+tests/test_daemon_log.py -q`` (marker ``obs``).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.runtime.daemon_log import (
+    FILENAME,
+    DaemonLog,
+    env_daemon_log,
+)
+from distributed_grep_tpu.runtime.explain import disruptions_view
+from distributed_grep_tpu.runtime.service import GrepService
+from distributed_grep_tpu.utils.config import JobConfig
+from distributed_grep_tpu.utils.spans import export_fleet_trace
+
+pytestmark = pytest.mark.obs
+
+
+# ------------------------------------------------------------------ knob
+
+def test_env_knob_parser(monkeypatch):
+    monkeypatch.delenv("DGREP_DAEMON_LOG", raising=False)
+    assert env_daemon_log() is True
+    monkeypatch.setenv("DGREP_DAEMON_LOG", "0")
+    assert env_daemon_log() is False
+    monkeypatch.setenv("DGREP_DAEMON_LOG", "1")
+    assert env_daemon_log() is True
+
+
+# ------------------------------------------------------------- mechanics
+
+def test_stage_flush_roundtrip_and_epoch_ordering(tmp_path):
+    d1 = DaemonLog(tmp_path, epoch=1, role="active")
+    d1.append_now("lease_acquire", addr="a:1")
+    d1.stage("start", work_root=str(tmp_path))
+    d1.stage("job_terminal", job="job-000001", state="done")
+    assert d1.flush() is True
+    d1.close()
+    d2 = DaemonLog(tmp_path, epoch=2, role="active")
+    d2.append_now("lease_steal", addr="a:2", prev_epoch=1)
+    d2.close()
+    events = DaemonLog.read(tmp_path)
+    assert [(e["epoch"], e["kind"]) for e in events] == [
+        (1, "lease_acquire"), (1, "start"), (1, "job_terminal"),
+        (2, "lease_steal"),
+    ]
+    # identity stamped per record; payload elided when empty
+    assert all(e["pid"] and e["role"] == "active" for e in events)
+    assert events[2]["payload"] == {"job": "job-000001", "state": "done"}
+
+
+def test_fence_drops_staged_batch_bytes_unchanged(tmp_path):
+    """The tentpole fence pin: a deposed daemon's staged events are
+    dropped WHOLE — the durable file never sees a stale interleave."""
+    d = DaemonLog(tmp_path, epoch=1, role="active")
+    d.append_now("start")
+    before = (tmp_path / FILENAME).read_bytes()
+    d.stage("lease_lost")
+    d.stage("stop")
+    assert d.flush(gate=lambda: False) is False
+    assert (tmp_path / FILENAME).read_bytes() == before
+    # the fenced batch is GONE, not re-staged: a later un-fenced flush
+    # must not resurrect it
+    assert d.flush() is True
+    assert (tmp_path / FILENAME).read_bytes() == before
+    d.close()
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    d = DaemonLog(tmp_path, epoch=1)
+    d.append_now("start")
+    d.close()
+    path = tmp_path / FILENAME
+    good = path.read_bytes()
+    with path.open("ab") as f:
+        f.write(b'{"ts": 1.0, "epoch": 1, "kind": "sto')  # torn mid-write
+    assert DaemonLog.read(tmp_path) == [json.loads(good)]
+    # reopen truncates the torn tail, then appends cleanly after it
+    d2 = DaemonLog(tmp_path, epoch=2)
+    d2.append_now("lease_steal", prev_epoch=1)
+    d2.close()
+    kinds = [e["kind"] for e in DaemonLog.read(tmp_path)]
+    assert kinds == ["start", "lease_steal"]
+
+
+def test_discard_drops_staged_without_flush(tmp_path):
+    d = DaemonLog(tmp_path, epoch=1)
+    d.append_now("start")
+    before = (tmp_path / FILENAME).read_bytes()
+    d.stage("lease_lost")
+    d.discard()
+    assert (tmp_path / FILENAME).read_bytes() == before
+    d.discard()  # idempotent (graceful-close-then-demote path)
+
+
+def test_read_missing_file_answers_empty(tmp_path):
+    assert DaemonLog.read(tmp_path) == []
+
+
+# --------------------------------------------------- service lifecycle
+
+def _tiny_cfg(tmp_path: Path, **kw) -> JobConfig:
+    p = tmp_path / "in.txt"
+    if not p.exists():
+        p.write_text("hello\nmiss\n")
+    return JobConfig(
+        input_files=[str(p)],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options={"pattern": "hello", "backend": "cpu"},
+        n_reduce=1,
+        **kw,
+    )
+
+
+def test_service_lifecycle_lands_on_timeline(tmp_path):
+    root = tmp_path / "svc"
+    svc = GrepService(work_root=root, daemon_log=DaemonLog(root),
+                      task_timeout_s=5.0, sweep_interval_s=0.1)
+    try:
+        jid = svc.submit(_tiny_cfg(tmp_path))
+        svc.start_local_workers(1)
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+        # the /status small fix: worker rows expose last_event_age_s
+        # (dgrep top and the scale advisor read the same freshness)
+        rows = svc.status()["workers"]
+        assert rows and all("last_event_age_s" in r for r in rows.values())
+        # a quiet job's explain report has NO disruptions key
+        assert "disruptions" not in svc.job_explain(jid)
+        # a job-tagged disruption lands in the report (the explain
+        # satellite's daemon.jsonl sourcing, through the live daemon)
+        svc._daemon_log.append_now("map_lost_output", job=jid, task=0)
+        assert svc.job_explain(jid)["disruptions"] == {"lost_outputs": 1}
+    finally:
+        svc.stop()
+    events = DaemonLog.read(root)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "start"
+    assert "worker_attach" in kinds
+    assert kinds[-1] == "stop"  # graceful stop is the LAST durable line
+    terminal = [e for e in events if e["kind"] == "job_terminal"]
+    assert [(e["payload"]["job"], e["payload"]["state"])
+            for e in terminal] == [(jid, "done")]
+
+
+def test_daemon_log_off_is_true_noop(tmp_path):
+    """No DaemonLog attached = no daemon.jsonl, same /status keys —
+    what DGREP_DAEMON_LOG=0 means (the serve path constructs None)."""
+    root = tmp_path / "svc"
+    svc = GrepService(work_root=root, task_timeout_s=5.0,
+                      sweep_interval_s=0.1)
+    try:
+        jid = svc.submit(_tiny_cfg(tmp_path))
+        svc.start_local_workers(1)
+        assert svc.wait_job(jid, timeout=60), svc.job_status(jid)
+        assert "disruptions" not in svc.job_explain(jid)
+        assert "daemon" not in svc.status()
+    finally:
+        svc.stop()
+    assert not (root / FILENAME).exists()
+
+
+# ------------------------------------------------------- fleet trace
+
+def _two_incarnation_root(tmp_path: Path) -> Path:
+    """Synthetic failover: epoch 1 serves and dies (no stop line),
+    epoch 2 parks, steals, promotes, serves a job, stops."""
+    d1 = DaemonLog(tmp_path, epoch=1, role="active")
+    d1.append_now("lease_acquire", addr="h:1")
+    d1.stage("start", work_root=str(tmp_path))
+    d1.flush()
+    d1.close()
+    d2 = DaemonLog(tmp_path, epoch=2, role="active")
+    d2.stage("standby_park", parked_s=1.5)
+    d2.append_now("lease_steal", addr="h:2", prev_epoch=1)
+    d2.append_now("promoted", addr="h:2", failover_s=2.25,
+                  running=1, queued=0)
+    d2.stage("job_terminal", job="job-000001", state="done")
+    d2.append_now("stop")
+    d2.close()
+    return tmp_path
+
+
+def test_fleet_trace_two_incarnations_golden(tmp_path):
+    root = _two_incarnation_root(tmp_path)
+    job_events = [
+        {"t": "span", "name": "map:compute", "ts": 10.0, "dur": 0.5,
+         "worker": 0, "args": {}},
+    ]
+    doc = export_fleet_trace(DaemonLog.read(root),
+                             jobs={"job-000001": job_events})
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    # daemon fleet is pid 1 and sorts ABOVE the job processes
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert pnames[1] == "dgrep daemon fleet"
+    assert pnames[2] == "dgrep job job-000001"
+    sort_idx = {e["pid"]: e["args"]["sort_index"] for e in evs
+                if e["ph"] == "M" and e["name"] == "process_sort_index"}
+    assert sort_idx[1] < sort_idx[2]
+    # one daemon row per epoch, epoch-ordered top to bottom
+    tnames = {e["tid"]: e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "thread_name"
+              and e["pid"] == 1}
+    assert [n for _, n in sorted(tnames.items())] == sorted(tnames.values())
+    assert any(n.startswith("daemon epoch 1") for n in tnames.values())
+    assert any(n.startswith("daemon epoch 2") for n in tnames.values())
+    # lease epochs render as spans; the promotion latency is a span
+    # from the steal to the promoted event on epoch 2's row
+    spans = {e["name"]: e for e in evs if e["ph"] == "X" and e["pid"] == 1}
+    assert "lease epoch 1" in spans and "lease epoch 2" in spans
+    promo = spans["promotion"]
+    assert promo["args"]["failover_s"] == 2.25
+    steal_ts = next(e["ts"] for e in evs if e["ph"] == "i"
+                    and e["name"] == "lease_steal")
+    assert promo["ts"] == steal_ts and promo["dur"] > 0
+    # every daemon event lands as an instant on its epoch's row
+    instants = [e["name"] for e in evs if e["ph"] == "i" and e["pid"] == 1]
+    assert {"lease_acquire", "start", "standby_park", "lease_steal",
+            "promoted", "job_terminal", "stop"} <= set(instants)
+    # the job's own events merged under its pid
+    assert any(e["ph"] == "X" and e["pid"] == 2
+               and e["name"] == "map:compute" for e in evs)
+    json.dumps(doc)  # whole doc stays JSON-serializable
+
+
+# ------------------------------------------------- explain disruptions
+
+def test_disruptions_view_windowing():
+    ev = [
+        {"ts": 5.0, "epoch": 1, "kind": "start"},
+        {"ts": 12.0, "epoch": 1, "kind": "quarantine",
+         "payload": {"worker": 0}},
+        {"ts": 13.0, "epoch": 1, "kind": "map_lost_output",
+         "payload": {"job": "job-000001", "task": 3}},
+        {"ts": 13.5, "epoch": 1, "kind": "map_lost_output",
+         "payload": {"job": "job-OTHER", "task": 1}},
+        {"ts": 14.0, "epoch": 2, "kind": "promoted",
+         "payload": {"failover_s": 2.5}},
+        {"ts": 15.0, "epoch": 2, "kind": "resume"},
+        {"ts": 99.0, "epoch": 2, "kind": "quarantine"},  # after finish
+    ]
+    view = disruptions_view(ev, "job-000001",
+                            submitted_at=10.0, finished_at=20.0)
+    assert view == {
+        "quarantines": 1, "lost_outputs": 1, "daemon_restarts": 1,
+        "failovers": 1, "max_failover_s": 2.5,
+    }
+    # the boot that ADMITTED the job is not a disruption
+    assert "daemon_restarts" not in disruptions_view(
+        ev[:1], "job-000001", submitted_at=5.0, finished_at=20.0)
+    # job-tagged lost outputs count regardless of window (ids never
+    # recycle — the revocation names its tenant directly)
+    assert disruptions_view(ev, "job-000001",
+                            submitted_at=50.0, finished_at=60.0) == \
+        {"lost_outputs": 1}
+    # nonzero-only: a quiet window for an untouched job answers {}
+    assert disruptions_view(ev, "job-000099",
+                            submitted_at=50.0, finished_at=60.0) == {}
+    assert disruptions_view([], "job-000001") == {}

@@ -16,6 +16,11 @@ bench tail's "N ms/pass" marker when present.  NO gating and no
 thresholds on purpose: this box's background load swings ~2x, so the
 trajectory is a report, not a check (BASELINE.md's interleaved A/B
 medians are the honest comparisons).
+
+When the newest daemon.jsonl under ``--root`` (recursively, mtime
+picks) carries ``promoted`` lines, a ``failover`` summary rides along —
+the fleet-timeline samples behind ``dgrep_daemon_failover_seconds``.
+Same reporting-only stance.
 """
 
 from __future__ import annotations
@@ -66,6 +71,48 @@ def load_rounds(root: Path) -> list[dict]:
     return rounds
 
 
+def failover_samples(root: Path) -> dict | None:
+    """Tail the newest daemon.jsonl under ``root`` for failover_s
+    samples (the ``promoted`` lines the round-19 histogram observes).
+    None when no work root with promotions is around — the trend line
+    keeps its pre-round-19 shape."""
+    newest = None
+    for path in root.rglob("daemon.jsonl"):
+        try:
+            mt = path.stat().st_mtime
+        except OSError:
+            continue
+        if newest is None or mt > newest[0]:
+            newest = (mt, path)
+    if newest is None:
+        return None
+    samples: list[float] = []
+    steals = 0
+    try:
+        for line in newest[1].read_text(encoding="utf-8").splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail — replay-tolerant, like the runtime
+            if rec.get("kind") == "lease_steal":
+                steals += 1
+            elif rec.get("kind") == "promoted":
+                f = (rec.get("payload") or {}).get("failover_s")
+                if f is not None:
+                    samples.append(float(f))
+    except OSError:
+        return None
+    if not samples and not steals:
+        return None
+    return {
+        "source": str(newest[1]),
+        "promotions": len(samples),
+        "lease_steals": steals,
+        "max_failover_s": max(samples, default=None),
+        "last_failover_s": samples[-1] if samples else None,
+    }
+
+
 def markdown_table(rounds: list[dict]) -> str:
     lines = ["| round | GB/s | ms/pass | notes |",
              "| --- | --- | --- | --- |"]
@@ -104,6 +151,9 @@ def main(argv: list[str] | None = None) -> int:
         "latest_gbps": rounds[-1]["gbps"],
         "best_chip_gbps": max((r["gbps"] for r in chip), default=None),
     }
+    failover = failover_samples(Path(args.root))
+    if failover is not None:
+        doc["failover"] = failover
     print(json.dumps(doc, sort_keys=True))
     if not args.json_only:
         print(markdown_table(rounds))
