@@ -5,7 +5,7 @@
 # installed).  CI and editors wanting annotations: `python -m
 # distributed_grep_tpu analyze --sarif`.
 
-.PHONY: lint native test chaos trend
+.PHONY: lint native test chaos trend caches
 
 lint:
 	python -m distributed_grep_tpu analyze
@@ -24,6 +24,15 @@ test:
 # are built per call from the env) — no extra env needed here.
 chaos:
 	python -m pytest tests/test_chaos.py -q
+
+# The warm-tier receipts end to end: corpus cache (round 7), shard
+# index (round 14), query-result cache (round 20) — each `--check`
+# gates byte identity plus its tier's speedup floor.  CPU-runnable;
+# each prints exactly one JSON line.
+caches:
+	python benchmarks/corpus_resident.py --check
+	python benchmarks/index_prune.py --check
+	python benchmarks/result_cache.py --check
 
 # Round-over-round bench trajectory (BENCH_r*.json) as one JSON line +
 # a markdown table.  Reporting only — no gating (this box's background

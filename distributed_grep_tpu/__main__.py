@@ -1625,6 +1625,15 @@ def cmd_submit(args: argparse.Namespace) -> int:
             out["index_bytes_skipped"] = int(
                 counters.get("index_bytes_skipped", 0)
             )
+        # result-cache routing, same nonzero-only contract: how many map
+        # splits answered from stored results without a scan
+        if counters.get("result_splits_reused"):
+            out["result_splits_reused"] = int(
+                counters["result_splits_reused"]
+            )
+            out["result_bytes_unscanned"] = int(
+                counters.get("result_bytes_unscanned", 0)
+            )
         if args.explain and status.get("state") in ("done", "failed"):
             # the routing report, inline on the one JSON line — best
             # effort: a daemon too old for /explain answers 404, the

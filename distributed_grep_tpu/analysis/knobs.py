@@ -277,6 +277,25 @@ KNOBS: dict[str, Knob] = {
         "rate on trigram-dense shards (accessor: "
         "index/summary.env_summary_bytes).",
     ),
+    "DGREP_RESULT_CACHE": Knob(
+        "runtime/result_cache.py", "on",
+        "Query-result cache (round 20): the daemon persists each "
+        "eligible job's results per map split under <work_root>/results/ "
+        "and answers repeated queries over unchanged inputs from the "
+        "store (full hit: no scheduler, no scan; partial hit: only "
+        "drifted splits rescan).  0/false is a true no-op — no results/ "
+        "dir, no /status key, byte-identical behavior.  One-shot CLI "
+        "jobs never consult the tier (accessor: "
+        "runtime/result_cache.env_result_cache).",
+    ),
+    "DGREP_RESULT_BYTES": Knob(
+        "runtime/result_cache.py", "268435456",
+        "On-disk byte budget for the result store (whole-entry LRU by "
+        "mtime; loads touch).  0 disables the tier like "
+        "DGREP_RESULT_CACHE=0; an entry larger than the whole budget is "
+        "declined outright (accessor: "
+        "runtime/result_cache.env_result_bytes).",
+    ),
 }
 
 

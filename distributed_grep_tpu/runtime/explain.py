@@ -58,6 +58,8 @@ def summarize_events(events: list[dict]) -> dict:
     routing: dict[str, dict] = {}
     fusion = {"fused_plans": 0, "fused_attempts": 0, "max_queries": 0}
     index = {"prunes": 0, "bytes_skipped": 0, "maybes": 0}
+    result = {"hits": 0, "partial_hits": 0, "misses": 0,
+              "splits_reused": 0, "bytes_unscanned": 0, "revalidations": 0}
     shuffle = {"peer_fetches": 0, "peer_bytes": 0, "relay_fetches": 0,
                "relay_fallbacks": 0, "lost_outputs": 0}
     tasks = {"map_assigns": 0, "reduce_assigns": 0, "timeouts": 0,
@@ -98,6 +100,18 @@ def summarize_events(events: list[dict]) -> dict:
                 )
             elif name == "index:maybe":
                 index["maybes"] += 1
+            elif name in ("result:hit", "result:partial"):
+                args = r.get("args") or {}
+                key = "hits" if name == "result:hit" else "partial_hits"
+                result[key] += 1
+                result["splits_reused"] += int(args.get("splits_reused", 0))
+                result["bytes_unscanned"] += int(
+                    args.get("bytes_unscanned", 0)
+                )
+            elif name == "result:miss":
+                result["misses"] += 1
+            elif name == "result:revalidate":
+                result["revalidations"] += 1
             elif name == "fuse:plan":
                 fusion["fused_plans"] += 1
                 fusion["max_queries"] = max(
@@ -140,6 +154,11 @@ def summarize_events(events: list[dict]) -> dict:
         out["fusion"] = fusion
     if any(index.values()):
         out["index"] = index
+    if any(result.values()):
+        # query-result cache (round 20): was this job answered from
+        # stored results, wholly or incrementally?  Nonzero-only — a
+        # cache-free job's report keeps its pre-round-20 shape.
+        out["result_cache"] = result
     if any(shuffle.values()):
         # shuffle route verdict (peer-to-peer shuffle, round 16): which
         # data plane the job's reduce fetches actually rode
@@ -232,6 +251,9 @@ def assemble(
     events: list[dict],
     index_shards_pruned: int = 0,
     index_bytes_skipped: int = 0,
+    result_splits_reused: int = 0,
+    result_bytes_unscanned: int = 0,
+    result_revalidations: int = 0,
     daemon_events: list[dict] | None = None,
 ) -> dict:
     """One job's routing report.  ``config`` is the JobConfig (only the
@@ -263,6 +285,15 @@ def assemble(
         idx = routing.setdefault("index", {})
         idx["planner_shards_pruned"] = index_shards_pruned
         idx["planner_bytes_skipped"] = index_bytes_skipped
+    # result-cache planner tallies (JobRecord fields — spans-off jobs
+    # still report them; with spans on they merge over the instant view)
+    if result_splits_reused or result_revalidations:
+        res = routing.setdefault("result_cache", {})
+        if result_splits_reused:
+            res["planner_splits_reused"] = result_splits_reused
+            res["planner_bytes_unscanned"] = result_bytes_unscanned
+        if result_revalidations:
+            res["planner_revalidations"] = result_revalidations
 
     counters = {
         k: v for k, v in sorted((metrics_counters or {}).items()) if v

@@ -1,0 +1,371 @@
+"""Query-result cache — the fourth warm tier (round 20).
+
+Model cache answers "same pattern", corpus cache "same data", shard index
+"cannot match"; this tier answers "same pattern over same data" with the
+stored RESULT: a repeated query over unchanged inputs is a stat walk plus
+a cache read, not a scan.  Results are stored PER MAP SPLIT — the split's
+final output records together with its content identity — so invalidation
+is per-shard: when one file of a thousand drifts, only its split rescans
+(the incremental re-query) and the merge with the surviving cached splits
+is byte-identical to a full scan (the unique-(file, line) keys make any
+k-way ``fileline_sorted`` merge partition-independent).
+
+Key = ``(fusion_key(config), query_spec(options))`` x split identity.
+``fusion_key`` already canonicalizes application + every non-query app
+option + the split-planning window, so two configs share cache entries
+exactly when their split plans align and their per-record semantics
+agree; folding the query spec back in is what distinguishes tenants —
+the fusion planner may RUN two queries in one dispatch, but their
+RESULTS are never interchangeable.  The split identity is the CorpusCache
+validator tuple (realpath, size, mtime_ns, inode — fresh-stat
+revalidated; drift evicts; stale results are NEVER served).
+
+Persistence rides the IndexStore mechanics (index/store.py): one file
+per (query, split) under ``<work_root>/results/``, content-hash
+filenames, JSON header + raw record bytes, tmp + ``os.replace``, NO
+fsync (a lost entry rescans).  On top of that: whole-entry LRU under the
+``DGREP_RESULT_BYTES`` budget (mtime is the recency clock — loads touch;
+an entry larger than the whole budget is DECLINED, never evicting
+smaller tenants — the CorpusCache put_segments rule).
+
+Pure Python, no ops imports — eligibility and planning run on the
+daemon's control plane (the runtime/fusion.py rule), and every stat or
+store I/O here runs in caller context with no service lock held
+(analyze: locked-blocking).
+
+Knobs (registered in analysis/knobs.py, owned here):
+
+* ``DGREP_RESULT_CACHE`` — 0/false disables the tier entirely (a true
+  no-op: no ``results/`` dir, no /status key, byte-identical behavior).
+  The daemon defaults it ON; one-shot CLI jobs never consult the tier
+  at all (their temp work dirs make reuse meaningless).
+* ``DGREP_RESULT_BYTES`` — on-disk budget for ``results/`` (default
+  256 MB); 0 disables like DGREP_RESULT_CACHE=0.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from pathlib import Path
+
+from distributed_grep_tpu.runtime import fusion as fusion_mod
+from distributed_grep_tpu.runtime.job import parse_grep_key_bytes
+
+_VERSION = 1
+DEFAULT_RESULT_BYTES = 256 << 20
+
+
+def env_result_cache(default: bool = True) -> bool:
+    """Result-tier switch — the ONE parser of DGREP_RESULT_CACHE
+    (fusion's env_service_fuse policy: "0"/"false"/"no" = off)."""
+    raw = os.environ.get("DGREP_RESULT_CACHE")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
+def env_result_bytes(default: int = DEFAULT_RESULT_BYTES) -> int:
+    """Result-store byte budget — the ONE parser of DGREP_RESULT_BYTES
+    (malformed keeps the default, env_batch_bytes' shrug-off policy;
+    negatives clamp to 0 = disabled)."""
+    raw = os.environ.get("DGREP_RESULT_BYTES")
+    if raw is None or raw == "":
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
+def result_key(config) -> tuple | None:
+    """Cache key for a JobConfig's query half, or None when this job's
+    results must never be cached.  Eligibility mirrors fusion
+    (grep_tpu, print mode, no approx/mesh/backrefs/empty patterns —
+    fusion_key refuses all of those) narrowed further: standing queries
+    have no terminal result, and ``-v`` rides the _UNPRUNABLE_OPTIONS
+    rationale — its output is the complement (every line of a zero-match
+    file), so entries would be corpus-sized and defeat the budget."""
+    if getattr(config, "follow", False):
+        return None
+    fkey = fusion_mod.fusion_key(config)
+    if fkey is None:
+        return None
+    opts = config.effective_app_options()
+    if opts.get("invert"):
+        return None
+    qspec = fusion_mod.query_spec(opts)
+    if qspec is None:  # unreachable past fusion_key; belt-and-braces
+        return None
+    return (fkey, qspec)
+
+
+def _canon(obj):
+    """Tuples -> lists (the JSON round-trip shape) and bytes -> str via
+    surrogateescape, recursively — stored headers must compare equal to
+    a live key's fields after one json round trip."""
+    if isinstance(obj, (list, tuple)):
+        return [_canon(x) for x in obj]
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", "surrogateescape")
+    return obj
+
+
+class ResultKey:
+    """One (query, split) cache address.  ``identity`` names the file
+    (query key + the member GIVEN names + their realpaths — stable
+    across content drift, so a drifted lookup maps to the SAME entry
+    and evicts it); ``validators`` is the full split identity the load
+    revalidates.  The given names are load-bearing: stored records
+    carry the publishing job's path spellings (fusion's symlinked
+    tenants keep per-job names), so a submit naming the same content
+    through an alias must MISS — a realpath-only identity would serve
+    it records labeled with the other tenant's paths."""
+
+    __slots__ = ("identity", "validators")
+
+    def __init__(self, query_key: tuple, split, split_ident: tuple):
+        members = split if isinstance(split, (list, tuple)) else [split]
+        self.identity = (
+            _canon(query_key),
+            [os.fsdecode(os.fspath(m)) for m in members],
+            [m[0] for m in split_ident],
+        )
+        self.validators = split_ident
+
+
+class ResultStore:
+    """IndexStore mechanics + LRU byte budget.  All I/O is best-effort
+    and runs in caller context with no lock held; a full disk or a lost
+    entry degrades warm answering, never correctness."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self._made = False
+        # lockless telemetry (single-writer daemon planning thread;
+        # approximate reads are fine)
+        self.stale_evictions = 0
+        self.lru_evictions = 0
+        # sweep tmp files torn by a crash between the tmp write and
+        # os.replace — _evict only accounts *.res, so they would leak
+        # unbounded across daemon lifetimes.  Construction implies
+        # work-root ownership (the lease in HA mode), so no live
+        # writer's tmp can be on disk here.
+        try:
+            with os.scandir(self.root) as it:
+                for e in it:
+                    if e.name.endswith(".tmp"):
+                        try:
+                            os.unlink(e.path)
+                        except OSError:
+                            pass
+        except OSError:
+            pass
+
+    def _path_for(self, identity) -> Path:
+        blob = json.dumps(_canon(identity), ensure_ascii=True,
+                          separators=(",", ":"))
+        h = hashlib.sha256(blob.encode("utf-8", "surrogatepass")).hexdigest()
+        return self.root / f"{h[:40]}.res"
+
+    def load(self, key: ResultKey) -> bytes | None:
+        """The stored split result for ``key``, or None.  A record whose
+        validators disagree with the key's fresh stat is STALE: deleted
+        (best-effort) and never served.  A hit touches mtime — the LRU
+        recency clock."""
+        p = self._path_for(key.identity)
+        try:
+            with open(p, "rb") as f:
+                header = json.loads(f.readline())
+                blob = f.read()
+        except (OSError, ValueError):
+            return None
+        if (
+            header.get("v") != _VERSION
+            or header.get("identity") != _canon(key.identity)
+            or len(blob) != header.get("m")
+        ):
+            return None
+        if header.get("validators") != _canon(key.validators):
+            self.stale_evictions += 1
+            try:
+                os.unlink(p)  # stat drift: evict the stale record
+            except OSError:
+                pass
+            return None
+        try:
+            os.utime(p)
+        except OSError:
+            pass
+        return blob
+
+    def save(self, key: ResultKey, records: bytes) -> bool:
+        """Atomically persist one split's result records, then enforce
+        the byte budget (oldest-mtime whole-entry eviction, never the
+        entry just written).  Entries larger than the whole budget are
+        declined outright — publishing one would LRU-wipe every smaller
+        tenant for a result that can never be served warm again."""
+        budget = env_result_bytes()
+        if budget <= 0 or len(records) > budget:
+            return False
+        p = self._path_for(key.identity)
+        header = json.dumps({
+            "v": _VERSION,
+            "identity": _canon(key.identity),
+            "validators": _canon(key.validators),
+            "m": len(records),
+        }, ensure_ascii=True, separators=(",", ":"))
+        tmp = p.with_name(
+            f".{p.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+        )
+        try:
+            if not self._made:
+                self.root.mkdir(parents=True, exist_ok=True)
+                self._made = True
+            with open(tmp, "wb") as f:
+                f.write(header.encode("utf-8", "surrogatepass"))
+                f.write(b"\n")
+                f.write(records)
+            os.replace(tmp, p)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        self._evict(budget, keep=p)
+        return True
+
+    def _evict(self, budget: int, keep: Path) -> None:
+        """Whole-entry LRU: drop oldest-mtime entries until the store
+        fits the budget.  Best-effort — a racing unlink just means the
+        entry was already gone."""
+        rows = []
+        total = 0
+        try:
+            with os.scandir(self.root) as it:
+                for e in it:
+                    if not e.name.endswith(".res"):
+                        continue
+                    try:
+                        st = e.stat()
+                    except OSError:
+                        continue
+                    rows.append((st.st_mtime_ns, st.st_size, e.path))
+                    total += st.st_size
+        except OSError:
+            return
+        if total <= budget:
+            return
+        keep_s = os.fspath(keep)
+        for _mtime, size, path in sorted(rows):
+            if total <= budget:
+                break
+            if path == keep_s:
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            self.lru_evictions += 1
+
+
+class ResultPlan:
+    """One job's submit-time cache verdicts: which planned splits answer
+    from cache (original index + record blob) and which must scan
+    (``remaining``, with their submit-time identities for publication
+    revalidation).  Built OUTSIDE the service lock (stat + store I/O)."""
+
+    __slots__ = ("query_key", "splits", "cached", "remaining",
+                 "remaining_identities", "bytes_unscanned")
+
+    def __init__(self, query_key):
+        self.query_key = query_key
+        self.splits: list = []
+        self.cached: list[tuple[int, bytes]] = []
+        self.remaining: list = []
+        self.remaining_identities: list = []
+        self.bytes_unscanned = 0
+
+    @property
+    def full(self) -> bool:
+        return bool(self.splits) and not self.remaining
+
+    @property
+    def splits_reused(self) -> int:
+        return len(self.cached)
+
+
+def plan_lookup(store: ResultStore, query_key: tuple,
+                splits: list) -> ResultPlan:
+    """Look every planned split up in the store with a FRESH stat per
+    member (drifted entries evict inside load()).  Splits without a
+    stable identity (unstattable, oversize) always scan and never
+    publish."""
+    plan = ResultPlan(query_key)
+    plan.splits = list(splits)
+    for i, split in enumerate(splits):
+        ident = fusion_mod.split_identity(split)
+        blob = None
+        if ident is not None:
+            blob = store.load(ResultKey(query_key, split, ident))
+        if blob is not None:
+            plan.cached.append((i, blob))
+            plan.bytes_unscanned += fusion_mod.split_n_bytes(ident)
+        else:
+            plan.remaining.append(split)
+            plan.remaining_identities.append(ident)
+    return plan
+
+
+def bucket_records(output_paths, splits) -> list[bytes] | None:
+    """Partition a finished job's committed output records back into
+    per-split blobs, sorted by (file, line) — each blob is then itself a
+    valid ``fileline_sorted`` stream for the k-way merge.  Returns None
+    when any record cannot be attributed (unparseable key, or a path no
+    split owns — a custom record shape): publication is all-or-nothing
+    per job, a wrong attribution must never poison an entry.  Paths
+    order by surrogateescape CODEPOINTS (the merge's se_cmp contract),
+    not raw bytes."""
+    owner: dict[bytes, int] = {}
+    for i, split in enumerate(splits):
+        members = split if isinstance(split, (list, tuple)) else [split]
+        for m in members:
+            key = os.fsencode(os.fspath(m))
+            if key in owner:
+                # a repeated member (the same file listed twice in
+                # input_files) makes attribution ambiguous — records
+                # would all land on the last split, and two
+                # same-identity splits would overwrite each other's
+                # store entry; publish nothing
+                return None
+            owner[key] = i
+    buckets: list[list] = [[] for _ in splits]
+    for path in output_paths:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        for line in data.splitlines(keepends=True):
+            if not line.rstrip(b"\n"):
+                continue
+            key = line.split(b"\t", 1)[0]
+            parsed = parse_grep_key_bytes(key)
+            if parsed is None:
+                return None
+            path_b, lineno = parsed
+            i = owner.get(path_b)
+            if i is None:
+                return None
+            buckets[i].append(
+                (path_b.decode("utf-8", "surrogateescape"), lineno, line)
+            )
+    out = []
+    for rows in buckets:
+        rows.sort(key=lambda t: (t[0], t[1]))
+        out.append(b"".join(t[2] for t in rows))
+    return out

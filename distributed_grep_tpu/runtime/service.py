@@ -50,6 +50,7 @@ from pathlib import Path
 
 from distributed_grep_tpu.runtime import daemon_log as daemon_log_mod
 from distributed_grep_tpu.runtime import fusion as fusion_mod
+from distributed_grep_tpu.runtime import result_cache as result_cache_mod
 from distributed_grep_tpu.runtime import rpc
 from distributed_grep_tpu.runtime.http_coordinator import (
     DataPlaneHandler,
@@ -390,6 +391,16 @@ class JobRecord:
     # /jobs/<id> and dgrep submit's final line can surface routing.
     index_shards_pruned: int = 0
     index_bytes_skipped: int = 0
+    # Query-result cache (round 20, runtime/result_cache.py): the
+    # submit-time cache plan.  When present, map_splits has been REDUCED
+    # to the drifted remainder (the incremental re-query) — the original
+    # full split list lives on result_plan.splits; a FULL hit answers at
+    # start flush with no scheduler at all.  Tallies ride the record for
+    # the same reason as the index ones (Metrics is built later).
+    result_plan: object = None
+    result_splits_reused: int = 0
+    result_bytes_unscanned: int = 0
+    result_revalidations: int = 0
     # Streaming tier (round 17, runtime/follow.py): the standing-query
     # runner of a follow job — such records have NO scheduler (every
     # assign-loop/consumer already None-guards it); the runner owns the
@@ -558,6 +569,26 @@ class GrepService:
             "index_maybe_scans": 0,
         }
 
+        # Query-result cache planning counters (round 20, GET /status
+        # "result_cache"): jobs answered wholly from cache, partial
+        # (incremental re-query) hits, splits served without a scan,
+        # bytes those splits would have scanned, and publications dropped
+        # because the split drifted mid-job.  Planner-side, leaf lock.
+        # DGREP_RESULT_CACHE=0 (or a zero budget) leaves the store None —
+        # a TRUE no-op: no results/ dir, no /status key, no instants.
+        self._result_lock = lockdep.make_lock("result-stats")
+        self._result_stats = {
+            "result_hits": 0, "result_partial_hits": 0,
+            "result_splits_reused": 0, "result_bytes_unscanned": 0,
+            "result_revalidations": 0,
+        }
+        self._result_store = (
+            result_cache_mod.ResultStore(self.work_root / "results")
+            if result_cache_mod.env_result_cache()
+            and result_cache_mod.env_result_bytes() > 0
+            else None
+        )
+
         # Durable job registry (jobs.jsonl) + staged transition records:
         # appends are fsync'd, so they happen OUTSIDE the service lock —
         # state changes decided under the lock stage here and flush after
@@ -699,11 +730,26 @@ class GrepService:
                 pruner=pruner,
             )
             self._stamp_index_plan(rec, pruner)
+            # result cache survives the restart (the "warm survives the
+            # process" contract): re-plan against the persisted store so
+            # a resumed job reuses every still-valid split result — a
+            # full hit resumes straight through the start flush with no
+            # scheduler, like a follow resume
+            rec.result_plan = self._result_plan(cfg, rec.map_splits)
+            if rec.result_plan is not None:
+                rec.map_splits = rec.result_plan.remaining
+            self._stamp_result_plan(rec)
             (rec.fusion_key, rec.split_identities,
              rec.fuse_index) = self._fusion_plan(cfg, rec.map_splits)
             self._jobs[jid] = rec
             if state == JobState.RUNNING:
-                self._resume_running_job(rec)
+                if rec.result_plan is not None and rec.result_plan.full:
+                    rec.state = JobState.RUNNING
+                    rec.started_at = time.time()
+                    self._running.append(jid)
+                    self._pending_starts.append(rec)
+                else:
+                    self._resume_running_job(rec)
             else:
                 rec.state = JobState.QUEUED
                 self._queue.append(jid)
@@ -752,6 +798,10 @@ class GrepService:
             # job's /jobs view and submit-client JSON keep the routing
             rec.metrics.inc("index_shards_pruned", rec.index_shards_pruned)
             rec.metrics.inc("index_bytes_skipped", rec.index_bytes_skipped)
+        if rec.result_splits_reused:
+            rec.metrics.inc("result_splits_reused", rec.result_splits_reused)
+            rec.metrics.inc("result_bytes_unscanned",
+                            rec.result_bytes_unscanned)
         rec.scheduler = Scheduler(
             files=rec.map_splits,
             n_reduce=cfg.n_reduce,
@@ -1004,6 +1054,7 @@ class GrepService:
             self._validate_follow_config(config)
             pruner = None
             splits: list = []
+            result_plan = None
             fuse_key, identities, fuse_index = None, [], {}
         else:
             missing = [f for f in config.input_files
@@ -1031,6 +1082,15 @@ class GrepService:
                 list(config.input_files), config.effective_batch_bytes(),
                 pruner=pruner,
             )
+            # Query-result cache (round 20): look every planned split up
+            # with a fresh stat per member — still outside the lock, the
+            # same locked-blocking contract.  A hit REDUCES the split
+            # list to the drifted remainder (the incremental re-query)
+            # BEFORE fusion planning, so fuse_index task ids line up
+            # with the scheduler the reduced list builds.
+            result_plan = self._result_plan(config, splits)
+            if result_plan is not None:
+                splits = result_plan.remaining
             fuse_key, identities, fuse_index = self._fusion_plan(
                 config, splits
             )
@@ -1062,8 +1122,10 @@ class GrepService:
                             submitted_at=time.time(), map_splits=splits,
                             fusion_key=fuse_key,
                             split_identities=identities,
-                            fuse_index=fuse_index)
+                            fuse_index=fuse_index,
+                            result_plan=result_plan)
         self._stamp_index_plan(rec, pruner)
+        self._stamp_result_plan(rec)
         # Durability BEFORE visibility: the registry append (fsync)
         # happens outside the lock and before the id is handed to the
         # client — from this line on a daemon crash re-admits the job at
@@ -1207,6 +1269,26 @@ class GrepService:
             # submit, before this Metrics object existed)
             metrics.inc("index_shards_pruned", rec.index_shards_pruned)
             metrics.inc("index_bytes_skipped", rec.index_bytes_skipped)
+        if rec.result_splits_reused:
+            # same contract for the result-cache planning tallies
+            metrics.inc("result_splits_reused", rec.result_splits_reused)
+            metrics.inc("result_bytes_unscanned",
+                        rec.result_bytes_unscanned)
+        if rec.result_plan is not None and event_log is not None:
+            # a job reaching this builder with a plan is a partial hit
+            # (full hits dispatch in _flush_starts) or a clean miss —
+            # say which, so dgrep explain can fold the verdict in
+            plan = rec.result_plan
+            event_log.write({
+                "t": "instant",
+                "name": "result:partial" if plan.cached else "result:miss",
+                "cat": "service", "ts": time.time(), "job": rec.job_id,
+                "args": {
+                    "splits_reused": plan.splits_reused,
+                    "splits_scanned": len(plan.remaining),
+                    "bytes_unscanned": plan.bytes_unscanned,
+                },
+            })
         scheduler = Scheduler(
             files=rec.map_splits,
             n_reduce=cfg.n_reduce,
@@ -1252,6 +1334,26 @@ class GrepService:
                 if getattr(rec.config, "follow", False):
                     self._flush_follow_start(rec)
                     continue
+                if rec.result_plan is not None and rec.result_plan.full:
+                    # Query-result cache FULL hit: every split answered
+                    # from the store at plan time — the job completes
+                    # right here with no scheduler, no worker dispatch,
+                    # no watcher thread.  A failed cache materialization
+                    # falls back to the normal scan path with the plan
+                    # dropped (never inject cached blobs on top of a
+                    # full rescan — that would duplicate records).
+                    if self._flush_result_hit(rec):
+                        continue
+                    rec.map_splits = rec.result_plan.splits
+                    rec.result_splits_reused = 0
+                    rec.result_bytes_unscanned = 0
+                    rec.result_plan = None
+                    # fusion was planned against the (empty) reduced
+                    # list — a stale fuse_index would map identities to
+                    # wrong task ids, so this job just never fuses
+                    rec.fusion_key = None
+                    rec.split_identities = []
+                    rec.fuse_index = {}
                 try:
                     parts = self._build_job_runtime(rec)
                 except Exception as e:  # noqa: BLE001 — bad job, healthy service
@@ -1420,19 +1522,41 @@ class GrepService:
         # cancel races us, in which case the locked section discards it.
         t_fin = time.perf_counter()
         outputs = [str(p) for p in rec.workdir.list_outputs()]
+        cache_error = ""
+        if rec.result_plan is not None:
+            # Query-result cache, still outside the lock (store I/O):
+            # publish the freshly scanned splits' results — only now, at
+            # finalize, when every reduce is committed (the fallback/
+            # rescue discipline: a crashed job publishes nothing) — then
+            # materialize the cached splits' blobs next to the scanned
+            # outputs so the incremental re-query's result is complete.
+            self._publish_results(rec, outputs)
+            try:
+                outputs = outputs + self._materialize_cached(rec)
+            except OSError as e:
+                # cached blobs we could not write = an INCOMPLETE result;
+                # serving it as DONE would silently drop matches
+                cache_error = f"result-cache materialization failed: {e}"
         _H_FINALIZE.observe(time.perf_counter() - t_fin)
         with self._cond:
             if rec.state is not JobState.RUNNING:
                 return
-            rec.state = JobState.DONE
-            rec.finished_at = time.time()
-            rec.outputs = outputs
-            _C_DONE.inc()
-            if rec.submitted_at:
-                _H_JOB_E2E.observe(rec.finished_at - rec.submitted_at)
-            if rec.started_at:
-                _H_JOB_RUN.observe(rec.finished_at - rec.started_at)
-            self._stage_state(rec, outputs=outputs)
+            if cache_error:
+                rec.state = JobState.FAILED
+                rec.error = cache_error
+                rec.finished_at = time.time()
+                _C_FAILED.inc()
+                self._stage_state(rec)
+            else:
+                rec.state = JobState.DONE
+                rec.finished_at = time.time()
+                rec.outputs = outputs
+                _C_DONE.inc()
+                if rec.submitted_at:
+                    _H_JOB_E2E.observe(rec.finished_at - rec.submitted_at)
+                if rec.started_at:
+                    _H_JOB_RUN.observe(rec.finished_at - rec.started_at)
+                self._stage_state(rec, outputs=outputs)
             self._close_job_locked(rec)
             self._maybe_start_locked()
             self._cond.notify_all()
@@ -1441,7 +1565,7 @@ class GrepService:
         self._flush_registry()
         self._flush_daemon_log()
         log.info(
-            "job %s done in %.3fs (%d outputs)", rec.job_id,
+            "job %s %s in %.3fs (%d outputs)", rec.job_id, rec.state,
             rec.finished_at - (rec.started_at or rec.finished_at),
             len(rec.outputs),
         )
@@ -1828,6 +1952,221 @@ class GrepService:
                 "index_bytes_skipped", float(pruner.bytes_skipped)
             )
 
+    # ------------------------------------------------ query-result cache
+    def _result_plan(self, config: JobConfig, splits: list):
+        """A submit/resume-time ResultPlan for this job, or None — tier
+        off (store None), ineligible config, or a lookup that broke.
+        Store/stat I/O: callers run it OUTSIDE the service lock,
+        alongside plan_map_splits (locked-blocking)."""
+        if self._result_store is None or not splits:
+            return None
+        try:
+            key = result_cache_mod.result_key(config)
+            if key is None:
+                return None
+            return result_cache_mod.plan_lookup(
+                self._result_store, key, splits
+            )
+        except Exception:  # noqa: BLE001 — a broken cache must degrade
+            # to a plain scan, never take submits down
+            log.exception("result-cache lookup failed; planning uncached")
+            return None
+
+    def _stamp_result_plan(self, rec: JobRecord) -> None:
+        """Fold one result-cache planning pass into the record tallies
+        (seeded into the job Metrics later — the _stamp_index_plan
+        contract), the /status "result_cache" counters, and the
+        dgrep_result_* metrics (created lazily at the event site: an
+        idle daemon's /metrics keeps its golden bytes).  PARTIAL hits
+        only: a full hit stamps in _flush_result_hit AFTER its cached
+        blobs materialize — the materialization-failure fallback
+        rescans, and counters stamped at plan time would over-count
+        /status and /metrics forever."""
+        plan = rec.result_plan
+        if plan is None or not plan.cached or plan.full:
+            return
+        self._stamp_result_counters(rec, plan)
+
+    def _stamp_result_counters(self, rec: JobRecord, plan) -> None:
+        rec.result_splits_reused += plan.splits_reused
+        rec.result_bytes_unscanned += plan.bytes_unscanned
+        full = plan.full
+        with self._result_lock:
+            if full:
+                self._result_stats["result_hits"] += 1
+            else:
+                self._result_stats["result_partial_hits"] += 1
+            self._result_stats["result_splits_reused"] += plan.splits_reused
+            self._result_stats["result_bytes_unscanned"] += (
+                plan.bytes_unscanned
+            )
+        if full:
+            metrics_mod.counter("dgrep_result_hits_total").inc()
+        else:
+            metrics_mod.counter("dgrep_result_partial_hits_total").inc()
+        metrics_mod.counter("dgrep_result_splits_reused_total").inc(
+            plan.splits_reused
+        )
+        metrics_mod.counter("dgrep_result_bytes_unscanned_total").inc(
+            plan.bytes_unscanned
+        )
+
+    @staticmethod
+    def _materialize_cached(rec: JobRecord) -> list[str]:
+        """Write the plan's cached split blobs under the job's work dir
+        (``out-cached/result-<i>`` — deliberately NOT mr-*, which
+        readers must resolve through the store) and return their paths.
+        Result consumers read output paths directly, and each blob is
+        itself (file, line)-sorted, so the k-way ``fileline_sorted``
+        merge over scanned + cached outputs is byte-identical to a full
+        scan.  Raises OSError — the caller decides whether that fails
+        the job."""
+        plan = rec.result_plan
+        if not plan.cached:
+            return []
+        out_dir = rec.workdir.root / "out-cached"
+        out_dir.mkdir(parents=True, exist_ok=True)
+        paths = []
+        for i, blob in plan.cached:
+            p = out_dir / f"result-{i}"
+            with open(p, "wb") as f:
+                f.write(blob)
+            paths.append(str(p))
+        return paths
+
+    def _flush_result_hit(self, rec: JobRecord) -> bool:
+        """Complete a FULL result-cache hit (start-flush context, no
+        service lock held): fresh work dir, cached blobs materialized as
+        the job's outputs, DONE published under the lock with
+        _finalize's accounting — no scheduler, no watcher thread.
+        Returns False on any failure; the caller falls back to the
+        normal scan path with the plan dropped."""
+        cfg = rec.config
+        event_log = None
+        try:
+            store = make_store(cfg.store)
+            workdir = WorkDir(cfg.work_dir, store=store)
+            workdir.clear()
+            rec.workdir = workdir  # _materialize_cached reads it
+            outputs = self._materialize_cached(rec)
+            spans_on = spans_mod.enabled(cfg.spans) or self.spans
+            if spans_on:
+                # one-instant event log: dgrep explain's verdict for a
+                # job no worker ever touched — closed right here (the
+                # record never publishes it, so no staged close)
+                event_log = spans_mod.EventLog(
+                    workdir.root / spans_mod.EventLog.FILENAME, fresh=True
+                )
+                event_log.write({
+                    "t": "instant", "name": "result:hit",
+                    "cat": "service", "ts": time.time(), "job": rec.job_id,
+                    "args": {
+                        "splits_reused": rec.result_plan.splits_reused,
+                        "bytes_unscanned": rec.result_plan.bytes_unscanned,
+                    },
+                })
+                event_log.close()
+                event_log = None
+        except Exception:  # noqa: BLE001 — fall back to a real scan
+            log.exception("job %s result-cache hit failed; rescanning",
+                          rec.job_id)
+            if event_log is not None:
+                try:
+                    event_log.close()
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    log.exception("event log close failed for %s",
+                                  rec.job_id)
+            rec.workdir = None
+            return False
+        # counters stamp only now, with the cached blobs materialized —
+        # the _stamp_result_plan contract: a fallback rescan must leave
+        # /status and /metrics untouched
+        self._stamp_result_counters(rec, rec.result_plan)
+        metrics = Metrics()
+        metrics.inc("result_splits_reused", rec.result_splits_reused)
+        metrics.inc("result_bytes_unscanned", rec.result_bytes_unscanned)
+        if rec.index_shards_pruned:
+            metrics.inc("index_shards_pruned", rec.index_shards_pruned)
+            metrics.inc("index_bytes_skipped", rec.index_bytes_skipped)
+        with self._cond:
+            if rec.state is not JobState.RUNNING:
+                # cancel/stop won the race: its terminal state stands,
+                # the materialized outputs are discarded history
+                return True
+            rec.metrics = metrics
+            rec.input_allowlist = frozenset(cfg.input_files)
+            rec.state = JobState.DONE
+            rec.finished_at = time.time()
+            rec.outputs = outputs
+            _C_DONE.inc()
+            if rec.submitted_at:
+                _H_JOB_E2E.observe(rec.finished_at - rec.submitted_at)
+            if rec.started_at:
+                _H_JOB_RUN.observe(rec.finished_at - rec.started_at)
+            self._stage_state(rec, outputs=outputs)
+            self._close_job_locked(rec)
+            self._maybe_start_locked()
+            self._cond.notify_all()
+        # staged registry records flush at every caller's post-release
+        # tail (_flush_starts callers all flush the registry) — flushing
+        # here would nest registry-flush under start-flush (lock-order)
+        log.info(
+            "job %s done from result cache (%d splits, %d bytes unscanned)",
+            rec.job_id, rec.result_plan.splits_reused,
+            rec.result_plan.bytes_unscanned,
+        )
+        return True
+
+    def _publish_results(self, rec: JobRecord,
+                         fresh_outputs: list[str]) -> None:
+        """Publish the freshly scanned splits' per-split results — at
+        finalize ONLY, after every reduce committed (a failed/crashed
+        job publishes nothing: the chaos pin).  Each split's submit-time
+        identity is REVALIDATED with a fresh stat first — a member that
+        drifted while the job ran is skipped, its entry would be stale
+        the moment it landed.  Record attribution is all-or-nothing
+        (bucket_records): custom record shapes publish nothing.  Never
+        raises — publication is best-effort warm-up, not correctness."""
+        plan = rec.result_plan
+        if self._result_store is None or plan is None or not plan.remaining:
+            return
+        try:
+            buckets = result_cache_mod.bucket_records(
+                fresh_outputs, plan.remaining
+            )
+            if buckets is None:
+                return
+            revalidated = 0
+            for split, ident, blob in zip(
+                plan.remaining, plan.remaining_identities, buckets
+            ):
+                if ident is None:
+                    # unstattable/oversize at plan time: never cached
+                    continue
+                if fusion_mod.split_identity(split) != ident:
+                    revalidated += 1
+                    if rec.event_log is not None:
+                        members = (split if isinstance(split, (list, tuple))
+                                   else [split])
+                        rec.event_log.write({
+                            "t": "instant", "name": "result:revalidate",
+                            "cat": "service", "ts": time.time(),
+                            "job": rec.job_id,
+                            "args": {"split": [str(m) for m in members]},
+                        })
+                    continue
+                self._result_store.save(
+                    result_cache_mod.ResultKey(plan.query_key, split, ident),
+                    blob,
+                )
+            if revalidated:
+                rec.result_revalidations += revalidated
+                rec.metrics.inc("result_revalidations", revalidated)
+                with self._result_lock:
+                    self._result_stats["result_revalidations"] += revalidated
+        except Exception:  # noqa: BLE001 — best-effort, see docstring
+            log.exception("job %s result publication failed", rec.job_id)
+
     def _plan_fused_assignment(self, rec: JobRecord,
                                reply: rpc.AssignTaskReply, worker_id: int,
                                order: list[str]) -> None:
@@ -2027,6 +2366,15 @@ class GrepService:
                 ),
             }
             out["metrics"] = rec.metrics.snapshot()
+        elif rec.follow is None and rec.state is JobState.DONE:
+            # a FULL result-cache hit completes with no scheduler — its
+            # Metrics (result_splits_reused / result_bytes_unscanned)
+            # must still reach GET /jobs/<id>, the submit client's one
+            # counter source; nonzero-only so queued/terminal jobs with
+            # empty Metrics keep the scheduler-gated payload shape
+            snap = rec.metrics.snapshot()
+            if snap.get("counters"):
+                out["metrics"] = snap
         if rec.follow is not None:
             # standing query: wake/cursor/stream state instead of phase
             # progress (nonzero-only gate not needed — the key only
@@ -2148,6 +2496,28 @@ class GrepService:
                 dict(self._index_stats)
                 if any(self._index_stats.values()) else {}
             )
+        with self._result_lock:
+            # query-result cache planner counters (round 20), same
+            # nonzero-only contract: DGREP_RESULT_CACHE=0 — or a daemon
+            # that never hit — keeps the pre-result /status shape
+            result_stats = (
+                dict(self._result_stats)
+                if any(self._result_stats.values()) else {}
+            )
+        if self._result_store is not None:
+            # store-side eviction telemetry (lockless approximate
+            # reads), gated on its OWN nonzero-ness: a daemon that
+            # published and evicted but never yet hit must still
+            # surface it (the nonzero-only /status contract holds —
+            # all-zero still omits the result_cache key)
+            if self._result_store.stale_evictions:
+                result_stats["result_stale_evictions"] = (
+                    self._result_store.stale_evictions
+                )
+            if self._result_store.lru_evictions:
+                result_stats["result_lru_evictions"] = (
+                    self._result_store.lru_evictions
+                )
         with self._lock:
             jobs = {
                 jid: {"state": rec.state}
@@ -2276,6 +2646,10 @@ class GrepService:
             # shard-index routing (planner side): shards never dispatched
             # because their trigram summary ruled the query out
             **({"index": index_stats} if index_stats else {}),
+            # query-result cache (round 20): jobs answered from stored
+            # results — full hits, incremental re-queries, splits/bytes
+            # served without a scan, drift-dropped publications
+            **({"result_cache": result_stats} if result_stats else {}),
             # streaming tier (round 17): standing queries + the follow
             # wake/suffix/shed counters (nonzero-only — a follow-free
             # daemon keeps the exact pre-follow /status shape)
@@ -2441,6 +2815,9 @@ class GrepService:
             events=events,
             index_shards_pruned=rec.index_shards_pruned,
             index_bytes_skipped=rec.index_bytes_skipped,
+            result_splits_reused=rec.result_splits_reused,
+            result_bytes_unscanned=rec.result_bytes_unscanned,
+            result_revalidations=rec.result_revalidations,
             daemon_events=daemon_events,
         )
 

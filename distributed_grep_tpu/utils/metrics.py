@@ -251,6 +251,18 @@ SERIES: dict[str, tuple[str, str]] = {
         "counter", "Elastic pool grow/drain actions applied."),
     "dgrep_maps_lost_output_total": (
         "counter", "Map tasks revoked after a lost peer shuffle output."),
+    # query-result cache (round 20, runtime/result_cache.py): created
+    # LAZILY at the planning event site (_stamp_result_plan, string-
+    # constant names) — a daemon that never hits the tier never renders
+    # them and the round-15 golden /metrics bytes hold
+    "dgrep_result_hits_total": (
+        "counter", "Jobs answered wholly from the result cache."),
+    "dgrep_result_partial_hits_total": (
+        "counter", "Jobs answered by incremental re-query (partial hit)."),
+    "dgrep_result_splits_reused_total": (
+        "counter", "Map splits served from stored results, no scan."),
+    "dgrep_result_bytes_unscanned_total": (
+        "counter", "Input bytes the result cache kept unscanned."),
 }
 
 

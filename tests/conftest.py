@@ -89,8 +89,8 @@ def corpus(tmp_path):
 @pytest.fixture(autouse=True)
 def _lockdep_audit(request):
     """The dynamic half of the concurrency-discipline layer (round 11):
-    under the `service`, `chaos`, `soak_mini`, and `follow` suites every
-    lock built
+    under the `service`, `chaos`, `soak_mini`, `follow`, and `result`
+    suites every lock built
     through utils/lockdep.make_lock is instrumented — per-thread
     acquisition stacks, lock-order inversion detection, blocking-syscall-
     while-held detection — and the test FAILS if the run observed either.
@@ -103,7 +103,7 @@ def _lockdep_audit(request):
     reach — the env-enabled path that covers them is pinned by a
     subprocess test in tests/test_lockdep.py."""
     markers = {m.name for m in request.node.iter_markers()}
-    if not markers & {"service", "chaos", "soak_mini", "follow"}:
+    if not markers & {"service", "chaos", "soak_mini", "follow", "result"}:
         yield
         return
     from distributed_grep_tpu.utils import lockdep

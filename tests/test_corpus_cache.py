@@ -725,14 +725,19 @@ def test_corpus_span_instants_reach_events_jsonl(tmp_path):
 # ------------------------------------------- cross-job via the service
 
 @pytest.mark.service
-def test_cross_job_warm_hit_through_service(tmp_path):
+def test_cross_job_warm_hit_through_service(tmp_path, monkeypatch):
     """ISSUE 7 acceptance: two submits of the same query over the same
     inputs through GrepService's persistent shared workers — the second
     job's packed window comes from the resident cache (hits counted in
-    the service /status corpus_cache view) and outputs are identical."""
+    the service /status corpus_cache view) and outputs are identical.
+    The round-20 RESULT tier would answer the resubmit before any scan
+    (no corpus lookup at all) — pin THIS tier with it off, the
+    corpus_resident.py base-leg discipline."""
     from distributed_grep_tpu.runtime.service import GrepService, JobState
     from distributed_grep_tpu.utils.config import JobConfig
     from pathlib import Path
+
+    monkeypatch.setenv("DGREP_RESULT_CACHE", "0")
 
     files = []
     for j in range(6):

@@ -526,6 +526,10 @@ def test_service_indexed_vs_off_byte_identity_and_restart(tmp_path,
 
     paths = _mk_corpus(tmp_path, n=8, needle_at=3)
 
+    # result tier off throughout: the warm resubmits below must PLAN
+    # (the index prune is what shrinks the warm plan) — the round-20
+    # result cache would answer them with no plan at all
+    monkeypatch.setenv("DGREP_RESULT_CACHE", "0")
     # DGREP_INDEX=0 oracle (fresh service, no summaries anywhere)
     monkeypatch.setenv("DGREP_INDEX", "0")
     svc0 = GrepService(work_root=tmp_path / "svc0", task_timeout_s=30)

@@ -401,14 +401,18 @@ def test_summarize_events_unit():
 
 
 @pytest.mark.service
-def test_explain_e2e_index_pruned_cache_warm(tmp_path, capsys):
+def test_explain_e2e_index_pruned_cache_warm(tmp_path, capsys, monkeypatch):
     """Acceptance e2e: a real service job that was index-pruned and
     model-cache-warm; `dgrep explain` reports the kernel family, the
     host/device route, the prune, and the cache hits — and the /metrics
-    rolling-window gauges move."""
+    rolling-window gauges move.  Result tier OFF: an identical resubmit
+    would otherwise answer wholly from the round-20 result cache — no
+    scan, nothing for this scan-path report to pin (that route has its
+    own pins in tests/test_result_cache.py)."""
     from distributed_grep_tpu.__main__ import main
     from distributed_grep_tpu.runtime.service import GrepService, ServiceServer
 
+    monkeypatch.setenv("DGREP_RESULT_CACHE", "0")
     corpus = tmp_path / "corpus"
     corpus.mkdir()
     files = []

@@ -1,0 +1,451 @@
+"""Query-result cache (round 20): repeated queries answer from stored
+per-split results in O(ms); drifted shards re-scan incrementally.
+
+Pins, in the order ISSUE 18 demands them:
+
+* byte identity across hit / partial / miss vs a cache-off oracle —
+  COLLATED record comparison (the cached job's output file layout
+  legitimately differs from a scanned job's);
+* the full-hit fast path never builds a scheduler and completes on a
+  daemon with ZERO workers (the strongest "no scan happened" proof);
+* stat-drift never serves stale bytes, including the cp -p + mv
+  same-size same-mtime inode replacement;
+* append one file of three -> exactly ONE split re-scans (planner
+  dispatch proof, `perf` marker);
+* entries persist across daemon restart (resume path re-plans with the
+  store);
+* whole-entry LRU under a tiny DGREP_RESULT_BYTES budget;
+* DGREP_RESULT_CACHE=0 is a TRUE no-op (no results/ dir, no /status
+  key); and a publish failure mid-job degrades to a partial/miss, never
+  to wrong bytes.
+
+Marker `result` (standalone: `pytest -m result`); the service-backed
+tests ride the lockdep audit like the `service` suite.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_grep_tpu.runtime import result_cache
+from distributed_grep_tpu.runtime.result_cache import (
+    ResultKey,
+    ResultStore,
+    result_key,
+)
+from distributed_grep_tpu.runtime.service import GrepService
+from distributed_grep_tpu.utils.config import JobConfig
+
+pytestmark = pytest.mark.result
+
+
+# --------------------------------------------------------------- helpers
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    root = tmp_path / "data"
+    root.mkdir()
+    files = {}
+    for name, text in {
+        "a.txt": "hello world\nthe quick brown fox\nhello again\n",
+        # b.txt keeps a match: a zero-match file's split would be
+        # index-PRUNED from the resubmit's plan (the tiers compose),
+        # which is correct but makes the reuse counts here input-shaped
+        "b.txt": "nothing here\nfox says hello\n\ntrailing line",
+        "c.txt": "HELLO uppercase\nhellohello twice\nlast hello\n",
+    }.items():
+        p = root / name
+        p.write_text(text)
+        files[name] = p
+    return files
+
+
+def _cfg(corpus, pattern="hello", **app_opts):
+    opts = {"pattern": pattern, "backend": "cpu"}
+    opts.update(app_opts)
+    return JobConfig(
+        input_files=[str(p) for p in corpus.values()],
+        application="distributed_grep_tpu.apps.grep_tpu",
+        app_options=opts,
+        n_reduce=3,
+    )
+
+
+def _collate(paths):
+    """Merged, sorted record lines — layout-independent comparison
+    (cached jobs materialize different file shapes than scanned ones)."""
+    lines = []
+    for p in paths:
+        with open(p, "rb") as f:
+            lines.extend(
+                ln for ln in f.read().splitlines(keepends=True) if ln.strip()
+            )
+    return sorted(lines)
+
+
+def _service(work_root, **kw):
+    kw.setdefault("task_timeout_s", 10.0)
+    kw.setdefault("sweep_interval_s", 0.1)
+    return GrepService(work_root=work_root, **kw)
+
+
+def _run(svc, config, timeout=60):
+    jid = svc.submit(config)
+    assert svc.wait_job(jid, timeout=timeout)
+    res = svc.job_result(jid)
+    assert res["state"] == "done", res
+    return jid, res
+
+
+# --------------------------------------------------- hit / partial / miss
+
+
+@pytest.mark.perf
+def test_hit_partial_miss_byte_identity(tmp_path, corpus):
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        # miss: first run scans everything and publishes per split
+        j1, r1 = _run(svc, _cfg(corpus))
+        rec1 = svc.record(j1)
+        assert rec1.result_splits_reused == 0
+        n_splits = len(rec1.map_splits)
+        assert n_splits == 3
+
+        # full hit: identical resubmit answers from cache — no scheduler
+        j2, r2 = _run(svc, _cfg(corpus))
+        rec2 = svc.record(j2)
+        assert rec2.scheduler is None
+        assert rec2.result_splits_reused == n_splits
+        assert rec2.result_bytes_unscanned > 0
+        assert _collate(r2["outputs"]) == _collate(r1["outputs"])
+        # metrics rider (the dgrep submit nonzero-only surface)
+        counters = r2["metrics"]["counters"]
+        assert counters["result_splits_reused"] == n_splits
+        assert counters["result_bytes_unscanned"] > 0
+        # GET /jobs/<id> is the submit CLIENT's counter source: a full
+        # hit has no scheduler, so job_status must surface the Metrics
+        # through the scheduler-less leg or the one-line submit JSON
+        # silently drops result_splits_reused (caught by the live drive)
+        js = svc.job_status(j2)
+        assert js["metrics"]["counters"]["result_splits_reused"] == n_splits
+
+        st = svc.status()
+        assert st["result_cache"]["result_hits"] == 1
+        assert st["result_cache"]["result_splits_reused"] == n_splits
+
+        # partial hit: append to ONE file -> exactly one split re-scans
+        with open(corpus["a.txt"], "a") as f:
+            f.write("hello appended\n")
+        j3, r3 = _run(svc, _cfg(corpus))
+        rec3 = svc.record(j3)
+        assert len(rec3.map_splits) == 1  # the dispatch proof
+        assert rec3.result_splits_reused == n_splits - 1
+        body = b"".join(_collate(r3["outputs"]))
+        assert b"appended" in body
+        assert svc.status()["result_cache"]["result_partial_hits"] == 1
+    finally:
+        svc.stop()
+
+    # oracle: cache-off daemon over the (drifted) corpus, byte-identical
+    os.environ["DGREP_RESULT_CACHE"] = "0"
+    try:
+        svc2 = _service(tmp_path / "svc2")
+        svc2.start_local_workers(1)
+        try:
+            _j, r4 = _run(svc2, _cfg(corpus))
+            assert _collate(r4["outputs"]) == _collate(r3["outputs"])
+        finally:
+            svc2.stop()
+    finally:
+        del os.environ["DGREP_RESULT_CACHE"]
+
+
+def test_full_hit_zero_workers_and_restart(tmp_path, corpus):
+    """Persistence + the strongest no-scan proof in one: prime daemon A,
+    stop it, start daemon B over the SAME work root with NO workers —
+    the resubmit must complete from the persisted store alone."""
+    work_root = tmp_path / "svc"
+    svc = _service(work_root)
+    svc.start_local_workers(1)
+    try:
+        _j, r1 = _run(svc, _cfg(corpus))
+    finally:
+        svc.stop()
+    assert (work_root / "results").exists()
+
+    svc2 = _service(work_root)  # no workers attached, resume replays
+    try:
+        _j, r2 = _run(svc2, _cfg(corpus), timeout=20)
+        assert _collate(r2["outputs"]) == _collate(r1["outputs"])
+    finally:
+        svc2.stop()
+
+
+def test_inode_drift_never_served(tmp_path, corpus):
+    """cp -p + mv: same size, same mtime, new inode, NEW CONTENT — the
+    validator tuple's inode member is what catches it."""
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        _j, r1 = _run(svc, _cfg(corpus))
+        target = corpus["c.txt"]
+        st = target.stat()
+        clone = target.with_name("c.txt.new")
+        # same byte COUNT, different bytes (hello -> hullo kills matches)
+        clone.write_bytes(target.read_bytes().replace(b"hello", b"hullo"))
+        os.utime(clone, ns=(st.st_atime_ns, st.st_mtime_ns))
+        os.replace(clone, target)
+        st2 = target.stat()
+        assert (st2.st_size, st2.st_mtime_ns) == (st.st_size, st.st_mtime_ns)
+
+        j2, r2 = _run(svc, _cfg(corpus))
+        rec2 = svc.record(j2)
+        assert len(rec2.map_splits) == 1  # only c.txt re-scanned
+        body = b"".join(_collate(r2["outputs"]))
+        assert b"hellohello" not in body
+        assert _collate(r2["outputs"]) != _collate(r1["outputs"])
+    finally:
+        svc.stop()
+
+
+def test_publish_failure_degrades_to_miss(tmp_path, corpus, monkeypatch):
+    """A save that dies mid-publish (the SIGKILL-between-publish-and-
+    finalize analogue) leaves at most a PREFIX of per-split entries —
+    the next submit partial-hits on what landed and re-scans the rest,
+    byte-identical either way."""
+    saved = []
+    orig = ResultStore.save
+
+    def flaky_save(self, key, records):
+        if saved:
+            return False  # crash after the first split's entry landed
+        saved.append(key)
+        return orig(self, key, records)
+
+    monkeypatch.setattr(ResultStore, "save", flaky_save)
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        _j, r1 = _run(svc, _cfg(corpus))
+        monkeypatch.setattr(ResultStore, "save", orig)
+        j2, r2 = _run(svc, _cfg(corpus))
+        rec2 = svc.record(j2)
+        assert rec2.result_splits_reused == 1  # only the landed entry
+        assert len(rec2.map_splits) == 2
+        assert _collate(r2["outputs"]) == _collate(r1["outputs"])
+    finally:
+        svc.stop()
+
+
+def test_alias_named_submit_misses(tmp_path, corpus):
+    """Same content through a symlink alias must MISS: cached records
+    carry the publishing job's GIVEN path names (fusion's symlinked
+    tenants keep per-job names), so a realpath-keyed hit would label
+    every line with the other tenant's paths."""
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        _j1, _r1 = _run(svc, _cfg(corpus))
+        alias_root = tmp_path / "alias"
+        alias_root.symlink_to(corpus["a.txt"].parent)
+        alias_corpus = {n: alias_root / n for n in corpus}
+        j2, r2 = _run(svc, _cfg(alias_corpus))
+        rec2 = svc.record(j2)
+        assert rec2.result_splits_reused == 0  # a clean miss, not a hit
+        body = b"".join(_collate(r2["outputs"]))
+        assert b"/alias/" in body  # records carry the ALIAS spellings
+        assert b"/data/" not in body
+        # the alias job published under ITS names: an alias resubmit hits
+        j3, _r3 = _run(svc, _cfg(alias_corpus))
+        assert svc.record(j3).result_splits_reused == len(alias_corpus)
+    finally:
+        svc.stop()
+
+
+def test_full_hit_fallback_counts_nothing(tmp_path, corpus, monkeypatch):
+    """A full hit whose materialization fails falls back to a real scan
+    — /status and /metrics must not keep the phantom hit (counters
+    stamp only after the cached blobs land)."""
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        _j1, r1 = _run(svc, _cfg(corpus))
+
+        def boom(*_a):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(GrepService, "_materialize_cached",
+                            staticmethod(boom))
+        j2, r2 = _run(svc, _cfg(corpus))
+        rec2 = svc.record(j2)
+        assert rec2.result_splits_reused == 0
+        assert _collate(r2["outputs"]) == _collate(r1["outputs"])
+        assert "result_cache" not in svc.status()  # no phantom hit
+    finally:
+        svc.stop()
+
+
+def test_status_surfaces_evictions_without_hits(tmp_path, corpus,
+                                                monkeypatch):
+    """Store eviction counters gate on their OWN nonzero-ness: a daemon
+    that published (and LRU-evicted) but never hit still reports them."""
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "256")
+    svc = _service(tmp_path / "svc")
+    svc.start_local_workers(1)
+    try:
+        _run(svc, _cfg(corpus))  # 3 published entries vs a 256 B budget
+        st = svc.status()
+        assert st["result_cache"]["result_lru_evictions"] >= 1
+        assert "result_hits" not in st["result_cache"]
+    finally:
+        svc.stop()
+
+
+def test_disabled_is_true_noop(tmp_path, corpus):
+    os.environ["DGREP_RESULT_CACHE"] = "0"
+    try:
+        svc = _service(tmp_path / "svc")
+        svc.start_local_workers(1)
+        try:
+            j1, _r1 = _run(svc, _cfg(corpus))
+            _j2, _r2 = _run(svc, _cfg(corpus))
+            rec = svc.record(j1)
+            assert rec.result_plan is None
+            assert not (tmp_path / "svc" / "results").exists()
+            assert "result_cache" not in svc.status()
+        finally:
+            svc.stop()
+    finally:
+        del os.environ["DGREP_RESULT_CACHE"]
+
+
+# ----------------------------------------------------------- store units
+
+
+def _ident_for(path: Path) -> tuple:
+    st = path.stat()
+    return ((os.path.realpath(path), st.st_size, st.st_mtime_ns,
+             st.st_ino),)
+
+
+def test_store_roundtrip_and_stale_eviction(tmp_path):
+    f = tmp_path / "x.txt"
+    f.write_text("one\ntwo\n")
+    store = ResultStore(tmp_path / "results")
+    key = ResultKey(("q",), str(f), _ident_for(f))
+    assert store.save(key, b"x.txt\x001\tone\n")
+    assert store.load(
+        ResultKey(("q",), str(f), _ident_for(f))
+    ) == b"x.txt\x001\tone\n"
+    # empty blob (zero-match split) is a VALID entry, not a miss
+    g = tmp_path / "y.txt"
+    g.write_text("nope\n")
+    assert store.save(ResultKey(("q",), str(g), _ident_for(g)), b"")
+    assert store.load(ResultKey(("q",), str(g), _ident_for(g))) == b""
+    # content drift: identity (the paths) is unchanged, so the lookup
+    # maps to the SAME stored file — whose validators now disagree with
+    # the fresh stat: never served, evicted on the spot
+    time.sleep(0.01)
+    f.write_text("one\ntwo\nthree\n")
+    fresh = ResultKey(("q",), str(f), _ident_for(f))
+    assert store.load(fresh) is None
+    assert store.stale_evictions == 1
+    assert not store._path_for(fresh.identity).exists()
+
+
+def test_alias_given_names_are_distinct_entries(tmp_path):
+    """Same realpath identity, different GIVEN spelling -> different
+    store entries (the records inside carry the given names)."""
+    f = tmp_path / "real.txt"
+    f.write_text("hit\n")
+    link = tmp_path / "alias.txt"
+    link.symlink_to(f)
+    ident = _ident_for(f)
+    assert _ident_for(link) == ident  # realpath collapses the alias
+    store = ResultStore(tmp_path / "results")
+    assert store.save(ResultKey(("q",), str(f), ident), b"real-records")
+    assert store.load(ResultKey(("q",), str(link), ident)) is None
+    assert store.load(ResultKey(("q",), str(f), ident)) == b"real-records"
+
+
+def test_bucket_records_duplicate_member_publishes_nothing(tmp_path):
+    out = tmp_path / "out-0"
+    out.write_bytes(b"a.txt (line number #1)\thit\n")
+    # the same file listed twice: attribution is ambiguous and the two
+    # same-identity splits would overwrite each other's entry
+    assert result_cache.bucket_records(
+        [str(out)], ["a.txt", "a.txt"]
+    ) is None
+    got = result_cache.bucket_records([str(out)], ["a.txt", "b.txt"])
+    assert got == [b"a.txt (line number #1)\thit\n", b""]
+
+
+def test_store_sweeps_torn_tmp_files(tmp_path):
+    root = tmp_path / "results"
+    root.mkdir()
+    torn = root / ".abc.res.123.456.tmp"
+    torn.write_bytes(b"torn half-write")
+    ResultStore(root)  # construction sweeps crash leftovers
+    assert not torn.exists()
+
+
+def test_store_lru_eviction_and_oversize_decline(tmp_path, monkeypatch):
+    f = tmp_path / "x.txt"
+    f.write_text("data\n")
+    ident = _ident_for(f)
+    store = ResultStore(tmp_path / "results")
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "4096")
+    old = ResultKey(("old",), str(f), ident)
+    assert store.save(old, b"a" * 1500)
+    time.sleep(0.01)
+    assert store.save(ResultKey(("mid",), str(f), ident), b"b" * 1500)
+    time.sleep(0.01)
+    # third entry overflows the 4096 budget -> oldest-mtime evicted
+    assert store.save(ResultKey(("new",), str(f), ident), b"c" * 1500)
+    assert store.load(old) is None
+    assert store.lru_evictions >= 1
+    # an entry larger than the WHOLE budget is declined, evicting nobody
+    before = sorted(p.name for p in (tmp_path / "results").glob("*.res"))
+    assert not store.save(ResultKey(("huge",), str(f), ident), b"z" * 8192)
+    after = sorted(p.name for p in (tmp_path / "results").glob("*.res"))
+    assert before == after
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "0")
+    assert not store.save(ResultKey(("off",), str(f), ident), b"x")
+
+
+def test_eligibility_boundaries(corpus):
+    assert result_key(_cfg(corpus)) is not None
+    assert result_key(_cfg(corpus, invert=True)) is None
+    assert result_key(_cfg(corpus, count_only=True)) is None
+    assert result_key(_cfg(corpus, presence_only=True)) is None
+    follow_cfg = _cfg(corpus)
+    follow_cfg.follow = True
+    assert result_key(follow_cfg) is None
+    other_app = _cfg(corpus)
+    other_app.application = "some.custom.app"
+    assert result_key(other_app) is None
+
+
+def test_env_knob_parsers(monkeypatch):
+    monkeypatch.delenv("DGREP_RESULT_CACHE", raising=False)
+    assert result_cache.env_result_cache() is True
+    for off in ("0", "false", "no", " NO "):
+        monkeypatch.setenv("DGREP_RESULT_CACHE", off)
+        assert result_cache.env_result_cache() is False
+    monkeypatch.setenv("DGREP_RESULT_CACHE", "1")
+    assert result_cache.env_result_cache() is True
+    monkeypatch.delenv("DGREP_RESULT_BYTES", raising=False)
+    assert result_cache.env_result_bytes() == result_cache.DEFAULT_RESULT_BYTES
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "1024")
+    assert result_cache.env_result_bytes() == 1024
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "-5")
+    assert result_cache.env_result_bytes() == 0
+    monkeypatch.setenv("DGREP_RESULT_BYTES", "zap")
+    assert result_cache.env_result_bytes() == result_cache.DEFAULT_RESULT_BYTES

@@ -218,7 +218,10 @@ def test_warm_resubmit_hits_cache_and_skips_rebuild(tmp_path, corpus,
     """ISSUE 6 acceptance: the SECOND submit of an identical pattern (after
     an intervening different pattern, so the app-level same-config
     short-circuit cannot answer) registers >= 1 compile_cache_hits and
-    constructs NO new engine."""
+    constructs NO new engine.  Result tier off: the round-20 result
+    cache would answer the resubmit without any engine touch at all
+    (its own pins live in tests/test_result_cache.py)."""
+    service._result_store = None
     constructions = []
     orig_init = engine_mod.GrepEngine.__init__
 
@@ -401,11 +404,14 @@ def test_env_knob_accessors(monkeypatch):
 
 # ------------------------------------------------------------- HTTP surface
 
-def test_http_api_submit_status_result_and_telemetry(tmp_path, corpus):
+def test_http_api_submit_status_result_and_telemetry(tmp_path, corpus,
+                                                     monkeypatch):
     """The full HTTP surface: POST /jobs -> GET /jobs/<id> -> result;
     service /status exposes queue/jobs/workers with piggybacked
     compile_cache_* counters; per-job events.jsonl carries the
-    cache:hit|miss instants and trace-export renders them."""
+    cache:hit|miss instants and trace-export renders them.  Result tier
+    off: the resubmit must SCAN for compile_cache_hits to register."""
+    monkeypatch.setenv("DGREP_RESULT_CACHE", "0")
     svc = GrepService(
         work_root=tmp_path / "svc", spans=True,
         task_timeout_s=5.0, sweep_interval_s=0.1,

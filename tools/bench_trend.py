@@ -113,6 +113,43 @@ def failover_samples(root: Path) -> dict | None:
     }
 
 
+def result_store_footprint(root: Path) -> dict | None:
+    """Size up the newest persisted result-cache store under ``root``
+    (the round-20 ``<work_root>/results/`` dirs): entry count + bytes.
+    Reporting only, like the failover rider — None keeps the trend line
+    its pre-round-20 shape when no store exists."""
+    newest = None
+    for path in root.rglob("results"):
+        if not path.is_dir():
+            continue
+        try:
+            mt = path.stat().st_mtime_ns
+        except OSError:
+            continue
+        if newest is None or mt > newest[0]:
+            newest = (mt, path)
+    if newest is None:
+        return None
+    entries = 0
+    total = 0
+    try:
+        for e in newest[1].glob("*.res"):
+            try:
+                total += e.stat().st_size
+            except OSError:
+                continue
+            entries += 1
+    except OSError:
+        return None
+    if not entries:
+        return None
+    return {
+        "source": str(newest[1]),
+        "entries": entries,
+        "bytes": total,
+    }
+
+
 def markdown_table(rounds: list[dict]) -> str:
     lines = ["| round | GB/s | ms/pass | notes |",
              "| --- | --- | --- | --- |"]
@@ -154,6 +191,9 @@ def main(argv: list[str] | None = None) -> int:
     failover = failover_samples(Path(args.root))
     if failover is not None:
         doc["failover"] = failover
+    results = result_store_footprint(Path(args.root))
+    if results is not None:
+        doc["result_store"] = results
     print(json.dumps(doc, sort_keys=True))
     if not args.json_only:
         print(markdown_table(rounds))
