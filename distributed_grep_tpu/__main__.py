@@ -1936,7 +1936,18 @@ def _render_top(statuses: dict[str, dict | None],
             lines.append(f"latency {key}: {_kv_line(summ)}")
     follow = st.get("follow")
     if follow:
+        follow = dict(follow)
+        groups = follow.pop("groups", None)
         lines.append(f"follow: {_kv_line(follow)}")
+        for g in groups or []:
+            # Per-group wake lag (now - last wake) is the standing-query
+            # liveness signal — a stuck group runner shows here first.
+            lines.append(
+                f"  group [{','.join(str(j) for j in g.get('jobs', []))}]: "
+                f"members={g.get('members', 0)} files={g.get('files', 0)} "
+                f"poll_s={g.get('poll_s', 0)} wakes={g.get('wakes', 0)} "
+                f"wake_lag_s={g.get('wake_lag_s', 0.0)}"
+            )
     workers = st.get("workers") or {}
     if workers:
         lines.append("")

@@ -238,6 +238,16 @@ KNOBS: dict[str, Knob] = {
         "count to the lagging consumer) — the scan loop never blocks "
         "(accessor: runtime/follow.env_stream_buffer).",
     ),
+    "DGREP_FOLLOW_FUSE": Knob(
+        "runtime/follow.py", "1",
+        "Fused follow tier (round 21): follow jobs sharing a "
+        "fusion-eligible (watched-input identity x non-query options) "
+        "key ride ONE group wake loop — one stat + one union suffix "
+        "scan per (file, wake) serves every member; 0/false disables "
+        "the group registry entirely — solo runners, /status, and wire "
+        "payloads then match the pre-fusion daemon byte for byte "
+        "(accessor: runtime/follow.env_follow_fuse).",
+    ),
     "DGREP_LEASE_TTL_S": Knob(
         "runtime/lease.py", "10",
         "Work-root lease staleness wall (round 18 active/standby "

@@ -1121,6 +1121,9 @@ class GrepEngine:
             # stream_dropped_records), same nonzero-only sys.modules-gated
             # contract — rides engine.stats onto the heartbeat piggyback
             self.stats.update(fol_mod.follow_counters())
+            # fused follow tier (round 21): follow_fused_queries/wakes/
+            # suffix_bytes_saved — separate dict so the =0 no-op holds
+            self.stats.update(fol_mod.follow_fused_counters())
         if t0 is not None:
             # after the EOL fix-up: the record's match count must equal the
             # ScanResult the caller actually receives

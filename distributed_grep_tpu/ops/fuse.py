@@ -272,6 +272,38 @@ class FusedScanner:
         _count_fusion(len(self.specs), 1, len(data))
         return results
 
+    def scan_suffix(self, path, offset: int = 0, *, final: bool = False,
+                    max_bytes: int | None = None):
+        """One live-append suffix, K exact results (the fused follow
+        tier's per-(file, wake) entry): one union suffix scan through
+        the ``GrepEngine.scan_file_suffix`` contract — cut at the last
+        newline, partial tail carried, ``offset`` MUST be a line start —
+        then the PR 11 candidate-line-slab confirm per member.  Returns
+        ``(results, consumed, data)``: per-spec suffix-LOCAL ScanResults
+        (matched_lines 1-based within ``data``), the shared cursor
+        advance, and the scanned bytes.  Exactness is the same two-step
+        argument as scan/scan_batch: the union suffix result is a
+        superset of every member's (alternation + OR'd ignore_case), and
+        the per-line confirm slab is position-invariant — so each
+        member's fused suffix result is bit-identical to its own solo
+        ``scan_file_suffix`` over the same (offset, bytes) window.
+
+        Telemetry: the fused-wake counters live follow-side
+        (runtime/follow.follow_fused_counters — the group runner knows
+        wake/member attribution); this entry does NOT bump the batch
+        ``fused_*`` counters, so batch-fusion telemetry keeps meaning."""
+        union_res, consumed, data = self.union.scan_file_suffix(
+            path, offset, final=final, max_bytes=max_bytes
+        )
+        if consumed == 0:
+            empty = [
+                ScanResult(np.zeros(0, dtype=np.int64), 0, 0)
+                for _ in self.specs
+            ]
+            return empty, 0, data
+        results, _nl = self._confirm_all(data, union_res)
+        return results, consumed, data
+
     def scan_batch(self, items, progress=None, emit=None):
         """Many inputs through the union engine's packed batching — one
         dispatch per DGREP_BATCH_BYTES window serves every query.  Items

@@ -64,6 +64,7 @@ def summarize_events(events: list[dict]) -> dict:
                "relay_fallbacks": 0, "lost_outputs": 0}
     tasks = {"map_assigns": 0, "reduce_assigns": 0, "timeouts": 0,
              "map_commits": 0, "reduce_commits": 0}
+    follow = {"solo_wakes": 0, "fused_wakes": 0, "records": 0}
     device_fallbacks = 0
     degrades = 0
     for r in events:
@@ -120,6 +121,14 @@ def summarize_events(events: list[dict]) -> dict:
                 )
             elif name == "fuse:split":
                 fusion["fused_attempts"] += 1
+            elif name in ("follow:wake", "fuse:wake"):
+                # streaming tier: which wake loop served this standing
+                # query — its own solo runner or a fused group (round 21)
+                key = "solo_wakes" if name == "follow:wake" else "fused_wakes"
+                follow[key] += 1
+                follow["records"] += int(
+                    (r.get("args") or {}).get("records", 0)
+                )
             elif name == "shuffle:peer":
                 shuffle["peer_fetches"] += 1
                 shuffle["peer_bytes"] += int(
@@ -159,6 +168,15 @@ def summarize_events(events: list[dict]) -> dict:
         # stored results, wholly or incrementally?  Nonzero-only — a
         # cache-free job's report keeps its pre-round-20 shape.
         out["result_cache"] = result
+    if follow["solo_wakes"] or follow["fused_wakes"]:
+        # standing-query route verdict: fused means every wake came from
+        # a group's shared scan; mixed marks a catch-up/demotion mid-run
+        follow["route"] = (
+            "fused" if follow["fused_wakes"] and not follow["solo_wakes"]
+            else "solo" if follow["solo_wakes"] and not follow["fused_wakes"]
+            else "mixed"
+        )
+        out["follow"] = follow
     if any(shuffle.values()):
         # shuffle route verdict (peer-to-peer shuffle, round 16): which
         # data plane the job's reduce fetches actually rode

@@ -45,6 +45,23 @@ bandwidth-bound counter update.
 The follow path never consults the shard index: a stale trigram summary
 can therefore never prune a standing query (and the batch entries'
 lookups revalidate fresh stats anyway — an append IS stat drift).
+
+Fused follow tier (round 21): ``FollowGroupRegistry``/``FollowGroup``
+cluster standing queries whose configs share a fusion-eligible
+``runtime/fusion.follow_fusion_key`` — same watched-input realpath set,
+same non-query options, a union-hostable query — under ONE shared
+per-file cursor and ONE wake loop (cadence = the tightest member's
+poll_s): each wake runs one suffix read + one union scan
+(``ops/fuse.FusedScanner.scan_suffix``) and fans each member's exact
+confirmed result into that member's OWN FollowLog + StreamRing, so
+per-job durability/replay/reconnect are untouched while reads, scans,
+and engine state stop scaling with K.  Members joining a live group
+catch up solo (on the group thread, byte-budgeted so the capped read
+lands exactly on the group cursor) before fusing; any fused-leg
+failure, per-file truncation/inode reset, or FuseError falls members
+back to their pre-round-21 solo runner — fusion is never a correctness
+dependency.  ``DGREP_FOLLOW_FUSE=0`` is a TRUE no-op: no registry, no
+/status group view, solo runners byte-identical to round 17.
 """
 
 from __future__ import annotations
@@ -101,6 +118,17 @@ def env_stream_buffer(default: int = DEFAULT_STREAM_BUFFER) -> int:
     return v if v > 0 else default
 
 
+def env_follow_fuse(default: bool = True) -> bool:
+    """Fused-follow switch — the ONE parser of DGREP_FOLLOW_FUSE.  On by
+    default; "0"/"false"/"no" disables the group registry entirely (a
+    TRUE no-op: runners start their pre-round-21 solo threads, /status
+    carries no group view, the fused counters never tick)."""
+    raw = os.environ.get("DGREP_FOLLOW_FUSE")
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no")
+
+
 # ------------------------------------------------------ module telemetry
 # Process-global follow counters, the fusion_counters contract: leaf
 # lock, nonzero-only reads, merged into engine.stats (ops/engine.scan
@@ -133,6 +161,39 @@ def follow_counters_clear() -> None:
     with _stats_lock:
         for k in _stats:
             _stats[k] = 0
+
+
+# Fused-follow counters (round 21): same contract, SEPARATE dict so the
+# DGREP_FOLLOW_FUSE=0 no-op pin stays byte-exact — solo runners touch
+# only the base dict above.  follow_fused_queries = standing queries
+# adopted into groups; follow_fused_wakes = group wakes with news that
+# served >= 2 fused members; follow_suffix_bytes_saved = suffix bytes
+# the co-members did NOT re-read/re-scan ((K_live - 1) x consumed).
+_fused_stats_lock = lockdep.make_lock("follow-fused-stats")
+_fused_stats = {
+    "follow_fused_queries": 0,
+    "follow_fused_wakes": 0,
+    "follow_suffix_bytes_saved": 0,
+}
+
+
+def _count_fused(name: str, n: int = 1) -> None:
+    with _fused_stats_lock:
+        _fused_stats[name] += n
+
+
+def follow_fused_counters() -> dict:
+    """Copy of the fused-follow counters, or {} when never touched."""
+    with _fused_stats_lock:
+        if not any(_fused_stats.values()):
+            return {}
+        return dict(_fused_stats)
+
+
+def follow_fused_counters_clear() -> None:
+    with _fused_stats_lock:
+        for k in _fused_stats:
+            _fused_stats[k] = 0
 
 
 # ------------------------------------------------------------- cursors
@@ -196,19 +257,30 @@ class FollowScanner:
         return any(c.emitted for c in self.cursors.values())
 
     # -- scanning --------------------------------------------------------
-    def poll_once(self, final: bool = False) -> list[tuple[str, list[dict], dict]]:
+    def poll_once(self, final: bool = False,
+                  limits: dict[str, int] | None = None
+                  ) -> list[tuple[str, list[dict], dict]]:
         """One wake over every file: scan grown suffixes, return
         ``[(path, records, cursor_state), ...]`` for files with news.
         ``final=True`` additionally scans an unterminated tail line
         (stream teardown — the idle-exit/finalize path that makes the
         output equal the one-shot oracle even without a trailing
-        newline)."""
+        newline).  ``limits`` (the fused tier's join catch-up) restricts
+        the wake to the listed paths and caps each file's suffix read at
+        its byte budget: group cursors are line starts, so the capped
+        read's last byte is a newline and the member lands EXACTLY on
+        the group cursor (or steps toward it in MAX_WAKE_BYTES hops)."""
         groups: list[tuple[str, list[dict], dict]] = []
         scanned = 0
         for cur in self.cursors.values():
+            cap = None
+            if limits is not None:
+                cap = limits.get(cur.path)
+                if cap is None or cap <= 0:
+                    continue
             snap = cur.state()
             try:
-                records = self._poll_file(cur, final)
+                records = self._poll_file(cur, final, cap)
             except OSError:
                 # per-file fault isolation: a file unlinked between the
                 # stat and the open (or any transient read error) must
@@ -230,7 +302,7 @@ class FollowScanner:
             _count("suffix_bytes_scanned", scanned)
         return groups
 
-    def _poll_file(self, cur: FileCursor, final: bool):
+    def _poll_file(self, cur: FileCursor, final: bool, cap: int | None = None):
         """(records, suffix_bytes) for one file, or None when nothing
         changed.  Truncation/replacement (validator-tuple drift: size
         below the cursor, or a new inode) emits a ``reset`` record and
@@ -261,7 +333,9 @@ class FollowScanner:
             # be re-read from disk at every poll)
             return (records, 0) if records else None
         res, consumed, data = self.engine.scan_file_suffix(
-            cur.path, cur.offset, final=final, max_bytes=MAX_WAKE_BYTES
+            cur.path, cur.offset, final=final,
+            max_bytes=(MAX_WAKE_BYTES if cap is None
+                       else min(MAX_WAKE_BYTES, cap)),
         )
         if consumed == 0:
             # no complete line in the suffix: remember the size so the
@@ -526,11 +600,17 @@ class FollowRunner:
     of losing them."""
 
     def __init__(self, job_id: str, config, work_root: str | Path, *,
-                 event_log=None, on_fail=None, write_gate=None):
+                 event_log=None, on_fail=None, write_gate=None,
+                 groups=None):
         self.job_id = job_id
         self.config = config
         self.event_log = event_log
         self.on_fail = on_fail
+        # Fused tier (round 21): the daemon's FollowGroupRegistry, or
+        # None (DGREP_FOLLOW_FUSE=0 / one-shot CLI) — then start() is
+        # the pre-round-21 solo thread, byte for byte.
+        self.groups = groups
+        self.fused = False  # True while a FollowGroup drives this runner
         # Daemon-scope write fence (round 18 HA failover): consulted
         # before each wake's journal writes.  A False answer means this
         # daemon lost the work-root lease — the wake is ABANDONED before
@@ -571,7 +651,7 @@ class FollowRunner:
         self.started_at = time.time()
 
     # -- engine construction (lazy: ops stack imports live here only) ----
-    def _build_scanner(self) -> FollowScanner:
+    def _build_engine(self):
         from distributed_grep_tpu.ops.engine import cached_engine
 
         opts = dict(self.config.effective_app_options())
@@ -587,6 +667,14 @@ class FollowRunner:
             # latency-bound small suffixes; "device" opts in explicitly
             backend=("device" if opts.get("backend") == "device" else "cpu"),
         )
+        return engine
+
+    def _make_scanner(self, engine) -> FollowScanner:
+        """Cursors + emit semantics around ``engine`` — which may be
+        None (a fused group member: the group's union scan feeds
+        ``_emit`` directly; the engine attaches lazily only for join
+        catch-up or after a demotion to solo)."""
+        opts = dict(self.config.effective_app_options())
         scanner = FollowScanner(
             engine, list(self.config.input_files),
             invert=bool(opts.get("invert", False)),
@@ -596,8 +684,24 @@ class FollowRunner:
         scanner.restore(self._resume_cursors)
         return scanner
 
+    def _build_scanner(self) -> FollowScanner:
+        return self._make_scanner(self._build_engine())
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
+        if self.groups is not None and self.groups.adopt(self):
+            return  # a FollowGroup's shared wake thread drives this runner
+        self.start_solo()
+
+    def start_solo(self) -> None:
+        """Spawn the solo wake thread — the only path when the registry
+        is absent (DGREP_FOLLOW_FUSE=0 / CLI) or the config is
+        group-ineligible, and the fall-back landing for a demoted group
+        member (whose scanner keeps the exact cursors; only the engine
+        is missing and attaches on the first solo wake)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self.fused = False
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"follow-{self.job_id}"
         )
@@ -615,6 +719,12 @@ class FollowRunner:
         runs ON this thread — joining it would raise and skip the log
         close below)."""
         self._stop.set()
+        if self.groups is not None:
+            # blocks on the group's wake lock: an in-flight group wake
+            # finishes its writes to this member's log/ring first; after
+            # this the group never touches the runner again (no-op for
+            # solo runners)
+            self.groups.discard(self)
         self.ring.close()
         if (self._thread is not None
                 and self._thread is not threading.current_thread()):
@@ -628,7 +738,12 @@ class FollowRunner:
         if self._stop.is_set():
             return  # cancelled between publish and start: skip the build
         try:
-            self._scanner = self._build_scanner()
+            if self._scanner is None:
+                self._scanner = self._build_scanner()
+            elif self._scanner.engine is None:
+                # demoted from a fused group: the member scanner carries
+                # the exact cursors; only the engine is missing
+                self._scanner.engine = self._build_engine()
         except Exception as e:  # noqa: BLE001 — bad query, healthy daemon
             log.exception("follow job %s failed to build its engine",
                           self.job_id)
@@ -655,6 +770,8 @@ class FollowRunner:
             return 0
         if self._scanner is None:
             self._scanner = self._build_scanner()
+        elif self._scanner.engine is None:
+            self._scanner.engine = self._build_engine()
         if self._log_dirty:
             # a failed journal write may have torn a line mid-file; a
             # plain append would glue the next record onto the fragment
@@ -705,6 +822,48 @@ class FollowRunner:
                     log.exception("follow:wake event write failed")
         return emitted
 
+    # -- fused-tier entries (called from a FollowGroup's wake thread) ----
+    def fused_commit(self, path: str, cursor: dict,
+                     records: list[dict]) -> None:
+        """Journal + publish one (file, wake) for this member — the same
+        journal-first ordering and torn-line reopen discipline as
+        wake_once, minus the scan (the group already ran the shared
+        union scan).  Raises on journal failure: the caller rolls this
+        member's cursor back and demotes it to solo."""
+        if self._log_dirty:
+            try:
+                self._log.close()
+            except Exception:  # noqa: BLE001 — the handle may be dead
+                log.exception("follow log close-for-reopen failed")
+            self._log = FollowLog(self._log_path)
+            self._log_dirty = False
+        seq0 = self.ring.next_seq
+        try:
+            self._log.record_wake(path, cursor, seq0, records)
+        except Exception:
+            self._log_dirty = True  # reopen before the next append
+            raise
+        self.ring.publish(records)
+
+    def note_fused_wake(self, n_files: int, n_records: int, *,
+                        fused: bool = True) -> None:
+        """Wake accounting + the explain instant for a group-driven
+        wake: fused wakes write ``fuse:wake`` (dgrep explain's
+        fused-route signal); join catch-up wakes — solo semantics on the
+        group thread — keep the solo ``follow:wake`` name."""
+        self.wakes += 1
+        if self.event_log is None:
+            return
+        try:
+            self.event_log.write({
+                "t": "instant",
+                "name": "fuse:wake" if fused else "follow:wake",
+                "cat": "follow", "ts": time.time(), "job": self.job_id,
+                "args": {"files": n_files, "records": n_records},
+            })
+        except Exception:  # noqa: BLE001 — telemetry only
+            log.exception("follow wake event write failed")
+
     def status(self) -> dict:
         out: dict = {
             "poll_s": self.poll_s,
@@ -714,6 +873,8 @@ class FollowRunner:
         }
         if self.resumed:
             out["resumed"] = True
+        if self.fused:
+            out["fused"] = True
         if self.error:
             out["error"] = self.error
         sc = self._scanner
@@ -722,3 +883,469 @@ class FollowRunner:
                 sum(c.emitted for c in sc.cursors.values())
             )
         return out
+
+
+# ------------------------------------------------------------ fused tier
+@dataclass
+class _GroupMember:
+    """One standing query inside a FollowGroup: the runner it fans into,
+    its query spec (the FusedScanner union slot), the group-realpath ->
+    member-spelling map (records carry each job's OWN path spellings),
+    and its engine-LESS FollowScanner — exact cursors + emit semantics;
+    the engine attaches only for join catch-up or after demotion."""
+
+    runner: FollowRunner
+    spec: tuple
+    paths: dict[str, str]
+    scanner: FollowScanner
+    catching_up: bool = True
+
+
+class FollowGroup:
+    """ONE wake loop + ONE shared per-file cursor serving K fused
+    standing queries: each wake runs one stat + one suffix read + one
+    union scan per grown file (ops/fuse.FusedScanner.scan_suffix) and
+    fans each member's exact confirmed result into that member's OWN
+    FollowLog + StreamRing via FollowRunner.fused_commit — per-job
+    durability, torn-tail replay, and reconnect semantics untouched.
+
+    Thread-safety: membership mutates under the registry's pure-state
+    lock; all scan/journal work runs under the group's io_ok wake lock
+    ("follow-group-wake"), which FollowGroupRegistry.discard also takes
+    so a leaving runner is never written to mid-wake.  Lock order: wake
+    lock OUTER, registry lock inner (demotions fire under a wake)."""
+
+    def __init__(self, key: tuple, reg: "FollowGroupRegistry"):
+        self.key = key
+        self._reg = reg
+        # shared per-file scan state, keyed by realpath (the key's
+        # watched half); offsets/lines are identical across fused
+        # members by construction (same content, same cursor)
+        self.cursors: dict[str, FileCursor] = {}
+        self._members: list[_GroupMember] = []
+        self._wake_lock = lockdep.make_lock("follow-group-wake", io_ok=True)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._fused = None  # ops.fuse.FusedScanner for the current members
+        self._fused_specs: tuple = ()
+        self.poll_s = DEFAULT_FOLLOW_POLL_S
+        self.wakes = 0
+        self.last_wake = time.monotonic()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"follow-group-{id(self):x}",
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.wake_once()
+            except Exception:  # noqa: BLE001 — one bad wake must not kill
+                # the group (files may reappear/recover next wake)
+                log.exception("fused follow wake failed")
+            self._stop.wait(self.poll_s)
+
+    def members(self) -> list[_GroupMember]:
+        with self._reg._lock:
+            return list(self._members)
+
+    def _recompute_cadence_locked(self) -> None:
+        # cadence = the tightest member's poll_s (pure state — callable
+        # under the registry lock)
+        if self._members:
+            self.poll_s = min(m.runner.poll_s for m in self._members)
+
+    # -- the group wake --------------------------------------------------
+    def wake_once(self) -> int:
+        """One group wake (tests and the benchmark drive this directly):
+        catch the joiners up, then ONE shared suffix scan per grown file
+        fanned into every fused member.  Returns records emitted."""
+        with self._wake_lock:
+            return self._wake_under_lock()
+
+    def _wake_under_lock(self) -> int:
+        gate = self._reg.write_gate
+        if gate is not None and not gate():
+            # deposed daemon (round 18 fence): stop every member BEFORE
+            # any journal write — the promoted daemon owns the cursors
+            for m in self.members():
+                m.runner.request_stop()
+            self._stop.set()
+            return 0
+        self.last_wake = time.monotonic()
+        emitted = 0
+        for m in self.members():
+            if m.catching_up and not m.runner._stop.is_set():
+                emitted += self._catch_up(m)
+        fused = [m for m in self.members()
+                 if not m.catching_up and not m.runner._stop.is_set()]
+        if not fused:
+            return emitted
+        if not self._ensure_union(fused):
+            return emitted  # FuseError: every member just went solo
+        tally: dict[str, list[int]] = {
+            m.runner.job_id: [0, 0] for m in fused
+        }
+        dead: set[int] = set()
+        news = False
+        for real in sorted(self.cursors):
+            n = self._wake_file(self.cursors[real], fused, dead, tally)
+            if n is None:
+                return emitted  # truncation: the whole group went solo
+            if n:
+                news = True
+                emitted += n
+        if news:
+            self.wakes += 1
+            # base counter parity with the solo path: the GROUP's one
+            # scan pass counts as one wake (K-flatness is the point)
+            _count("follow_wakes")
+            alive = [m for m in fused if id(m) not in dead]
+            if len(alive) >= 2:
+                _count_fused("follow_fused_wakes")
+            for m in alive:
+                files, recs = tally[m.runner.job_id]
+                if files:
+                    m.runner.note_fused_wake(files, recs)
+        return emitted
+
+    def _wake_file(self, gcur: FileCursor, fused: list[_GroupMember],
+                   dead: set[int], tally: dict[str, list[int]]):
+        """One shared suffix scan fanned into every fused member.
+        Returns records emitted, 0 when the file had no news, or None
+        when truncation/replacement demoted the group to solo."""
+        try:
+            st = os.stat(gcur.path)
+        except OSError:
+            return 0  # not created yet / vanished: keep the cursor
+        if st.st_size < gcur.offset or (
+                gcur.ino >= 0 and st.st_ino != gcur.ino):
+            # truncation/replacement: fall the WHOLE group back to solo —
+            # each member's own runner re-detects the reset against its
+            # durable cursor and emits its exact reset record + rescan
+            # (the reset path stays the single solo-tested one; fusion
+            # is never a correctness dependency)
+            self._demote_all()
+            return None
+        gcur.ino = int(st.st_ino)
+        if st.st_size <= gcur.offset:
+            return 0
+        if st.st_size == gcur.seen:
+            return 0
+        try:
+            results, consumed, data = self._fused.scan_suffix(
+                gcur.path, gcur.offset, max_bytes=MAX_WAKE_BYTES
+            )
+        except OSError:
+            log.exception("fused follow scan failed for %s", gcur.path)
+            return 0  # transient read error: next wake retries
+        if consumed == 0:
+            gcur.seen = int(st.st_size)
+            return 0
+        # ONE read + one union scan for K members: the base counter
+        # ticks once per shared scan (the flat-in-K figure the benchmark
+        # pins); the saved counter prices what solo runners would have
+        # re-read and re-scanned
+        _count("suffix_bytes_scanned", consumed)
+        live = [m for m in fused if id(m) not in dead
+                and not m.runner._stop.is_set()]
+        if len(live) >= 2:
+            _count_fused("follow_suffix_bytes_saved",
+                         consumed * (len(live) - 1))
+        n_records = 0
+        for k, m in enumerate(fused):
+            if id(m) in dead or m.runner._stop.is_set():
+                continue
+            mpath = m.paths[gcur.path]
+            mcur = m.scanner.cursors[mpath]
+            snap = mcur.state()
+            recs = m.scanner._emit(mcur, results[k], data)
+            mcur.offset += consumed
+            mcur.ino = gcur.ino
+            try:
+                m.runner.fused_commit(mpath, mcur.state(), recs)
+            except Exception:  # noqa: BLE001 — journal fault: this
+                # member falls back to solo with its cursor rolled back
+                # (no line lost, none duplicated); the others continue
+                log.exception("fused commit failed for %s — demoting",
+                              m.runner.job_id)
+                mcur.restore(snap)
+                dead.add(id(m))
+                self._demote(m)
+                continue
+            t = tally[m.runner.job_id]
+            t[0] += 1
+            t[1] += len(recs)
+            n_records += len(recs)
+        gcur.offset += consumed
+        # consumed > 0 under final=False means data ends at a newline,
+        # so the line advance is exactly the newline count
+        gcur.line += data.count(b"\n")
+        return n_records
+
+    def _ensure_union(self, fused: list[_GroupMember]) -> bool:
+        """(Re)build the FusedScanner when membership changed.  Specs
+        ride the cross-job model cache, so a stable group pays zero
+        compiles per rebuild.  FuseError/any failure demotes every
+        member to solo and answers False."""
+        specs = tuple(m.spec for m in fused)
+        if self._fused is not None and specs == self._fused_specs:
+            return True
+        try:
+            from distributed_grep_tpu.ops.fuse import FusedScanner
+
+            opts = dict(fused[0].runner.config.effective_app_options())
+            self._fused = FusedScanner(
+                list(specs),
+                backend=("device" if opts.get("backend") == "device"
+                         else "cpu"),
+            )
+            self._fused_specs = specs
+            return True
+        except Exception:  # noqa: BLE001 — union outside every subset
+            log.exception("fused follow union build failed — solo fallback")
+            self._fused = None
+            self._fused_specs = ()
+            self._demote_all()
+            return False
+
+    def _catch_up(self, m: _GroupMember) -> int:
+        """Advance a joiner from its durable cursor to the group cursor
+        (solo semantics on the group thread, byte-budgeted so the capped
+        suffix read cuts exactly at the group cursor — both are line
+        starts).  A member AHEAD of the group (a demoted-then-readopted
+        resume) or anchored to a different inode goes solo: only
+        behind-or-aligned members can fuse without re-emitting."""
+        limits: dict[str, int] = {}
+        for real, gcur in self.cursors.items():
+            mpath = m.paths.get(real)
+            mcur = m.scanner.cursors.get(mpath) if mpath else None
+            if mcur is None:
+                self._demote(m)
+                return 0
+            if mcur.offset > gcur.offset or (
+                    mcur.ino >= 0 and gcur.ino >= 0
+                    and mcur.ino != gcur.ino):
+                self._demote(m)
+                return 0
+            if mcur.offset < gcur.offset:
+                limits[mpath] = gcur.offset - mcur.offset
+        if not limits:
+            m.catching_up = False
+            m.runner.fused = True
+            return 0
+        if m.scanner.engine is None:
+            try:
+                m.scanner.engine = m.runner._build_engine()
+            except Exception:  # noqa: BLE001 — bad query/env: the solo
+                # runner's engine-failure path owns the job-fail report
+                log.exception("fused catch-up engine build failed for %s",
+                              m.runner.job_id)
+                self._demote(m)
+                return 0
+        snap = {p: c.state() for p, c in m.scanner.cursors.items()}
+        try:
+            groups = m.scanner.poll_once(limits=limits)
+        except Exception:  # noqa: BLE001
+            log.exception("fused catch-up scan failed for %s",
+                          m.runner.job_id)
+            for p, st in snap.items():
+                c = m.scanner.cursors.get(p)
+                if c is not None:
+                    c.restore(st)
+            self._demote(m)
+            return 0
+        emitted = 0
+        for i, (path, records, cursor) in enumerate(groups):
+            try:
+                m.runner.fused_commit(path, cursor, records)
+            except Exception:  # noqa: BLE001 — journal fault: roll back
+                # the uncommitted groups and let the solo runner retry
+                log.exception("fused catch-up commit failed for %s",
+                              m.runner.job_id)
+                for p2, _r2, _c2 in groups[i:]:
+                    c2 = m.scanner.cursors.get(p2)
+                    if c2 is not None and p2 in snap:
+                        c2.restore(snap[p2])
+                self._demote(m)
+                return emitted
+            emitted += len(records)
+        if groups:
+            m.runner.note_fused_wake(len(groups), emitted, fused=False)
+        return emitted
+
+    def _demote(self, m: _GroupMember) -> None:
+        self._reg.demote(self, m)
+
+    def _demote_all(self) -> None:
+        for m in self.members():
+            self._reg.demote(self, m)
+
+    # -- telemetry -------------------------------------------------------
+    def status(self) -> dict:
+        with self._reg._lock:
+            members = list(self._members)
+        row: dict = {
+            "members": len(members),
+            "jobs": [m.runner.job_id for m in members],
+            "files": len(self.cursors),
+            "poll_s": self.poll_s,
+            "wakes": self.wakes,
+            "cursor_bytes": int(
+                sum(c.offset for c in self.cursors.values())
+            ),
+            # now-minus-last-wake: a stalled group runner shows here
+            # before subscribers notice shed records (dgrep top renders
+            # this per group)
+            "wake_lag_s": round(
+                max(0.0, time.monotonic() - self.last_wake), 3
+            ),
+        }
+        catching = sum(1 for m in members if m.catching_up)
+        if catching:
+            row["catching_up"] = catching
+        return row
+
+
+class FollowGroupRegistry:
+    """Daemon-scope group table for the fused follow tier.  ``adopt``
+    routes a starting FollowRunner into its group (creating one per
+    runtime/fusion.follow_fusion_key); ``discard`` removes a stopping
+    runner; ``demote`` falls a member back to its solo runner.  The
+    registry lock ("follow-groups") is PURE STATE — key computation
+    (realpath stats) and every scan/journal run outside it
+    (analyze: locked-blocking); group wake locks are io_ok and OUTER to
+    it (lock-order)."""
+
+    def __init__(self, *, write_gate=None, start_threads: bool = True,
+                 auto_solo: bool = True):
+        from distributed_grep_tpu.runtime.fusion import env_fuse_max_queries
+
+        self._lock = lockdep.make_lock("follow-groups")
+        self._groups: dict[tuple, FollowGroup] = {}
+        self.write_gate = write_gate
+        # test hooks: start_threads=False drives group.wake_once
+        # manually; auto_solo=False leaves demoted runners unstarted so
+        # a test can inspect the handoff state deterministically
+        self.start_threads = start_threads
+        self.auto_solo = auto_solo
+        self.max_members = env_fuse_max_queries()
+
+    def adopt(self, runner: FollowRunner) -> bool:
+        """Route a starting runner into a fused group when its config is
+        group-eligible.  False → the caller runs solo (the pre-round-21
+        path, byte for byte).  Key computation (realpath) runs BEFORE
+        the membership lock; the lock itself is dict/list surgery."""
+        from distributed_grep_tpu.runtime.fusion import (
+            follow_fusion_key,
+            query_spec,
+        )
+
+        key = follow_fusion_key(runner.config)
+        if key is None:
+            return False
+        spec = query_spec(dict(runner.config.effective_app_options()))
+        if spec is None:
+            return False  # fusion_key implies a spec; stay defensive
+        paths: dict[str, str] = {}
+        for f in runner.config.input_files:
+            paths[os.path.realpath(os.fspath(f))] = str(f)
+        if len(paths) != len(runner.config.input_files):
+            # two spellings of one file: the solo scanner keeps a cursor
+            # per spelling (scans it twice per wake) — there is no
+            # shared-cursor form of that; solo serves it unchanged
+            return False
+        member = _GroupMember(
+            runner=runner, spec=spec, paths=paths,
+            scanner=runner._make_scanner(None),
+        )
+        fresh: FollowGroup | None = None
+        with self._lock:
+            group = self._groups.get(key)
+            if group is None or group._stop.is_set():
+                group = FollowGroup(key, self)
+                for real, mpath in member.paths.items():
+                    gcur = FileCursor(path=real)
+                    gcur.restore(member.scanner.cursors[mpath].state())
+                    group.cursors[real] = gcur
+                self._groups[key] = group
+                fresh = group
+            elif len(group._members) >= self.max_members:
+                # DGREP_FUSE_MAX_QUERIES bounds the union automaton and
+                # one lost wake's blast radius, exactly like batch fusion
+                return False
+            group._members.append(member)
+            group._recompute_cadence_locked()
+            runner._scanner = member.scanner
+        _count_fused("follow_fused_queries")
+        if fresh is not None and self.start_threads:
+            fresh.start()
+        return True
+
+    def demote(self, group: FollowGroup, member: _GroupMember) -> None:
+        """Remove a member and fall it back to its solo runner (called
+        from the group's wake thread, under the wake lock).  The LAST
+        demotion retires the group."""
+        empty = False
+        with self._lock:
+            if member in group._members:
+                group._members.remove(member)
+            group._recompute_cadence_locked()
+            if not group._members:
+                self._groups.pop(group.key, None)
+                empty = True
+        member.runner.fused = False
+        if empty:
+            group._stop.set()
+        if self.auto_solo and not member.runner._stop.is_set():
+            member.runner.start_solo()
+
+    def discard(self, runner: FollowRunner) -> None:
+        """Detach a stopping runner (job cancel / daemon stop).  Takes
+        the group's wake lock FIRST (lock order: wake OUTER, registry
+        inner) so an in-flight group wake finishes its writes to this
+        runner's log/ring before close() tears them down."""
+        found = None
+        with self._lock:
+            for g in self._groups.values():
+                for m in g._members:
+                    if m.runner is runner:
+                        found = (g, m)
+                        break
+                if found:
+                    break
+        if found is None:
+            return
+        g, m = found
+        with g._wake_lock:
+            empty = False
+            with self._lock:
+                if m in g._members:
+                    g._members.remove(m)
+                g._recompute_cadence_locked()
+                if not g._members:
+                    self._groups.pop(g.key, None)
+                    empty = True
+            if empty:
+                g._stop.set()
+        runner.fused = False
+
+    def status_rows(self) -> list[dict]:
+        """Per-group /status rows (computed outside the service lock;
+        the registry lock only snapshots the group list)."""
+        with self._lock:
+            groups = list(self._groups.values())
+        return [g.status() for g in groups]
+
+    def close(self) -> None:
+        """Stop every group loop (daemon-stop safety net — normally the
+        last member's discard already retired each group)."""
+        with self._lock:
+            groups = list(self._groups.values())
+            self._groups.clear()
+        for g in groups:
+            g._stop.set()

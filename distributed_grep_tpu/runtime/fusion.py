@@ -161,6 +161,34 @@ def fusion_key(config) -> tuple | None:
     return (config.application, frozen, int(config.effective_batch_bytes()))
 
 
+def follow_fusion_key(config) -> tuple | None:
+    """Grouping key for FUSED STANDING QUERIES (round 21), or None when
+    this follow job must run its own solo wake loop.  Two standing
+    queries share one group wake — one suffix read + one union scan per
+    (file, wake) — only when the batch ``fusion_key`` agrees (same app,
+    same non-query options, a union-hostable query) AND they watch the
+    SAME input set by realpath: follow cursors track file CONTENT as it
+    grows, so the watched-identity half of the key is the resolved path
+    set, not the CorpusCache validator tuple (size/mtime drift every
+    append — that is the point of the tier).  Realpath is stat-ish work:
+    call outside the service lock only (the _flush_follow_start
+    context)."""
+    if not getattr(config, "follow", False):
+        return None
+    base = fusion_key(config)
+    if base is None:
+        return None
+    try:
+        watched = tuple(sorted(
+            os.path.realpath(os.fspath(f)) for f in config.input_files
+        ))
+    except (OSError, TypeError):
+        return None
+    if not watched:
+        return None
+    return (base, watched)
+
+
 def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)

@@ -487,6 +487,12 @@ class GrepService:
         # I/O) keeps setups in staging order.
         self._pending_starts: list[JobRecord] = []
         self._start_flush_lock = lockdep.make_lock("start-flush", io_ok=True)
+        # Fused follow tier (round 21): daemon-scope FollowGroupRegistry,
+        # built lazily under the start-flush lock by the first follow
+        # start when DGREP_FOLLOW_FUSE is on.  None until then — and
+        # FOREVER when the knob is off (the true-no-op pin: no group
+        # state, no /status key, solo runners byte-identical to pre-21).
+        self._follow_groups = None
         # Journal/event-log closes staged by _close_job_locked and run by
         # _flush_closes after release — a file close flushes buffers,
         # filesystem work the service lock must not hold.
@@ -1409,9 +1415,19 @@ class GrepService:
         the workdir + event log + FollowRunner (journal open and cursor
         replay are filesystem work), publish under the lock, start the
         wake loop.  A cancel/stop that won the race mid-setup tears the
-        fresh runner down exactly like the scheduler path."""
+        fresh runner down exactly like the scheduler path.
+
+        Fused tier (round 21): runs under the start-flush lock (the
+        _flush_starts contract), so the lazy FollowGroupRegistry build
+        below cannot race — DGREP_FOLLOW_FUSE=0 leaves it None forever
+        and every runner keeps the pre-round-21 solo path."""
+        from distributed_grep_tpu.runtime import follow as follow_mod
         from distributed_grep_tpu.runtime.follow import FollowRunner
 
+        if self._follow_groups is None and follow_mod.env_follow_fuse():
+            self._follow_groups = follow_mod.FollowGroupRegistry(
+                write_gate=self._write_gate()
+            )
         cfg = rec.config
         event_log = None
         try:
@@ -1431,6 +1447,7 @@ class GrepService:
                 rec.job_id, cfg, workdir.root,
                 event_log=event_log, on_fail=self._fail_follow_job,
                 write_gate=self._write_gate(),
+                groups=self._follow_groups,
             )
         except Exception as e:  # noqa: BLE001 — bad job, healthy service
             log.exception("follow job %s failed to start", rec.job_id)
@@ -2582,6 +2599,19 @@ class GrepService:
                 follow_view["jobs"] = standing
             if fol is not None:
                 follow_view.update(fol.follow_counters())
+        # fused follow tier (round 21): group rows (members, shared
+        # cursor bytes, cadence, wake lag) + the fused counters —
+        # nonzero-only, and ALWAYS absent when DGREP_FOLLOW_FUSE=0 (the
+        # registry is then never built: the no-op /status pin).  Group
+        # status snapshots membership under the registry's own leaf
+        # lock — computed outside the service lock like the rest.
+        if fol is not None and follow_view:
+            follow_view.update(fol.follow_fused_counters())
+            groups_reg = self._follow_groups
+            if groups_reg is not None:
+                group_rows = groups_reg.status_rows()
+                if group_rows:
+                    follow_view["groups"] = group_rows
         for jid in jobs:
             rec = self._jobs.get(jid)  # pruning may race this unlocked read
             if rec is not None and rec.scheduler is not None:
@@ -3018,6 +3048,11 @@ class GrepService:
             self._cond.notify_all()
         self._flush_starts()  # drains (and tears down) cancelled pendings
         self._flush_closes()
+        if self._follow_groups is not None:
+            # safety net: the runners' close() discards already emptied
+            # every group — this only stops a loop orphaned by a raced
+            # teardown (pure state; never constructed with fusion off)
+            self._follow_groups.close()
         self._flush_registry()
         if self._daemon_log is not None:
             # graceful stop is a timeline event; a deposed daemon's stop

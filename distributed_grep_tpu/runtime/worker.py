@@ -85,6 +85,8 @@ def _engine_cache_counters() -> dict | None:
         # streaming-tier counters (follow_wakes/suffix_bytes_scanned/
         # stream_dropped_records), nonzero-only — same contract
         counters.update(fol.follow_counters())
+        # fused follow tier (round 21): follow_fused_* counters
+        counters.update(fol.follow_fused_counters())
     return counters or None
 
 

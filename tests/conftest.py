@@ -189,10 +189,12 @@ def _fresh_follow():
     fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
     if fol is not None:
         fol.follow_counters_clear()
+        fol.follow_fused_counters_clear()
     yield
     fol = _sys.modules.get("distributed_grep_tpu.runtime.follow")
     if fol is not None:
         fol.follow_counters_clear()
+        fol.follow_fused_counters_clear()
 
 
 @pytest.fixture(autouse=True)
