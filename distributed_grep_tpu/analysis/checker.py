@@ -164,6 +164,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--knobs", action="store_true",
                    help="print the DGREP_* env-knob registry as markdown "
                         "(the generated operator docs)")
+    p.add_argument("--events", action="store_true",
+                   help="print the telemetry event vocabulary (span/"
+                        "instant/daemon-event names) as markdown")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -174,6 +177,11 @@ def main(argv: list[str] | None = None) -> int:
         from distributed_grep_tpu.analysis.knobs import knob_docs
 
         print(knob_docs(), end="")
+        return 0
+    if args.events:
+        from distributed_grep_tpu.analysis.events import event_docs
+
+        print(event_docs(), end="")
         return 0
 
     try:

@@ -141,6 +141,16 @@ KNOBS: dict[str, Knob] = {
         "returns the same engine, skipping model compile and the "
         "per-shape compile-grace path.",
     ),
+    "DGREP_EVENT_AUDIT": Knob(
+        "utils/event_audit.py", "unset",
+        "1 switches the runtime event-vocabulary recorder on: every "
+        "span/instant/daemon-event name emitted through SpanBuffer, "
+        "EventLog, or DaemonLog is validated against the "
+        "analysis/events.py registry and undeclared names log warnings "
+        "(accessor: utils/event_audit.env_event_audit).  The "
+        "service/obs/follow/fuse/result/chaos test fixture activates it "
+        "per test — the dynamic half of analyze rule event-registry.",
+    ),
     "DGREP_LOCKDEP": Knob(
         "utils/lockdep.py", "unset",
         "1 switches the runtime lock-discipline harness on: locks built "

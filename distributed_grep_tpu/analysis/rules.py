@@ -463,14 +463,38 @@ def _is_optional_ann(ann: ast.expr | None) -> bool:
     return False
 
 
+def _str_seq_assign(tree: ast.AST, name: str):
+    """(values, lineno) of a module-level ``NAME = ("a", "b", ...)`` tuple/
+    list of string constants; (None, 1) when absent."""
+    for node in ast.walk(tree):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if (targets
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in targets)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            return ([e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)], node.lineno)
+    return None, 1
+
+
 def rule_rpc_elide(project: Project, rel: str = "runtime/rpc.py"
                    ) -> Iterator[Violation]:
-    """R5: wire-compat reflection over the RPC schema.  Every
+    """R5: wire-compat reflection over the RPC schema, both halves.  Every
     Optional-default field on the rpc dataclasses must appear in
     ``_ELIDE_DEFAULTS`` (else a span-disabled run's payloads grow keys old
     peers choke on), every elide key must exist as a field, and the
     registered elide default must EQUAL the field's declared default on
-    every dataclass carrying it (drift silently un-elides the field)."""
+    every dataclass carrying it (drift silently un-elides the field).
+    Reply side: every field on a ``*Reply`` dataclass must be declared on
+    exactly one side of the wire contract — ``_REPLY_BASE`` (historical
+    asdict shape, always present) or ``_REPLY_ELIDE`` (dropped at its
+    falsy default by ``reply_to_dict`` — old peers interop) — and an
+    elide-registered field's default must be falsy, because reply_to_dict
+    elides by ``not value``: a truthy default never elides and the
+    registration is a lie."""
     tree = project.tree(rel)
     if tree is None:
         return
@@ -526,6 +550,60 @@ def rule_rpc_elide(project: Project, rel: str = "runtime/rpc.py"
             "rpc-elide", rel, elide_line,
             f"_ELIDE_DEFAULTS key {key!r} is not a field on any rpc "
             f"dataclass: dead elision entry",
+        )
+
+    replies = [cls for cls in ast.walk(tree)
+               if isinstance(cls, ast.ClassDef) and _is_dataclass(cls)
+               and cls.name.endswith("Reply")]
+    if not replies:
+        return
+    base, base_line = _str_seq_assign(tree, "_REPLY_BASE")
+    reply_elide, relide_line = _str_seq_assign(tree, "_REPLY_ELIDE")
+    if base is None or reply_elide is None:
+        yield Violation(
+            "rpc-elide", rel, replies[0].lineno,
+            "reply dataclasses present but _REPLY_BASE/_REPLY_ELIDE tuple "
+            "literals missing: every reply field must declare its wire side",
+        )
+        return
+    base_set, elide_set = set(base), set(reply_elide)
+    for key in sorted(base_set & elide_set):
+        yield Violation(
+            "rpc-elide", rel, relide_line,
+            f"reply field {key!r} registered in BOTH _REPLY_BASE and "
+            f"_REPLY_ELIDE: the wire contract must pick one side",
+        )
+    reply_field_names: set[str] = set()
+    for cls in replies:
+        for stmt in cls.body:
+            if not (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                continue
+            name = stmt.target.id
+            reply_field_names.add(name)
+            if name not in base_set and name not in elide_set:
+                yield Violation(
+                    "rpc-elide", rel, stmt.lineno,
+                    f"reply field {cls.name}.{name} is in neither "
+                    f"_REPLY_BASE nor _REPLY_ELIDE: a new reply field must "
+                    f"declare its wire side (elide it unless old peers "
+                    f"already expect the key)",
+                )
+            if name in elide_set:
+                known, default = _field_default(stmt.value)
+                if known and default:
+                    yield Violation(
+                        "rpc-elide", rel, stmt.lineno,
+                        f"_REPLY_ELIDE field {cls.name}.{name} defaults to "
+                        f"{default!r} (truthy): reply_to_dict elides falsy "
+                        f"values only, so this registration never fires",
+                    )
+    for key in sorted((base_set | elide_set) - reply_field_names):
+        yield Violation(
+            "rpc-elide", rel,
+            base_line if key in base_set else relide_line,
+            f"reply registry key {key!r} is not a field on any *Reply "
+            f"dataclass: dead wire-contract entry",
         )
 
 
@@ -1369,6 +1447,229 @@ def rule_metrics_registry(project: Project) -> Iterator[Violation]:
                 )
 
 
+# ------------------------------------------------------------------ rule R13
+
+# Consumer modules that string-match event names (explain views, fleet
+# trace export, daemon-log readers, `dgrep top`): every literal compare on
+# a variable named `name`/`kind` there must hit a declared event.
+_EVENT_CONSUMER_FILES = ("runtime/explain.py", "utils/spans.py",
+                         "runtime/daemon_log.py", "__main__.py")
+# Emitter callables: span-pipeline entry points plus the daemon-event
+# helpers (service._daemon_event, DaemonLog.append_now, the scheduler's
+# daemon_events hook, WorkerHealth._emit).
+_SPAN_EMITTERS = {"instant": "instant", "span": "span", "complete": "span"}
+_DAEMON_EMITTERS = {"_daemon_event", "append_now", "daemon_events", "_emit"}
+
+
+def _event_name_shapes(expr: ast.expr):
+    """Resolve an emit-site name expression to concrete names and family
+    patterns (``*`` marks a computed f-string segment).  None = not
+    statically resolvable (a bare-Name pass-through helper parameter) —
+    silently skipped, the metrics-registry convention; the
+    utils/event_audit.py dynamic recorder covers those at runtime."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.IfExp):
+        body = _event_name_shapes(expr.body)
+        orelse = _event_name_shapes(expr.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+        return None
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        pat = "".join(parts)
+        if pat.count("*") == 1:
+            return [pat]
+    return None
+
+
+def rule_event_registry(project: Project) -> Iterator[Violation]:
+    """R13: every exported telemetry event name — span/instant names and
+    DaemonLog kinds — is declared once in ``analysis/events.py EVENTS``
+    (the knobs/metrics registry pattern; the table is also the operator
+    docs via ``analyze --events``).  Emit sites (``instant()``/``span()``/
+    ``complete()`` calls, daemon-event helpers, and raw ``{"t": "instant"|
+    "span", "name": ...}`` dict literals) must use string constants or
+    declared-family f-strings; a consumer-side compare in explain / trace
+    export / daemon-log readers matching an undeclared name is a one-sided
+    rename that turns the view into a lie; a declared name with no
+    surviving emit site is stale (checked only when the project carries
+    utils/spans.py — fixture mini-trees stay silent)."""
+    from distributed_grep_tpu.analysis.events import (
+        EVENTS, is_family, lookup)
+
+    seen_keys: set[str] = set()
+
+    def check_site(rel, line, kind, name_expr, cat):
+        shapes = _event_name_shapes(name_expr)
+        if shapes is None:
+            return
+        for shape in shapes:
+            if "*" in shape:
+                ev = EVENTS.get(shape)
+                if ev is None or not is_family(shape):
+                    yield Violation(
+                        "event-registry", rel, line,
+                        f"undeclared event family {shape!r}: a computed "
+                        f"emit name must match an enumerated family "
+                        f"declared in analysis/events.py EVENTS",
+                    )
+                    continue
+                key = shape
+            else:
+                hit = lookup(shape)
+                if hit is None:
+                    yield Violation(
+                        "event-registry", rel, line,
+                        f"undeclared event name {shape!r}: add it (kind, "
+                        f"cat, owner) to analysis/events.py EVENTS — the "
+                        f"registry is the telemetry vocabulary and the one "
+                        f"place an event name is owned",
+                    )
+                    continue
+                key, ev = hit
+            seen_keys.add(key)
+            if kind not in ev.kinds:
+                yield Violation(
+                    "event-registry", rel, line,
+                    f"{shape!r} emitted as a {kind} but declared "
+                    f"{'/'.join(ev.kinds)} in analysis/events.py EVENTS",
+                )
+            if cat is not None and ev.cat and cat != ev.cat:
+                yield Violation(
+                    "event-registry", rel, line,
+                    f"{shape!r} emitted with cat {cat!r} but declared cat "
+                    f"{ev.cat!r} in analysis/events.py EVENTS — consumers "
+                    f"and trace rows bucket by cat",
+                )
+
+    for rel in project.files():
+        if rel.startswith("analysis/"):
+            continue
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Dict):
+                keys = {k.value: v for k, v in zip(node.keys, node.values)
+                        if isinstance(k, ast.Constant)}
+                t = keys.get("t")
+                if not (isinstance(t, ast.Constant)
+                        and t.value in ("span", "instant")):
+                    continue
+                name_expr = keys.get("name")
+                if name_expr is None:
+                    continue
+                cat_expr = keys.get("cat")
+                cat = (cat_expr.value
+                       if isinstance(cat_expr, ast.Constant)
+                       and isinstance(cat_expr.value, str) else None)
+                yield from check_site(rel, node.lineno, t.value,
+                                      name_expr, cat)
+            elif isinstance(node, ast.Call) and node.args:
+                fname = _last_name(node.func)
+                kind = cat = None
+                if fname in _SPAN_EMITTERS:
+                    kind = _SPAN_EMITTERS[fname]
+                    for k in node.keywords:
+                        if (k.arg == "cat"
+                                and isinstance(k.value, ast.Constant)
+                                and isinstance(k.value.value, str)):
+                            cat = k.value.value
+                elif fname == "_event":
+                    kind = "instant"
+                elif fname in _DAEMON_EMITTERS:
+                    kind = "daemon"
+                elif fname == "stage" and isinstance(node.func,
+                                                    ast.Attribute):
+                    recv = _last_name(node.func.value) or ""
+                    if "daemon" in recv or recv == "dl":
+                        kind = "daemon"
+                if kind is None:
+                    continue
+                yield from check_site(rel, node.lineno, kind,
+                                      node.args[0], cat)
+
+    for rel in _EVENT_CONSUMER_FILES:
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        module_dicts: dict[str, tuple[list[str], int]] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Dict)
+                    and node.value.keys
+                    and all(isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            for k in node.value.keys)):
+                module_dicts[node.targets[0].id] = (
+                    [k.value for k in node.value.keys], node.lineno)
+        getted: set[str] = set()
+        matched: list[tuple[str, int]] = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Compare)
+                    and isinstance(node.left, ast.Name)
+                    and node.left.id in ("name", "kind")
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.Eq, ast.In))):
+                comp = node.comparators[0]
+                if (isinstance(comp, ast.Constant)
+                        and isinstance(comp.value, str)):
+                    matched.append((comp.value, node.lineno))
+                elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    matched.extend(
+                        (e.value, node.lineno) for e in comp.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.args
+                    and isinstance(node.func.value, ast.Name)):
+                if (node.func.attr == "startswith"
+                        and node.func.value.id in ("name", "kind")
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.endswith(":")):
+                    pat = node.args[0].value + "*"
+                    if not (pat in EVENTS and is_family(pat)):
+                        yield Violation(
+                            "event-registry", rel, node.lineno,
+                            f"consumer matches undeclared event family "
+                            f"{pat!r}: no declared family produces these "
+                            f"names (analysis/events.py EVENTS)",
+                        )
+                elif (node.func.attr == "get"
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in ("name", "kind")):
+                    getted.add(node.func.value.id)
+        for dname in sorted(getted & set(module_dicts)):
+            keys, line = module_dicts[dname]
+            matched.extend((k, line) for k in keys)
+        for value, line in matched:
+            if value and lookup(value) is None:
+                yield Violation(
+                    "event-registry", rel, line,
+                    f"consumer matches undeclared event name {value!r}: "
+                    f"no emitter produces it (analysis/events.py EVENTS) — "
+                    f"a one-sided rename turns this view into a lie",
+                )
+
+    if (project.root / "utils/spans.py").exists():
+        for key in EVENTS:
+            if key not in seen_keys:
+                yield Violation(
+                    "event-registry", "analysis/events.py", 1,
+                    f"declared event {key!r} has no surviving emit site: "
+                    f"stale registry entry in analysis/events.py EVENTS",
+                )
+
+
 # ------------------------------------------------------------------ registry
 
 RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
@@ -1384,6 +1685,7 @@ RULES: dict[str, Callable[[Project], Iterator[Violation]]] = {
     "lock-order": rule_lock_order,
     "shard-map-rep": rule_shard_map_rep,
     "metrics-registry": rule_metrics_registry,
+    "event-registry": rule_event_registry,
 }
 
 RULE_DOCS: dict[str, str] = {

@@ -151,9 +151,11 @@ def configure(
         backend=backend,
         **engine_opts,  # type: ignore[arg-type]
     )
-    if cache_verdict != "off":
-        _spans_mod.instant(f"cache:{cache_verdict}", cat="engine",
-                           mode=_engine.mode)
+    # "off" (cache-bypassed construction) emits too: explain's model_cache
+    # "bypassed" counter consumes it — the registry (analysis/events.py)
+    # declares all three cache:* members as produced.
+    _spans_mod.instant(f"cache:{cache_verdict}", cat="engine",
+                       mode=_engine.mode)
     # grep -w / -x: the device scan stays on the raw pattern (its matched
     # lines are a SUPERSET of word/line matches — a word/line match is in
     # particular a substring match), and each candidate line is confirmed

@@ -31,7 +31,7 @@ import time
 from pathlib import Path
 
 from distributed_grep_tpu.runtime.journal import TaskJournal
-from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils import event_audit, lockdep
 from distributed_grep_tpu.utils.logging import get_logger
 
 log = get_logger("daemon_log")
@@ -85,6 +85,8 @@ class DaemonLog:
     def stage(self, kind: str, **payload) -> None:
         """Stage one event under the leaf lock — callable from under any
         hot lock (list append only; the fsync happens in flush())."""
+        if event_audit.is_active():
+            event_audit.record("daemon", kind)
         rec = {"ts": time.time(), "epoch": self.epoch, "pid": self.pid,
                "role": self.role, "kind": str(kind)}
         if payload:

@@ -13,6 +13,13 @@ and rendered by ``dgrep explain`` (and ``dgrep submit --explain``).
 
 Pure Python, no ops imports — the daemon control plane assembles reports
 without touching the jax stack (the runtime/fusion.py rule).
+
+Every event name this module matches on is declared in
+``analysis/events.py EVENTS`` — the authoritative telemetry vocabulary
+(``analyze --events`` renders it).  The ``event-registry`` rule audits
+both sides: an emit of an undeclared name and a consumer match here that
+no emitter produces are both violations, so emitters and this view
+cannot drift apart silently.
 """
 
 from __future__ import annotations

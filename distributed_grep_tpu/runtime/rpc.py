@@ -252,13 +252,20 @@ _ELIDE_DEFAULTS: dict[str, Any] = {
     "peer_endpoint": "", "peer_parts": None, "lost_file": "",
 }
 
-# Reply serialization keeps the historical asdict shape (default-valued
-# fields INCLUDED — changing that would alter every existing payload);
-# only NEW reply fields elide at their defaults, so a fusion-disabled
-# daemon's replies are byte-identical to the pre-fusion protocol and old
-# workers (cls(**payload) constructors) only break when fusion is
-# actually handing them fused work.
-_REPLY_ELIDE = ("fused", "peer_endpoint", "peer_size", "peer_checksum")
+# Reply wire contract, machine-checked by analyze rule `rpc-elide`: every
+# *Reply field declares its side.  _REPLY_BASE is the historical asdict
+# shape — always on the wire, because old workers' parsers grew up with
+# these keys and changing them would alter every existing payload.
+# _REPLY_ELIDE fields drop from the payload at their (falsy) defaults, so
+# a daemon with the owning feature off answers byte-identical to the
+# protocol that predates the field, and old workers (cls(**payload)
+# constructors) only break when actually handed the new work.
+_REPLY_BASE = ("assignment", "filename", "task_id", "n_reduce",
+               "worker_id", "app_options", "task_timeout_s", "ok",
+               "next_file", "done")
+_REPLY_ELIDE = ("job_id", "application", "filenames", "retry_after_s",
+                "epoch", "fused", "abort",
+                "peer_endpoint", "peer_size", "peer_checksum")
 
 
 def reply_to_dict(msg: Any) -> dict:

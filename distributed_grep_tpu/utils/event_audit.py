@@ -1,0 +1,103 @@
+"""Runtime half of the event-vocabulary contract (analyze rule
+``event-registry``) — the lockdep static+dynamic pairing, applied to
+telemetry names.
+
+The static rule audits emit sites the AST can resolve; names built
+dynamically (helper pass-throughs, f-string members outside the declared
+family enumeration) only surface at runtime.  This recorder validates
+every name actually emitted — ``SpanBuffer.add`` (worker spans/instants),
+``EventLog.write_many`` (coordinator events.jsonl), ``DaemonLog.stage``
+(daemon lifecycle kinds) — against ``analysis/events.py EVENTS``.
+
+Two activation paths, like utils/lockdep.py:
+
+- fixture: tests/conftest.py ``_event_vocab_audit`` (autouse, gated on the
+  service/obs/follow/fuse/result/chaos markers) calls ``activate()`` and
+  FAILS the test on any finding;
+- env: ``DGREP_EVENT_AUDIT=1`` before process launch activates at import
+  and additionally logs each finding as a warning — the live-daemon
+  debugging recipe.
+
+Off (the default) every hook is one module-global bool read; the hot
+paths call ``record()`` OUTSIDE their buffer/staging locks, so the audit
+never adds work under a lock the span pipeline holds.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_MAX_FINDINGS = 256
+
+_lock = threading.Lock()
+_active = False
+_env_mode = False
+_findings: list[str] = []
+_flagged: set[str] = set()
+
+
+def env_event_audit() -> bool:
+    """DGREP_EVENT_AUDIT: ``1`` validates every emitted span/instant/
+    daemon-event name against the analysis/events.py registry and logs
+    undeclared names.  Default off (zero overhead)."""
+    return os.environ.get("DGREP_EVENT_AUDIT", "").strip() == "1"
+
+
+def is_active() -> bool:
+    return _active
+
+
+def activate() -> None:
+    global _active
+    _active = True
+
+
+def deactivate() -> None:
+    global _active
+    _active = False
+
+
+def reset() -> None:
+    with _lock:
+        _findings.clear()
+        _flagged.clear()
+
+
+def findings() -> list[str]:
+    with _lock:
+        return list(_findings)
+
+
+def record(kind: str, name) -> None:
+    """Validate one emitted event name (kind: "span"|"instant"|"daemon").
+    No-op unless the audit is active; duplicate names report once."""
+    if not _active or not isinstance(name, str) or not name:
+        return
+    # Lazy import: utils/spans.py imports this module, and the registry
+    # lives in analysis/ — resolve it on first use, not at import time.
+    from distributed_grep_tpu.analysis.events import lookup
+
+    hit = lookup(name)
+    if hit is None:
+        msg = (f"undeclared {kind} event name {name!r}: not in "
+               f"analysis/events.py EVENTS (nor any declared family)")
+    elif kind not in hit[1].kinds:
+        msg = (f"event {name!r} emitted as a {kind} but declared "
+               f"{'/'.join(hit[1].kinds)} in analysis/events.py EVENTS")
+    else:
+        return
+    with _lock:
+        if name in _flagged or len(_findings) >= _MAX_FINDINGS:
+            return
+        _flagged.add(name)
+        _findings.append(msg)
+    if _env_mode:
+        from distributed_grep_tpu.utils.logging import get_logger
+
+        get_logger("event_audit").warning("%s", msg)
+
+
+if env_event_audit():
+    _env_mode = True
+    activate()

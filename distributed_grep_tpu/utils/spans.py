@@ -33,7 +33,7 @@ import time
 from contextlib import contextmanager
 from pathlib import Path
 
-from distributed_grep_tpu.utils import lockdep
+from distributed_grep_tpu.utils import event_audit, lockdep
 
 _ENV_VAR = "DGREP_SPANS"
 
@@ -74,6 +74,8 @@ class SpanBuffer:
         self.seq = 0  # batch counter (drain_batch) — the RPC dedup key
 
     def add(self, rec: dict) -> None:
+        if event_audit.is_active() and rec.get("t") in ("span", "instant"):
+            event_audit.record(rec["t"], rec.get("name"))
         with self._lock:
             if len(self._recs) >= self.cap:
                 self.dropped += 1
@@ -257,6 +259,12 @@ class EventLog:
     def write_many(self, recs: list[dict]) -> None:
         if not recs:
             return
+        if event_audit.is_active():
+            for r in recs:
+                # non-event records (worker_clock observations, follow
+                # cursor lines) pass through unaudited
+                if r.get("t") in ("span", "instant"):
+                    event_audit.record(r["t"], r.get("name"))
         lines = "".join(
             json.dumps(r, separators=(",", ":"), sort_keys=True,
                        default=str) + "\n"

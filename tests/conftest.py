@@ -127,6 +127,38 @@ def _lockdep_audit(request):
 
 
 @pytest.fixture(autouse=True)
+def _event_vocab_audit(request):
+    """The dynamic half of the event-vocabulary contract (analyze rule
+    `event-registry`): under the `service`/`obs`/`follow`/`fuse`/
+    `result`/`chaos` suites every span/instant/daemon-event name emitted
+    through SpanBuffer/EventLog/DaemonLog is validated against
+    analysis/events.py EVENTS and the test FAILS on an undeclared name or
+    a kind mismatch — catching dynamically-built names the static AST
+    rule cannot resolve (helper pass-throughs, f-string members outside
+    the declared family).  Other tests skip activation: the hooks cost
+    one module-global bool read when off."""
+    markers = {m.name for m in request.node.iter_markers()}
+    if not markers & {"service", "obs", "follow", "fuse", "result",
+                      "chaos"}:
+        yield
+        return
+    from distributed_grep_tpu.utils import event_audit
+
+    event_audit.activate()
+    event_audit.reset()
+    try:
+        yield
+    finally:
+        found = event_audit.findings()
+        event_audit.deactivate()
+        event_audit.reset()
+    assert not found, (
+        "event audit observed names outside the analysis/events.py "
+        "registry:\n" + "\n".join(found)
+    )
+
+
+@pytest.fixture(autouse=True)
 def _fresh_device_probe_state():
     """The engine's device-probe verdict is process-global (one backend
     per process in production); tests that exercise demotion would poison

@@ -242,6 +242,67 @@ def test_rpc_elide_silent_on_consistent_schema(tmp_path):
     assert not _hits(tmp_path, "rpc-elide")
 
 
+def test_rpc_elide_reply_side_fires_on_unregistered_and_truthy(tmp_path):
+    _mk(tmp_path, "runtime/rpc.py",
+        "from dataclasses import dataclass\n"
+        "from typing import Any\n"
+        "_ELIDE_DEFAULTS: dict[str, Any] = {}\n"
+        "_REPLY_BASE = ('ok',)\n"
+        "_REPLY_ELIDE = ('retries', 'gone')\n"
+        "@dataclass\n"
+        "class PollReply:\n"
+        "    ok: bool = False\n"
+        "    retries: int = 3\n"     # truthy default: elision never fires
+        "    orphan: str = ''\n")    # declared on neither side
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "rpc-elide"))
+    assert ("reply field PollReply.orphan is in neither _REPLY_BASE nor "
+            "_REPLY_ELIDE") in msgs
+    assert ("_REPLY_ELIDE field PollReply.retries defaults to 3 (truthy)"
+            ) in msgs
+    assert "reply registry key 'gone' is not a field" in msgs
+
+
+def test_rpc_elide_reply_side_fires_on_missing_registries_and_both(
+        tmp_path):
+    _mk(tmp_path, "runtime/rpc.py",
+        "from dataclasses import dataclass\n"
+        "from typing import Any\n"
+        "_ELIDE_DEFAULTS: dict[str, Any] = {}\n"
+        "@dataclass\n"
+        "class PollReply:\n"
+        "    ok: bool = False\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "rpc-elide"))
+    assert ("reply dataclasses present but _REPLY_BASE/_REPLY_ELIDE "
+            "tuple literals missing") in msgs
+    _mk(tmp_path, "runtime/rpc.py",
+        "from dataclasses import dataclass\n"
+        "from typing import Any\n"
+        "_ELIDE_DEFAULTS: dict[str, Any] = {}\n"
+        "_REPLY_BASE = ('ok',)\n"
+        "_REPLY_ELIDE = ('ok',)\n"
+        "@dataclass\n"
+        "class PollReply:\n"
+        "    ok: bool = False\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "rpc-elide"))
+    assert ("registered in BOTH _REPLY_BASE and _REPLY_ELIDE") in msgs
+
+
+def test_rpc_elide_reply_side_silent_on_partitioned_schema(tmp_path):
+    # non-Reply dataclasses need no registries (the old fixtures'
+    # shape), and a correct partition is silent
+    _mk(tmp_path, "runtime/rpc.py",
+        "from dataclasses import dataclass\n"
+        "from typing import Any\n"
+        "_ELIDE_DEFAULTS: dict[str, Any] = {}\n"
+        "_REPLY_BASE = ('ok',)\n"
+        "_REPLY_ELIDE = ('extra',)\n"
+        "@dataclass\n"
+        "class PollReply:\n"
+        "    ok: bool = False\n"
+        "    extra: str = ''\n")
+    assert not _hits(tmp_path, "rpc-elide")
+
+
 # ---------------------------------------------------------------- R6 mosaic
 
 def test_mosaic_fires_on_narrow_compare_and_bad_unroll(tmp_path):
@@ -715,6 +776,73 @@ def test_metrics_registry_silent_on_declared_and_mini_trees(tmp_path):
     assert not _hits(tmp_path, "metrics-registry")
 
 
+# ------------------------------------------------------- R13 event-registry
+
+def test_event_registry_fires_on_undeclared_name_and_kind(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "from distributed_grep_tpu.utils import spans\n"
+        "spans.instant('totally_bogus', cat='engine')\n"  # undeclared
+        "with spans.span('resume', cat='service'):\n"     # declared instant
+        "    pass\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "event-registry"))
+    assert "undeclared event name 'totally_bogus'" in msgs
+    assert "'resume' emitted as a span but declared instant/daemon" in msgs
+
+
+def test_event_registry_fires_on_cat_mismatch_and_dict_literal(tmp_path):
+    _mk(tmp_path, "runtime/x.py",
+        "buf.add({'t': 'instant', 'name': 'index:prune', 'cat': 'map'})\n"
+        "buf.add({'t': 'span', 'name': 'nobody:declared'})\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "event-registry"))
+    assert ("'index:prune' emitted with cat 'map' but declared cat "
+            "'engine'") in msgs
+    assert "undeclared event name 'nobody:declared'" in msgs
+
+
+def test_event_registry_fires_on_undeclared_family_fstring(tmp_path):
+    # computed names must land in a declared enumerated family
+    _mk(tmp_path, "apps/x.py",
+        "from distributed_grep_tpu.utils import spans\n"
+        "def f(verdict):\n"
+        "    spans.instant(f'bogus:{verdict}', cat='engine')\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "event-registry"))
+    assert "undeclared event family 'bogus:*'" in msgs
+
+
+def test_event_registry_fires_on_consumer_side_drift(tmp_path):
+    # a consumer matching a name no emitter produces is a one-sided
+    # rename (explain.py is in the audited consumer set)
+    _mk(tmp_path, "runtime/explain.py",
+        "def view(events):\n"
+        "    return [e for e in events if e.get('name') is not None\n"
+        "            and (name := e['name']) and name == 'scan_old_name']\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "event-registry"))
+    assert "consumer matches undeclared event name 'scan_old_name'" in msgs
+
+
+def test_event_registry_fires_on_stale_declaration(tmp_path):
+    # the emit owner exists but emits nothing: every declared entry is
+    # stale (gated on utils/spans.py so the other mini-trees stay silent)
+    _mk(tmp_path, "utils/spans.py", "x = 1\n")
+    msgs = "\n".join(v.message for v in _hits(tmp_path, "event-registry"))
+    assert ("declared event 'scan:*' has no surviving emit site" in msgs)
+
+
+def test_event_registry_silent_on_declared_and_daemon_emitters(tmp_path):
+    # declared names with matching kind/cat — incl. a family f-string,
+    # a daemon stage() call, and a non-constant name (dynamic-audit
+    # territory, silently skipped like metrics-registry)
+    _mk(tmp_path, "runtime/ok.py",
+        "from distributed_grep_tpu.utils import spans\n"
+        "def f(mode, verdict, daemon_log, anything):\n"
+        "    spans.instant(f'cache:{verdict}', cat='engine')\n"
+        "    with spans.span('map:read', cat='map'):\n"
+        "        pass\n"
+        "    daemon_log.stage('lease_steal', prev_epoch=1)\n"
+        "    spans.instant(anything)\n")
+    assert not _hits(tmp_path, "event-registry")
+
+
 # ----------------------------------------------------------- SARIF output
 
 def test_sarif_output_shape_and_stability(tmp_path, capsys):
@@ -779,6 +907,9 @@ def test_baseline_roundtrip_and_exit_codes(tmp_path, capsys):
     assert analyze_main(["--knobs"]) == 0
     out = capsys.readouterr().out
     assert "DGREP_BATCH_BYTES" in out
+    assert analyze_main(["--events"]) == 0
+    out = capsys.readouterr().out
+    assert "scan:*" in out and "lease_steal" in out
 
 
 def test_json_output_shape(tmp_path, capsys):

@@ -67,11 +67,15 @@ def test_metrics_concurrent_exact():
 
 def test_span_buffer_bounded_and_drop_reporting():
     buf = spans.SpanBuffer(cap=4)
-    for i in range(7):
-        buf.add({"t": "instant", "name": f"e{i}", "ts": float(i)})
+    # declared instant names (analysis/events.py) — the event-vocabulary
+    # audit fixture validates everything that passes through the buffer
+    names = ["cache:hit", "cache:miss", "cache:off", "corpus:hit",
+             "corpus:miss", "index:prune", "index:maybe"]
+    for i, n in enumerate(names):
+        buf.add({"t": "instant", "name": n, "ts": float(i)})
     assert len(buf) == 4 and buf.dropped == 3
     first = buf.drain(limit=2)
-    assert [r["name"] for r in first] == ["e0", "e1"]
+    assert [r["name"] for r in first] == ["cache:hit", "cache:miss"]
     rest = buf.drain()
     # the drop count is reported once, when the buffer fully drains
     assert rest[-1]["name"] == "spans_dropped"
@@ -85,12 +89,12 @@ def test_span_context_tags_and_nesting():
     with spans.task_context(buf, job="j", worker=3, task=7, attempt="a1",
                             kind="map"):
         assert spans.active()
-        with spans.span("phase", cat="map", detail=1):
+        with spans.span("map:read", cat="map", detail=1):
             pass
-        spans.instant("blip", cat="engine")
+        spans.instant("index:maybe", cat="engine")
     assert not spans.active()
     recs = buf.drain()
-    assert [r["name"] for r in recs] == ["phase", "blip"]
+    assert [r["name"] for r in recs] == ["map:read", "index:maybe"]
     for r in recs:
         assert (r["job"], r["worker"], r["task"], r["attempt"]) == ("j", 3, 7, "a1")
     assert recs[0]["t"] == "span" and "dur" in recs[0]
@@ -160,7 +164,8 @@ def test_span_batch_retry_dedup(tmp_path):
     from distributed_grep_tpu.runtime.scheduler import Scheduler
 
     buf = spans.SpanBuffer()
-    buf.add({"t": "instant", "name": "e0", "ts": 1.0, "worker": 0})
+    buf.add({"t": "instant", "name": "device_demoted", "ts": 1.0,
+             "worker": 0})
     seq, batch = buf.drain_batch()
     assert seq == 1 and len(batch) == 1
     assert buf.drain_batch() == (-1, [])  # empty drain allocates no seq
@@ -173,7 +178,7 @@ def test_span_batch_retry_dedup(tmp_path):
         s.heartbeat("map", 0, args=args)
         s.heartbeat("map", 0, args=args)  # the retry: identical batch
         events = [e for e in spans.EventLog.read(log_path)
-                  if e.get("name") == "e0"]
+                  if e.get("name") == "device_demoted"]
         assert len(events) == 1
     finally:
         s.stop()
